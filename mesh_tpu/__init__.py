@@ -1,0 +1,40 @@
+"""mesh_tpu: a TPU-native 3D triangle-mesh processing framework.
+
+Built from scratch in JAX/XLA/Pallas with the full capabilities of the
+MPI-IS `psbody-mesh` package (see SURVEY.md at the repo root).  Public
+surface mirrors the reference package __init__ (mesh/__init__.py:1-20):
+`Mesh`, `MeshViewer`/`MeshViewers`, `texture_path`, and the crc32-keyed
+topology cache folder configurable via $MESH_TPU_CACHE (the reference's
+$PSBODY_MESH_CACHE idea).
+"""
+
+import os
+
+from .core import MeshArrays  # noqa: F401
+from .mesh import Mesh  # noqa: F401
+
+texture_path = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "ressources", "textures")
+)
+
+mesh_package_cache_folder = os.environ.get(
+    "MESH_TPU_CACHE",
+    os.environ.get(
+        "PSBODY_MESH_CACHE",
+        os.path.expanduser(os.path.join("~", ".mesh_tpu", "cache")),
+    ),
+)
+if not os.path.exists(mesh_package_cache_folder):
+    os.makedirs(mesh_package_cache_folder, exist_ok=True)
+
+
+def MeshViewer(*args, **kwargs):
+    from .viewer import MeshViewer as _MeshViewer
+
+    return _MeshViewer(*args, **kwargs)
+
+
+def MeshViewers(*args, **kwargs):
+    from .viewer import MeshViewers as _MeshViewers
+
+    return _MeshViewers(*args, **kwargs)

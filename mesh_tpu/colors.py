@@ -1,0 +1,92 @@
+"""Color-name lookup (reference mesh/colors.py).
+
+The reference ships a ~750-entry dict generated from an X11 rgb.txt.  Here
+the table is built at import time from matplotlib's CSS4 color list (the
+modern standard covering the X11 names), expanded with the X11 conventions
+the reference dict also carries: spaced forms ('steel blue'), CamelCase forms
+('SteelBlue'), and the gray0..gray100 / grey0..grey100 numeric shades.
+`name_to_rgb[name]` -> np.array([r, g, b]) in [0, 1].
+"""
+
+import re
+
+import numpy as np
+
+# word-split table for multi-word X11/CSS4 names, so both 'steel blue' and
+# 'SteelBlue' resolve (single-word names need no entry)
+_MULTIWORD = [
+    "alice blue", "antique white", "blanched almond", "blue violet",
+    "cadet blue", "cornflower blue", "dark blue", "dark cyan",
+    "dark goldenrod", "dark gray", "dark green", "dark grey", "dark khaki",
+    "dark magenta", "dark olive green", "dark orange", "dark orchid",
+    "dark red", "dark salmon", "dark sea green", "dark slate blue",
+    "dark slate gray", "dark slate grey", "dark turquoise", "dark violet",
+    "deep pink", "deep sky blue", "dim gray", "dim grey", "dodger blue",
+    "floral white", "forest green", "ghost white", "green yellow",
+    "hot pink", "indian red", "lawn green", "lemon chiffon", "light blue",
+    "light coral", "light cyan", "light goldenrod yellow", "light gray",
+    "light green", "light grey", "light pink", "light salmon",
+    "light sea green", "light sky blue", "light slate gray",
+    "light slate grey", "light steel blue", "light yellow", "lime green",
+    "medium aquamarine", "medium blue", "medium orchid", "medium purple",
+    "medium sea green", "medium slate blue", "medium spring green",
+    "medium turquoise", "medium violet red", "midnight blue", "mint cream",
+    "misty rose", "navajo white", "navy blue", "old lace", "olive drab",
+    "orange red", "pale goldenrod", "pale green", "pale turquoise",
+    "pale violet red", "papaya whip", "peach puff", "powder blue",
+    "rosy brown", "royal blue", "saddle brown", "sandy brown", "sea green",
+    "sky blue", "slate blue", "slate gray", "slate grey", "spring green",
+    "steel blue", "white smoke", "yellow green", "rebecca purple",
+]
+
+
+def _build():
+    from matplotlib.colors import CSS4_COLORS, to_rgb
+
+    table = {}
+
+    def put(name, rgb):
+        table[name] = np.round(np.array(rgb, dtype=np.float64), 2)
+
+    joined_to_spaced = {w.replace(" ", ""): w for w in _MULTIWORD}
+    for name, hexval in CSS4_COLORS.items():
+        rgb = to_rgb(hexval)
+        put(name, rgb)
+        if name in joined_to_spaced:
+            spaced = joined_to_spaced[name]
+            put(spaced, rgb)
+            put("".join(w.capitalize() for w in spaced.split()), rgb)
+        else:
+            put(name.capitalize(), rgb)
+    for i in range(101):
+        shade = round(i * 2.55) / 255.0
+        for g in ("gray", "grey"):
+            put("%s%d" % (g, i), (shade, shade, shade))
+    return table
+
+
+name_to_rgb = _build()
+
+
+def jet(val):
+    """Map a scalar in [0, 1] through the jet colormap -> (1, 3) row
+    (shared by Mesh.colors_like and Lines.colors_like; reference inlines the
+    same arithmetic in both, mesh.py:141-152 / lines.py:35-44)."""
+    four = 4 * float(val)
+    rgb = np.array([
+        min(four - 1.5, -four + 4.5),
+        min(four - 0.5, -four + 3.5),
+        min(four + 0.5, -four + 2.5),
+    ])
+    return np.clip(rgb, 0.0, 1.0).reshape(1, 3)
+
+
+def main():
+    """Generate static dict code from an X11-format rgb.txt, as the
+    reference's generator does (colors.py:17-31)."""
+    with open("rgb.txt") as fp:
+        for line in fp:
+            reg = re.match(r"\s*(\d+)\s*(\d+)\s*(\d+)\s*(\w.*\w).*", line)
+            if reg:
+                r, g, b = (int(reg.group(i)) / 255.0 for i in (1, 2, 3))
+                print("'%s': np.array([%.2f, %.2f, %.2f])," % (reg.group(4), r, g, b))

@@ -1,0 +1,65 @@
+"""MeshArrays: the functional, jit/vmap-compatible mesh container.
+
+This is the TPU-native data model (SURVEY.md section 7.1): a registered
+pytree dataclass whose leaves are `jax.Array`s.  Vertices may carry leading
+batch axes ``[..., V, 3]`` over a shared static topology ``f [F, 3]`` — the
+multi-mesh batching the reference lacks entirely (SURVEY.md P5).  All
+operations on it are free functions (mesh_tpu.geometry / mesh_tpu.query)
+usable under jit, vmap, grad, and shard_map.
+
+The mutable `mesh_tpu.Mesh` facade (mesh.py) wraps host numpy arrays for
+reference-API parity and converts at the kernel boundary; heavy pipelines
+should hold a MeshArrays and stay on device.
+"""
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class MeshArrays:
+    """Device-resident triangle mesh.
+
+    v: [..., V, 3] float32 vertices (leading batch axes allowed)
+    f: [F, 3] int32 faces, shared across the batch
+    vn/vc: optional per-vertex arrays batched like v
+    vt/ft: optional texture coords / texture faces (unbatched topology)
+    """
+
+    v: jax.Array
+    f: jax.Array
+    vn: Optional[jax.Array] = None
+    vc: Optional[jax.Array] = None
+    vt: Optional[jax.Array] = None
+    ft: Optional[jax.Array] = None
+
+    @classmethod
+    def create(cls, v, f, vn=None, vc=None, vt=None, ft=None, dtype=jnp.float32):
+        as_f = lambda x: None if x is None else jnp.asarray(np.asarray(x), dtype)
+        as_i = lambda x: None if x is None else jnp.asarray(np.asarray(x), jnp.int32)
+        return cls(v=as_f(v), f=as_i(f), vn=as_f(vn), vc=as_f(vc),
+                   vt=as_f(vt), ft=as_i(ft))
+
+    @property
+    def num_vertices(self):
+        return self.v.shape[-2]
+
+    @property
+    def num_faces(self):
+        return self.f.shape[0]
+
+    @property
+    def batch_shape(self):
+        return self.v.shape[:-2]
+
+    def with_vertices(self, v):
+        return dataclasses.replace(self, v=v)
+
+    def tri(self):
+        """Triangle corner coordinates [..., F, 3, 3]."""
+        return jnp.take(self.v, self.f, axis=-2)

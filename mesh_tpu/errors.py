@@ -1,0 +1,26 @@
+"""Exception hierarchy for mesh_tpu.
+
+Parity with reference mesh/errors.py:8-15 (MeshError <- SerializationError),
+extended with the error classes the reference registers per C extension
+(spatialsearchmodule.cpp:60-62, py_visibility.cpp:52-54, py_loadobj.cpp:56-58).
+"""
+
+
+class MeshError(Exception):
+    """Base error for every mesh_tpu failure."""
+
+
+class SerializationError(MeshError):
+    """Raised on file I/O / parse failures (reference errors.py:12-15)."""
+
+
+class SpatialSearchError(MeshError):
+    """Raised on spatial-query failures (reference Mesh_IntersectionsError)."""
+
+
+class VisibilityError(MeshError):
+    """Raised on visibility-computation failures (reference VisibilityError)."""
+
+
+class TopologyError(MeshError):
+    """Raised on topology-op failures (decimation/subdivision)."""

@@ -1,0 +1,11 @@
+from .cross_product import cross  # noqa: F401
+from .tri_normals import (  # noqa: F401
+    tri_edges,
+    tri_normals,
+    tri_normals_scaled,
+    normalize_rows,
+)
+from .vert_normals import vert_normals, vert_normals_scaled  # noqa: F401
+from .triangle_area import triangle_area  # noqa: F401
+from .barycentric import barycentric_coordinates_of_projection  # noqa: F401
+from .rodrigues import rodrigues, rodrigues2rotmat, rotmat2rodrigues  # noqa: F401

@@ -1,0 +1,31 @@
+"""Projected barycentric coordinates (Heidrich, JGT'05).
+
+Parity: reference mesh/geometry/barycentric_coordinates_of_projection.py:9-49.
+The reference takes transposed 3xN arrays and special-cases scalar `s`; here
+everything is (..., N, 3) with a branch-free epsilon guard for degenerate
+(collinear-edge) triangles, so it jits and vmaps cleanly.
+"""
+
+import jax.numpy as jnp
+
+
+def barycentric_coordinates_of_projection(p, q, u, v):
+    """Barycentric coords of p's projection onto triangle (q, q+u, q+v).
+
+    :param p: points to project, [..., N, 3]
+    :param q: a triangle vertex per point, [..., N, 3]
+    :param u, v: triangle edge vectors per point, [..., N, 3]
+    :returns: [..., N, 3] barycentric coords (b0, b1, b2), b0 = 1 - b1 - b2
+    """
+    p, q, u, v = (jnp.asarray(x) for x in (p, q, u, v))
+    n = jnp.cross(u, v)
+    s = jnp.sum(n * n, axis=-1, keepdims=True)
+    # Degenerate triangle: cross product ~ 0 -> avoid 0/0 exactly as the
+    # reference does (s == 0 replaced by machine epsilon, barycentric...py:36-41).
+    s = jnp.where(s == 0, jnp.finfo(p.dtype).eps, s)
+    one_over_4a_sq = 1.0 / s
+    w = p - q
+    b2 = jnp.sum(jnp.cross(u, w) * n, axis=-1, keepdims=True) * one_over_4a_sq
+    b1 = jnp.sum(jnp.cross(w, v) * n, axis=-1, keepdims=True) * one_over_4a_sq
+    b0 = 1.0 - b1 - b2
+    return jnp.concatenate([b0, b1, b2], axis=-1)

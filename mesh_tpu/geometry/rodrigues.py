@@ -1,0 +1,193 @@
+"""Axis-angle <-> rotation matrix (Rodrigues transform).
+
+Parity target: reference mesh/geometry/rodrigues.py:10-125 (a cv2.Rodrigues
+port).  TPU-first redesign:
+
+- ``rodrigues2rotmat``: batched ``[..., 3] -> [..., 3, 3]``, branch-free and
+  differentiable *through* theta = 0 (Taylor-guarded sinc terms), so it can
+  sit inside jitted/grad'd model code (e.g. linear-blend-skinning pose maps).
+- ``rotmat2rodrigues``: batched inverse, branch-free (``where``-selected
+  pi-rotation handling), no Jacobian.
+- ``rodrigues``: the reference-compatible entry point — accepts a 3-vector or
+  a 3x3 matrix, returns numpy, and optionally the cv2-layout Jacobian
+  (3x9 forward via autodiff of the exact map; 9x3 inverse via the analytic
+  chain rule cv2 uses).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_TAYLOR_EPS = 1e-8
+
+
+def _skew(r):
+    """[..., 3] -> [..., 3, 3] skew-symmetric cross-product matrix."""
+    x, y, z = r[..., 0], r[..., 1], r[..., 2]
+    zero = jnp.zeros_like(x)
+    return jnp.stack(
+        [
+            jnp.stack([zero, -z, y], axis=-1),
+            jnp.stack([z, zero, -x], axis=-1),
+            jnp.stack([-y, x, zero], axis=-1),
+        ],
+        axis=-2,
+    )
+
+
+def rodrigues2rotmat(r):
+    """Axis-angle [..., 3] -> rotation matrix [..., 3, 3].
+
+    R = I + sinc(t) * K + (1 - cos t)/t^2 * K^2 with K = skew(r); the two
+    coefficient functions are computed with a Taylor switch near t = 0 so that
+    both the value and the autodiff gradient are exact there (reference
+    rodrigues2rotmat, rodrigues.py:121-125, is not batched and divides by 0
+    at the identity).
+    """
+    r = jnp.asarray(r)
+    t2 = jnp.sum(r * r, axis=-1)[..., None, None]
+    small = t2 < _TAYLOR_EPS
+    t2_safe = jnp.where(small, 1.0, t2)
+    t = jnp.sqrt(t2_safe)
+    a = jnp.where(small, 1.0 - t2 / 6.0, jnp.sin(t) / t)          # sinc
+    b = jnp.where(small, 0.5 - t2 / 24.0, (1.0 - jnp.cos(t)) / t2_safe)
+    K = _skew(r)
+    # K^2 = r r^T - t^2 I in closed form: elementwise outer product instead
+    # of a matmul, because f32 matmuls default to reduced (bf16-style)
+    # precision on TPU-profile XLA builds and 3x3 products hit the VPU anyway
+    rrt = r[..., :, None] * r[..., None, :]
+    eye = jnp.broadcast_to(jnp.eye(3, dtype=r.dtype), K.shape)
+    return eye + a * K + b * (rrt - t2 * eye)
+
+
+def rotmat2rodrigues(R):
+    """Rotation matrix [..., 3, 3] -> axis-angle [..., 3], branch-free.
+
+    Mirrors the cv2 branch structure of reference rodrigues.py:59-118 with
+    ``where`` selection: generic case from the antisymmetric part; near-pi
+    case from the diagonal with cv2's sign conventions; near-identity -> 0.
+    """
+    R = jnp.asarray(R)
+    rx = R[..., 2, 1] - R[..., 1, 2]
+    ry = R[..., 0, 2] - R[..., 2, 0]
+    rz = R[..., 1, 0] - R[..., 0, 1]
+    rvec = jnp.stack([rx, ry, rz], axis=-1)
+    s = jnp.sqrt(jnp.sum(rvec * rvec, axis=-1) * 0.25)
+    c = jnp.clip((R[..., 0, 0] + R[..., 1, 1] + R[..., 2, 2] - 1.0) * 0.5, -1.0, 1.0)
+    theta = jnp.arccos(c)
+
+    # generic branch: r = theta / (2 sin theta) * rvec
+    s_safe = jnp.where(s < 1e-5, 1.0, s)
+    generic = rvec * (theta / (2.0 * s_safe))[..., None]
+
+    # near-pi branch: |axis_i| from diagonal, signs fixed as cv2 does
+    diag = jnp.stack([R[..., 0, 0], R[..., 1, 1], R[..., 2, 2]], axis=-1)
+    axis = jnp.sqrt(jnp.clip((diag + 1.0) * 0.5, 0.0, None))
+    ax, ay, az = axis[..., 0], axis[..., 1], axis[..., 2]
+    ay = jnp.where(R[..., 0, 1] < 0, -ay, ay)
+    az = jnp.where(R[..., 0, 2] < 0, -az, az)
+    flip = (
+        (jnp.abs(ax) < jnp.abs(ay))
+        & (jnp.abs(ax) < jnp.abs(az))
+        & ((R[..., 1, 2] > 0) != (ay * az > 0))
+    )
+    az = jnp.where(flip, -az, az)
+    axis = jnp.stack([ax, ay, az], axis=-1)
+    norm = jnp.sqrt(jnp.sum(axis * axis, axis=-1))
+    norm_safe = jnp.where(norm == 0, 1.0, norm)
+    near_pi = axis * (theta / norm_safe)[..., None]
+
+    small = (s < 1e-5)[..., None]
+    out = jnp.where(small, jnp.where((c > 0)[..., None], jnp.zeros_like(rvec), near_pi), generic)
+    return out
+
+
+def _forward_jacobian(r):
+    """cv2-layout forward Jacobian: row i = d(R.flatten())/d r_i, shape (3, 9)."""
+    J = jax.jacfwd(lambda rr: rodrigues2rotmat(rr).reshape(9))(jnp.asarray(r, jnp.float64))
+    return np.asarray(J).T.reshape(3, 9)
+
+
+def _inverse_jacobian(R, rvec_parts, s, c, theta):
+    """cv2 analytic chain for d(axis-angle)/d(R.flatten()), shape (9, 3).
+
+    Variable chain (reference rodrigues.py:88-112): R -> (rx,ry,rz,tr) ->
+    (ux,uy,uz,theta) -> omega.
+    """
+    rx, ry, rz = rvec_parts
+    if s < 1e-5:
+        jac = np.zeros((9, 3))
+        if c > 0:
+            jac[1, 2] = jac[5, 0] = jac[6, 1] = -0.5
+            jac[2, 1] = jac[3, 2] = jac[7, 0] = 0.5
+        return jac
+    vth = 1.0 / (2.0 * s)
+    dtheta_dtr = -1.0 / s
+    dvth_dtheta = -vth * c / s
+    d1 = 0.5 * dvth_dtheta * dtheta_dtr
+    d2 = 0.5 * dtheta_dtr
+    # d(rx,ry,rz,vth,theta) / dR(flat)
+    dvar_dR = np.array(
+        [
+            [0, 0, 0, 0, 0, 1, 0, -1, 0],
+            [0, 0, -1, 0, 0, 0, 1, 0, 0],
+            [0, 1, 0, -1, 0, 0, 0, 0, 0],
+            [d1, 0, 0, 0, d1, 0, 0, 0, d1],
+            [d2, 0, 0, 0, d2, 0, 0, 0, d2],
+        ],
+        dtype=np.float64,
+    )
+    dvar2_dvar = np.array(
+        [
+            [vth, 0, 0, rx, 0],
+            [0, vth, 0, ry, 0],
+            [0, 0, vth, rz, 0],
+            [0, 0, 0, 0, 1],
+        ],
+        dtype=np.float64,
+    )
+    domega_dvar2 = np.array(
+        [
+            [theta, 0, 0, rx * vth],
+            [0, theta, 0, ry * vth],
+            [0, 0, theta, rz * vth],
+        ],
+        dtype=np.float64,
+    )
+    jac = domega_dvar2 @ dvar2_dvar @ dvar_dR
+    # cv2 stores d/dR with R traversed column-major per output row
+    for i in range(3):
+        jac[i] = jac[i].reshape(3, 3).T.flatten()
+    return jac.T
+
+
+def rodrigues(r, calculate_jacobian=True):
+    """Reference-compatible Rodrigues transform (rodrigues.py:10-118).
+
+    3-vector input -> (3,3) rotation matrix [+ (3,9) Jacobian];
+    3x3 matrix input -> (3,1) axis-angle [+ (9,3) Jacobian].  All numpy f64.
+    """
+    r = np.array(r, dtype=np.float64)
+    if r.shape in ((3,), (3, 1), (1, 3)):
+        rf = r.flatten()
+        with jax.enable_x64(True):
+            R = np.asarray(rodrigues2rotmat(jnp.asarray(rf, jnp.float64)))
+            if not calculate_jacobian:
+                return R
+            jac = _forward_jacobian(rf)
+        return R, jac
+    if r.shape == (3, 3):
+        u, _, vt = np.linalg.svd(r)
+        Rp = u @ vt
+        rx = Rp[2, 1] - Rp[1, 2]
+        ry = Rp[0, 2] - Rp[2, 0]
+        rz = Rp[1, 0] - Rp[0, 1]
+        s = np.linalg.norm([rx, ry, rz]) * 0.5
+        c = np.clip((np.trace(Rp) - 1.0) * 0.5, -1.0, 1.0)
+        theta = np.arccos(c)
+        with jax.enable_x64(True):
+            out = np.asarray(rotmat2rodrigues(jnp.asarray(Rp, jnp.float64))).reshape(3, 1)
+        if not calculate_jacobian:
+            return out
+        return out, _inverse_jacobian(Rp, (rx, ry, rz), s, c, theta)
+    raise ValueError("rodrigues: input must be a 3-vector or 3x3 matrix.")

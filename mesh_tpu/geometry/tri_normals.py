@@ -1,0 +1,44 @@
+"""Per-face (triangle) normals, pure JAX.
+
+Parity: reference mesh/geometry/tri_normals.py:19-53.  The reference flattens
+everything to 1-D between steps (a chumpy-era idiom); here every function keeps
+natural shapes — ``v: [..., V, 3]`` float, ``f: [F, 3]`` int32 — and supports
+arbitrary leading batch axes on ``v`` with shared topology ``f``, which is the
+headline capability the reference lacks (SURVEY.md P5).
+"""
+
+import jax.numpy as jnp
+
+
+def tri_edges(v, f, cplus, cminus):
+    """Edge vectors v[f[:,cplus]] - v[f[:,cminus]] -> [..., F, 3].
+
+    Reference TriEdges/_edges_for (tri_normals.py:35-43).
+    """
+    gathered = jnp.take(v, f, axis=-2)  # [..., F, 3(corner), 3(xyz)]
+    return gathered[..., cplus, :] - gathered[..., cminus, :]
+
+
+def tri_normals_scaled(v, f):
+    """Unnormalized face normals cross(e10, e20) -> [..., F, 3].
+
+    Reference TriNormalsScaled (tri_normals.py:23-24) and TriToScaledNormal
+    (tri_normals.py:46-53).  Magnitude = 2 * triangle area.
+    """
+    return jnp.cross(tri_edges(v, f, 1, 0), tri_edges(v, f, 2, 0))
+
+
+def normalize_rows(x, eps=0.0):
+    """Row-normalize (..., 3) with the reference's zero-guard.
+
+    Reference NormalizedNx3 (tri_normals.py:27-32): rows with zero norm are
+    left at zero (divide by 1) rather than NaN.
+    """
+    sqnorm = jnp.sum(x * x, axis=-1, keepdims=True)
+    sqnorm = jnp.where(sqnorm <= eps, 1.0, sqnorm)
+    return x / jnp.sqrt(sqnorm)
+
+
+def tri_normals(v, f):
+    """Unit face normals -> [..., F, 3] (reference TriNormals, tri_normals.py:19)."""
+    return normalize_rows(tri_normals_scaled(v, f))

@@ -1,0 +1,11 @@
+"""Per-triangle areas (reference mesh/geometry/triangle_area.py:10-12)."""
+
+import jax.numpy as jnp
+
+from .tri_normals import tri_normals_scaled
+
+
+def triangle_area(v, f):
+    """Area of each face -> [..., F] (= |scaled normal| / 2)."""
+    n = tri_normals_scaled(v, f)
+    return jnp.sqrt(jnp.sum(n * n, axis=-1)) / 2.0

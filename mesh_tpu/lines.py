@@ -1,0 +1,47 @@
+"""Polyline primitive (reference mesh/lines.py)."""
+
+import numpy as np
+
+from . import colors
+from .colors import jet as _jet
+from .utils import col
+
+
+class Lines(object):
+    """Collection of 3D lines.
+
+    Attributes: v (Vx3 vertices), e (Ex2 edges), optional vc/ec colors.
+    """
+
+    def __init__(self, v, e, vc=None, ec=None):
+        self.v = np.array(v)
+        self.e = np.array(e)
+        if vc is not None:
+            self.set_vertex_colors(vc)
+        if ec is not None:
+            self.set_edge_colors(ec)
+
+    def colors_like(self, color, arr):
+        """Scalar weights map through the jet colormap; names/lists broadcast
+        (reference lines.py:28-48)."""
+        if isinstance(color, str):
+            color = colors.name_to_rgb[color]
+        elif isinstance(color, list):
+            color = np.array(color)
+        if color.shape == (arr.shape[0],):
+            color = col(color)
+            color = np.concatenate([_jet(color[i]) for i in range(color.size)], axis=0)
+        return np.ones((arr.shape[0], 3)) * color
+
+    def set_vertex_colors(self, vc):
+        self.vc = self.colors_like(vc, self.v)
+
+    def set_edge_colors(self, ec):
+        self.ec = self.colors_like(ec, self.e)
+
+    def write_obj(self, filename):
+        with open(filename, "w") as fi:
+            for r in self.v:
+                fi.write("v %f %f %f\n" % (r[0], r[1], r[2]))
+            for e in self.e:
+                fi.write("l %d %d\n" % (e[0] + 1, e[1] + 1))

@@ -1,0 +1,549 @@
+"""The Mesh facade: reference-compatible 3D triangle mesh object.
+
+API parity with reference mesh/mesh.py:34-492 (same constructor keywords,
+same ~70 methods, same delegation structure to domain modules) — but where
+the reference's methods lazily import compiled C++/CGAL extensions
+(search.py:22-24, mesh.py:292), these delegate to jit'd JAX kernels in
+`mesh_tpu.query` / `mesh_tpu.geometry`.  Host-side attributes (v, f, vn, ...)
+are numpy arrays so the in-place editing idioms of reference users keep
+working; conversion to device arrays happens at the kernel boundary
+(`.arrays()` exports a `MeshArrays` pytree for fully on-device pipelines).
+"""
+
+import os
+from functools import reduce
+
+import numpy as np
+
+from . import colors, landmarks, processing, texture
+from .core import MeshArrays
+from .serialization import serialization
+
+__all__ = ["Mesh"]
+
+
+class Mesh(object):
+    """3d Triangulated Mesh class.
+
+    Attributes:
+        v: Vx3 array of vertices
+        f: Fx3 array of faces
+
+    Optional attributes:
+        fc: Fx3 array of face colors
+        vc: Vx3 array of vertex colors
+        vn: Vx3 array of vertex normals
+        segm: dictionary of part names to triangle indices
+    """
+
+    def __init__(self, v=None, f=None, segm=None, filename=None,
+                 ppfilename=None, lmrkfilename=None, basename=None,
+                 vc=None, fc=None, vscale=None, landmarks=None):
+        if filename is not None:
+            self.load_from_file(filename)
+            if hasattr(self, "f"):
+                self.f = np.require(self.f, dtype=np.uint32)
+            self.v = np.require(self.v, dtype=np.float64)
+            self.filename = filename
+            if vscale is not None:
+                self.v *= vscale
+        if v is not None:
+            self.v = np.array(v, dtype=np.float64)
+            if vscale is not None:
+                self.v *= vscale
+        if f is not None:
+            self.f = np.require(f, dtype=np.uint32)
+
+        self.basename = basename
+        if self.basename is None and filename is not None:
+            self.basename = os.path.splitext(os.path.basename(filename))[0]
+
+        if segm is not None:
+            self.segm = segm
+        if landmarks is not None:
+            self.set_landmark_indices_from_any(landmarks)
+        if ppfilename is not None:
+            self.set_landmark_indices_from_ppfile(ppfilename)
+        if lmrkfilename is not None:
+            self.set_landmark_indices_from_lmrkfile(lmrkfilename)
+        if vc is not None:
+            self.set_vertex_colors(vc)
+        if fc is not None:
+            self.set_face_colors(fc)
+
+    # ------------------------------------------------------------------
+    # Device export
+
+    def arrays(self, dtype=None):
+        """Export to the functional `MeshArrays` pytree (device f32)."""
+        import jax.numpy as jnp
+
+        return MeshArrays.create(
+            self.v, getattr(self, "f", np.zeros((0, 3), np.int32)),
+            vn=getattr(self, "vn", None), vc=getattr(self, "vc", None),
+            vt=getattr(self, "vt", None), ft=getattr(self, "ft", None),
+            dtype=dtype or jnp.float32,
+        )
+
+    # ------------------------------------------------------------------
+    # Visualization helpers
+
+    def edges_as_lines(self, copy_vertices=False):
+        from .lines import Lines
+
+        edges = self.f[:, [0, 1, 1, 2, 2, 0]].flatten().reshape(-1, 2)
+        verts = self.v.copy() if copy_vertices else self.v
+        return Lines(v=verts, e=edges)
+
+    def show(self, mv=None, meshes=[], lines=[]):
+        from .viewer import MeshViewer
+        from .utils import row
+
+        if mv is None:
+            mv = MeshViewer(keepalive=True)
+
+        if hasattr(self, "landm"):
+            from .sphere import Sphere
+
+            sphere = Sphere(np.zeros((3)), 1.0).to_mesh()
+            scalefactor = (
+                1e-2
+                * np.max(np.max(self.v) - np.min(self.v))
+                / np.max(np.max(sphere.v) - np.min(sphere.v))
+            )
+            sphere.v = sphere.v * scalefactor
+            spheres = [
+                Mesh(vc="SteelBlue", f=sphere.f,
+                     v=sphere.v + row(np.array(self.landm_raw_xyz[k])))
+                for k in self.landm.keys()
+            ]
+            mv.set_dynamic_meshes([self] + spheres + meshes, blocking=True)
+        else:
+            mv.set_dynamic_meshes([self] + meshes, blocking=True)
+        mv.set_dynamic_lines(lines)
+        return mv
+
+    # ------------------------------------------------------------------
+    # Colors
+
+    def colors_like(self, color, arr=None):
+        from .utils import row, col
+
+        if arr is None:
+            arr = np.zeros(self.v.shape)
+        if arr.ndim == 1 or arr.shape[1] == 1:
+            arr = arr.reshape(-1, 3)
+        if isinstance(color, str):
+            color = colors.name_to_rgb[color]
+        elif isinstance(color, list):
+            color = np.array(color)
+        if color.shape[0] == arr.shape[0] and color.shape[0] == color.size:
+            color = col(color)
+            color = np.concatenate(
+                [colors.jet(color[i]) for i in range(color.size)], axis=0
+            )
+        return np.ones_like(arr) * color
+
+    def set_vertex_colors(self, vc, vertex_indices=None):
+        if vertex_indices is not None:
+            self.vc[vertex_indices] = self.colors_like(vc, self.v[vertex_indices])
+        else:
+            self.vc = self.colors_like(vc, self.v)
+        return self
+
+    def set_vertex_colors_from_weights(self, weights, scale_to_range_1=True, color=True):
+        if weights is None:
+            return self
+        if scale_to_range_1:
+            weights = weights - np.min(weights)
+            weights = weights / np.max(weights)
+        if color:
+            from matplotlib import cm
+
+            self.vc = cm.jet(weights)[:, :3]
+        else:
+            self.vc = np.tile(np.reshape(weights, (len(weights), 1)), (1, 3))
+        return self
+
+    def scale_vertex_colors(self, weights, w_min=0.0, w_max=1.0):
+        if weights is None:
+            return self
+        weights = weights - np.min(weights)
+        weights = (w_max - w_min) * weights / np.max(weights) + w_min
+        self.vc = (weights * self.vc.T).T
+        return self
+
+    def set_face_colors(self, fc):
+        self.fc = self.colors_like(fc, self.f)
+        return self
+
+    # ------------------------------------------------------------------
+    # Geometry
+
+    def faces_by_vertex(self, as_sparse_matrix=False):
+        """V->F incidence (reference mesh.py:193-206)."""
+        import scipy.sparse as sp
+
+        if not as_sparse_matrix:
+            faces_by_vertex = [[] for _ in range(len(self.v))]
+            for i, face in enumerate(self.f):
+                faces_by_vertex[face[0]].append(i)
+                faces_by_vertex[face[1]].append(i)
+                faces_by_vertex[face[2]].append(i)
+        else:
+            row = self.f.flatten()
+            col = np.array([range(self.f.shape[0])] * 3).T.flatten()
+            data = np.ones(len(col))
+            faces_by_vertex = sp.csr_matrix(
+                (data, (row, col)), shape=(self.v.shape[0], self.f.shape[0])
+            )
+        return faces_by_vertex
+
+    def estimate_vertex_normals(self, face_to_verts_sparse_matrix=None):
+        """Area-weighted vertex normals on the TPU kernel
+        (reference mesh.py:208-216; kernel: geometry/vert_normals.py)."""
+        from .geometry import vert_normals
+
+        return np.asarray(
+            vert_normals(self.v.astype(np.float32), self.f.astype(np.int32)),
+            dtype=np.float64,
+        )
+
+    def barycentric_coordinates_for_points(self, points, face_indices):
+        from .geometry import barycentric_coordinates_of_projection
+
+        face_indices = np.asarray(face_indices)
+        vertex_indices = self.f[face_indices.flatten(), :]
+        tri = np.array([
+            self.v[vertex_indices[:, 0]],
+            self.v[vertex_indices[:, 1]],
+            self.v[vertex_indices[:, 2]],
+        ])
+        coeffs = np.asarray(
+            barycentric_coordinates_of_projection(
+                np.asarray(points, np.float64), tri[0],
+                tri[1] - tri[0], tri[2] - tri[0],
+            )
+        )
+        return vertex_indices, coeffs
+
+    # ------------------------------------------------------------------
+    # Segmentation
+
+    def transfer_segm(self, mesh, exclude_empty_parts=True):
+        self.segm = {}
+        if hasattr(mesh, "segm"):
+            face_centers = self.v[self.f.astype(np.int64)].mean(axis=1)
+            closest_faces, _ = mesh.closest_faces_and_points(face_centers)
+            mesh_parts_by_face = mesh.parts_by_face()
+            parts_by_face = [
+                mesh_parts_by_face[face] for face in np.asarray(closest_faces).flatten()
+            ]
+            self.segm = dict((part, []) for part in mesh.segm.keys())
+            for face, part in enumerate(parts_by_face):
+                self.segm[part].append(face)
+            for part in list(self.segm.keys()):
+                self.segm[part].sort()
+                if exclude_empty_parts and not self.segm[part]:
+                    del self.segm[part]
+
+    @property
+    def verts_by_segm(self):
+        return dict(
+            (segment, sorted(set(self.f[indices].flatten())))
+            for segment, indices in self.segm.items()
+        )
+
+    def parts_by_face(self):
+        segments_by_face = [""] * len(self.f)
+        for part in self.segm.keys():
+            for face in self.segm[part]:
+                segments_by_face[face] = part
+        return segments_by_face
+
+    def verts_in_common(self, segments):
+        """All vertex indices common to each segment in segments."""
+        return sorted(
+            reduce(
+                lambda s0, s1: s0.intersection(s1),
+                [set(self.verts_by_segm[segm]) for segm in segments],
+            )
+        )
+
+    # ------------------------------------------------------------------
+    # Joints
+
+    @property
+    def joint_names(self):
+        return self.joint_regressors.keys()
+
+    @property
+    def joint_xyz(self):
+        joint_locations = {}
+        for name in self.joint_names:
+            joint_locations[name] = self.joint_regressors[name]["offset"] + np.sum(
+                self.v[self.joint_regressors[name]["v_indices"]].T
+                * self.joint_regressors[name]["coeff"],
+                axis=1,
+            )
+        return joint_locations
+
+    def set_joints(self, joint_names, vertex_indices):
+        """Equal-weight joint regressors from vertex rings
+        (reference mesh.py:275-280)."""
+        self.joint_regressors = {}
+        for name, indices in zip(joint_names, vertex_indices):
+            self.joint_regressors[name] = {
+                "v_indices": indices,
+                "coeff": [1.0 / len(indices)] * len(indices),
+                "offset": np.array([0.0, 0.0, 0.0]),
+            }
+
+    # ------------------------------------------------------------------
+    # Visibility
+
+    def vertex_visibility(self, camera, normal_threshold=None,
+                          omni_directional_camera=False, binary_visiblity=True):
+        vis, n_dot_cam = self.vertex_visibility_and_normals(
+            camera, omni_directional_camera
+        )
+        if normal_threshold is not None:
+            vis = np.logical_and(vis, n_dot_cam > normal_threshold)
+        return np.squeeze(vis) if binary_visiblity else np.squeeze(vis * n_dot_cam)
+
+    def vertex_visibility_and_normals(self, camera, omni_directional_camera=False):
+        from .query import visibility_compute
+
+        # accept either a camera object with .origin/.sensor_axis or a bare
+        # xyz position (treated as omnidirectional)
+        if hasattr(camera, "origin"):
+            origin = np.asarray(camera.origin).flatten()
+        else:
+            origin = np.asarray(camera, dtype=np.float64).flatten()
+            omni_directional_camera = True
+        arguments = {"v": self.v, "f": self.f, "cams": np.array([origin])}
+        if not omni_directional_camera:
+            arguments["sensors"] = np.array([np.asarray(camera.sensor_axis).flatten()])
+        arguments["n"] = self.vn if hasattr(self, "vn") else self.estimate_vertex_normals()
+        return visibility_compute(**arguments)
+
+    def visibile_mesh(self, camera=[0.0, 0.0, 0.0]):
+        vis = self.vertex_visibility(camera)
+        faces_to_keep = [
+            face for face in self.f if vis[face[0]] * vis[face[1]] * vis[face[2]]
+        ]
+        vertex_indices_to_keep = np.nonzero(vis)[0]
+        vertices_to_keep = self.v[vertex_indices_to_keep]
+        old_to_new_indices = np.zeros(len(vis))
+        old_to_new_indices[vertex_indices_to_keep] = range(len(vertex_indices_to_keep))
+        return Mesh(
+            v=vertices_to_keep,
+            f=np.array([old_to_new_indices[face] for face in faces_to_keep]),
+        )
+
+    def estimate_circumference(self, plane_normal, plane_distance,
+                               partNamesAllowed=None, want_edges=False):
+        raise NotImplementedError(
+            "estimate_circumference lives in body-model packages, not here"
+        )
+
+    # ------------------------------------------------------------------
+    # Processing (delegates, reference mesh.py:318-366)
+
+    def reset_normals(self, face_to_verts_sparse_matrix=None, reset_face_normals=False):
+        return processing.reset_normals(
+            self, face_to_verts_sparse_matrix, reset_face_normals
+        )
+
+    def reset_face_normals(self):
+        return processing.reset_face_normals(self)
+
+    def uniquified_mesh(self):
+        return processing.uniquified_mesh(self)
+
+    def keep_vertices(self, keep_list):
+        return processing.keep_vertices(self, keep_list)
+
+    def remove_vertices(self, v_list):
+        return self.keep_vertices(np.setdiff1d(np.arange(self.v.shape[0]), v_list))
+
+    def point_cloud(self):
+        return (
+            Mesh(v=self.v, f=[], vc=self.vc)
+            if hasattr(self, "vc")
+            else Mesh(v=self.v, f=[])
+        )
+
+    def remove_faces(self, face_indices_to_remove):
+        return processing.remove_faces(self, face_indices_to_remove)
+
+    def scale_vertices(self, scale_factor):
+        return processing.scale_vertices(self, scale_factor)
+
+    def rotate_vertices(self, rotation):
+        return processing.rotate_vertices(self, rotation)
+
+    def translate_vertices(self, translation):
+        return processing.translate_vertices(self, translation)
+
+    def flip_faces(self):
+        return processing.flip_faces(self)
+
+    def simplified(self, factor=None, n_verts_desired=None):
+        from .topology import qslim_decimator
+
+        return qslim_decimator(self, factor, n_verts_desired)
+
+    def subdivide_triangles(self):
+        return processing.subdivide_triangles(self)
+
+    def concatenate_mesh(self, mesh):
+        return processing.concatenate_mesh(self, mesh)
+
+    def reorder_vertices(self, new_ordering, new_normal_ordering=None):
+        processing.reorder_vertices(self, new_ordering, new_normal_ordering)
+
+    # ------------------------------------------------------------------
+    # Landmarks (delegates, reference mesh.py:371-404)
+
+    @property
+    def landm_names(self):
+        names = []
+        if hasattr(self, "landm_regressors") or hasattr(self, "landm"):
+            names = (
+                self.landm_regressors.keys()
+                if hasattr(self, "landm_regressors")
+                else self.landm.keys()
+            )
+        return list(names)
+
+    @property
+    def landm_xyz(self, ordering=None):
+        landmark_order = ordering if ordering else self.landm_names
+        transform = self.landm_xyz_linear_transform(landmark_order)
+        if landmark_order:
+            locations = (transform * self.v.flatten()).reshape(-1, 3)
+            return dict(
+                (landmark_order[i], xyz) for i, xyz in enumerate(locations)
+            )
+        return {}
+
+    def set_landmarks_from_xyz(self, landm_raw_xyz):
+        landmarks.set_landmarks_from_xyz(self, landm_raw_xyz)
+
+    def landm_xyz_linear_transform(self, ordering=None):
+        return landmarks.landm_xyz_linear_transform(self, ordering)
+
+    def recompute_landmark_xyz(self):
+        self.landm_raw_xyz = dict(
+            (name, self.v[ind]) for name, ind in self.landm.items()
+        )
+
+    def recompute_landmark_indices(self, landmark_fname=None, safe_mode=True):
+        landmarks.recompute_landmark_indices(self, landmark_fname, safe_mode)
+
+    def set_landmarks_from_regressors(self, regressors):
+        self.landm_regressors = regressors
+
+    def set_landmark_indices_from_any(self, landmark_file_or_values):
+        serialization.set_landmark_indices_from_any(self, landmark_file_or_values)
+
+    def set_landmarks_from_raw(self, landmark_file_or_values):
+        landmarks.set_landmarks_from_raw(self, landmark_file_or_values)
+
+    # ------------------------------------------------------------------
+    # Texture (delegates, reference mesh.py:409-434)
+
+    @property
+    def texture_image(self):
+        if not hasattr(self, "_texture_image") or self._texture_image is None:
+            self.reload_texture_image()
+        return self._texture_image
+
+    def set_texture_image(self, path_to_texture):
+        self.texture_filepath = path_to_texture
+
+    def texture_coordinates_by_vertex(self):
+        return texture.texture_coordinates_by_vertex(self)
+
+    def reload_texture_image(self):
+        texture.reload_texture_image(self)
+
+    def transfer_texture(self, mesh_with_texture):
+        texture.transfer_texture(self, mesh_with_texture)
+
+    def load_texture(self, texture_version):
+        texture.load_texture(self, texture_version)
+
+    def texture_rgb(self, texture_coordinate):
+        return texture.texture_rgb(self, texture_coordinate)
+
+    def texture_rgb_vec(self, texture_coordinates):
+        return texture.texture_rgb_vec(self, texture_coordinates)
+
+    # ------------------------------------------------------------------
+    # Search (delegates; reference mesh.py:439-455 via search.py trees)
+
+    def compute_aabb_tree(self):
+        from .search import AabbTree
+
+        return AabbTree(self)
+
+    def compute_aabb_normals_tree(self):
+        from .search import AabbNormalsTree
+
+        return AabbNormalsTree(self)
+
+    def compute_closest_point_tree(self, use_cgal=False):
+        from .search import CGALClosestPointTree, ClosestPointTree
+
+        return CGALClosestPointTree(self) if use_cgal else ClosestPointTree(self)
+
+    def closest_vertices(self, vertices, use_cgal=False):
+        return self.compute_closest_point_tree(use_cgal).nearest(vertices)
+
+    def closest_points(self, vertices):
+        return self.closest_faces_and_points(vertices)[1]
+
+    def closest_faces_and_points(self, vertices):
+        return self.compute_aabb_tree().nearest(vertices)
+
+    # ------------------------------------------------------------------
+    # Serialization (delegates, reference mesh.py:460-492)
+
+    def load_from_file(self, filename):
+        serialization.load_from_file(self, filename)
+
+    def load_from_ply(self, filename):
+        serialization.load_from_ply(self, filename)
+
+    def load_from_obj(self, filename):
+        serialization.load_from_obj(self, filename)
+
+    def write_json(self, filename, header="", footer="", name="",
+                   include_faces=True, texture_mode=True):
+        serialization.write_json(self, filename, header, footer, name,
+                                 include_faces, texture_mode)
+
+    def write_three_json(self, filename, name=""):
+        serialization.write_three_json(self, filename, name)
+
+    def write_ply(self, filename, flip_faces=False, ascii=False,
+                  little_endian=True, comments=[]):
+        serialization.write_ply(self, filename, flip_faces, ascii,
+                                little_endian, comments)
+
+    def write_mtl(self, path, material_name, texture_name):
+        serialization.write_mtl(self, path, material_name, texture_name)
+
+    def write_obj(self, filename, flip_faces=False, group=False, comments=None):
+        serialization.write_obj(self, filename, flip_faces, group, comments)
+
+    def load_from_obj_cpp(self, filename):
+        serialization.load_from_obj_cpp(self, filename)
+
+    def set_landmark_indices_from_ppfile(self, ppfilename):
+        serialization.set_landmark_indices_from_ppfile(self, ppfilename)
+
+    def set_landmark_indices_from_lmrkfile(self, lmrkfilename):
+        serialization.set_landmark_indices_from_lmrkfile(self, lmrkfilename)

@@ -1,0 +1,14 @@
+from .point_triangle import closest_point_on_triangle  # noqa: F401
+from .closest_point import (  # noqa: F401
+    closest_faces_and_points,
+    closest_vertices,
+    closest_vertices_with_distance,
+)
+from .normal_weighted import nearest_normal_weighted  # noqa: F401
+from .ray import (  # noqa: F401
+    ray_triangle_hits,
+    nearest_alongnormal,
+    intersections_mask,
+    self_intersection_count,
+)
+from .visibility import visibility_compute  # noqa: F401

@@ -1,0 +1,115 @@
+"""Closest-point-on-mesh and closest-vertex queries, pure JAX.
+
+TPU-native replacement for the reference `spatialsearch` CGAL AABB tree
+(mesh/src/spatialsearchmodule.cpp:74-218) and the scipy-KDTree
+`ClosestPointTree` (mesh/search.py:52-65, which loops per query point in
+Python).  Strategy per SURVEY.md section 7.1: for SMPL-scale meshes
+(F <~ 16k) exact brute force over (query x triangle) pairs is the *fast*
+path on TPU — branch-free arithmetic on the VPU beats pointer-chasing — so we
+tile the query axis to bound memory and argmin over faces.
+
+All functions are jit-friendly, batch over leading axes of ``v`` via vmap,
+and return fixed-shape arrays.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .point_triangle import closest_point_barycentric, closest_point_on_triangle
+
+
+def _pad_to_multiple(x, multiple, axis):
+    n = x.shape[axis]
+    pad = (-n) % multiple
+    if pad == 0:
+        return x, n
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths, mode="edge"), n
+
+
+@partial(jax.jit, static_argnames=("chunk",))
+def closest_faces_and_points(v, f, points, chunk=512):
+    """For each query point, the nearest face / part / point on the mesh.
+
+    :param v: [V, 3] mesh vertices
+    :param f: [F, 3] int faces
+    :param points: [Q, 3] query points
+    :param chunk: query-tile size (memory knob: each tile materializes a
+        chunk x F distance matrix)
+    :returns: dict with ``face`` [Q] int32, ``part`` [Q] int32 (CGAL codes
+        0-6, spatialsearchmodule.cpp:129-140), ``point`` [Q, 3], and
+        ``sqdist`` [Q].
+    """
+    v = jnp.asarray(v)
+    points = jnp.asarray(points, dtype=v.dtype)
+    # f32 conditioning: center on the mesh so coordinates are small relative
+    # to the query geometry (SURVEY.md 7.1 dtype policy).
+    center = jnp.mean(v, axis=0)
+    v = v - center
+    points = points - center
+
+    tri = v[f]  # [F, 3, 3]
+    a, b, c = tri[:, 0], tri[:, 1], tri[:, 2]
+
+    padded, n_q = _pad_to_multiple(points, chunk, axis=0)
+    tiles = padded.reshape(-1, chunk, 3)
+
+    def one_tile(pts):
+        # [chunk, 1, 3] vs [1, F, 3] -> [chunk, F]
+        bary, _ = closest_point_barycentric(
+            pts[:, None, :], a[None], b[None], c[None]
+        )
+        cp = (
+            bary[..., 0:1] * a[None]
+            + bary[..., 1:2] * b[None]
+            + bary[..., 2:3] * c[None]
+        )
+        diff = pts[:, None, :] - cp
+        sq = jnp.sum(diff * diff, axis=-1)  # [chunk, F]
+        best = jnp.argmin(sq, axis=-1)  # [chunk]
+        # Recompute exactly for the winning face (cheap: chunk x 1).
+        pt, sqd, part = closest_point_on_triangle(
+            pts, a[best], b[best], c[best]
+        )
+        return best.astype(jnp.int32), part, pt, sqd
+
+    face, part, point, sqdist = jax.lax.map(one_tile, tiles)
+    face = face.reshape(-1)[:n_q]
+    part = part.reshape(-1)[:n_q]
+    point = point.reshape(-1, 3)[:n_q] + center
+    sqdist = sqdist.reshape(-1)[:n_q]
+    return {"face": face, "part": part, "point": point, "sqdist": sqdist}
+
+
+@partial(jax.jit, static_argnames=("chunk",))
+def closest_vertices_with_distance(v, points, chunk=2048):
+    """Nearest mesh vertex per query -> (index [Q] int32, distance [Q]).
+
+    Replaces reference ClosestPointTree (search.py:52-65) / the
+    degenerate-triangle CGALClosestPointTree (search.py:68-86) with a tiled
+    brute-force pairwise argmin — one fused XLA computation instead of a
+    Python loop over scipy KDTree queries.
+    """
+    v = jnp.asarray(v)
+    points = jnp.asarray(points, dtype=v.dtype)
+    center = jnp.mean(v, axis=0)
+    vc = v - center
+    padded, n_q = _pad_to_multiple(points - center, chunk, axis=0)
+    tiles = padded.reshape(-1, chunk, 3)
+
+    def one_tile(pts):
+        diff = pts[:, None, :] - vc[None]  # [chunk, V, 3]
+        sq = jnp.sum(diff * diff, axis=-1)
+        idx = jnp.argmin(sq, axis=-1)
+        return idx.astype(jnp.int32), jnp.sqrt(sq[jnp.arange(pts.shape[0]), idx])
+
+    idx, dist = jax.lax.map(one_tile, tiles)
+    return idx.reshape(-1)[:n_q], dist.reshape(-1)[:n_q]
+
+
+def closest_vertices(v, points, chunk=2048):
+    """Nearest-vertex indices only (reference ClosestPointTree.nearest)."""
+    return closest_vertices_with_distance(v, points, chunk=chunk)[0]

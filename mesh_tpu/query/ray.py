@@ -1,0 +1,188 @@
+"""Ray / segment / triangle-triangle intersection queries, pure JAX.
+
+TPU-native replacement for the reference's CGAL intersection machinery:
+- `nearest_alongnormal` (spatialsearchmodule.cpp:222-323): per query, the
+  nearest mesh intersection along +/- the query normal; sentinel 1e100 when
+  nothing is hit.  The CGAL all-hits list is never materialized — it becomes
+  a min-reduction over all triangles (SURVEY.md section 7.3).
+- `intersections_mask` (spatialsearchmodule.cpp:326-417): which query
+  triangles intersect the mesh.  Returned as a fixed-shape boolean mask
+  (the reference's variable-length index list has a data-dependent shape).
+  NB the reference implementation has a real data race here
+  (SURVEY.md section 5) — the functional formulation removes it.
+- `self_intersection_count` (aabb_normals.cpp:192-207 /
+  AABB_n_tree.h:95-117): number of ordered triangle pairs that intersect,
+  excluding pairs sharing a vertex index.
+
+Triangle-triangle overlap uses the segment-vs-triangle formulation (each edge
+of one triangle tested against the face of the other, both ways), which is
+exact for non-coplanar pairs; exactly-coplanar overlapping pairs are not
+counted (CGAL counts them; they do not occur in generic float data).
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-9
+# The reference uses 1e100 as its no-hit sentinel (spatialsearchmodule.cpp:
+# 309-311); that overflows float32, so device code uses +inf and the Mesh
+# facade converts to 1e100 at the numpy boundary.
+NO_HIT = jnp.inf
+
+
+def _dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+def ray_triangle_hits(o, d, a, b, c, eps=_EPS):
+    """Moller-Trumbore: signed ray parameter t per (ray, triangle) pair.
+
+    All inputs broadcastable to [..., 3].  Returns (t, hit): the intersection
+    is at o + t*d where `hit` (t unrestricted in sign — callers clamp).
+    """
+    e1 = b - a
+    e2 = c - a
+    pvec = jnp.cross(d, e2)
+    det = _dot(e1, pvec)
+    parallel = jnp.abs(det) < eps
+    inv_det = 1.0 / jnp.where(parallel, 1.0, det)
+    tvec = o - a
+    u = _dot(tvec, pvec) * inv_det
+    qvec = jnp.cross(tvec, e1)
+    v = _dot(d, qvec) * inv_det
+    t = _dot(e2, qvec) * inv_det
+    hit = (~parallel) & (u >= -eps) & (v >= -eps) & (u + v <= 1 + eps)
+    return t, hit
+
+
+@partial(jax.jit, static_argnames=("chunk",))
+def nearest_alongnormal(v, f, points, normals, chunk=512):
+    """Nearest mesh hit along the line through each point in +/-normal.
+
+    Matches reference AabbTree.nearest_alongnormal (search.py:32-37):
+    returns (distance [Q], face [Q] int32, point [Q, 3]); distance is the
+    euclidean distance from the query to the hit (|t| * |n|), +inf when no
+    triangle is hit in either direction (the Mesh facade maps that to the
+    reference's 1e100 sentinel).
+    """
+    v = jnp.asarray(v)
+    points = jnp.asarray(points, v.dtype)
+    normals = jnp.asarray(normals, v.dtype)
+    tri = v[f]
+    a, b, c = tri[:, 0], tri[:, 1], tri[:, 2]
+
+    pad = (-points.shape[0]) % chunk
+    n_q = points.shape[0]
+    points_p = jnp.pad(points, ((0, pad), (0, 0)), mode="edge")
+    normals_p = jnp.pad(normals, ((0, pad), (0, 0)), mode="edge")
+
+    def one_tile(args):
+        pts, nrm = args  # [chunk, 3]
+        t, hit = ray_triangle_hits(
+            pts[:, None, :], nrm[:, None, :], a[None], b[None], c[None]
+        )  # [chunk, F]
+        dist = jnp.abs(t) * jnp.linalg.norm(nrm, axis=-1, keepdims=True)
+        dist = jnp.where(hit, dist, NO_HIT)
+        best = jnp.argmin(dist, axis=-1)
+        rows = jnp.arange(pts.shape[0])
+        best_t = t[rows, best]
+        best_d = dist[rows, best]
+        pt = pts + best_t[:, None] * nrm
+        pt = jnp.where(jnp.isfinite(best_d)[:, None], pt, 0.0)
+        return best_d, best.astype(jnp.int32), pt
+
+    dist, face, point = jax.lax.map(
+        one_tile, (points_p.reshape(-1, chunk, 3), normals_p.reshape(-1, chunk, 3))
+    )
+    return (
+        dist.reshape(-1)[:n_q],
+        face.reshape(-1)[:n_q],
+        point.reshape(-1, 3)[:n_q],
+    )
+
+
+def _segment_hits_triangles(s0, s1, a, b, c, eps=_EPS):
+    """True where segment s0->s1 crosses triangle abc (broadcast [...])."""
+    d = s1 - s0
+    t, hit = ray_triangle_hits(s0, d, a, b, c, eps)
+    return hit & (t >= -eps) & (t <= 1 + eps)
+
+
+def tri_tri_intersects(p, q, eps=_EPS):
+    """Pairwise triangle-triangle intersection.
+
+    :param p: [..., 3, 3] triangles (3 vertices x xyz)
+    :param q: [..., 3, 3] triangles, broadcast-compatible with p
+    :returns: boolean [...]
+    """
+    out = jnp.zeros(jnp.broadcast_shapes(p.shape[:-2], q.shape[:-2]), bool)
+    for src, dst in ((p, q), (q, p)):
+        a, b, c = dst[..., 0, :], dst[..., 1, :], dst[..., 2, :]
+        for i in range(3):
+            s0 = src[..., i, :]
+            s1 = src[..., (i + 1) % 3, :]
+            out = out | _segment_hits_triangles(s0, s1, a, b, c, eps)
+    return out
+
+
+@partial(jax.jit, static_argnames=("chunk",))
+def intersections_mask(v, f, q_v, q_f, chunk=128):
+    """Boolean mask over query faces: does q_f[i] intersect the (v, f) mesh?
+
+    Fixed-shape replacement for AabbTree.intersections_indices
+    (search.py:39-49); `np.nonzero(mask)` recovers the reference's index list.
+    """
+    v = jnp.asarray(v)
+    tri_mesh = v[f]  # [F, 3, 3]
+    q_tri = jnp.asarray(q_v, v.dtype)[q_f]  # [QF, 3, 3]
+    n_q = q_tri.shape[0]
+    pad = (-n_q) % chunk
+    q_tri_p = jnp.pad(q_tri, ((0, pad), (0, 0), (0, 0)), mode="edge")
+
+    def one_tile(qt):  # [chunk, 3, 3]
+        return jnp.any(
+            tri_tri_intersects(qt[:, None], tri_mesh[None]), axis=-1
+        )
+
+    mask = jax.lax.map(one_tile, q_tri_p.reshape(-1, chunk, 3, 3))
+    return mask.reshape(-1)[:n_q]
+
+
+@partial(jax.jit, static_argnames=("chunk",))
+def self_intersection_count(v, f, chunk=128):
+    """Count of ordered intersecting face pairs, excluding vertex-sharing pairs.
+
+    Parity with aabb_normals.aabbtree_n_selfintersects (aabb_normals.cpp:
+    192-207): the CGAL traversal counts each unordered intersecting pair twice
+    (tree vs own triangles), and pairs sharing any vertex index are excluded
+    (Do_intersect_noself_traits, AABB_n_tree.h:95-117).
+    """
+    v = jnp.asarray(v)
+    tri = v[f]  # [F, 3, 3]
+    n_f = tri.shape[0]
+    pad = (-n_f) % chunk
+    tri_p = jnp.pad(tri, ((0, pad), (0, 0), (0, 0)), mode="edge")
+    f_p = jnp.pad(f, ((0, pad), (0, 0)), mode="edge")
+    idx_p = jnp.pad(jnp.arange(n_f), (0, pad), constant_values=-1)
+
+    def one_tile(args):
+        qt, qf, qi = args
+        inter = tri_tri_intersects(qt[:, None], tri[None])  # [chunk, F]
+        shares = jnp.any(
+            qf[:, None, :, None] == f[None, :, None, :], axis=(-1, -2)
+        )  # [chunk, F]
+        not_self = qi[:, None] != jnp.arange(n_f)[None]
+        valid = (qi >= 0)[:, None]
+        return jnp.sum(inter & ~shares & not_self & valid, dtype=jnp.int32)
+
+    counts = jax.lax.map(
+        one_tile,
+        (
+            tri_p.reshape(-1, chunk, 3, 3),
+            f_p.reshape(-1, chunk, 3),
+            idx_p.reshape(-1, chunk),
+        ),
+    )
+    return jnp.sum(counts)

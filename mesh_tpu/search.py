@@ -1,0 +1,123 @@
+"""Search-tree facades with the reference API, backed by TPU kernels.
+
+The reference wraps CGAL AABB trees behind lazy imports of compiled modules
+(mesh/search.py:19-100).  Here the same class names and `nearest(...)` return
+conventions are kept — including the reference's (1, S) row-vector index
+shapes — but "building the tree" is just capturing device arrays; queries run
+the jit'd brute-force kernels in mesh_tpu.query (no tree is needed at
+SMPL-scale, SURVEY.md section 7.1).
+"""
+
+import numpy as np
+
+from . import query
+
+__all__ = ["AabbTree", "AabbNormalsTree", "ClosestPointTree", "CGALClosestPointTree"]
+
+_NO_HIT_SENTINEL = 1e100  # reference spatialsearchmodule.cpp:309-311
+
+
+def _mesh_vf(m):
+    v = np.asarray(m.v, dtype=np.float32)
+    f = np.asarray(m.f, dtype=np.int32)
+    return v, f
+
+
+class AabbTree(object):
+    """Closest-point / ray / intersection queries against a mesh
+    (reference search.py:19-49)."""
+
+    def __init__(self, m):
+        self.v, self.f = _mesh_vf(m)
+
+    def nearest(self, v_samples, nearest_part=False):
+        """nearest_part tells you whether the closest point in triangle abc
+        is in the interior (0), on an edge (ab:1, bc:2, ca:3), or a vertex
+        (a:4, b:5, c:6)."""
+        pts = np.asarray(v_samples, dtype=np.float32).reshape(-1, 3)
+        res = query.closest_faces_and_points(self.v, self.f, pts)
+        f_idxs = np.asarray(res["face"]).astype(np.uint32).reshape(1, -1)
+        f_part = np.asarray(res["part"]).astype(np.uint32).reshape(1, -1)
+        v_out = np.asarray(res["point"], dtype=np.float64)
+        return (f_idxs, f_part, v_out) if nearest_part else (f_idxs, v_out)
+
+    def nearest_alongnormal(self, points, normals):
+        dist, f_idxs, v_out = query.nearest_alongnormal(
+            self.v, self.f,
+            np.asarray(points, np.float32).reshape(-1, 3),
+            np.asarray(normals, np.float32).reshape(-1, 3),
+        )
+        dist = np.asarray(dist, dtype=np.float64)
+        dist[~np.isfinite(dist)] = _NO_HIT_SENTINEL
+        return (
+            dist,
+            np.asarray(f_idxs).astype(np.uint32),
+            np.asarray(v_out, dtype=np.float64),
+        )
+
+    def intersections_indices(self, q_v, q_f):
+        """Indices into q_f of query faces intersecting the mesh
+        (reference search.py:39-49; fixed-shape mask kernel + host nonzero)."""
+        mask = query.intersections_mask(
+            self.v, self.f,
+            np.asarray(q_v, np.float32), np.asarray(q_f, np.int32),
+        )
+        return np.nonzero(np.asarray(mask))[0]
+
+
+class ClosestPointTree(object):
+    """Nearest-vertex queries (reference search.py:52-65, scipy KDTree with a
+    per-point Python loop — here one vectorized kernel call)."""
+
+    def __init__(self, m):
+        self.v = np.asarray(m.v)
+        self._v32 = self.v.astype(np.float32)
+
+    def nearest(self, v_samples):
+        idx, dist = query.closest_vertices_with_distance(
+            self._v32, np.asarray(v_samples, np.float32).reshape(-1, 3)
+        )
+        return np.asarray(idx), np.asarray(dist, dtype=np.float64)
+
+    def nearest_vertices(self, v_samples):
+        idx, _ = self.nearest(v_samples)
+        return self.v[idx]
+
+
+class CGALClosestPointTree(object):
+    """Reference search.py:68-86 builds a degenerate-triangle CGAL tree to get
+    vertex-only NN; the kernel is the same as ClosestPointTree here."""
+
+    def __init__(self, m):
+        self.v = np.asarray(m.v)
+        self._v32 = self.v.astype(np.float32)
+
+    def nearest(self, v_samples):
+        idx, dist = query.closest_vertices_with_distance(
+            self._v32, np.asarray(v_samples, np.float32).reshape(-1, 3)
+        )
+        return np.asarray(idx).flatten(), np.asarray(dist, dtype=np.float64).flatten()
+
+    def nearest_vertices(self, v_samples):
+        return self.v[self.nearest(v_samples)[0]]
+
+
+class AabbNormalsTree(object):
+    """Normal-weighted NN (reference search.py:89-100; eps weights the normal
+    agreement term)."""
+
+    def __init__(self, m, eps=0.1):
+        self.v, self.f = _mesh_vf(m)
+        self.eps = eps
+
+    def nearest(self, v_samples, n_samples):
+        face, point = query.nearest_normal_weighted(
+            self.v, self.f,
+            np.asarray(v_samples, np.float32).reshape(-1, 3),
+            np.asarray(n_samples, np.float32).reshape(-1, 3),
+            eps=self.eps,
+        )
+        return (
+            np.asarray(face).astype(np.uint32).reshape(-1, 1),
+            np.asarray(point, dtype=np.float64),
+        )

@@ -1,0 +1,3 @@
+from . import serialization  # noqa: F401
+from .ply import read_ply, write_ply_data  # noqa: F401
+from .obj import load_obj, write_obj_data  # noqa: F401

@@ -1,0 +1,16 @@
+from .connectivity import (  # noqa: F401
+    get_vert_opposites_per_edge,
+    get_vert_connectivity,
+    get_vertices_per_edge,
+    get_faces_per_edge,
+    vertices_to_edges_matrix,
+    vertices_in_common,
+)
+from .decimation import (  # noqa: F401
+    qslim_decimator,
+    qslim_decimator_transformer,
+    vertex_quadrics,
+    remove_redundant_verts,
+)
+from .subdivision import loop_subdivider  # noqa: F401
+from .linear_mesh_transform import LinearMeshTransform  # noqa: F401

@@ -1,0 +1,85 @@
+"""Callable sparse vertex->vertex resolution transform
+(reference mesh/topology/linear_mesh_transform.py).
+
+Wraps the sparse up/downsample matrix produced by loop_subdivider /
+qslim_decimator.  Applied to a Mesh it returns the remeshed Mesh; applied to
+a raw array it returns the mapped flat coordinates; with want_edges=True it
+returns per-edge difference vectors.  `as_dense_gather()` exports the
+transform as device arrays for on-TPU application inside jitted pipelines.
+"""
+
+import numpy as np
+
+from ..utils import col, row
+from .connectivity import vertices_to_edges_matrix
+
+
+class LinearMeshTransform(object):
+    def __init__(self, mtx, faces, vt=None, ft=None):
+        from ..mesh import Mesh
+
+        self.mtx = mtx
+        self.faces = faces
+        self.remeshed_vtx_to_remeshed_edge_mtx = vertices_to_edges_matrix(
+            Mesh(f=faces, v=np.zeros((mtx.shape[0], 3))), want_xyz=True
+        )
+        self.vtx_to_edge_mtx = self.remeshed_vtx_to_remeshed_edge_mtx.dot(self.mtx)
+        if vt is not None:
+            self.vt = vt
+        if ft is not None:
+            self.ft = ft
+
+    def as_coo_arrays(self):
+        """(rows, cols, vals) int32/int32/float32 device-ready COO triplets,
+        for applying the transform with jax segment_sum inside jit."""
+        coo = self.mtx.tocoo()
+        return (
+            np.asarray(coo.row, np.int32),
+            np.asarray(coo.col, np.int32),
+            np.asarray(coo.data, np.float32),
+        )
+
+    def __call__(self, a, want_edges=False):
+        from ..mesh import Mesh
+
+        if not isinstance(a, Mesh):
+            return self.chained_obj_for(a, want_edges)
+
+        a_is_subdivided = a.v.size == self.mtx.shape[0]
+        if want_edges:
+            if a_is_subdivided:
+                return self.remeshed_vtx_to_remeshed_edge_mtx.dot(
+                    col(a.v)
+                ).reshape((-1, 3))
+            return self.vtx_to_edge_mtx.dot(col(a.v)).reshape((-1, 3))
+
+        if a_is_subdivided:
+            return a
+        result = Mesh(
+            v=self.mtx.dot(col(a.v)).reshape((-1, 3)), f=self.faces.copy()
+        )
+        if hasattr(a, "segm"):
+            result.transfer_segm(a)
+        if hasattr(a, "landm"):
+            result.landm = dict(
+                (k, np.argmin(np.sum((result.v - row(a.v[v])) ** 2, axis=1)))
+                for k, v in a.landm.items()
+            )
+        if hasattr(self, "ft"):
+            result.ft = self.ft
+        if hasattr(self, "vt"):
+            result.vt = self.vt
+        return result
+
+    def chained_obj_for(self, a, want_edges):
+        a_len = len(a.r) if hasattr(a, "r") else a.size
+        a_is_subdivided = a_len == self.mtx.shape[0]
+        if a_is_subdivided and not want_edges:
+            return a
+        if not want_edges:
+            mtx = self.mtx
+        elif a_is_subdivided:
+            mtx = self.remeshed_vtx_to_remeshed_edge_mtx
+        else:
+            mtx = self.vtx_to_edge_mtx
+        return mtx.dot(col(np.asarray(a))).flatten()

@@ -1,0 +1,1 @@
+from .arrays import row, col, sparse, asarray_f32, asarray_i32  # noqa: F401

@@ -1,0 +1,8 @@
+from .meshviewer import (  # noqa: F401
+    Dummy,
+    MeshViewer,
+    MeshViewerLocal,
+    MeshViewers,
+    MeshSubwindow,
+    test_for_opengl,
+)

@@ -1,0 +1,103 @@
+"""Quaternion trackball ("arcball") math for viewer rotation
+(reference mesh/arcball.py — same behavior, quaternion-based implementation).
+
+Screen drags map to rotations: a click picks a point on a virtual sphere
+behind the viewport, a drag to a second point defines the great-circle
+rotation between them.
+"""
+
+import numpy as np
+
+# typed constructors kept for reference API familiarity (arcball.py:110-180)
+def Matrix4fT():
+    return np.identity(4, "f")
+
+
+def Matrix3fT():
+    return np.identity(3, "f")
+
+
+def Quat4fT():
+    return np.zeros(4, "f")
+
+
+def Vector3fT():
+    return np.zeros(3, "f")
+
+
+def Point2fT(x=0.0, y=0.0):
+    return np.array([x, y], "f")
+
+
+class ArcBallT:
+    """Maps 2D viewport points onto a unit sphere and drags into quaternions
+    (reference arcball.py:54-107)."""
+
+    def __init__(self, width, height):
+        self.start_vec = Vector3fT()
+        self.setBounds(width, height)
+
+    def setBounds(self, width, height):
+        if width <= 1.0 or height <= 1.0:
+            raise ValueError("arcball viewport must be larger than 1x1")
+        self.adjust_width = 1.0 / ((width - 1.0) * 0.5)
+        self.adjust_height = 1.0 / ((height - 1.0) * 0.5)
+
+    def _map_to_sphere(self, pt):
+        # scale to [-1, 1] with y up
+        x = pt[0] * self.adjust_width - 1.0
+        y = 1.0 - pt[1] * self.adjust_height
+        r2 = x * x + y * y
+        if r2 > 1.0:
+            norm = 1.0 / np.sqrt(r2)
+            return np.array([x * norm, y * norm, 0.0], "f")
+        return np.array([x, y, np.sqrt(1.0 - r2)], "f")
+
+    def click(self, pt):
+        self.start_vec = self._map_to_sphere(pt)
+
+    def drag(self, pt):
+        """Quaternion [x, y, z, w] rotating start_vec to the current point."""
+        end_vec = self._map_to_sphere(pt)
+        perp = np.cross(self.start_vec, end_vec)
+        if np.linalg.norm(perp) > 1.0e-5:
+            q = np.zeros(4, "f")
+            q[:3] = perp
+            q[3] = np.dot(self.start_vec, end_vec)
+            return q
+        return np.zeros(4, "f")
+
+
+def Matrix3fSetRotationFromQuat4f(q):
+    """3x3 rotation from quaternion [x, y, z, w]
+    (reference arcball.py:204-247)."""
+    n = np.dot(q, q)
+    if n < np.finfo(float).eps:
+        return np.identity(3, "f")
+    x, y, z, w = q * np.sqrt(2.0 / n)
+    R = np.array(
+        [
+            [1.0 - (y * y + z * z), x * y - w * z, x * z + w * y],
+            [x * y + w * z, 1.0 - (x * x + z * z), y * z - w * x],
+            [x * z - w * y, y * z + w * x, 1.0 - (x * x + y * y)],
+        ],
+        "f",
+    )
+    # reference stores row-major "transposed" layout for OpenGL; match it
+    return R.T
+
+
+def Matrix3fMulMatrix3f(a, b):
+    return np.matmul(a, b)
+
+
+def Matrix4fSetRotationScaleFromMatrix3f(NewObj, three_x_three_matrix):
+    NewObj[0:3, 0:3] = three_x_three_matrix
+    return NewObj
+
+
+def Matrix4fSetRotationFromMatrix3f(NewObj, three_x_three_matrix):
+    """Insert a 3x3 rotation into a 4x4 matrix preserving its uniform scale
+    (reference arcball.py:185-201: scale recovered via SVD)."""
+    scale = np.linalg.svd(NewObj[0:3, 0:3])[1].mean()
+    return Matrix4fSetRotationScaleFromMatrix3f(NewObj, three_x_three_matrix * scale)

@@ -1,0 +1,57 @@
+"""Text -> OpenGL texture rendering for vertex labels
+(reference mesh/fonts.py: PIL-drawn text uploaded as a GL texture, cached by
+string crc32)."""
+
+import zlib
+
+import numpy as np
+
+_texture_cache = {}
+
+
+def get_image_with_text(text, fgcolor, bgcolor):
+    """Render text to a numpy uint8 image with PIL
+    (reference fonts.py:22-47)."""
+    from PIL import Image, ImageDraw, ImageFont
+
+    try:
+        font = ImageFont.truetype("DejaVuSans.ttf", 100)
+    except OSError:
+        font = ImageFont.load_default()
+    bg = tuple(int(c * 255) for c in bgcolor)
+    fg = tuple(int(c * 255) for c in fgcolor)
+    probe = Image.new("RGB", (1, 1))
+    bbox = ImageDraw.Draw(probe).textbbox((0, 0), text, font=font)
+    w, h = bbox[2] - bbox[0], bbox[3] - bbox[1]
+    img = Image.new("RGB", (w + 20, h + 20), bg)
+    ImageDraw.Draw(img).text((10 - bbox[0], 10 - bbox[1]), text, fill=fg, font=font)
+    return np.asarray(img)
+
+
+def get_textureid_with_text(text, fgcolor, bgcolor):
+    """Upload (and cache) a text image as a GL texture; returns the texture id
+    (reference fonts.py:50-87)."""
+    from OpenGL.GL import (
+        GL_LINEAR, GL_LINEAR_MIPMAP_LINEAR, GL_RGB, GL_TEXTURE_2D,
+        GL_TEXTURE_MAG_FILTER, GL_TEXTURE_MIN_FILTER, GL_UNSIGNED_BYTE,
+        glBindTexture, glGenTextures, glTexParameterf,
+    )
+    from OpenGL.GLU import gluBuild2DMipmaps
+
+    key = zlib.crc32(
+        text.encode() + np.asarray(fgcolor, "f").tobytes() + np.asarray(bgcolor, "f").tobytes()
+    )
+    if key in _texture_cache:
+        return _texture_cache[key]
+
+    im = get_image_with_text(text, fgcolor, bgcolor)
+    texture_id = glGenTextures(1)
+    glBindTexture(GL_TEXTURE_2D, texture_id)
+    glTexParameterf(GL_TEXTURE_2D, GL_TEXTURE_MAG_FILTER, GL_LINEAR)
+    glTexParameterf(GL_TEXTURE_2D, GL_TEXTURE_MIN_FILTER, GL_LINEAR_MIPMAP_LINEAR)
+    gluBuild2DMipmaps(
+        GL_TEXTURE_2D, GL_RGB, im.shape[1], im.shape[0], GL_RGB,
+        GL_UNSIGNED_BYTE, np.ascontiguousarray(im),
+    )
+    _texture_cache[key] = texture_id
+    return texture_id

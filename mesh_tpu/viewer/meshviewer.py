@@ -1,0 +1,325 @@
+"""Multi-process mesh viewer, client side
+(reference mesh/meshviewer.py:144-905).
+
+Architecture preserved from the reference (SURVEY.md P4): the client forks a
+render-server process (`python -m mesh_tpu.viewer.server`), reads a
+``<PORT>nnnn</PORT>`` handshake from its stdout, and pushes pickled command
+dicts ``{label, obj, which_window, port}`` over a ZMQ PUSH socket; blocking
+calls open an ephemeral PULL socket and wait for the server's ack.  Device
+arrays (jax) are converted to numpy at this boundary.  With no usable
+OpenGL, `MeshViewer(s)` degrade to a `Dummy` no-op object
+(reference meshviewer.py:144-156).
+"""
+
+import logging
+import pickle
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+ZMQ_HOST = "127.0.0.1"
+
+
+def _run_server_process(args):
+    """Fork the render server; returns the Popen object
+    (reference meshviewer.py:87-94 forks `python -m ...meshviewer`).
+
+    The child must be able to import mesh_tpu even when the package is used
+    from a source tree rather than installed, so the parent's package root is
+    prepended to the child's PYTHONPATH."""
+    import os
+
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+    cmd = [sys.executable, "-m", "mesh_tpu.viewer.server"] + [str(a) for a in args]
+    return subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True, env=env
+    )
+
+
+def test_for_opengl():
+    """Probe OpenGL availability in a throwaway subprocess
+    (reference meshviewer.py:111-141)."""
+    p = _run_server_process(["TEST_FOR_OPENGL"])
+    try:
+        out, _ = p.communicate(timeout=20)
+    except subprocess.TimeoutExpired:
+        p.kill()
+        return False
+    return "success" in (out or "")
+
+
+class Dummy(object):
+    """No-op stand-in when OpenGL is unavailable
+    (reference meshviewer.py:144-156)."""
+
+    def __getattr__(self, name):
+        return Dummy()
+
+    def __call__(self, *args, **kwargs):
+        return Dummy()
+
+    def __getitem__(self, key):
+        return Dummy()
+
+    def __setitem__(self, key, value):
+        pass
+
+
+def MeshViewer(titlebar="Mesh Viewer", static_meshes=None, static_lines=None,
+               uid=None, autorecenter=True, shape=(1, 1), keepalive=True,
+               window_width=1280, window_height=960, snapshot_camera=None):
+    """Single-window viewer factory (reference meshviewer.py:159-201)."""
+    if not test_for_opengl():
+        return Dummy()
+    mv = MeshViewerLocal(
+        shape=(1, 1), uid=uid, titlebar=titlebar, keepalive=keepalive,
+        window_width=window_width, window_height=window_height,
+    )
+    result = mv.get_subwindows()[0][0]
+    if static_meshes is not None:
+        result.static_meshes = static_meshes
+    if static_lines is not None:
+        result.static_lines = static_lines
+    result.autorecenter = autorecenter
+    return result
+
+
+def MeshViewers(shape=(1, 1), titlebar="Mesh Viewers", keepalive=True,
+                window_width=1280, window_height=960):
+    """Grid-of-subwindows viewer factory (reference meshviewer.py:204-227)."""
+    if not test_for_opengl():
+        return Dummy()
+    mv = MeshViewerLocal(
+        shape=shape, titlebar=titlebar, uid=None, keepalive=keepalive,
+        window_width=window_width, window_height=window_height,
+    )
+    return mv.get_subwindows()
+
+
+class MeshSubwindow(object):
+    """Client handle to one subwindow of a viewer grid
+    (reference meshviewer.py:230-288)."""
+
+    def __init__(self, parent_window, which_window):
+        self.parent_window = parent_window
+        self.which_window = which_window
+
+    def _send(self, label, obj=None, blocking=False):
+        self.parent_window._send_pyobj(label, obj, blocking, self.which_window)
+
+    def set_dynamic_meshes(self, meshes, blocking=False):
+        self._send("dynamic_meshes", meshes, blocking)
+
+    def set_static_meshes(self, meshes, blocking=False):
+        self._send("static_meshes", meshes, blocking)
+
+    # same as set_dynamic_meshes, kept for reference compat: dynamic models
+    # (body-model wrappers exposing .r as vertices) are sanitized client-side
+    def set_dynamic_models(self, models, blocking=False):
+        self._send("dynamic_meshes", models, blocking)
+
+    def set_dynamic_lines(self, lines, blocking=False):
+        self._send("dynamic_lines", lines, blocking)
+
+    def set_static_lines(self, lines, blocking=False):
+        self._send("static_lines", lines, blocking)
+
+    def set_titlebar(self, titlebar, blocking=False):
+        self._send("titlebar", titlebar, blocking)
+
+    def set_lighting_on(self, lighting_on, blocking=False):
+        self._send("lighting_on", lighting_on, blocking)
+
+    def set_autorecenter(self, autorecenter, blocking=False):
+        self._send("autorecenter", autorecenter, blocking)
+
+    def set_background_color(self, background_color, blocking=False):
+        self._send("background_color", np.asarray(background_color, np.float64), blocking)
+
+    def save_snapshot(self, path, blocking=False):
+        self.parent_window.save_snapshot(path, blocking)
+
+    def get_keypress(self):
+        return self.parent_window.get_keypress()
+
+    def get_mouseclick(self):
+        return self.parent_window.get_mouseclick()
+
+    background_color = property(
+        fset=lambda self, v: self.set_background_color(v), doc="Background color (r, g, b)"
+    )
+    dynamic_meshes = property(fset=set_dynamic_meshes, doc="Dynamic meshes")
+    static_meshes = property(fset=set_static_meshes, doc="Static meshes")
+    dynamic_models = property(fset=set_dynamic_models, doc="Dynamic models")
+    dynamic_lines = property(fset=set_dynamic_lines, doc="Dynamic lines")
+    static_lines = property(fset=set_static_lines, doc="Static lines")
+    titlebar = property(fset=set_titlebar, doc="Titlebar text")
+    lighting_on = property(fset=set_lighting_on, doc="Lighting on/off")
+    autorecenter = property(fset=set_autorecenter, doc="Autorecenter on/off")
+
+
+def _sanitize_meshes(mesh_list):
+    """Strip device arrays / lazy members down to picklable numpy attributes
+    (reference meshviewer.py:742-768)."""
+    from ..lines import Lines
+    from ..mesh import Mesh
+
+    sanitized = []
+    for m in mesh_list or []:
+        if hasattr(m, "e"):
+            out = Lines(v=np.asarray(m.v, np.float64), e=np.asarray(m.e))
+            if hasattr(m, "vc"):
+                out.vc = np.asarray(m.vc)
+            if hasattr(m, "ec"):
+                out.ec = np.asarray(m.ec)
+        else:
+            # models expose vertices as .r (chumpy convention); meshes as .v
+            verts = m.r if hasattr(m, "r") else m.v
+            out = Mesh(v=np.asarray(verts, np.float64))
+            if hasattr(m, "f"):
+                out.f = np.asarray(m.f, np.uint32)
+            for attr in ("vc", "fc", "vn", "vt", "ft"):
+                if hasattr(m, attr):
+                    setattr(out, attr, np.asarray(getattr(m, attr)))
+            for attr in ("texture_filepath", "v_to_text"):
+                if hasattr(m, attr):
+                    setattr(out, attr, getattr(m, attr))
+        sanitized.append(out)
+    return sanitized
+
+
+class MeshViewerLocal(object):
+    """Proxy to a forked render-server process
+    (reference meshviewer.py:657-905)."""
+
+    managed_viewers = {}  # uid -> (process, port), reused across calls
+
+    def __init__(self, shape=(1, 1), titlebar="Mesh Viewer", uid=None,
+                 keepalive=False, window_width=1280, window_height=960):
+        import zmq
+
+        if uid is not None and uid in MeshViewerLocal.managed_viewers:
+            self.p, self.port = MeshViewerLocal.managed_viewers[uid]
+        else:
+            self.p = _run_server_process(
+                [titlebar, shape[0], shape[1], window_width, window_height]
+            )
+            self.port = self._read_port_handshake(self.p)
+            if uid is not None:
+                MeshViewerLocal.managed_viewers[uid] = (self.p, self.port)
+        self.shape = shape
+        self.keepalive = keepalive
+        self.context = zmq.Context.instance()
+        self.client = self.context.socket(zmq.PUSH)
+        self.client.linger = 0
+        self.client.connect("tcp://%s:%d" % (ZMQ_HOST, self.port))
+        log.info("connected to mesh viewer server on port %d", self.port)
+
+    @staticmethod
+    def _read_port_handshake(process, timeout=30.0):
+        """Parse '<PORT>nnnn</PORT>' from server stdout
+        (reference meshviewer.py:722-728 / 937-940)."""
+        import re
+
+        deadline = time.time() + timeout
+        buf = ""
+        while time.time() < deadline:
+            line = process.stdout.readline()
+            if not line:
+                time.sleep(0.05)
+                continue
+            buf += line
+            m = re.search(r"<PORT>(\d+)</PORT>", buf)
+            if m:
+                return int(m.group(1))
+        raise RuntimeError("mesh viewer server did not report a port")
+
+    def _send_pyobj(self, label, obj=None, blocking=False, which_window=(0, 0)):
+        """Push one pickled command; optionally wait for the server ack on an
+        ephemeral PULL socket (reference meshviewer.py:770-804)."""
+        import zmq
+
+        if label in ("dynamic_meshes", "static_meshes"):
+            obj = _sanitize_meshes(obj)
+        msg = {"label": label, "obj": obj, "which_window": which_window}
+        if blocking:
+            ack = self.context.socket(zmq.PULL)
+            ack_port = ack.bind_to_random_port("tcp://%s" % ZMQ_HOST)
+            msg["port"] = ack_port
+            self.client.send_pyobj(msg)
+            poller = zmq.Poller()
+            poller.register(ack, zmq.POLLIN)
+            if poller.poll(30000):
+                task_time = ack.recv_pyobj()
+                log.debug("task %s took %.2e s", label, task_time)
+            ack.close()
+        else:
+            self.client.send_pyobj(msg)
+
+    def _recv_reply(self, label, which_window=(0, 0)):
+        """Round-trip request returning data from the server (keypress etc.)."""
+        import zmq
+
+        sock = self.context.socket(zmq.PULL)
+        port = sock.bind_to_random_port("tcp://%s" % ZMQ_HOST)
+        self.client.send_pyobj(
+            {"label": label, "obj": None, "which_window": which_window, "port": port}
+        )
+        poller = zmq.Poller()
+        poller.register(sock, zmq.POLLIN)
+        result = sock.recv_pyobj() if poller.poll(600000) else None
+        sock.close()
+        return result
+
+    def get_subwindows(self):
+        return [
+            [MeshSubwindow(self, (r, c)) for c in range(self.shape[1])]
+            for r in range(self.shape[0])
+        ]
+
+    def get_keypress(self):
+        return self._recv_reply("get_keypress")
+
+    def get_mouseclick(self):
+        """Returns a dict with subwindow indices and the clicked 3D point
+        (reference meshviewer.py:855-868)."""
+        return self._recv_reply("get_mouseclick")
+
+    def get_event(self):
+        return self._recv_reply("get_event")
+
+    def save_snapshot(self, path, blocking=False):
+        print("Saving snapshot to %s, please wait..." % path)
+        self._send_pyobj("save_snapshot", path, blocking)
+
+    def set_dynamic_meshes(self, meshes, blocking=False):
+        self._send_pyobj("dynamic_meshes", meshes, blocking)
+
+    def set_static_meshes(self, meshes, blocking=False):
+        self._send_pyobj("static_meshes", meshes, blocking)
+
+    def set_dynamic_lines(self, lines, blocking=False):
+        self._send_pyobj("dynamic_lines", lines, blocking)
+
+    def set_static_lines(self, lines, blocking=False):
+        self._send_pyobj("static_lines", lines, blocking)
+
+    def set_titlebar(self, titlebar, blocking=False):
+        self._send_pyobj("titlebar", titlebar, blocking)
+
+    def close(self):
+        if not self.keepalive:
+            self.p.terminate()
+
+    def __del__(self):
+        try:
+            if not self.keepalive:
+                self.p.terminate()
+        except Exception:
+            pass

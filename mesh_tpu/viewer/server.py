@@ -1,0 +1,435 @@
+"""Mesh viewer render server (reference mesh/meshviewer.py:907-1274).
+
+Run as ``python -m mesh_tpu.viewer.server <titlebar> <nx> <ny> <w> <h>``:
+binds a ZMQ PULL socket on a random port, prints ``<PORT>nnnn</PORT>`` on
+stdout for the client handshake, then enters a GLUT main loop polling the
+socket on a 20 ms timer.  `TEST_FOR_OPENGL` mode just probes GL context
+creation and prints success/failure (reference meshviewer.py:96-108).
+"""
+
+import sys
+import time
+import traceback
+
+import numpy as np
+
+from .arcball import (
+    ArcBallT,
+    Matrix3fMulMatrix3f,
+    Matrix3fSetRotationFromQuat4f,
+    Matrix4fSetRotationFromMatrix3f,
+    Matrix4fT,
+    Point2fT,
+)
+
+ZMQ_HOST = "127.0.0.1"
+
+
+class Subwindow(object):
+    """Per-subwindow scene + camera state."""
+
+    def __init__(self):
+        self.dynamic_meshes = []
+        self.static_meshes = []
+        self.dynamic_lines = []
+        self.static_lines = []
+        self.lighting_on = True
+        self.autorecenter = True
+        self.background_color = np.array([0.3, 0.5, 0.7])
+        self.transform = Matrix4fT()
+        self.arcball = ArcBallT(640, 480)
+        self.isdragging = False
+        self.scale = 1.0
+        self.translation = np.zeros(3)
+
+    def all_meshes(self):
+        return self.dynamic_meshes + self.static_meshes
+
+    def all_lines(self):
+        return self.dynamic_lines + self.static_lines
+
+
+class MeshViewerRemote(object):
+    def __init__(self, titlebar="Mesh Viewer", nx=1, ny=1, width=1280, height=960):
+        import zmq
+
+        context = zmq.Context.instance()
+        self.socket = context.socket(zmq.PULL)
+        self.port = self.socket.bind_to_random_port("tcp://%s" % ZMQ_HOST)
+        # handshake BEFORE GL init so the client never blocks on a dead pipe
+        # (reference meshviewer.py:937-940)
+        sys.stdout.write("<PORT>%d</PORT>\n" % self.port)
+        sys.stdout.flush()
+
+        self.shape = (int(nx), int(ny))
+        self.subwindows = [
+            [Subwindow() for _ in range(self.shape[1])] for _ in range(self.shape[0])
+        ]
+        self.titlebar = titlebar
+        self.width = int(width)
+        self.height = int(height)
+        self.need_redraw = True
+        self.keypress_queue = []
+        self.mouseclick_queue = []
+        self.pending_keypress_port = None
+        self.pending_mouseclick_port = None
+        self.context = context
+        self.init_opengl()
+        self.activate()
+
+    # ------------------------------------------------------------------
+    # GLUT setup / main loop
+
+    def init_opengl(self):
+        from OpenGL.GL import (
+            GL_BLEND, GL_COLOR_MATERIAL, GL_DEPTH_TEST, GL_LEQUAL, GL_LIGHT0,
+            GL_LIGHTING, GL_NICEST, GL_ONE_MINUS_SRC_ALPHA,
+            GL_PERSPECTIVE_CORRECTION_HINT, GL_POSITION, GL_SMOOTH,
+            GL_SRC_ALPHA, glBlendFunc, glClearColor, glClearDepth,
+            glDepthFunc, glEnable, glHint, glLightfv, glShadeModel,
+        )
+        from OpenGL.GLUT import (
+            GLUT_DEPTH, GLUT_DOUBLE, GLUT_RGB, glutCreateWindow,
+            glutDisplayFunc, glutInit, glutInitDisplayMode,
+            glutInitWindowSize, glutKeyboardFunc, glutMotionFunc,
+            glutMouseFunc, glutReshapeFunc, glutTimerFunc,
+        )
+
+        glutInit([])
+        glutInitDisplayMode(GLUT_RGB | GLUT_DOUBLE | GLUT_DEPTH)
+        glutInitWindowSize(self.width, self.height)
+        glutCreateWindow(self.titlebar)
+        glutDisplayFunc(self.on_draw)
+        glutReshapeFunc(self.on_resize)
+        glutKeyboardFunc(self.on_keypress)
+        glutMouseFunc(self.on_click)
+        glutMotionFunc(self.on_drag)
+        glutTimerFunc(20, self.check_queue, 0)
+
+        glClearColor(0.3, 0.5, 0.7, 1.0)
+        glClearDepth(1.0)
+        glDepthFunc(GL_LEQUAL)
+        glEnable(GL_DEPTH_TEST)
+        glShadeModel(GL_SMOOTH)
+        glHint(GL_PERSPECTIVE_CORRECTION_HINT, GL_NICEST)
+        glEnable(GL_COLOR_MATERIAL)
+        glEnable(GL_LIGHT0)
+        glEnable(GL_LIGHTING)
+        glLightfv(GL_LIGHT0, GL_POSITION, [0.0, 0.0, 10.0, 0.0])
+        glEnable(GL_BLEND)
+        glBlendFunc(GL_SRC_ALPHA, GL_ONE_MINUS_SRC_ALPHA)
+
+    def activate(self):
+        from OpenGL.GLUT import glutMainLoop
+
+        glutMainLoop()
+
+    # ------------------------------------------------------------------
+    # ZMQ polling (reference checkQueue, meshviewer.py:1205-1237)
+
+    def check_queue(self, _=0):
+        import zmq
+        from OpenGL.GLUT import glutPostRedisplay, glutTimerFunc
+
+        try:
+            while True:
+                try:
+                    msg = self.socket.recv_pyobj(zmq.NOBLOCK)
+                except zmq.Again:
+                    break
+                t0 = time.time()
+                self.handle_request(msg)
+                if msg.get("port") is not None and msg["label"] not in (
+                    "get_keypress", "get_mouseclick", "get_event"
+                ):
+                    push = self.context.socket(zmq.PUSH)
+                    push.connect("tcp://%s:%d" % (ZMQ_HOST, msg["port"]))
+                    push.send_pyobj(time.time() - t0)
+                    push.close()
+        except Exception:
+            traceback.print_exc()
+        if self.need_redraw:
+            glutPostRedisplay()
+            self.need_redraw = False
+        glutTimerFunc(20, self.check_queue, 0)
+
+    def handle_request(self, msg):
+        """Command dispatch (reference meshviewer.py:1150-1203)."""
+        label = msg["label"]
+        obj = msg.get("obj")
+        r, c = msg.get("which_window", (0, 0))
+        sub = self.subwindows[r][c]
+        if label == "dynamic_meshes":
+            sub.dynamic_meshes = obj
+        elif label == "static_meshes":
+            sub.static_meshes = obj
+        elif label == "dynamic_lines":
+            sub.dynamic_lines = obj or []
+        elif label == "static_lines":
+            sub.static_lines = obj or []
+        elif label == "titlebar":
+            from OpenGL.GLUT import glutSetWindowTitle
+
+            glutSetWindowTitle(obj)
+        elif label == "background_color":
+            sub.background_color = np.asarray(obj)
+        elif label == "autorecenter":
+            sub.autorecenter = bool(obj)
+        elif label == "lighting_on":
+            sub.lighting_on = bool(obj)
+        elif label == "save_snapshot":
+            self.save_snapshot(obj)
+        elif label == "get_keypress":
+            self.pending_keypress_port = msg.get("port")
+            self._flush_keypress()
+            return
+        elif label == "get_mouseclick":
+            self.pending_mouseclick_port = msg.get("port")
+            self._flush_mouseclick()
+            return
+        self.need_redraw = True
+
+    def _reply(self, port, obj):
+        import zmq
+
+        push = self.context.socket(zmq.PUSH)
+        push.connect("tcp://%s:%d" % (ZMQ_HOST, port))
+        push.send_pyobj(obj)
+        push.close()
+
+    def _flush_keypress(self):
+        if self.pending_keypress_port is not None and self.keypress_queue:
+            self._reply(self.pending_keypress_port, self.keypress_queue.pop(0))
+            self.pending_keypress_port = None
+
+    def _flush_mouseclick(self):
+        if self.pending_mouseclick_port is not None and self.mouseclick_queue:
+            self._reply(self.pending_mouseclick_port, self.mouseclick_queue.pop(0))
+            self.pending_mouseclick_port = None
+
+    # ------------------------------------------------------------------
+    # Events
+
+    def on_keypress(self, key, x, y):
+        self.keypress_queue.append(key.decode() if isinstance(key, bytes) else key)
+        self._flush_keypress()
+
+    def _subwindow_at(self, x, y):
+        nx, ny = self.shape
+        w_sub = self.width // ny
+        h_sub = self.height // nx
+        c = min(x // max(w_sub, 1), ny - 1)
+        r = min(y // max(h_sub, 1), nx - 1)
+        return int(r), int(c)
+
+    def on_click(self, button, button_state, x, y):
+        """Left drag rotates via arcball; clicks are unprojected to 3D and
+        queued for get_mouseclick (reference meshviewer.py:1039-1120)."""
+        r, c = self._subwindow_at(x, y)
+        sub = self.subwindows[r][c]
+        if button_state == 0:  # press
+            if self.pending_mouseclick_port is not None:
+                point = self.unproject(x, y)
+                self.mouseclick_queue.append(
+                    {"which_subwindow": (r, c), "point": point}
+                )
+                self._flush_mouseclick()
+            sub.isdragging = True
+            sub.arcball.setBounds(self.width, self.height)
+            sub.arcball.click(Point2fT(x, y))
+            self._drag_start_transform = sub.transform.copy()
+        else:
+            sub.isdragging = False
+
+    def on_drag(self, x, y):
+        for row in self.subwindows:
+            for sub in row:
+                if sub.isdragging:
+                    quat = sub.arcball.drag(Point2fT(x, y))
+                    rot3 = Matrix3fSetRotationFromQuat4f(quat)
+                    base = self._drag_start_transform
+                    combined = Matrix3fMulMatrix3f(rot3, base[0:3, 0:3])
+                    sub.transform = Matrix4fSetRotationFromMatrix3f(
+                        base.copy(), combined
+                    )
+                    self.need_redraw = True
+
+    def unproject(self, x, y):
+        from OpenGL.GL import (
+            GL_DEPTH_COMPONENT, GL_FLOAT, GL_MODELVIEW_MATRIX,
+            GL_PROJECTION_MATRIX, GL_VIEWPORT, glGetDoublev, glGetIntegerv,
+            glReadPixels,
+        )
+        from OpenGL.GLU import gluUnProject
+
+        modelview = glGetDoublev(GL_MODELVIEW_MATRIX)
+        projection = glGetDoublev(GL_PROJECTION_MATRIX)
+        viewport = glGetIntegerv(GL_VIEWPORT)
+        win_y = viewport[3] - y
+        depth = glReadPixels(x, win_y, 1, 1, GL_DEPTH_COMPONENT, GL_FLOAT)
+        return np.array(
+            gluUnProject(x, win_y, float(depth[0][0]), modelview, projection, viewport)
+        )
+
+    def on_resize(self, width, height):
+        from OpenGL.GL import glViewport
+
+        self.width, self.height = width, height
+        glViewport(0, 0, width, height)
+        self.need_redraw = True
+
+    # ------------------------------------------------------------------
+    # Drawing
+
+    def on_draw(self):
+        from OpenGL.GL import (
+            GL_COLOR_BUFFER_BIT, GL_DEPTH_BUFFER_BIT, GL_MODELVIEW,
+            GL_PROJECTION, glClear, glClearColor, glLoadIdentity,
+            glLoadMatrixf, glMatrixMode, glMultMatrixf, glTranslatef,
+            glViewport, glScissor, GL_SCISSOR_TEST, glEnable, glDisable,
+        )
+        from OpenGL.GLU import gluPerspective
+        from OpenGL.GLUT import glutSwapBuffers
+
+        nx, ny = self.shape
+        w_sub = self.width // ny
+        h_sub = self.height // nx
+        glEnable(GL_SCISSOR_TEST)
+        for r in range(nx):
+            for c in range(ny):
+                sub = self.subwindows[r][c]
+                x0 = c * w_sub
+                y0 = (nx - 1 - r) * h_sub
+                glViewport(x0, y0, w_sub, h_sub)
+                glScissor(x0, y0, w_sub, h_sub)
+                bg = sub.background_color
+                glClearColor(bg[0], bg[1], bg[2], 1.0)
+                glClear(GL_COLOR_BUFFER_BIT | GL_DEPTH_BUFFER_BIT)
+                glMatrixMode(GL_PROJECTION)
+                glLoadIdentity()
+                gluPerspective(45.0, float(w_sub) / max(h_sub, 1), 0.1, 100.0)
+                glMatrixMode(GL_MODELVIEW)
+                glLoadIdentity()
+                glTranslatef(0.0, 0.0, -2.5)
+                glMultMatrixf(sub.transform)
+                self.draw_scene(sub)
+        glDisable(GL_SCISSOR_TEST)
+        glutSwapBuffers()
+
+    def draw_scene(self, sub):
+        from OpenGL.GL import GL_LIGHTING, glDisable, glEnable, glPushMatrix, glPopMatrix, glScalef, glTranslatef
+
+        meshes = sub.all_meshes()
+        lines = sub.all_lines()
+        glPushMatrix()
+        if sub.autorecenter and (meshes or lines):
+            # recenter+rescale the scene into the unit view volume
+            # (reference draw_primitives recenter path, meshviewer.py:535-597)
+            all_v = np.vstack([np.asarray(m.v).reshape(-1, 3) for m in meshes + lines])
+            center = (all_v.max(axis=0) + all_v.min(axis=0)) / 2.0
+            extent = (all_v.max(axis=0) - all_v.min(axis=0)).max()
+            s = 1.0 / extent if extent > 0 else 1.0
+            glScalef(s, s, s)
+            glTranslatef(-center[0], -center[1], -center[2])
+        if sub.lighting_on:
+            glEnable(GL_LIGHTING)
+        else:
+            glDisable(GL_LIGHTING)
+        for m in meshes:
+            self.draw_mesh(m)
+        for l in lines:
+            self.draw_lines(l)
+        glPopMatrix()
+
+    def draw_mesh(self, m):
+        """Vertex-array draw of one mesh (reference meshviewer.py:390-513
+        uses VBOs; vertex arrays keep the same throughput at viewer scale)."""
+        from OpenGL.GL import (
+            GL_NORMAL_ARRAY, GL_COLOR_ARRAY, GL_TRIANGLES, GL_VERTEX_ARRAY,
+            glColor3f, glColorPointerf, glDisableClientState,
+            glDrawElementsui, glEnableClientState, glNormalPointerf,
+            glVertexPointerf,
+        )
+
+        v = np.asarray(m.v, np.float64).reshape(-1, 3)
+        if not hasattr(m, "f") or np.size(m.f) == 0:
+            return
+        f = np.asarray(m.f, np.uint32)
+        if hasattr(m, "vn"):
+            vn = np.asarray(m.vn)
+        else:
+            from ..geometry import vert_normals
+
+            vn = np.asarray(vert_normals(v.astype(np.float32), f.astype(np.int32)))
+        glEnableClientState(GL_VERTEX_ARRAY)
+        glVertexPointerf(np.ascontiguousarray(v, np.float32))
+        glEnableClientState(GL_NORMAL_ARRAY)
+        glNormalPointerf(np.ascontiguousarray(vn, np.float32))
+        if hasattr(m, "vc"):
+            glEnableClientState(GL_COLOR_ARRAY)
+            glColorPointerf(np.ascontiguousarray(np.asarray(m.vc), np.float32))
+        else:
+            glColor3f(0.7, 0.7, 0.9)
+        glDrawElementsui(GL_TRIANGLES, np.ascontiguousarray(f))
+        glDisableClientState(GL_VERTEX_ARRAY)
+        glDisableClientState(GL_NORMAL_ARRAY)
+        if hasattr(m, "vc"):
+            glDisableClientState(GL_COLOR_ARRAY)
+
+    def draw_lines(self, l):
+        from OpenGL.GL import (
+            GL_LIGHTING, GL_LINES, GL_VERTEX_ARRAY, glColor3f,
+            glDisable, glDisableClientState, glDrawElementsui,
+            glEnable, glEnableClientState, glLineWidth, glVertexPointerf,
+        )
+
+        glDisable(GL_LIGHTING)
+        glLineWidth(2.0)
+        glEnableClientState(GL_VERTEX_ARRAY)
+        glVertexPointerf(np.ascontiguousarray(np.asarray(l.v), np.float32))
+        if hasattr(l, "ec"):
+            glColor3f(*np.asarray(l.ec).reshape(-1, 3)[0])
+        else:
+            glColor3f(1.0, 0.0, 0.0)
+        glDrawElementsui(GL_LINES, np.ascontiguousarray(np.asarray(l.e, np.uint32)))
+        glDisableClientState(GL_VERTEX_ARRAY)
+        glEnable(GL_LIGHTING)
+
+    def save_snapshot(self, path):
+        """glReadPixels -> PNG (reference meshviewer.py:892-900)."""
+        from OpenGL.GL import GL_RGB, GL_UNSIGNED_BYTE, glReadPixels
+        from OpenGL.GLUT import glutPostRedisplay
+        from PIL import Image
+
+        self.on_draw()
+        data = glReadPixels(0, 0, self.width, self.height, GL_RGB, GL_UNSIGNED_BYTE)
+        image = Image.frombytes("RGB", (self.width, self.height), data)
+        image.transpose(Image.FLIP_TOP_BOTTOM).save(path)
+        glutPostRedisplay()
+
+
+def _test_for_opengl():
+    try:
+        from OpenGL.GLUT import glutInit
+
+        glutInit([])
+        print("success")
+    except Exception as e:
+        print("failure: %s" % e)
+
+
+def main():
+    args = sys.argv[1:]
+    if args and args[0] == "TEST_FOR_OPENGL":
+        _test_for_opengl()
+        return
+    titlebar = args[0] if args else "Mesh Viewer"
+    nx = int(args[1]) if len(args) > 1 else 1
+    ny = int(args[2]) if len(args) > 2 else 1
+    width = int(args[3]) if len(args) > 3 else 1280
+    height = int(args[4]) if len(args) > 4 else 960
+    MeshViewerRemote(titlebar, nx, ny, width, height)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,11 @@
+import os
+
+test_data_folder = os.path.join(os.path.dirname(__file__), "data")
+temporary_files_folder = os.path.join(os.path.dirname(__file__), "_tmp")
+os.makedirs(temporary_files_folder, exist_ok=True)
+# golden fixtures from the reference checkout, used read-only when present
+reference_data_folder = "/root/reference/data/unittest"
+
+
+def has_reference_data():
+    return os.path.isdir(reference_data_folder)

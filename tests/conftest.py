@@ -1,0 +1,18 @@
+"""Test harness config: force an 8-device CPU JAX platform before jax loads,
+so multi-device sharding tests run anywhere (SURVEY.md section 4: the
+reference forks real viewer processes; we use XLA's host-platform device
+virtualization for the device-level analog)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# per-test-session topology cache (reference Makefile:9-25 uses a throwaway
+# PSBODY_MESH_CACHE for the same reason)
+import tempfile
+
+os.environ.setdefault("MESH_TPU_CACHE", tempfile.mkdtemp(prefix="mesh_tpu_cache_"))

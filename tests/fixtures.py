@@ -1,0 +1,47 @@
+"""Programmatically generated tiny fixtures (the reference checks in
+data/unittest/*.obj|ply; we generate equivalent analytic geometry so the test
+suite is self-contained — reference goldens are used only in guarded parity
+tests)."""
+
+import numpy as np
+
+
+def box(size=1.0, center=(0.0, 0.0, 0.0)):
+    """Unit box: 8 verts, 12 faces, outward-facing normals."""
+    c = np.asarray(center, dtype=np.float64)
+    h = size / 2.0
+    v = np.array([
+        [-h, -h, -h], [h, -h, -h], [h, h, -h], [-h, h, -h],
+        [-h, -h, h], [h, -h, h], [h, h, h], [-h, h, h],
+    ]) + c
+    f = np.array([
+        [0, 2, 1], [0, 3, 2],      # z = -h (normal -z)
+        [4, 5, 6], [4, 6, 7],      # z = +h (normal +z)
+        [0, 1, 5], [0, 5, 4],      # y = -h (normal -y)
+        [2, 3, 7], [2, 7, 6],      # y = +h (normal +y)
+        [0, 4, 7], [0, 7, 3],      # x = -h (normal -x)
+        [1, 2, 6], [1, 6, 5],      # x = +h (normal +x)
+    ], dtype=np.uint32)
+    return v, f
+
+
+def icosphere(subdivisions=2, radius=1.0):
+    from mesh_tpu.sphere import _icosphere
+
+    v, f = _icosphere(subdivisions)
+    return v * radius, f.astype(np.uint32)
+
+
+def cylinder(n=16, radius=1.0, height=2.0):
+    """Open-ended triangulated cylinder around the z axis."""
+    theta = np.linspace(0, 2 * np.pi, n, endpoint=False)
+    ring = np.stack([radius * np.cos(theta), radius * np.sin(theta)], axis=1)
+    bottom = np.concatenate([ring, np.full((n, 1), -height / 2)], axis=1)
+    top = np.concatenate([ring, np.full((n, 1), height / 2)], axis=1)
+    v = np.vstack([bottom, top])
+    f = []
+    for i in range(n):
+        j = (i + 1) % n
+        f.append([i, j, n + i])
+        f.append([j, n + j, n + i])
+    return v, np.array(f, dtype=np.uint32)

@@ -1,0 +1,157 @@
+"""Mesh facade tests: construction, normals, search wrappers, landmarks,
+segmentation (reference tests/test_mesh.py style)."""
+
+import numpy as np
+
+from mesh_tpu import Mesh
+
+from .fixtures import box, icosphere
+
+
+class TestMeshBasics:
+    def test_construction_dtypes(self):
+        v, f = box()
+        m = Mesh(v=v, f=f)
+        assert m.v.dtype == np.float64
+        assert m.f.dtype == np.uint32
+
+    def test_vscale(self):
+        v, f = box()
+        m = Mesh(v=v, f=f, vscale=2.0)
+        np.testing.assert_allclose(m.v, v * 2.0)
+
+    def test_vertex_colors(self):
+        v, f = box()
+        m = Mesh(v=v, f=f, vc="red")
+        assert m.vc.shape == (8, 3)
+        np.testing.assert_allclose(m.vc[0], [1.0, 0, 0])
+
+    def test_estimate_vertex_normals_box(self):
+        v, f = box()
+        m = Mesh(v=v, f=f)
+        n = m.estimate_vertex_normals()
+        # box corner normals point outward (same octant as the corner)
+        assert np.all(np.sign(n) == np.sign(v))
+
+    def test_arrays_export(self):
+        v, f = box()
+        arrs = Mesh(v=v, f=f).arrays()
+        assert arrs.v.shape == (8, 3)
+        assert arrs.num_faces == 12
+        assert arrs.tri().shape == (12, 3, 3)
+
+    def test_edges_as_lines(self):
+        v, f = box()
+        lines = Mesh(v=v, f=f).edges_as_lines()
+        assert lines.e.shape == (36, 2)
+
+
+class TestSearchWrappers:
+    def test_closest_faces_and_points(self):
+        v, f = icosphere(2)
+        m = Mesh(v=v, f=f)
+        queries = np.array([[2.0, 0, 0], [0, 3.0, 0], [0, 0, -4.0]])
+        faces, points = m.closest_faces_and_points(queries)
+        assert faces.shape == (1, 3)
+        # closest point on the unit sphere mesh lies near radius 1 toward query
+        np.testing.assert_allclose(
+            points / np.linalg.norm(points, axis=1, keepdims=True),
+            queries / np.linalg.norm(queries, axis=1, keepdims=True),
+            atol=0.05,
+        )
+
+    def test_nearest_part_codes(self):
+        v, f = box(2.0)
+        m = Mesh(v=v, f=f)
+        tree = m.compute_aabb_tree()
+        f_idx, f_part, pts = tree.nearest(np.array([[0.3, 0.2, -5.0]]), nearest_part=True)
+        assert f_part.shape == (1, 1)
+        assert f_part[0, 0] == 0  # face interior
+
+    def test_closest_vertices(self):
+        v, f = box()
+        m = Mesh(v=v, f=f)
+        idx, dist = m.closest_vertices(v + 0.01)
+        np.testing.assert_array_equal(np.asarray(idx).flatten(), np.arange(8))
+
+    def test_cgal_style_tree(self):
+        v, f = box()
+        m = Mesh(v=v, f=f)
+        idx, dist = m.compute_closest_point_tree(use_cgal=True).nearest(v[:3])
+        np.testing.assert_array_equal(idx, [0, 1, 2])
+        np.testing.assert_allclose(dist, 0.0, atol=1e-6)
+
+
+class TestLandmarks:
+    def test_from_xyz(self):
+        v, f = box()
+        m = Mesh(v=v, f=f)
+        m.set_landmarks_from_raw({"corner": [-0.5, -0.5, -0.5], "top": [0.5, 0.5, 0.5]})
+        assert m.landm["corner"] == 0
+        assert m.landm["top"] == 6
+        # regressors reproduce the landmark positions
+        xyz = m.landm_xyz
+        np.testing.assert_allclose(xyz["corner"], [-0.5, -0.5, -0.5], atol=1e-5)
+
+    def test_from_indices(self):
+        v, f = box()
+        m = Mesh(v=v, f=f)
+        m.set_landmarks_from_raw({"a": 3, "b": 5})
+        assert m.landm == {"a": 3, "b": 5}
+        np.testing.assert_allclose(m.landm_raw_xyz["a"], v[3])
+
+    def test_linear_transform(self):
+        v, f = box()
+        m = Mesh(v=v, f=f)
+        m.set_landmarks_from_raw({"x": [0.5, 0.5, 0.5]})
+        T = m.landm_xyz_linear_transform()
+        assert T.shape == (3, 24)
+        np.testing.assert_allclose(
+            (T * m.v.flatten()).reshape(-1, 3), [[0.5, 0.5, 0.5]], atol=1e-5
+        )
+
+
+class TestSegmentation:
+    def test_verts_by_segm(self):
+        v, f = box()
+        m = Mesh(v=v, f=f, segm={"bottom": [0, 1], "top": [2, 3]})
+        vb = m.verts_by_segm
+        assert vb["bottom"] == [0, 1, 2, 3]
+        assert vb["top"] == [4, 5, 6, 7]
+
+    def test_parts_by_face(self):
+        v, f = box()
+        m = Mesh(v=v, f=f, segm={"bottom": [0, 1]})
+        parts = m.parts_by_face()
+        assert parts[0] == "bottom" and parts[2] == ""
+
+    def test_transfer_segm(self):
+        v, f = box()
+        src = Mesh(v=v, f=f, segm={"bottom": [0, 1], "rest": list(range(2, 12))})
+        dst = Mesh(v=v, f=f)
+        dst.transfer_segm(src)
+        assert dst.segm["bottom"] == [0, 1]
+
+    def test_verts_in_common(self):
+        v, f = box()
+        m = Mesh(v=v, f=f, segm={"a": [0], "b": [1]})
+        common = m.verts_in_common(["a", "b"])
+        assert common == sorted(set([0, 2, 1]) & set([0, 3, 2]))
+
+
+class TestJoints:
+    def test_set_joints(self):
+        v, f = box()
+        m = Mesh(v=v, f=f)
+        m.set_joints(["j0"], [[0, 1, 2, 3]])
+        xyz = m.joint_xyz["j0"]
+        np.testing.assert_allclose(xyz, v[:4].mean(axis=0))
+
+
+class TestVisibilityWrapper:
+    def test_visibile_mesh(self):
+        v, f = box(2.0)
+        m = Mesh(v=v, f=f)
+        vm = m.visibile_mesh(camera=[0.0, 0.0, 5.0])
+        assert vm.v.shape[0] == 4  # the +z face
+        assert np.all(vm.v[:, 2] > 0)

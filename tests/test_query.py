@@ -1,0 +1,209 @@
+"""Spatial-query kernel tests: exact closest point vs f64 brute-force oracle,
+part codes, nearest-alongnormal, normal-weighted NN, intersections
+(reference styles: tests/test_mesh.py:89-109, tests/test_aabb_n_tree.py)."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from mesh_tpu.query import (
+    closest_faces_and_points,
+    closest_vertices_with_distance,
+    nearest_alongnormal,
+    nearest_normal_weighted,
+    intersections_mask,
+    self_intersection_count,
+)
+from .fixtures import box, cylinder, icosphere
+
+
+def _oracle_closest(v, f, points):
+    """f64 numpy closest-point-on-mesh oracle (Ericson, unvectorized)."""
+    tri = v[f.astype(np.int64)]
+    out_d = np.full(len(points), np.inf)
+    out_p = np.zeros((len(points), 3))
+    for qi, p in enumerate(points):
+        for (a, b, c) in tri:
+            ab, ac, ap = b - a, c - a, p - a
+            d1, d2 = ab @ ap, ac @ ap
+            bp = p - b
+            d3, d4 = ab @ bp, ac @ bp
+            cp = p - c
+            d5, d6 = ab @ cp, ac @ cp
+            if d1 <= 0 and d2 <= 0:
+                q = a
+            elif d3 >= 0 and d4 <= d3:
+                q = b
+            elif d6 >= 0 and d5 <= d6:
+                q = c
+            else:
+                vc = d1 * d4 - d3 * d2
+                vb = d5 * d2 - d1 * d6
+                va = d3 * d6 - d5 * d4
+                if vc <= 0 and d1 >= 0 and d3 <= 0:
+                    q = a + ab * (d1 / (d1 - d3))
+                elif vb <= 0 and d2 >= 0 and d6 <= 0:
+                    q = a + ac * (d2 / (d2 - d6))
+                elif va <= 0 and (d4 - d3) >= 0 and (d5 - d6) >= 0:
+                    w = (d4 - d3) / ((d4 - d3) + (d5 - d6))
+                    q = b + w * (c - b)
+                else:
+                    denom = 1.0 / (va + vb + vc)
+                    q = a + ab * (vb * denom) + ac * (vc * denom)
+            d = np.sum((p - q) ** 2)
+            if d < out_d[qi]:
+                out_d[qi] = d
+                out_p[qi] = q
+    return out_p, np.sqrt(out_d)
+
+
+class TestClosestPoint:
+    def test_vs_oracle_random(self):
+        rng = np.random.RandomState(0)
+        v = rng.rand(20, 3)
+        f = rng.randint(0, 20, (10, 3)).astype(np.uint32)
+        points = rng.rand(25, 3) * 2 - 0.5
+        res = closest_faces_and_points(
+            v.astype(np.float32), f.astype(np.int32), points.astype(np.float32)
+        )
+        oracle_p, oracle_d = _oracle_closest(v, f, points)
+        got_d = np.linalg.norm(points - np.asarray(res["point"]), axis=1)
+        # distances must match the exact oracle to 1e-5 (BASELINE parity bar)
+        np.testing.assert_allclose(got_d, oracle_d, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(res["point"]), oracle_p, atol=1e-4)
+
+    def test_part_codes_box(self):
+        v, f = box(2.0)  # corners at +-1
+        queries = np.array([
+            [0.3, 0.2, -2.0],   # interior of a -z face
+            [2.0, 2.0, 2.0],    # vertex corner (1,1,1)
+            [0.0, -2.0, -2.0],  # edge between y=-1,z=-1
+        ], dtype=np.float32)
+        res = closest_faces_and_points(v.astype(np.float32), f.astype(np.int32), queries)
+        part = np.asarray(res["part"])
+        assert part[0] == 0          # interior
+        assert part[1] in (4, 5, 6)  # some vertex code
+        assert part[2] in (1, 2, 3)  # some edge code
+        np.testing.assert_allclose(
+            np.asarray(res["point"]),
+            np.array([[0.3, 0.2, -1.0], [1, 1, 1], [0, -1, -1]]),
+            atol=1e-6,
+        )
+
+    def test_closest_vertices(self):
+        rng = np.random.RandomState(1)
+        v = rng.randn(50, 3)
+        q = rng.randn(30, 3)
+        idx, dist = closest_vertices_with_distance(
+            v.astype(np.float32), q.astype(np.float32)
+        )
+        d2 = np.linalg.norm(q[:, None] - v[None], axis=-1)
+        np.testing.assert_array_equal(np.asarray(idx), d2.argmin(axis=1))
+        np.testing.assert_allclose(np.asarray(dist), d2.min(axis=1), atol=1e-5)
+
+    def test_batched_queries_large(self):
+        """Chunking must not corrupt results at non-multiple sizes."""
+        rng = np.random.RandomState(2)
+        v, f = icosphere(2)
+        q = rng.randn(1037, 3).astype(np.float32)
+        res = closest_faces_and_points(v.astype(np.float32), f.astype(np.int32), q, chunk=256)
+        # every closest point lies (approximately) on the unit sphere surface
+        r = np.linalg.norm(np.asarray(res["point"]), axis=1)
+        assert np.all(r < 1.01) and np.all(r > 0.9)
+
+
+class TestNearestAlongNormal:
+    def test_box_interior(self):
+        v, f = box(2.0)
+        # z = 0.25 so the +z wall (distance 0.75) strictly beats the -z wall
+        p = np.array([[0.2, 0.3, 0.25]], np.float32)
+        n = np.array([[0.0, 0.0, 1.0]], np.float32)
+        dist, face, pt = nearest_alongnormal(
+            v.astype(np.float32), f.astype(np.int32), p, n
+        )
+        np.testing.assert_allclose(np.asarray(dist), [0.75], atol=1e-6)
+        np.testing.assert_allclose(np.asarray(pt), [[0.2, 0.3, 1.0]], atol=1e-6)
+
+    def test_miss_gives_inf(self):
+        v, f = box(2.0)
+        p = np.array([[10.0, 10.0, 10.0]], np.float32)
+        n = np.array([[0.0, 0.0, 1.0]], np.float32)
+        dist, _, _ = nearest_alongnormal(v.astype(np.float32), f.astype(np.int32), p, n)
+        assert not np.isfinite(np.asarray(dist))[0]
+
+    def test_unnormalized_direction_distance(self):
+        v, f = box(2.0)
+        p = np.array([[0.0, 0.0, 0.0]], np.float32)
+        n = np.array([[0.0, 0.0, 4.0]], np.float32)  # |n| = 4
+        dist, _, pt = nearest_alongnormal(v.astype(np.float32), f.astype(np.int32), p, n)
+        np.testing.assert_allclose(np.asarray(dist), [1.0], atol=1e-6)
+
+
+class TestNormalWeighted:
+    def _two_walls(self):
+        # two parallel unit quads at z=0 (normal +z) and z=0.4 (normal -z)
+        v = np.array([
+            [0, 0, 0], [1, 0, 0], [1, 1, 0], [0, 1, 0],
+            [0, 0, 0.4], [1, 1, 0.4], [1, 0, 0.4], [0, 1, 0.4],
+        ], np.float32)
+        f = np.array([
+            [0, 1, 2], [0, 2, 3],      # +z normals
+            [4, 5, 6], [4, 7, 5],      # -z normals
+        ], np.int32)
+        return v, f
+
+    def test_eps0_is_classic_nn(self):
+        """reference tests/test_aabb_n_tree.py:27-39: eps=0 == euclidean NN."""
+        v, f = self._two_walls()
+        q = np.array([[0.5, 0.5, 0.15]], np.float32)  # nearer z=0 wall
+        n = np.array([[0.0, 0.0, -1.0]], np.float32)
+        face, point = nearest_normal_weighted(v, f, q, n, eps=0.0)
+        assert int(np.asarray(face)[0]) in (0, 1)
+        np.testing.assert_allclose(np.asarray(point)[0, 2], 0.0, atol=1e-6)
+
+    def test_eps_flips_choice(self):
+        """reference tests/test_aabb_n_tree.py:41-52: with a normal term the
+        farther-but-normal-agreeing wall wins."""
+        v, f = self._two_walls()
+        q = np.array([[0.5, 0.5, 0.15]], np.float32)
+        n = np.array([[0.0, 0.0, -1.0]], np.float32)  # agrees with z=0.4 wall
+        face, point = nearest_normal_weighted(v, f, q, n, eps=0.5)
+        assert int(np.asarray(face)[0]) in (2, 3)
+        np.testing.assert_allclose(np.asarray(point)[0, 2], 0.4, atol=1e-6)
+
+
+class TestIntersections:
+    def test_crossing_triangles(self):
+        v1 = np.array([[0, 0, 0], [1, 0, 0], [0, 1, 0]], np.float32)
+        f1 = np.array([[0, 1, 2]], np.int32)
+        # a triangle piercing the first one's plane
+        qv = np.array([[0.2, 0.2, -0.5], [0.4, 0.2, 0.5], [0.2, 0.4, 0.5]], np.float32)
+        qf = np.array([[0, 1, 2]], np.int32)
+        mask = np.asarray(intersections_mask(v1, f1, qv, qf))
+        assert mask.tolist() == [True]
+
+    def test_disjoint(self):
+        v1, f1 = box(1.0)
+        v2, f2 = box(1.0, center=(5, 5, 5))
+        mask = np.asarray(
+            intersections_mask(v1.astype(np.float32), f1.astype(np.int32),
+                               v2.astype(np.float32), f2.astype(np.int32))
+        )
+        assert not mask.any()
+
+    def test_self_intersection_counts(self):
+        v, f = box(1.0)
+        assert int(self_intersection_count(v.astype(np.float32), f.astype(np.int32))) == 0
+        # a mesh of two crossing triangles, disjoint vertex sets
+        v2 = np.array([
+            [0, 0, 0], [1, 0, 0], [0, 1, 0],
+            [0.2, 0.2, -0.5], [0.4, 0.2, 0.5], [0.2, 0.4, 0.5],
+        ], np.float32)
+        f2 = np.array([[0, 1, 2], [3, 4, 5]], np.int32)
+        # ordered pairs -> count of 2 (reference counts both directions,
+        # tests/test_aabb_n_tree.py:78-89 asserts 2 * n_pairs)
+        assert int(self_intersection_count(v2, f2)) == 2
+
+    def test_shared_vertex_pairs_excluded(self):
+        v, f = cylinder(12)
+        assert int(self_intersection_count(v.astype(np.float32), f.astype(np.int32))) == 0

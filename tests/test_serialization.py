@@ -1,0 +1,155 @@
+"""Serialization tests: PLY/OBJ round trips, golden byte-format parity with
+the reference's rply-written fixtures (tests/test_mesh.py:35-87 style),
+error paths."""
+
+import os
+
+import numpy as np
+import pytest
+
+from mesh_tpu import Mesh
+from mesh_tpu.errors import MeshError, SerializationError
+from mesh_tpu.serialization import read_ply, write_ply_data
+
+from . import has_reference_data, reference_data_folder, temporary_files_folder
+from .fixtures import box
+
+
+class TestPly:
+    def _roundtrip(self, tmp_path, **kw):
+        v, f = box()
+        src = Mesh(v=v, f=f)
+        path = str(tmp_path / "out.ply")
+        src.write_ply(path, **kw)
+        dst = Mesh(filename=path)
+        np.testing.assert_allclose(dst.v, v, atol=1e-6)  # f32 storage
+        np.testing.assert_array_equal(dst.f, f)
+        return dst
+
+    def test_ascii_roundtrip(self, tmp_path):
+        self._roundtrip(tmp_path, ascii=True)
+
+    def test_little_endian_roundtrip(self, tmp_path):
+        self._roundtrip(tmp_path, little_endian=True)
+
+    def test_big_endian_roundtrip(self, tmp_path):
+        self._roundtrip(tmp_path, little_endian=False)
+
+    def test_colors_and_normals_roundtrip(self, tmp_path):
+        v, f = box()
+        m = Mesh(v=v, f=f)
+        m.set_vertex_colors("red")
+        m.vn = np.tile([0.0, 0.0, 1.0], (8, 1))
+        path = str(tmp_path / "cn.ply")
+        m.write_ply(path)
+        back = Mesh(filename=path)
+        np.testing.assert_allclose(back.vc, m.vc, atol=1 / 255.0 + 1e-6)
+        np.testing.assert_allclose(back.vn, m.vn, atol=1e-6)
+
+    def test_flip_faces(self, tmp_path):
+        v, f = box()
+        m = Mesh(v=v, f=f)
+        path = str(tmp_path / "flip.ply")
+        m.write_ply(path, flip_faces=True)
+        back = Mesh(filename=path)
+        np.testing.assert_array_equal(back.f, f[:, ::-1])
+
+    def test_comments(self, tmp_path):
+        v, f = box()
+        path = str(tmp_path / "c.ply")
+        Mesh(v=v, f=f).write_ply(path, ascii=True, comments=["hello\nworld"])
+        text = open(path).read()
+        assert "comment hello\ncomment world" in text
+
+    def test_missing_file_raises(self):
+        with pytest.raises(SerializationError, match="Failed to open PLY file"):
+            Mesh(filename=os.path.join(temporary_files_folder, "nope.ply"))
+
+    def test_error_hierarchy(self):
+        """reference tests/test_mesh.py:49-60."""
+        assert issubclass(SerializationError, MeshError)
+
+
+@pytest.mark.skipif(not has_reference_data(), reason="reference data not mounted")
+class TestGoldenParity:
+    def test_load_reference_box_obj(self):
+        m = Mesh(filename=os.path.join(reference_data_folder, "test_box.obj"))
+        assert m.v.shape == (8, 3)
+        assert m.f.shape == (12, 3)
+        assert set(m.segm.keys()) == {"a", "b", "c"}
+
+    def test_load_reference_box_ply_ascii_and_binary(self):
+        ma = Mesh(filename=os.path.join(reference_data_folder, "test_box.ply"))
+        mb = Mesh(filename=os.path.join(reference_data_folder, "test_box_le.ply"))
+        np.testing.assert_allclose(ma.v, mb.v, atol=1e-7)
+        np.testing.assert_array_equal(ma.f, mb.f)
+        assert ma.v.shape == (8, 3)
+
+    def test_write_ascii_bytematch(self, tmp_path):
+        """Our writer reproduces rply's ascii bytes exactly
+        (reference golden-equality style, tests/test_mesh.py:67-87)."""
+        golden = os.path.join(reference_data_folder, "test_box.ply")
+        m = Mesh(filename=golden)
+        out = str(tmp_path / "rewrite.ply")
+        m.write_ply(out, ascii=True)
+        assert open(out, "rb").read() == open(golden, "rb").read()
+
+    def test_write_binary_bytematch(self, tmp_path):
+        golden = os.path.join(reference_data_folder, "test_box_le.ply")
+        m = Mesh(filename=golden)
+        out = str(tmp_path / "rewrite_le.ply")
+        m.write_ply(out, little_endian=True)
+        assert open(out, "rb").read() == open(golden, "rb").read()
+
+    def test_landmarks_pp(self):
+        m = Mesh(
+            filename=os.path.join(reference_data_folder, "test_box.obj"),
+            ppfilename=os.path.join(reference_data_folder, "test_box.pp"),
+        )
+        assert len(m.landm) > 0
+        assert set(m.landm) == set(m.landm_regressors)
+
+
+class TestObj:
+    def test_roundtrip(self, tmp_path):
+        v, f = box()
+        m = Mesh(v=v, f=f)
+        path = str(tmp_path / "out.obj")
+        m.write_obj(path)
+        back = Mesh(filename=path)
+        np.testing.assert_allclose(back.v, v, atol=1e-6)
+        np.testing.assert_array_equal(back.f, f)
+
+    def test_segments_roundtrip(self, tmp_path):
+        v, f = box()
+        m = Mesh(v=v, f=f, segm={"top": [2, 3], "bottom": [0, 1]})
+        path = str(tmp_path / "seg.obj")
+        m.write_obj(path)
+        back = Mesh(filename=path)
+        assert set(back.segm) == {"top", "bottom"}
+        assert len(back.segm["top"]) == 2
+
+    def test_landmark_comment(self, tmp_path):
+        path = str(tmp_path / "landm.obj")
+        with open(path, "w") as fp:
+            fp.write("#landmark nose\nv 0 0 0\nv 1 0 0\nv 0 1 0\nf 1 2 3\n")
+        m = Mesh(filename=path)
+        assert m.landm == {"nose": 0}
+
+    def test_polygon_fan_triangulation(self, tmp_path):
+        path = str(tmp_path / "quad.obj")
+        with open(path, "w") as fp:
+            fp.write("v 0 0 0\nv 1 0 0\nv 1 1 0\nv 0 1 0\nf 1 2 3 4\n")
+        m = Mesh(filename=path)
+        np.testing.assert_array_equal(m.f, [[0, 1, 2], [0, 2, 3]])
+
+    def test_json(self, tmp_path):
+        import json
+
+        v, f = box()
+        path = str(tmp_path / "m.json")
+        Mesh(v=v, f=f, basename="box").write_json(path)
+        data = json.load(open(path))
+        assert data["name"] == "box"
+        assert len(data["vertices"]) == 8
+        assert len(data["faces"]) == 12

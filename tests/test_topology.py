@@ -1,0 +1,146 @@
+"""Topology tests: connectivity invariants, Loop subdivision, QSlim
+(goes beyond the reference's smoke tests, tests/test_topology.py, which skip
+qslim entirely)."""
+
+import numpy as np
+
+from mesh_tpu import Mesh
+from mesh_tpu.topology import (
+    get_faces_per_edge,
+    get_vert_connectivity,
+    get_vert_opposites_per_edge,
+    get_vertices_per_edge,
+    loop_subdivider,
+    qslim_decimator,
+    vertices_to_edges_matrix,
+)
+from mesh_tpu.topology.connectivity import edge_topology_arrays
+
+from .fixtures import box, icosphere
+
+
+class TestConnectivity:
+    def test_box_euler(self):
+        v, f = box()
+        m = Mesh(v=v, f=f)
+        vpe = get_vertices_per_edge(m)
+        assert vpe.shape == (18, 2)  # V - E + F = 2 -> E = 18
+        fpe = get_faces_per_edge(m)
+        assert fpe.shape == (18, 2)
+        vc = get_vert_connectivity(m)
+        assert vc.shape == (8, 8)
+        assert (vc.todense() > 0).sum() == 36  # 2 * E directed
+
+    def test_opposites(self):
+        v, f = box()
+        m = Mesh(v=v, f=f)
+        vo = get_vert_opposites_per_edge(m)
+        assert len(vo) == 18
+        # every closed-mesh edge has exactly two opposite vertices
+        assert all(len(opp) == 2 for opp in vo.values())
+
+    def test_edges_matrix(self):
+        v, f = box()
+        m = Mesh(v=v, f=f)
+        M = vertices_to_edges_matrix(m, want_xyz=True)
+        e = M.dot(v.flatten()).reshape(-1, 3)
+        vpe = np.asarray(get_vertices_per_edge(m), dtype=np.int64)
+        np.testing.assert_allclose(e, v[vpe[:, 0]] - v[vpe[:, 1]])
+
+    def test_edge_topology_arrays(self):
+        v, f = box()
+        topo = edge_topology_arrays(f, len(v))
+        assert topo["edges"].shape == (18, 2)
+        assert (topo["edge_opposites"] >= 0).all()  # closed mesh: no pads
+        assert (topo["faces_per_edge"] >= 0).all()
+
+    def test_cache_roundtrip(self):
+        v, f = box()
+        m = Mesh(v=v, f=f)
+        first = get_vertices_per_edge(m)
+        second = get_vertices_per_edge(m)  # served from disk cache
+        np.testing.assert_array_equal(first, second)
+
+
+class TestLoopSubdivision:
+    def test_box_counts(self):
+        v, f = box()
+        m = Mesh(v=v, f=f)
+        xform = loop_subdivider(m)
+        sub = xform(m)
+        assert sub.v.shape == (8 + 18, 3)   # verts + edge midpoints
+        assert sub.f.shape == (48, 3)       # 4x faces
+        # subdivision surface shrinks toward the interior: all within box
+        assert np.abs(sub.v).max() <= 0.5 + 1e-9
+
+    def test_sphere_stays_spherical(self):
+        v, f = icosphere(1)
+        m = Mesh(v=v, f=f)
+        sub = loop_subdivider(m)(m)
+        r = np.linalg.norm(sub.v, axis=1)
+        assert r.min() > 0.7 and r.max() <= 1.0 + 1e-9
+
+    def test_raw_array_application(self):
+        v, f = box()
+        m = Mesh(v=v, f=f)
+        xform = loop_subdivider(m)
+        flat = xform(v.flatten())
+        np.testing.assert_allclose(flat.reshape(-1, 3), xform(m).v)
+
+
+class TestQslim:
+    def test_decimates_to_target(self):
+        v, f = icosphere(2)  # 162 verts
+        m = Mesh(v=v, f=f)
+        xform = qslim_decimator(m, n_verts_desired=80)
+        dec = xform(m)
+        assert dec.v.shape[0] <= 82
+        assert dec.f.min() >= 0 and dec.f.max() < dec.v.shape[0]
+        # decimated sphere still roughly spherical
+        r = np.linalg.norm(dec.v, axis=1)
+        assert r.min() > 0.6 and r.max() < 1.3
+
+    def test_factor(self):
+        v, f = icosphere(1)
+        m = Mesh(v=v, f=f)
+        dec = qslim_decimator(m, factor=0.5)(m)
+        assert dec.v.shape[0] <= 0.55 * v.shape[0] + 2
+
+
+class TestProcessing:
+    def test_subdivide_triangles(self):
+        v, f = box()
+        m = Mesh(v=v, f=f)
+        m.subdivide_triangles()
+        assert m.v.shape == (8 + 12, 3)
+        assert m.f.shape == (36, 3)
+
+    def test_keep_vertices(self):
+        v, f = box()
+        m = Mesh(v=v, f=f)
+        m.keep_vertices([0, 1, 2, 3])  # bottom face only
+        assert m.v.shape == (4, 3)
+        assert (m.f < 4).all()
+        assert m.f.shape[0] == 2  # only the z=-0.5 faces survive
+
+    def test_flip_faces(self):
+        v, f = box()
+        m = Mesh(v=v, f=f)
+        m.flip_faces()
+        np.testing.assert_array_equal(m.f, f[:, ::-1])
+
+    def test_concatenate(self):
+        v, f = box()
+        m1 = Mesh(v=v, f=f)
+        m2 = Mesh(v=v + 5.0, f=f)
+        m1.concatenate_mesh(m2)
+        assert m1.v.shape == (16, 3)
+        assert m1.f.shape == (24, 3)
+        assert m1.f.max() == 15
+
+    def test_uniquified(self):
+        v, f = box()
+        m = Mesh(v=v, f=f)
+        u = m.uniquified_mesh()
+        assert u.v.shape == (36, 3)
+        np.testing.assert_array_equal(u.f, np.arange(36).reshape(-1, 3))

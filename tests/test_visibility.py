@@ -1,0 +1,63 @@
+"""Visibility tests: analytic box, sign-of-coordinate checks, sensors,
+extra occluders (port of reference tests/test_visibility.py:13-53 style)."""
+
+import numpy as np
+
+from mesh_tpu.query import visibility_compute
+from mesh_tpu.geometry import vert_normals
+import jax.numpy as jnp
+
+from .fixtures import box
+
+
+class TestVisibility:
+    def _box(self):
+        v, f = box(2.0)
+        n = np.asarray(vert_normals(jnp.asarray(v, jnp.float32), jnp.asarray(f, jnp.int32)))
+        return v, f, n
+
+    def test_axis_camera(self):
+        v, f, n = self._box()
+        cam = np.array([[0.0, 0.0, 5.0]])
+        vis, ndc = visibility_compute(v, f, cam, n=n)
+        assert vis.shape == (1, 8)
+        # exactly the verts on the +z face are visible
+        np.testing.assert_array_equal(vis[0].astype(bool), v[:, 2] > 0)
+
+    def test_each_side(self):
+        v, f, n = self._box()
+        for axis in range(3):
+            for sign in (+1, -1):
+                cam = np.zeros((1, 3))
+                cam[0, axis] = sign * 10.0
+                vis, _ = visibility_compute(v, f, cam, n=n)
+                np.testing.assert_array_equal(
+                    vis[0].astype(bool), sign * v[:, axis] > 0,
+                    err_msg="axis %d sign %d" % (axis, sign),
+                )
+
+    def test_multiple_cameras_batched(self):
+        v, f, n = self._box()
+        cams = np.array([[0, 0, 5.0], [0, 0, -5.0], [5.0, 0, 0]])
+        vis, ndc = visibility_compute(v, f, cams, n=n)
+        assert vis.shape == (3, 8)
+        np.testing.assert_array_equal(vis[0].astype(bool), v[:, 2] > 0)
+        np.testing.assert_array_equal(vis[1].astype(bool), v[:, 2] < 0)
+        np.testing.assert_array_equal(vis[2].astype(bool), v[:, 0] > 0)
+
+    def test_extra_occluder_blocks(self):
+        v, f, n = self._box()
+        # big wall between camera and box
+        wall_v = np.array([[-10, -10, 2.5], [10, -10, 2.5], [10, 10, 2.5], [-10, 10, 2.5]])
+        wall_f = np.array([[0, 1, 2], [0, 2, 3]])
+        cam = np.array([[0.0, 0.0, 5.0]])
+        vis, _ = visibility_compute(v, f, cam, n=n, extra_v=wall_v, extra_f=wall_f)
+        assert not vis.any()
+
+    def test_n_dot_cam(self):
+        v, f, n = self._box()
+        cam = np.array([[0.0, 0.0, 100.0]])
+        _, ndc = visibility_compute(v, f, cam, n=n)
+        # camera is far: dir ~ +z; verts on +z face have n . dir > 0
+        assert np.all(ndc[0][v[:, 2] > 0] > 0.3)
+        assert np.all(ndc[0][v[:, 2] < 0] < 0.0)
