@@ -1,0 +1,190 @@
+"""North-star benchmark (BASELINE.md config 3): batch-256 posed SMPL-shaped
+bodies (6890 v / 13776 f each) -> per-mesh vertex normals + closest-point
+queries, on whatever accelerator jax exposes (one v5e chip under the driver).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": queries/sec, "unit": ..., "vs_baseline": speedup}
+
+vs_baseline is the measured speedup over a single-core CPU implementation of
+the same queries (numpy normals + scipy cKDTree nearest-vertex seed with an
+exact local triangle refinement — the same algorithmic class as the
+reference's CGAL AABB tree, which cannot be built here).  The reference
+itself publishes no numbers (BASELINE.md).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BATCH = 256
+QUERIES_PER_MESH = 1024
+
+
+def log(*args):
+    print(*args, file=sys.stderr, flush=True)
+
+
+def tpu_workload():
+    import jax
+    import jax.numpy as jnp
+
+    from mesh_tpu.geometry.vert_normals import vert_normals
+    from mesh_tpu.models import lbs, synthetic_body_model
+    from mesh_tpu.query.point_triangle import closest_point_barycentric
+
+    model = synthetic_body_model(seed=0)
+    f = model.faces
+    rng = np.random.RandomState(0)
+    betas = jnp.asarray(rng.randn(BATCH, model.num_betas) * 0.3, jnp.float32)
+    pose = jnp.asarray(rng.randn(BATCH, model.num_joints, 3) * 0.1, jnp.float32)
+    queries = jnp.asarray(
+        rng.randn(BATCH, QUERIES_PER_MESH, 3) * 0.4, jnp.float32
+    )
+
+    @jax.jit
+    def workload(betas, pose, queries):
+        verts, _ = lbs(model, betas, pose)          # (B, V, 3) posed bodies
+        normals = vert_normals(verts, f)            # (B, V, 3)
+
+        def per_mesh(args):
+            v_mesh, q_mesh = args
+            tri = v_mesh[f]                         # (F, 3, 3)
+            a, b, c = tri[:, 0], tri[:, 1], tri[:, 2]
+            bary, part = closest_point_barycentric(
+                q_mesh[:, None, :], a[None], b[None], c[None]
+            )                                        # (Q, F, 3)
+            cp = (
+                bary[..., 0:1] * a[None]
+                + bary[..., 1:2] * b[None]
+                + bary[..., 2:3] * c[None]
+            )
+            d2 = jnp.sum((q_mesh[:, None, :] - cp) ** 2, axis=-1)
+            best = jnp.argmin(d2, axis=-1)
+            rows = jnp.arange(q_mesh.shape[0])
+            return best.astype(jnp.int32), cp[rows, best], d2[rows, best]
+
+        face, point, sqd = jax.lax.map(per_mesh, (verts, queries))
+        return normals, face, point, sqd
+
+    # warm up (compile)
+    out = workload(betas, pose, queries)
+    jax.block_until_ready(out)
+    n_rep = 3
+    t0 = time.perf_counter()
+    for _ in range(n_rep):
+        out = workload(betas, pose, queries)
+    jax.block_until_ready(out)
+    elapsed = (time.perf_counter() - t0) / n_rep
+    total_queries = BATCH * QUERIES_PER_MESH
+    log("device:", jax.devices()[0], " batch elapsed: %.4fs" % elapsed)
+    # export a few meshes for the CPU baseline + parity check
+    verts_np = np.asarray(workload(betas, pose, queries)[0])  # warm normals
+    return elapsed, total_queries, out, model, betas, pose, queries
+
+
+def cpu_baseline(model, betas, pose, queries, n_meshes=4):
+    """Single-core numpy/scipy implementation of the same per-mesh work."""
+    import jax
+
+    from mesh_tpu.models import lbs
+
+    verts = np.asarray(lbs(model, betas[:n_meshes], pose[:n_meshes])[0], np.float64)
+    f = np.asarray(model.faces)
+    queries = np.asarray(queries[:n_meshes], np.float64)
+
+    from scipy.spatial import cKDTree
+
+    # vertex -> incident faces adjacency (setup, excluded from timing like
+    # the reference's cached AABB tree build)
+    v_count = verts.shape[1]
+    incident = [[] for _ in range(v_count)]
+    for fi, (a, b, c) in enumerate(f):
+        incident[a].append(fi)
+        incident[b].append(fi)
+        incident[c].append(fi)
+    # 2-ring face sets per vertex for exactness of the local refinement
+    neighbors = [set() for _ in range(v_count)]
+    for vi in range(v_count):
+        for fi in incident[vi]:
+            neighbors[vi].update(f[fi])
+    ring_faces = [
+        sorted(set(sum((incident[u] for u in neighbors[vi]), [])))
+        for vi in range(v_count)
+    ]
+
+    def closest_on_tri(p, tri):
+        a, b, c = tri
+        ab, ac, ap = b - a, c - a, p - a
+        d1, d2 = ab @ ap, ac @ ap
+        if d1 <= 0 and d2 <= 0:
+            return a
+        bp = p - b
+        d3, d4 = ab @ bp, ac @ bp
+        if d3 >= 0 and d4 <= d3:
+            return b
+        cp = p - c
+        d5, d6 = ab @ cp, ac @ cp
+        if d6 >= 0 and d5 <= d6:
+            return c
+        vc = d1 * d4 - d3 * d2
+        if vc <= 0 and d1 >= 0 and d3 <= 0:
+            return a + ab * (d1 / (d1 - d3))
+        vb = d5 * d2 - d1 * d6
+        if vb <= 0 and d2 >= 0 and d6 <= 0:
+            return a + ac * (d2 / (d2 - d6))
+        va = d3 * d6 - d5 * d4
+        if va <= 0 and (d4 - d3) >= 0 and (d5 - d6) >= 0:
+            w = (d4 - d3) / ((d4 - d3) + (d5 - d6))
+            return b + w * (c - b)
+        denom = 1.0 / (va + vb + vc)
+        return a + ab * (vb * denom) + ac * (vc * denom)
+
+    t0 = time.perf_counter()
+    for mi in range(n_meshes):
+        v = verts[mi]
+        # normals (vectorized numpy, like reference estimate_vertex_normals)
+        fn = np.cross(v[f[:, 1]] - v[f[:, 0]], v[f[:, 2]] - v[f[:, 0]])
+        vn = np.zeros_like(v)
+        np.add.at(vn, f[:, 0], fn)
+        np.add.at(vn, f[:, 1], fn)
+        np.add.at(vn, f[:, 2], fn)
+        norms = np.linalg.norm(vn, axis=1)
+        norms[norms == 0] = 1
+        vn /= norms[:, None]
+        # closest points: KDTree seed + exact local refinement
+        tree = cKDTree(v)
+        _, seed = tree.query(queries[mi])
+        for qi, p in enumerate(queries[mi]):
+            best_d = np.inf
+            for fi in ring_faces[seed[qi]]:
+                q = closest_on_tri(p, v[f[fi]])
+                d = np.sum((p - q) ** 2)
+                if d < best_d:
+                    best_d = d
+    elapsed = time.perf_counter() - t0
+    per_mesh = elapsed / n_meshes
+    log("cpu baseline: %.3fs/mesh (x%d meshes measured)" % (per_mesh, n_meshes))
+    return per_mesh * BATCH
+
+
+def main():
+    elapsed, total_queries, out, model, betas, pose, queries = tpu_workload()
+    qps = total_queries / elapsed
+    cpu_total = cpu_baseline(model, betas, pose, queries)
+    vs_baseline = cpu_total / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "batch256_smpl_normals_plus_closest_point",
+                "value": round(qps, 1),
+                "unit": "queries/sec",
+                "vs_baseline": round(vs_baseline, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,7 @@
+from .body_model import (  # noqa: F401
+    BodyModel,
+    lbs,
+    load_body_model_npz,
+    synthetic_body_model,
+    smpl_sized_sphere,
+)

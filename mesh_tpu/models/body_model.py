@@ -1,0 +1,239 @@
+"""TPU-native linear-blend-skinning body model (SMPL architecture).
+
+The reference package is the geometric substrate under SMPL / FLAME / MANO
+pipelines (reference README.md:10-22) but contains no body model itself; this
+module supplies the model family those pipelines need, designed TPU-first:
+
+- the whole forward pass (shape blendshapes -> joint regression -> pose
+  blendshapes -> forward kinematics -> skinning) is one jittable function
+  batched over arbitrary leading axes, with the kinematic-tree scan unrolled
+  over the (static) joint count so XLA sees straight-line MXU work;
+- per-joint rotations come from the Taylor-guarded `rodrigues2rotmat`, so
+  gradients flow through theta = 0 (rest pose);
+- weights can be loaded from a standard SMPL-family .npz, or synthesized
+  (`synthetic_body_model`) for tests/benchmarks where real model weights
+  cannot be shipped.
+
+Layout conventions: V vertices, J joints, B shape coefficients.
+"""
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..geometry.rodrigues import rodrigues2rotmat
+
+
+@dataclasses.dataclass(frozen=True)
+class BodyModel:
+    """Model weights as device arrays; `parents` is static metadata."""
+
+    v_template: jax.Array          # (V, 3)
+    shapedirs: jax.Array           # (V, 3, B)
+    posedirs: jax.Array            # (V, 3, 9*(J-1))
+    joint_regressor: jax.Array     # (J, V)
+    lbs_weights: jax.Array         # (V, J)
+    faces: jax.Array               # (F, 3) int32
+    parents: Tuple[int, ...]       # static kinematic tree, parents[0] == -1
+
+    @property
+    def num_vertices(self):
+        return self.v_template.shape[0]
+
+    @property
+    def num_joints(self):
+        return self.joint_regressor.shape[0]
+
+    @property
+    def num_betas(self):
+        return self.shapedirs.shape[-1]
+
+
+jax.tree_util.register_dataclass(
+    BodyModel,
+    data_fields=["v_template", "shapedirs", "posedirs", "joint_regressor",
+                 "lbs_weights", "faces"],
+    meta_fields=["parents"],
+)
+
+
+def _with_homogeneous_row(R, t):
+    """Stack (..., 3, 3) rotation and (..., 3) translation into (..., 4, 4)."""
+    top = jnp.concatenate([R, t[..., :, None]], axis=-1)         # (..., 3, 4)
+    bottom = jnp.broadcast_to(
+        jnp.array([0.0, 0.0, 0.0, 1.0], dtype=R.dtype), top.shape[:-2] + (1, 4)
+    )
+    return jnp.concatenate([top, bottom], axis=-2)
+
+
+def lbs(model, betas, pose, trans=None, precision=jax.lax.Precision.HIGHEST):
+    """Linear blend skinning forward pass.
+
+    :param betas: (..., B) shape coefficients
+    :param pose: (..., J, 3) axis-angle per joint (joint 0 = global rotation)
+    :param trans: optional (..., 3) root translation
+    :param precision: matmul precision for the einsums/FK chain.  Default
+        HIGHEST: XLA's default f32 matmul runs at reduced (bf16-style)
+        precision on TPU, which accumulates to ~cm errors down a 24-joint
+        kinematic chain; pass Precision.DEFAULT to trade accuracy for MXU
+        throughput in benchmarks.
+    :returns: (vertices (..., V, 3), joints (..., J, 3))
+    """
+    betas = jnp.asarray(betas)
+    pose = jnp.asarray(pose)
+    dtype = model.v_template.dtype
+
+    # 1. shape blendshapes
+    v_shaped = model.v_template + jnp.einsum(
+        "vcb,...b->...vc", model.shapedirs, betas.astype(dtype),
+        precision=precision,
+    )
+    # 2. joint locations from the shaped body
+    joints = jnp.einsum(
+        "jv,...vc->...jc", model.joint_regressor, v_shaped, precision=precision
+    )
+    # 3. per-joint rotations + pose blendshapes
+    R = rodrigues2rotmat(pose.astype(dtype))                    # (..., J, 3, 3)
+    eye = jnp.eye(3, dtype=dtype)
+    pose_feature = (R[..., 1:, :, :] - eye).reshape(pose.shape[:-2] + (-1,))
+    v_posed = v_shaped + jnp.einsum(
+        "vcp,...p->...vc", model.posedirs, pose_feature, precision=precision
+    )
+    # 4. forward kinematics, unrolled over the static tree
+    rel_joints = [joints[..., 0, :]]
+    for j in range(1, model.num_joints):
+        rel_joints.append(joints[..., j, :] - joints[..., model.parents[j], :])
+    world = [None] * model.num_joints
+    world[0] = _with_homogeneous_row(R[..., 0, :, :], rel_joints[0])
+    for j in range(1, model.num_joints):
+        local = _with_homogeneous_row(R[..., j, :, :], rel_joints[j])
+        world[j] = jnp.einsum(
+            "...ab,...bc->...ac", world[model.parents[j]], local,
+            precision=precision,
+        )
+    G = jnp.stack(world, axis=-3)                               # (..., J, 4, 4)
+    posed_joints = G[..., :3, 3]
+    # 5. remove the rest-pose joint offset: A_j = G_j - [0 | G_j[:3,:3] j_rest]
+    correction = jnp.einsum(
+        "...jab,...jb->...ja", G[..., :3, :3], joints, precision=precision
+    )
+    A = _with_homogeneous_row(G[..., :3, :3], G[..., :3, 3] - correction)
+    # 6. skinning: blend joint transforms per vertex and apply
+    T = jnp.einsum(
+        "vj,...jab->...vab", model.lbs_weights, A, precision=precision
+    )
+    v_out = (
+        jnp.einsum(
+            "...vab,...vb->...va", T[..., :3, :3], v_posed, precision=precision
+        )
+        + T[..., :3, 3]
+    )
+    if trans is not None:
+        v_out = v_out + jnp.asarray(trans, dtype)[..., None, :]
+        posed_joints = posed_joints + jnp.asarray(trans, dtype)[..., None, :]
+    return v_out, posed_joints
+
+
+def smpl_sized_sphere():
+    """A UV-sphere with *exactly* SMPL's vertex/face counts (6890 v, 13776 f):
+    84 latitude rings x 82 segments + 2 poles.  Used so benchmarks exercise
+    the precise shapes of BASELINE.md configs without shipping SMPL data."""
+    n_seg, n_ring = 82, 84
+    theta = np.pi * (np.arange(1, n_ring + 1)) / (n_ring + 1)
+    phi = 2 * np.pi * np.arange(n_seg) / n_seg
+    rings = np.stack(
+        [
+            np.outer(np.sin(theta), np.cos(phi)),
+            np.outer(np.sin(theta), np.sin(phi)),
+            np.outer(np.cos(theta), np.ones(n_seg)),
+        ],
+        axis=-1,
+    ).reshape(-1, 3)
+    v = np.vstack([[[0, 0, 1.0]], rings, [[0, 0, -1.0]]])
+    faces = []
+    for r in range(n_ring - 1):
+        base0 = 1 + r * n_seg
+        base1 = 1 + (r + 1) * n_seg
+        for s in range(n_seg):
+            s1 = (s + 1) % n_seg
+            faces.append([base0 + s, base1 + s, base1 + s1])
+            faces.append([base0 + s, base1 + s1, base0 + s1])
+    for s in range(n_seg):  # pole fans
+        s1 = (s + 1) % n_seg
+        faces.append([0, 1 + s, 1 + s1])
+        last = 1 + (n_ring - 1) * n_seg
+        faces.append([len(v) - 1, last + s1, last + s])
+    f = np.array(faces, dtype=np.int32)
+    assert v.shape == (6890, 3) and f.shape == (13776, 3)
+    return v, f
+
+
+def synthetic_body_model(seed=0, n_betas=10, n_joints=24, template=None,
+                         dtype=jnp.float32):
+    """A well-formed random body model for tests and benchmarks.
+
+    Joint centers are placed along a chain inside the body; skinning weights
+    are a softmax over vertex-to-joint distances (smooth, convex); shape/pose
+    blendshape magnitudes roughly match SMPL's (~cm scale).
+    """
+    rng = np.random.RandomState(seed)
+    if template is None:
+        v, f = smpl_sized_sphere()
+        v = v * np.array([0.3, 0.2, 0.9])  # body-ish proportions, meters
+    else:
+        v, f = template
+    n_v = v.shape[0]
+
+    # kinematic chain: root at centroid, children spread along +z
+    parents = [-1] + [max(0, j - 1 + (0 if j < 3 else rng.randint(-2, 1))) for j in range(1, n_joints)]
+    z_span = np.linspace(v[:, 2].min(), v[:, 2].max(), n_joints)
+    joint_centers = np.stack(
+        [0.05 * rng.randn(n_joints), 0.05 * rng.randn(n_joints), z_span], axis=1
+    )
+    # joint regressor: normalized RBF of vertices around each center
+    d2 = ((v[None, :, :] - joint_centers[:, None, :]) ** 2).sum(-1)
+    reg = np.exp(-d2 / 0.02)
+    joint_regressor = reg / reg.sum(axis=1, keepdims=True)
+    # skinning weights: softmax over -distance to joints
+    w = np.exp(-d2.T / 0.05)
+    lbs_weights = w / w.sum(axis=1, keepdims=True)
+    # smooth random blendshapes (low-frequency via joint-space mixing)
+    shape_basis = reg.T @ rng.randn(n_joints, 3 * n_betas) * 0.5
+    shapedirs = shape_basis.reshape(n_v, 3, n_betas) * 0.3
+    posedirs = (reg.T @ rng.randn(n_joints, 3 * 9 * (n_joints - 1))).reshape(
+        n_v, 3, 9 * (n_joints - 1)
+    ) * 0.01
+
+    return BodyModel(
+        v_template=jnp.asarray(v, dtype),
+        shapedirs=jnp.asarray(shapedirs, dtype),
+        posedirs=jnp.asarray(posedirs, dtype),
+        joint_regressor=jnp.asarray(joint_regressor, dtype),
+        lbs_weights=jnp.asarray(lbs_weights, dtype),
+        faces=jnp.asarray(f, jnp.int32),
+        parents=tuple(parents),
+    )
+
+
+def load_body_model_npz(path, dtype=jnp.float32):
+    """Load a standard SMPL-family .npz (keys: v_template, shapedirs,
+    posedirs, J_regressor, weights, f, kintree_table)."""
+    data = np.load(path, allow_pickle=True)
+    kintree = np.asarray(data["kintree_table"])
+    parents = kintree[0].astype(np.int64)
+    parents[0] = -1
+    posedirs = np.asarray(data["posedirs"])
+    if posedirs.ndim == 3:
+        posedirs = posedirs.reshape(posedirs.shape[0], 3, -1)
+    return BodyModel(
+        v_template=jnp.asarray(data["v_template"], dtype),
+        shapedirs=jnp.asarray(np.asarray(data["shapedirs"]), dtype),
+        posedirs=jnp.asarray(posedirs, dtype),
+        joint_regressor=jnp.asarray(np.asarray(data["J_regressor"]), dtype),
+        lbs_weights=jnp.asarray(np.asarray(data["weights"]), dtype),
+        faces=jnp.asarray(np.asarray(data["f"]), jnp.int32),
+        parents=tuple(int(p) for p in parents),
+    )

@@ -1,0 +1,7 @@
+from .sharding import (  # noqa: F401
+    make_device_mesh,
+    shard_queries,
+    sharded_closest_faces_and_points,
+    sharded_batched_vert_normals,
+)
+from .fit import FitState, make_fit_step, init_fit_state, fit_scan  # noqa: F401

@@ -1,0 +1,121 @@
+"""Multi-device sharding for mesh queries (SURVEY.md P6 / section 5).
+
+The scaling axes of this framework are Q (query points), B (mesh batch), and
+V/F (mesh size) — the geometric analog of sequence parallelism.  Closest-point
+is embarrassingly parallel over queries, so the design is:
+
+- topology (f) and mesh vertices are replicated,
+- the query axis (or the mesh batch axis) is sharded over the ICI mesh,
+- `shard_map` runs the single-device kernel per shard; the only collective is
+  the implicit all-gather of the output (no ring structure needed —
+  SURVEY.md section 5, "Long-context" entry).
+
+On a v5e-8 slice `make_device_mesh()` yields an 8-way ("dp",) mesh or a 2D
+("dp", "sp") mesh; multi-host extends transparently via jax.distributed
+(DCN between hosts, ICI within).
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..geometry.vert_normals import vert_normals
+from ..query.closest_point import closest_faces_and_points
+
+
+def make_device_mesh(n_devices=None, axis_names=("dp",), shape=None):
+    """Build a jax.sharding.Mesh over the first n devices.
+
+    :param shape: explicit mesh shape per axis name; default puts all devices
+        on the first axis.
+    """
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    devices = np.asarray(devices[:n])
+    if shape is None:
+        shape = (n,) + (1,) * (len(axis_names) - 1)
+    return Mesh(devices.reshape(shape), axis_names)
+
+
+def shard_queries(points, mesh, axis="dp"):
+    """Place query points sharded along their leading axis."""
+    return jax.device_put(points, NamedSharding(mesh, P(axis)))
+
+
+def _pad_rows(arr, multiple):
+    pad = (-arr.shape[0]) % multiple
+    if pad:
+        arr = np.concatenate([arr, np.repeat(arr[-1:], pad, axis=0)], axis=0)
+    return arr, pad
+
+
+def sharded_closest_faces_and_points(v, f, points, mesh, axis="dp", chunk=512):
+    """Closest-point query sharded over the query axis of an ICI mesh.
+
+    v/f are replicated to every device; each device runs the tiled
+    brute-force kernel on its query shard (BASELINE config 5: 100k-vert scan
+    vs SMPL over v5e-8).  Returns the same dict as closest_faces_and_points.
+    """
+    n_shards = mesh.devices.size if axis == "dp" else mesh.shape[axis]
+    points = np.asarray(points, np.float32)
+    points_padded, pad = _pad_rows(points, n_shards)
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(), P(axis)),
+        out_specs=P(axis),
+    )
+    def _run(v_rep, f_rep, pts_shard):
+        res = closest_faces_and_points(v_rep, f_rep, pts_shard, chunk=chunk)
+        return jnp.stack(
+            [
+                res["face"].astype(jnp.float32),
+                res["part"].astype(jnp.float32),
+                res["sqdist"],
+                res["point"][:, 0],
+                res["point"][:, 1],
+                res["point"][:, 2],
+            ],
+            axis=1,
+        )
+
+    out = jax.jit(_run)(
+        jnp.asarray(v, jnp.float32), jnp.asarray(f, jnp.int32),
+        jax.device_put(
+            points_padded, NamedSharding(mesh, P(axis))
+        ),
+    )
+    out = np.asarray(out)
+    if pad:
+        out = out[:-pad]
+    return {
+        "face": out[:, 0].astype(np.int32),
+        "part": out[:, 1].astype(np.int32),
+        "sqdist": out[:, 2],
+        "point": out[:, 3:6],
+    }
+
+
+def sharded_batched_vert_normals(v_batch, f, mesh, axis="dp"):
+    """Vertex normals for a batch of meshes, batch axis sharded over devices
+    (BASELINE config 3 at multi-chip scale)."""
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(axis), P()),
+        out_specs=P(axis),
+    )
+    def _run(vb, f_rep):
+        return vert_normals(vb, f_rep)
+
+    return jax.jit(_run)(
+        jax.device_put(
+            jnp.asarray(v_batch, jnp.float32), NamedSharding(mesh, P(axis))
+        ),
+        jnp.asarray(f, jnp.int32),
+    )

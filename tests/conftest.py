@@ -1,16 +1,34 @@
 """Test harness config: force an 8-device CPU JAX platform before jax loads,
 so multi-device sharding tests run anywhere (SURVEY.md section 4: the
 reference forks real viewer processes; we use XLA's host-platform device
-virtualization for the device-level analog)."""
+virtualization for the device-level analog).
+
+NOTE on this machine's TPU tunnel: an `axon` sitecustomize hook registers the
+TPU PJRT plugin in every python process and overrides JAX_PLATFORMS=cpu.  It
+only activates when PALLAS_AXON_POOL_IPS is set, so clearing that variable
+(plus JAX_PLATFORMS=cpu) is what actually yields a CPU backend here.  Real-
+TPU verification runs use the default environment instead (see
+.claude/skills/verify/SKILL.md).
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["PALLAS_AXON_POOL_IPS"] = ""     # disable the axon TPU hook
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_NUM_CPU_DEVICES", "8")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+# The axon sitecustomize registers its plugin at interpreter start and calls
+# jax.config.update("jax_platforms", "axon,cpu"), overriding the env var —
+# counter-update the config here, before any backend is initialized.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
 # per-test-session topology cache (reference Makefile:9-25 uses a throwaway
 # PSBODY_MESH_CACHE for the same reason)
 import tempfile

@@ -1,0 +1,107 @@
+"""Body model (LBS) tests: rest-pose identity, rigid-transform equivariance,
+batching, differentiability."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from mesh_tpu.models import lbs, smpl_sized_sphere, synthetic_body_model
+
+
+def _small_model():
+    from mesh_tpu.sphere import _icosphere
+
+    v, f = _icosphere(1)
+    return synthetic_body_model(seed=1, n_betas=4, n_joints=6,
+                                template=(v, f.astype(np.int32)))
+
+
+class TestSmplSizedSphere:
+    def test_exact_smpl_shapes(self):
+        v, f = smpl_sized_sphere()
+        assert v.shape == (6890, 3)
+        assert f.shape == (13776, 3)
+        # closed manifold: every edge shared by exactly 2 faces
+        edges = np.sort(
+            np.concatenate([f[:, [0, 1]], f[:, [1, 2]], f[:, [2, 0]]]), axis=1
+        )
+        _, counts = np.unique(edges, axis=0, return_counts=True)
+        assert (counts == 2).all()
+
+
+class TestLBS:
+    def test_rest_pose_is_template(self):
+        model = _small_model()
+        verts, joints = lbs(
+            model,
+            jnp.zeros((model.num_betas,)),
+            jnp.zeros((model.num_joints, 3)),
+        )
+        np.testing.assert_allclose(
+            np.asarray(verts), np.asarray(model.v_template), atol=1e-5
+        )
+
+    def test_global_rotation_is_rigid(self):
+        """Rotating only the root joint rigidly rotates the whole body about
+        the root."""
+        model = _small_model()
+        pose = np.zeros((model.num_joints, 3), np.float32)
+        pose[0] = [0.0, 0.0, np.pi / 2]
+        verts, joints = lbs(model, jnp.zeros(model.num_betas), jnp.asarray(pose))
+        rest, rest_joints = lbs(
+            model, jnp.zeros(model.num_betas), jnp.zeros((model.num_joints, 3))
+        )
+        Rz = np.array([[0, -1, 0], [1, 0, 0], [0, 0, 1.0]])
+        root = np.asarray(rest_joints)[0]
+        expected = (np.asarray(rest) - root) @ Rz.T + root
+        np.testing.assert_allclose(np.asarray(verts), expected, atol=1e-4)
+
+    def test_translation(self):
+        model = _small_model()
+        t = jnp.asarray([1.0, 2.0, 3.0])
+        verts, joints = lbs(
+            model, jnp.zeros(model.num_betas),
+            jnp.zeros((model.num_joints, 3)), t
+        )
+        rest, _ = lbs(
+            model, jnp.zeros(model.num_betas), jnp.zeros((model.num_joints, 3))
+        )
+        np.testing.assert_allclose(
+            np.asarray(verts), np.asarray(rest) + np.asarray(t), atol=1e-5
+        )
+
+    def test_batched_matches_single(self):
+        model = _small_model()
+        rng = np.random.RandomState(0)
+        betas = jnp.asarray(rng.randn(3, model.num_betas) * 0.3, jnp.float32)
+        pose = jnp.asarray(rng.randn(3, model.num_joints, 3) * 0.2, jnp.float32)
+        batched, _ = lbs(model, betas, pose)
+        for i in range(3):
+            single, _ = lbs(model, betas[i], pose[i])
+            np.testing.assert_allclose(
+                np.asarray(batched[i]), np.asarray(single), atol=1e-5
+            )
+
+    def test_shape_blendshapes_move_vertices(self):
+        model = _small_model()
+        betas = jnp.zeros(model.num_betas).at[0].set(2.0)
+        shaped, _ = lbs(model, betas, jnp.zeros((model.num_joints, 3)))
+        rest, _ = lbs(model, jnp.zeros(model.num_betas), jnp.zeros((model.num_joints, 3)))
+        assert float(jnp.abs(shaped - rest).max()) > 1e-3
+
+    def test_differentiable(self):
+        model = _small_model()
+
+        def loss(pose):
+            v, _ = lbs(model, jnp.zeros(model.num_betas), pose)
+            return jnp.sum(v ** 2)
+
+        g = jax.grad(loss)(jnp.zeros((model.num_joints, 3)))
+        assert np.all(np.isfinite(np.asarray(g)))
+        assert float(jnp.abs(g).max()) > 0  # gradient at rest pose is nonzero
+
+    def test_jit_compiles(self):
+        model = _small_model()
+        fn = jax.jit(lambda b, p: lbs(model, b, p)[0])
+        out = fn(jnp.zeros(model.num_betas), jnp.zeros((model.num_joints, 3)))
+        assert out.shape == (model.num_vertices, 3)
