@@ -517,8 +517,8 @@ class Mesh(object):
     def load_from_ply(self, filename):
         serialization.load_from_ply(self, filename)
 
-    def load_from_obj(self, filename):
-        serialization.load_from_obj(self, filename)
+    def load_from_obj(self, filename, use_native=False):
+        serialization.load_from_obj(self, filename, use_native=use_native)
 
     def write_json(self, filename, header="", footer="", name="",
                    include_faces=True, texture_mode=True):

@@ -1,0 +1,195 @@
+"""Pallas TPU kernel for brute-force closest-point-on-mesh.
+
+The plain-JAX path (closest_point.py) materializes a (Q, F) distance matrix
+(plus barycentric intermediates) in HBM per query tile — bandwidth-bound.
+This kernel tiles (query x face) onto the VPU and keeps the running
+min/argmin accumulators in VMEM, so HBM traffic is O(Q + F) instead of
+O(Q * F): each (TQ, TF) tile computes the branch-free Ericson point-triangle
+squared distance and folds it into per-query best-distance / best-face
+registers.  The exact closest point and CGAL part code are recomputed on the
+winning faces afterwards (O(Q) work) by the shared point_triangle module.
+
+Inputs are passed as component planes — px/py/pz of shape (Q, 1) and
+ax/.../cz of shape (1, F) — so every kernel operand broadcasts to the native
+(TQ, TF) VPU tile shape with no in-kernel transposes.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .point_triangle import closest_point_on_triangle
+
+_BIG = 1e30
+
+
+def _sqdist_tile(px, py, pz, ax, ay, az, bx, by, bz, cx, cy, cz):
+    """Branch-free Ericson closest-point squared distance on a (TQ, TF) tile.
+
+    Component-plane version of point_triangle.closest_point_barycentric:
+    identical region logic, but expressed on x/y/z planes so the whole tile
+    stays in native 2D vector registers.
+    """
+
+    def dot(ux, uy, uz, vx, vy, vz):
+        return ux * vx + uy * vy + uz * vz
+
+    abx, aby, abz = bx - ax, by - ay, bz - az
+    acx, acy, acz = cx - ax, cy - ay, cz - az
+    apx, apy, apz = px - ax, py - ay, pz - az
+    d1 = dot(abx, aby, abz, apx, apy, apz)
+    d2 = dot(acx, acy, acz, apx, apy, apz)
+    bpx, bpy, bpz = px - bx, py - by, pz - bz
+    d3 = dot(abx, aby, abz, bpx, bpy, bpz)
+    d4 = dot(acx, acy, acz, bpx, bpy, bpz)
+    cpx, cpy, cpz = px - cx, py - cy, pz - cz
+    d5 = dot(abx, aby, abz, cpx, cpy, cpz)
+    d6 = dot(acx, acy, acz, cpx, cpy, cpz)
+
+    va = d3 * d6 - d5 * d4
+    vb = d5 * d2 - d1 * d6
+    vc = d1 * d4 - d3 * d2
+
+    def safe_div(n, d):
+        return n / jnp.where(d == 0, 1.0, d)
+
+    t_ab = safe_div(d1, d1 - d3)
+    t_ca = safe_div(d2, d2 - d6)
+    t_bc = safe_div(d4 - d3, (d4 - d3) + (d5 - d6))
+    denom = safe_div(jnp.ones_like(va), va + vb + vc)
+    v_in = vb * denom
+    w_in = vc * denom
+
+    # barycentric (b1, b2) per region, selected in priority order
+    b1 = v_in
+    b2 = w_in
+    on_bc = (va <= 0) & (d4 - d3 >= 0) & (d5 - d6 >= 0)
+    b1 = jnp.where(on_bc, 1.0 - t_bc, b1)
+    b2 = jnp.where(on_bc, t_bc, b2)
+    on_ca = (vb <= 0) & (d2 >= 0) & (d6 <= 0)
+    b1 = jnp.where(on_ca, 0.0, b1)
+    b2 = jnp.where(on_ca, t_ca, b2)
+    on_ab = (vc <= 0) & (d1 >= 0) & (d3 <= 0)
+    b1 = jnp.where(on_ab, t_ab, b1)
+    b2 = jnp.where(on_ab, 0.0, b2)
+    in_c = (d6 >= 0) & (d5 <= d6)
+    b1 = jnp.where(in_c, 0.0, b1)
+    b2 = jnp.where(in_c, 1.0, b2)
+    in_b = (d3 >= 0) & (d4 <= d3)
+    b1 = jnp.where(in_b, 1.0, b1)
+    b2 = jnp.where(in_b, 0.0, b2)
+    in_a = (d1 <= 0) & (d2 <= 0)
+    b1 = jnp.where(in_a, 0.0, b1)
+    b2 = jnp.where(in_a, 0.0, b2)
+
+    qx = ax + b1 * abx + b2 * acx
+    qy = ay + b1 * aby + b2 * acy
+    qz = az + b1 * abz + b2 * acz
+    dx, dy, dz = px - qx, py - qy, pz - qz
+    return dx * dx + dy * dy + dz * dz
+
+
+def _kernel(px, py, pz, ax, ay, az, bx, by, bz, cx, cy, cz,
+            out_d, out_i, acc_d, acc_i):
+    j = pl.program_id(1)
+    n_j = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_d[:] = jnp.full_like(acc_d, _BIG)
+        acc_i[:] = jnp.zeros_like(acc_i)
+
+    d2 = _sqdist_tile(
+        px[:], py[:], pz[:], ax[:], ay[:], az[:],
+        bx[:], by[:], bz[:], cx[:], cy[:], cz[:],
+    )  # (TQ, TF)
+    tf = d2.shape[1]
+    tile_min = jnp.min(d2, axis=1, keepdims=True)            # (TQ, 1)
+    tile_arg = jnp.argmin(d2, axis=1).astype(jnp.int32)[:, None] + j * tf
+    better = tile_min < acc_d[:]
+    acc_d[:] = jnp.where(better, tile_min, acc_d[:])
+    acc_i[:] = jnp.where(better, tile_arg, acc_i[:])
+
+    @pl.when(j == n_j - 1)
+    def _write():
+        out_d[:] = acc_d[:]
+        out_i[:] = acc_i[:]
+
+
+def _pad_cols(x, multiple, fill):
+    pad = (-x.shape[-1]) % multiple
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad)), constant_values=fill)
+    return x
+
+
+def _pad_rows(x, multiple, fill):
+    pad = (-x.shape[0]) % multiple
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)), constant_values=fill)
+    return x
+
+
+@partial(jax.jit, static_argnames=("tile_q", "tile_f", "interpret"))
+def closest_point_pallas(v, f, points, tile_q=256, tile_f=2048, interpret=False):
+    """Pallas-accelerated closest_faces_and_points.
+
+    Same contract as query.closest_faces_and_points: returns dict with
+    ``face`` [Q] int32, ``part`` [Q] int32, ``point`` [Q, 3], ``sqdist`` [Q].
+    """
+    v = jnp.asarray(v, jnp.float32)
+    points = jnp.asarray(points, jnp.float32)
+    center = jnp.mean(v, axis=0)
+    vc_ = v - center
+    pts = points - center
+
+    tri = vc_[f]  # (F, 3, 3)
+    n_q = pts.shape[0]
+
+    p_cols = [_pad_rows(pts[:, k:k + 1], tile_q, 0.0) for k in range(3)]
+    tri_rows = [
+        _pad_cols(tri[:, corner, k][None, :], tile_f, _BIG)
+        for corner in range(3)
+        for k in range(3)
+    ]  # ax, ay, az, bx, ..., cz each (1, F_pad)
+    q_pad = p_cols[0].shape[0]
+    f_pad = tri_rows[0].shape[1]
+    grid = (q_pad // tile_q, f_pad // tile_f)
+
+    out_d, out_i = pl.pallas_call(
+        _kernel,
+        grid=grid,
+        in_specs=[
+            *[pl.BlockSpec((tile_q, 1), lambda i, j: (i, 0)) for _ in range(3)],
+            *[pl.BlockSpec((1, tile_f), lambda i, j: (0, j)) for _ in range(9)],
+        ],
+        out_specs=[
+            pl.BlockSpec((tile_q, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((tile_q, 1), lambda i, j: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((q_pad, 1), jnp.float32),
+            jax.ShapeDtypeStruct((q_pad, 1), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((tile_q, 1), jnp.float32),
+            pltpu.VMEM((tile_q, 1), jnp.int32),
+        ],
+        interpret=interpret,
+    )(*p_cols, *tri_rows)
+
+    best = out_i[:n_q, 0]
+    # exact recompute on the winning faces (also yields the CGAL part code)
+    a, b, c = tri[:, 0], tri[:, 1], tri[:, 2]
+    point, sqd, part = closest_point_on_triangle(
+        pts, a[best], b[best], c[best]
+    )
+    return {
+        "face": best,
+        "part": part,
+        "point": point + center,
+        "sqdist": sqd,
+    }

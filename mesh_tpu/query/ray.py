@@ -26,6 +26,13 @@ import jax
 import jax.numpy as jnp
 
 _EPS = 1e-9
+# Barycentric inclusion tolerance for ray hits.  Must be much wider than f32
+# roundoff: a ray crossing exactly on the shared edge of two triangles must
+# register on at least one of them (with 1e-9 it can slip through the crack
+# between both and a back-face vertex reports visible).  1e-6 in barycentric
+# units errs toward counting edge hits on both neighbors, matching CGAL's
+# exact-arithmetic behavior for occlusion tests.
+_BARY_EPS = 1e-6
 # The reference uses 1e100 as its no-hit sentinel (spatialsearchmodule.cpp:
 # 309-311); that overflows float32, so device code uses +inf and the Mesh
 # facade converts to 1e100 at the numpy boundary.
@@ -36,11 +43,15 @@ def _dot(x, y):
     return jnp.sum(x * y, axis=-1)
 
 
-def ray_triangle_hits(o, d, a, b, c, eps=_EPS):
+def ray_triangle_hits(o, d, a, b, c, eps=_EPS, bary_eps=_BARY_EPS):
     """Moller-Trumbore: signed ray parameter t per (ray, triangle) pair.
 
     All inputs broadcastable to [..., 3].  Returns (t, hit): the intersection
     is at o + t*d where `hit` (t unrestricted in sign — callers clamp).
+    `eps` guards the parallel-ray determinant; `bary_eps` is the barycentric
+    inclusion tolerance (wide default for watertight occlusion/along-normal
+    queries; intersection predicates pass a tight value — see
+    tri_tri_intersects).
     """
     e1 = b - a
     e2 = c - a
@@ -53,7 +64,12 @@ def ray_triangle_hits(o, d, a, b, c, eps=_EPS):
     qvec = jnp.cross(tvec, e1)
     v = _dot(d, qvec) * inv_det
     t = _dot(e2, qvec) * inv_det
-    hit = (~parallel) & (u >= -eps) & (v >= -eps) & (u + v <= 1 + eps)
+    hit = (
+        (~parallel)
+        & (u >= -bary_eps)
+        & (v >= -bary_eps)
+        & (u + v <= 1 + bary_eps)
+    )
     return t, hit
 
 
@@ -104,9 +120,12 @@ def nearest_alongnormal(v, f, points, normals, chunk=512):
 
 
 def _segment_hits_triangles(s0, s1, a, b, c, eps=_EPS):
-    """True where segment s0->s1 crosses triangle abc (broadcast [...])."""
+    """True where segment s0->s1 crosses triangle abc (broadcast [...]).
+
+    Uses a tight barycentric tolerance: intersection predicates must not
+    report grazing-but-separate geometry as intersecting."""
     d = s1 - s0
-    t, hit = ray_triangle_hits(s0, d, a, b, c, eps)
+    t, hit = ray_triangle_hits(s0, d, a, b, c, eps, bary_eps=eps)
     return hit & (t >= -eps) & (t <= 1 + eps)
 
 

@@ -1,0 +1,141 @@
+"""ctypes binding for the native I/O core (native/meshio.cpp).
+
+Compiles the shared library on first use into the package cache folder
+(g++ -O3; no pybind11 in the image, so the ABI is plain C consumed through
+ctypes).  Falls back silently when no compiler is available — callers check
+`available()` and use the pure-Python parser otherwise, preserving the
+reference's graceful-degradation idiom for missing compiled extensions
+(reference mesh.py:21-24)."""
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+_SRC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+    "meshio.cpp",
+)
+
+
+def _build_and_load():
+    from .. import mesh_package_cache_folder
+
+    so_path = os.path.join(mesh_package_cache_folder, "meshio.so")
+    if not os.path.exists(so_path) or (
+        os.path.exists(_SRC)
+        and os.path.getmtime(_SRC) > os.path.getmtime(so_path)
+    ):
+        if not os.path.exists(_SRC):
+            return None
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-o", so_path, _SRC]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        except Exception:
+            return None
+    lib = ctypes.CDLL(so_path)
+    lib.obj_load.restype = ctypes.c_void_p
+    lib.obj_load.argtypes = [ctypes.c_char_p]
+    lib.obj_free.argtypes = [ctypes.c_void_p]
+    lib.obj_error.restype = ctypes.c_char_p
+    lib.obj_error.argtypes = [ctypes.c_void_p]
+    lib.obj_events.restype = ctypes.c_char_p
+    lib.obj_events.argtypes = [ctypes.c_void_p]
+    lib.obj_counts.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64)]
+    lib.obj_copy.argtypes = [ctypes.c_void_p] + [ctypes.c_void_p] * 7
+    return lib
+
+
+def _get_lib():
+    global _lib, _tried
+    with _lock:
+        if not _tried:
+            _tried = True
+            try:
+                _lib = _build_and_load()
+            except Exception:
+                _lib = None
+    return _lib
+
+
+def available():
+    return _get_lib() is not None
+
+
+def load_obj_native(filename):
+    """Parse an OBJ with the native core; same dict contract as
+    serialization.obj.load_obj.  Raises on I/O errors."""
+    from ..errors import SerializationError
+
+    lib = _get_lib()
+    if lib is None:
+        raise RuntimeError("native meshio unavailable")
+    handle = lib.obj_load(filename.encode())
+    try:
+        err = lib.obj_error(handle)
+        if err:
+            raise SerializationError(err.decode())
+        counts = (ctypes.c_int64 * 8)()
+        lib.obj_counts(handle, counts)
+        nv, nvt, nvn, nf, nft, nfn, nvc, vtw = (int(c) for c in counts)
+
+        def buf(n, width, dtype):
+            return np.empty((n, width), dtype=dtype) if n else None
+
+        v = buf(nv, 3, np.float64)
+        vt = buf(nvt, vtw, np.float64)
+        vn = buf(nvn, 3, np.float64)
+        vc = buf(nvc, 3, np.float64)
+        f = buf(nf, 3, np.int64)
+        ft = buf(nft, 3, np.int64)
+        fn = buf(nfn, 3, np.int64)
+
+        def ptr(arr):
+            return arr.ctypes.data_as(ctypes.c_void_p) if arr is not None else None
+
+        lib.obj_copy(handle, ptr(v), ptr(vt), ptr(vn), ptr(vc),
+                     ptr(f), ptr(ft), ptr(fn))
+        events = lib.obj_events(handle).decode()
+    finally:
+        lib.obj_free(handle)
+
+    out = {
+        "v": v if v is not None else np.zeros((0, 3)),
+        "f": f if f is not None else np.zeros((0, 3), np.int64),
+    }
+    for key, arr in (("vt", vt), ("vn", vn), ("vc", vc), ("ft", ft), ("fn", fn)):
+        if arr is not None:
+            out[key] = arr
+
+    # decode the event log: segment starts, landmarks, mtllib
+    segm = {}
+    landm = {}
+    seg_starts = []  # (face_idx, name) in order
+    for line in events.splitlines():
+        kind, _, rest = line.partition(" ")
+        if kind == "g":
+            name, _, idx = rest.rpartition(" ")
+            seg_starts.append((int(idx), name))
+            segm.setdefault(name, [])
+        elif kind == "l":
+            name, _, idx = rest.rpartition(" ")
+            landm[name] = int(idx)
+        elif kind == "m":
+            out["mtl_path"] = rest
+    if seg_starts:
+        n_faces = out["f"].shape[0]
+        for i, (start, name) in enumerate(seg_starts):
+            end = seg_starts[i + 1][0] if i + 1 < len(seg_starts) else n_faces
+            segm[name].extend(range(start, end))
+    if segm:
+        out["segm"] = segm
+    if landm:
+        out["landm"] = landm
+    return out

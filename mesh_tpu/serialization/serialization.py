@@ -27,8 +27,19 @@ __all__ = [
 ]
 
 
-def load_from_obj(self, filename):
-    data = load_obj(filename)
+def _load_obj_dict(filename, use_native=True):
+    """Parse with the native C++ core when available (the reference's
+    use_cpp=True default, serialization.py:414-418), else pure Python."""
+    if use_native:
+        from . import native
+
+        if native.available():
+            return native.load_obj_native(filename)
+    return load_obj(filename)
+
+
+def load_from_obj(self, filename, use_native=False):
+    data = _load_obj_dict(filename, use_native=use_native)
     self.v = data["v"]
     self.f = data["f"]
     for key in ("vc", "vt", "vn", "ft", "fn"):
@@ -55,10 +66,10 @@ def load_from_obj(self, filename):
         self.recompute_landmark_xyz()
 
 
-# the reference distinguishes a slow python and a fast C++ OBJ path
-# (serialization.py:410-418); here there is one parser, exposed under both
-# names for API parity
-load_from_obj_cpp = load_from_obj
+def load_from_obj_cpp(self, filename):
+    """The fast native path (reference load_from_obj_cpp,
+    serialization.py:97-131), with silent fallback to the Python parser."""
+    return load_from_obj(self, filename, use_native=True)
 
 
 def load_from_ply(self, filename):
@@ -80,7 +91,7 @@ def load_from_file(self, filename, use_cpp=True):
     if re.search(".ply$", filename):
         self.load_from_ply(filename)
     elif re.search(".obj$", filename):
-        load_from_obj(self, filename)
+        load_from_obj(self, filename, use_native=use_cpp)
     else:
         raise NotImplementedError("Unknown mesh file format.")
 

@@ -1,0 +1,216 @@
+// Fast OBJ parser for mesh_tpu — native I/O core.
+//
+// TPU-native analog of the reference's C++ loader (mesh/src/py_loadobj.cpp):
+// the device side of the framework is JAX/Pallas, but file ingest is still
+// host CPU work, and Python-level line parsing is the bottleneck the
+// reference grew a C++ loader for (serialization.py:414: "XXX experimental
+// cpp obj loader" is the default).  This library exposes a plain C ABI
+// consumed via ctypes (no pybind11 in the image): parse once into growable
+// buffers, hand Python flat arrays + a compact event log for segments,
+// landmarks and mtllib lines.
+//
+// Supported surface (parity with py_loadobj.cpp:105-189):
+//   v x y z [r g b]      vt u v [w]        vn x y z
+//   f a b c d...         (fan triangulation; a, a/t, a/t/n, a//n forms)
+//   g <name>             #landmark <name>  mtllib <path>
+
+#include <cctype>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct ObjData {
+  std::vector<double> v, vt, vn, vc;
+  std::vector<int64_t> f, ft, fn;
+  int vt_width = 2;
+  // event log: lines of "g <name> <next_face_idx>", "l <name> <next_vert>",
+  // "m <mtl_path>" — decoded by the Python binding
+  std::string events;
+  std::string error;
+};
+
+inline const char* skip_ws(const char* p) {
+  while (*p == ' ' || *p == '\t' || *p == '\r') ++p;
+  return p;
+}
+
+inline const char* next_token(const char* p, std::string* out) {
+  p = skip_ws(p);
+  const char* start = p;
+  while (*p && *p != ' ' && *p != '\t' && *p != '\r' && *p != '\n') ++p;
+  out->assign(start, p - start);
+  return p;
+}
+
+// parse up to `max_vals` doubles; returns count parsed
+inline int parse_doubles(const char* p, double* out, int max_vals) {
+  int n = 0;
+  char* end = nullptr;
+  while (n < max_vals) {
+    p = skip_ws(p);
+    if (*p == '\0' || *p == '\n') break;
+    double val = strtod(p, &end);
+    if (end == p) break;
+    out[n++] = val;
+    p = end;
+  }
+  return n;
+}
+
+}  // namespace
+
+extern "C" {
+
+ObjData* obj_load(const char* path) {
+  FILE* fp = fopen(path, "rb");
+  auto* data = new ObjData();
+  if (!fp) {
+    data->error = std::string("could not open ") + path;
+    return data;
+  }
+  // slurp the file; OBJ files are line-oriented ascii
+  fseek(fp, 0, SEEK_END);
+  long size = ftell(fp);
+  fseek(fp, 0, SEEK_SET);
+  std::string buf(size, '\0');
+  size_t got = fread(&buf[0], 1, size, fp);
+  fclose(fp);
+  buf.resize(got);
+
+  std::string pending_landmark;
+  std::string tok;
+  std::vector<int64_t> corner_v, corner_t, corner_n;
+
+  const char* p = buf.c_str();
+  const char* bufend = p + buf.size();
+  while (p < bufend) {
+    const char* line_end = static_cast<const char*>(memchr(p, '\n', bufend - p));
+    if (!line_end) line_end = bufend;
+    const char* q = skip_ws(p);
+    if (q[0] == 'v' && (q[1] == ' ' || q[1] == '\t')) {
+      double vals[6];
+      int n = parse_doubles(q + 1, vals, 6);
+      if (n >= 3) {
+        data->v.insert(data->v.end(), vals, vals + 3);
+        if (n == 6) data->vc.insert(data->vc.end(), vals + 3, vals + 6);
+        if (!pending_landmark.empty()) {
+          data->events += "l " + pending_landmark + " " +
+                          std::to_string(data->v.size() / 3 - 1) + "\n";
+          pending_landmark.clear();
+        }
+      }
+    } else if (q[0] == 'v' && q[1] == 't') {
+      // always store 3 slots per vt so a mid-file 2->3 component switch
+      // cannot misalign the buffer; obj_copy strides by the final width
+      double vals[3] = {0.0, 0.0, 0.0};
+      int n = parse_doubles(q + 2, vals, 3);
+      if (n >= 2) {
+        if (n == 3) data->vt_width = 3;
+        data->vt.insert(data->vt.end(), vals, vals + 3);
+      }
+    } else if (q[0] == 'v' && q[1] == 'n') {
+      double vals[3];
+      if (parse_doubles(q + 2, vals, 3) == 3)
+        data->vn.insert(data->vn.end(), vals, vals + 3);
+    } else if (q[0] == 'f' && (q[1] == ' ' || q[1] == '\t')) {
+      corner_v.clear();
+      corner_t.clear();
+      corner_n.clear();
+      const char* c = q + 1;
+      while (c < line_end) {
+        c = skip_ws(c);
+        if (c >= line_end || *c == '\n') break;
+        char* end = nullptr;
+        long a = strtol(c, &end, 10);
+        if (end == c) break;
+        c = end;
+        long t = 0, nn = 0;
+        bool has_t = false, has_n = false;
+        if (*c == '/') {
+          ++c;
+          if (*c != '/') {
+            t = strtol(c, &end, 10);
+            has_t = end != c;
+            c = end;
+          }
+          if (*c == '/') {
+            ++c;
+            nn = strtol(c, &end, 10);
+            has_n = end != c;
+            c = end;
+          }
+        }
+        corner_v.push_back(a);
+        corner_t.push_back(has_t ? t : 0);
+        corner_n.push_back(has_n ? nn : 0);
+      }
+      for (size_t i = 1; i + 1 < corner_v.size(); ++i) {
+        data->f.push_back(corner_v[0] - 1);
+        data->f.push_back(corner_v[i] - 1);
+        data->f.push_back(corner_v[i + 1] - 1);
+        if (corner_t[0] > 0) {
+          data->ft.push_back(corner_t[0] - 1);
+          data->ft.push_back(corner_t[i] - 1);
+          data->ft.push_back(corner_t[i + 1] - 1);
+        }
+        if (corner_n[0] > 0) {
+          data->fn.push_back(corner_n[0] - 1);
+          data->fn.push_back(corner_n[i] - 1);
+          data->fn.push_back(corner_n[i + 1] - 1);
+        }
+      }
+    } else if (q[0] == 'g' && (q[1] == ' ' || q[1] == '\t')) {
+      next_token(q + 1, &tok);
+      data->events +=
+          "g " + tok + " " + std::to_string(data->f.size() / 3) + "\n";
+    } else if (strncmp(q, "#landmark", 9) == 0) {
+      next_token(q + 9, &pending_landmark);
+    } else if (strncmp(q, "mtllib", 6) == 0) {
+      next_token(q + 6, &tok);
+      data->events += "m " + tok + "\n";
+    }
+    p = line_end + 1;
+  }
+  return data;
+}
+
+void obj_free(ObjData* data) { delete data; }
+
+const char* obj_error(ObjData* data) { return data->error.c_str(); }
+
+const char* obj_events(ObjData* data) { return data->events.c_str(); }
+
+void obj_counts(ObjData* data, int64_t* out) {
+  out[0] = data->v.size() / 3;
+  out[1] = data->vt.size() / 3;  // stored 3 slots per entry regardless of width
+  out[2] = data->vn.size() / 3;
+  out[3] = data->f.size() / 3;
+  out[4] = data->ft.size() / 3;
+  out[5] = data->fn.size() / 3;
+  out[6] = data->vc.size() / 3;
+  out[7] = data->vt_width;
+}
+
+void obj_copy(ObjData* data, double* v, double* vt, double* vn, double* vc,
+              int64_t* f, int64_t* ft, int64_t* fn) {
+  if (v) memcpy(v, data->v.data(), data->v.size() * sizeof(double));
+  if (vt) {
+    // emit rows of vt_width components from the 3-slot storage
+    size_t rows = data->vt.size() / 3;
+    for (size_t r = 0; r < rows; ++r)
+      memcpy(vt + r * data->vt_width, data->vt.data() + r * 3,
+             data->vt_width * sizeof(double));
+  }
+  if (vn) memcpy(vn, data->vn.data(), data->vn.size() * sizeof(double));
+  if (vc) memcpy(vc, data->vc.data(), data->vc.size() * sizeof(double));
+  if (f) memcpy(f, data->f.data(), data->f.size() * sizeof(int64_t));
+  if (ft) memcpy(ft, data->ft.data(), data->ft.size() * sizeof(int64_t));
+  if (fn) memcpy(fn, data->fn.data(), data->fn.size() * sizeof(int64_t));
+}
+
+}  // extern "C"

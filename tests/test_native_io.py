@@ -1,0 +1,75 @@
+"""Native C++ OBJ parser parity with the pure-Python parser."""
+
+import os
+
+import numpy as np
+import pytest
+
+from mesh_tpu import Mesh
+from mesh_tpu.serialization import native
+
+from . import has_reference_data, reference_data_folder
+from .fixtures import box
+
+needs_native = pytest.mark.skipif(
+    not native.available(), reason="no g++ / native build failed"
+)
+
+
+@needs_native
+class TestNativeObj:
+    def test_matches_python_parser(self, tmp_path):
+        v, f = box()
+        m = Mesh(v=v, f=f, segm={"top": [2, 3], "rest": [0, 1, 4]})
+        path = str(tmp_path / "seg.obj")
+        m.write_obj(path)
+        py = Mesh()
+        py.load_from_obj(path, use_native=False)
+        nat = Mesh()
+        nat.load_from_obj(path, use_native=True)
+        np.testing.assert_array_equal(py.v, nat.v)
+        np.testing.assert_array_equal(py.f, nat.f)
+        assert py.segm == nat.segm
+
+    @pytest.mark.skipif(not has_reference_data(), reason="no reference data")
+    def test_reference_fixture(self):
+        path = os.path.join(reference_data_folder, "test_box.obj")
+        py = Mesh()
+        py.load_from_obj(path, use_native=False)
+        nat = Mesh()
+        nat.load_from_obj(path, use_native=True)
+        np.testing.assert_array_equal(py.v, nat.v)
+        np.testing.assert_array_equal(py.f, nat.f)
+        assert py.segm == nat.segm
+        # test_box.obj landmarks sit exactly on vertices, so the python
+        # path's snapped indices equal the native path's direct indices
+        assert py.landm == nat.landm
+
+    def test_face_forms(self, tmp_path):
+        path = str(tmp_path / "forms.obj")
+        with open(path, "w") as fp:
+            fp.write(
+                "v 0 0 0\nv 1 0 0\nv 0 1 0\nv 1 1 0\n"
+                "vt 0 0\nvt 1 0\nvt 0 1\n"
+                "vn 0 0 1\n"
+                "f 1/1/1 2/2/1 3/3/1\n"
+                "f 1//1 2//1 4//1\n"
+                "f 1 2 3 4\n"
+            )
+        py = Mesh()
+        py.load_from_obj(path, use_native=False)
+        nat = Mesh()
+        nat.load_from_obj(path, use_native=True)
+        np.testing.assert_array_equal(py.f, nat.f)
+        np.testing.assert_array_equal(py.fn, nat.fn)
+        # python parser records ft only for faces with texture indices;
+        # both parsers must agree
+        np.testing.assert_array_equal(py.ft, nat.ft)
+
+    def test_landmarks(self, tmp_path):
+        path = str(tmp_path / "landm.obj")
+        with open(path, "w") as fp:
+            fp.write("#landmark nose\nv 0 0 0\nv 1 0 0\nv 0 1 0\nf 1 2 3\n")
+        nat = Mesh()
+        nat.load_from_obj(path, use_native=True)
+        assert nat.landm == {"nose": 0}

@@ -1,0 +1,46 @@
+"""Pallas closest-point kernel correctness (interpret mode on the CPU test
+platform; the same kernel runs compiled on TPU — see bench.py)."""
+
+import numpy as np
+import pytest
+
+from mesh_tpu.query import closest_faces_and_points
+from mesh_tpu.query.pallas_closest import closest_point_pallas
+
+from .fixtures import box, icosphere
+
+
+class TestPallasClosestPoint:
+    @pytest.mark.parametrize("n_q", [16, 300])
+    def test_matches_plain_jax(self, n_q):
+        rng = np.random.RandomState(0)
+        v, f = icosphere(1)
+        v = v.astype(np.float32)
+        f = f.astype(np.int32)
+        q = (rng.randn(n_q, 3) * 0.8).astype(np.float32)
+        ref = closest_faces_and_points(v, f, q)
+        out = closest_point_pallas(v, f, q, tile_q=8, tile_f=128, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(out["sqdist"]), np.asarray(ref["sqdist"]), atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(out["point"]), np.asarray(ref["point"]), atol=1e-4
+        )
+        # parts agree wherever faces agree (ties can pick either neighbor)
+        same = np.asarray(out["face"]) == np.asarray(ref["face"])
+        assert same.mean() > 0.8
+        np.testing.assert_array_equal(
+            np.asarray(out["part"])[same], np.asarray(ref["part"])[same]
+        )
+
+    def test_part_codes(self):
+        v, f = box(2.0)
+        q = np.array([[0.3, 0.2, -5.0]], np.float32)
+        out = closest_point_pallas(
+            v.astype(np.float32), f.astype(np.int32), q,
+            tile_q=8, tile_f=128, interpret=True,
+        )
+        assert int(np.asarray(out["part"])[0]) == 0
+        np.testing.assert_allclose(
+            np.asarray(out["point"]), [[0.3, 0.2, -1.0]], atol=1e-6
+        )
