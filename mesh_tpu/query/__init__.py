@@ -4,6 +4,11 @@ from .closest_point import (  # noqa: F401
     closest_vertices,
     closest_vertices_with_distance,
 )
+from .culled import (  # noqa: F401
+    closest_faces_and_points_auto,
+    closest_faces_and_points_culled,
+    triangle_bounds,
+)
 from .normal_weighted import nearest_normal_weighted  # noqa: F401
 from .ray import (  # noqa: F401
     ray_triangle_hits,

@@ -33,9 +33,12 @@ class AabbTree(object):
     def nearest(self, v_samples, nearest_part=False):
         """nearest_part tells you whether the closest point in triangle abc
         is in the interior (0), on an edge (ab:1, bc:2, ca:3), or a vertex
-        (a:4, b:5, c:6)."""
+        (a:4, b:5, c:6).
+
+        Strategy is automatic: exact brute force at SMPL scale, top-k culled
+        with exact fallback beyond (query/culled.py)."""
         pts = np.asarray(v_samples, dtype=np.float32).reshape(-1, 3)
-        res = query.closest_faces_and_points(self.v, self.f, pts)
+        res = query.closest_faces_and_points_auto(self.v, self.f, pts)
         f_idxs = np.asarray(res["face"]).astype(np.uint32).reshape(1, -1)
         f_part = np.asarray(res["part"]).astype(np.uint32).reshape(1, -1)
         v_out = np.asarray(res["point"], dtype=np.float64)
