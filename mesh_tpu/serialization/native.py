@@ -50,6 +50,13 @@ def _build_and_load():
     lib.obj_events.argtypes = [ctypes.c_void_p]
     lib.obj_counts.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64)]
     lib.obj_copy.argtypes = [ctypes.c_void_p] + [ctypes.c_void_p] * 7
+    lib.ply_load.restype = ctypes.c_void_p
+    lib.ply_load.argtypes = [ctypes.c_char_p]
+    lib.ply_free.argtypes = [ctypes.c_void_p]
+    lib.ply_error.restype = ctypes.c_char_p
+    lib.ply_error.argtypes = [ctypes.c_void_p]
+    lib.ply_counts.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64)]
+    lib.ply_copy.argtypes = [ctypes.c_void_p] + [ctypes.c_void_p] * 4
     return lib
 
 
@@ -138,4 +145,44 @@ def load_obj_native(filename):
         out["segm"] = segm
     if landm:
         out["landm"] = landm
+    return out
+
+
+def load_ply_native(filename):
+    """Parse a PLY with the native core; same dict contract as ply.read_ply
+    ('pts' (V,3) f64, 'tri' (F,3) u32, optional 'normals' / 'color')."""
+    from ..errors import SerializationError
+
+    lib = _get_lib()
+    if lib is None:
+        raise RuntimeError("native meshio unavailable")
+    handle = lib.ply_load(filename.encode())
+    try:
+        err = lib.ply_error(handle)
+        if err:
+            raise SerializationError(err.decode())
+        counts = (ctypes.c_int64 * 4)()
+        lib.ply_counts(handle, counts)
+        npts, ntri, n_normals, n_color = (int(c) for c in counts)
+
+        # buffers sized by the counts the parser reports (normals/color can
+        # legitimately differ from npts in malformed files; ply_copy fills
+        # exactly what was parsed)
+        pts = np.empty((npts, 3), np.float64)
+        tri = np.empty((ntri, 3), np.int64)
+        normals = np.empty((n_normals, 3), np.float64) if n_normals else None
+        color = np.empty((n_color, 3), np.float64) if n_color else None
+
+        def ptr(arr):
+            return arr.ctypes.data_as(ctypes.c_void_p) if arr is not None else None
+
+        lib.ply_copy(handle, ptr(pts), ptr(tri), ptr(normals), ptr(color))
+    finally:
+        lib.ply_free(handle)
+
+    out = {"pts": pts, "tri": tri.astype(np.uint32)}
+    if normals is not None and len(normals) == npts:
+        out["normals"] = normals
+    if color is not None and len(color) == npts:
+        out["color"] = color
     return out

@@ -196,22 +196,39 @@ def read_ply(filename):
                 ) if count else np.zeros((0, len(props)))
                 _extract_vertex_props(out, name, props, table)
             else:
-                # Fast path: single list property with constant count 3
-                # (every reference-written file); general fallback otherwise.
                 _, (_, cdt, idt) = next(
                     (p, k) for p, k in props if isinstance(k, tuple)
                 )
                 cnt_size = np.dtype(cdt).itemsize
                 idx_size = np.dtype(idt).itemsize
-                rows = []
-                for _ in range(count):
-                    n = int(np.frombuffer(body, dtype=cdt, count=1, offset=offset)[0])
-                    offset += cnt_size
-                    rows.append(
-                        np.frombuffer(body, dtype=bo + idt, count=n, offset=offset).tolist()
-                    )
-                    offset += idx_size * n
-                _extract_face_rows(out, name, rows)
+                # Fast path: a lone list property whose every count is 3
+                # (every reference-written file) reads as one record array.
+                tri3 = None
+                if len(props) == 1 and count:
+                    stride = cnt_size + 3 * idx_size
+                    if offset + stride * count <= len(body):
+                        rec = np.frombuffer(
+                            body,
+                            dtype=[("n", bo + cdt), ("idx", bo + idt, (3,))],
+                            count=count,
+                            offset=offset,
+                        )
+                        if (rec["n"] == 3).all():
+                            tri3 = rec["idx"]
+                            offset += stride * count
+                if tri3 is not None:
+                    if name == "face":
+                        out["tri"] = tri3.astype(np.uint32)
+                else:
+                    rows = []
+                    for _ in range(count):
+                        n = int(np.frombuffer(body, dtype=bo + cdt, count=1, offset=offset)[0])
+                        offset += cnt_size
+                        rows.append(
+                            np.frombuffer(body, dtype=bo + idt, count=n, offset=offset).tolist()
+                        )
+                        offset += idx_size * n
+                    _extract_face_rows(out, name, rows)
     return out
 
 

@@ -214,3 +214,389 @@ void obj_copy(ObjData* data, double* v, double* vt, double* vn, double* vc,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// PLY reader — native analog of the reference's plyutils.c + rply.c stack
+// (mesh/src/plyutils.c:64-137 reads via per-element rply callbacks into
+// Python lists; here one pass fills contiguous buffers).  Handles ascii,
+// binary_little_endian and binary_big_endian, arbitrary extra elements and
+// properties (skipped correctly), and fan-triangulates polygonal face rows.
+
+namespace {
+
+enum PlyType { T_I8, T_U8, T_I16, T_U16, T_I32, T_U32, T_F32, T_F64, T_BAD };
+
+inline int ply_type_size(PlyType t) {
+  switch (t) {
+    case T_I8: case T_U8: return 1;
+    case T_I16: case T_U16: return 2;
+    case T_I32: case T_U32: case T_F32: return 4;
+    case T_F64: return 8;
+    default: return 0;
+  }
+}
+
+PlyType ply_type_from(const std::string& s) {
+  if (s == "char" || s == "int8") return T_I8;
+  if (s == "uchar" || s == "uint8") return T_U8;
+  if (s == "short" || s == "int16") return T_I16;
+  if (s == "ushort" || s == "uint16") return T_U16;
+  if (s == "int" || s == "int32") return T_I32;
+  if (s == "uint" || s == "uint32") return T_U32;
+  if (s == "float" || s == "float32") return T_F32;
+  if (s == "double" || s == "float64") return T_F64;
+  return T_BAD;
+}
+
+inline uint64_t load_swapped(const unsigned char* p, int size, bool swap) {
+  uint64_t raw = 0;
+  if (swap) {
+    for (int i = 0; i < size; ++i) raw = (raw << 8) | p[i];
+  } else {
+    for (int i = size - 1; i >= 0; --i) raw = (raw << 8) | p[i];
+  }
+  return raw;
+}
+
+// read one binary scalar at p (advancing it) as double
+inline double read_binary(const unsigned char*& p, PlyType t, bool swap) {
+  if (!swap) {
+    // fast path: file endianness matches the (little-endian) host
+    switch (t) {
+      case T_I8: return static_cast<int8_t>(*p++);
+      case T_U8: return *p++;
+      case T_I16: { int16_t x; memcpy(&x, p, 2); p += 2; return x; }
+      case T_U16: { uint16_t x; memcpy(&x, p, 2); p += 2; return x; }
+      case T_I32: { int32_t x; memcpy(&x, p, 4); p += 4; return x; }
+      case T_U32: { uint32_t x; memcpy(&x, p, 4); p += 4; return x; }
+      case T_F32: { float x; memcpy(&x, p, 4); p += 4; return x; }
+      case T_F64: { double x; memcpy(&x, p, 8); p += 8; return x; }
+      default: return 0.0;
+    }
+  }
+  int size = ply_type_size(t);
+  uint64_t raw = load_swapped(p, size, swap);
+  p += size;
+  switch (t) {
+    case T_I8: return static_cast<int8_t>(raw);
+    case T_U8: return static_cast<uint8_t>(raw);
+    case T_I16: return static_cast<int16_t>(raw);
+    case T_U16: return static_cast<uint16_t>(raw);
+    case T_I32: return static_cast<int32_t>(raw);
+    case T_U32: return static_cast<uint32_t>(raw);
+    case T_F32: {
+      uint32_t bits = static_cast<uint32_t>(raw);
+      float out;
+      memcpy(&out, &bits, 4);
+      return out;
+    }
+    case T_F64: {
+      double out;
+      memcpy(&out, &raw, 8);
+      return out;
+    }
+    default: return 0.0;
+  }
+}
+
+struct PlyProp {
+  bool is_list = false;
+  PlyType count_type = T_U8, value_type = T_F32;
+  std::string name;
+};
+
+struct PlyElement {
+  std::string name;
+  int64_t count = 0;
+  std::vector<PlyProp> props;
+};
+
+struct PlyData {
+  std::vector<double> pts, normals, color;
+  std::vector<int64_t> tri;
+  std::string error;
+};
+
+}  // namespace
+
+namespace {
+
+void ply_parse(const char* path, PlyData* data) {
+  FILE* fp = fopen(path, "rb");
+  if (!fp) {
+    data->error = "Failed to open PLY file.";
+    return;
+  }
+  fseek(fp, 0, SEEK_END);
+  long size = ftell(fp);
+  fseek(fp, 0, SEEK_SET);
+  std::string buf(size, '\0');
+  size_t got = fread(&buf[0], 1, size, fp);
+  fclose(fp);
+  buf.resize(got);
+
+  // --- header ---
+  size_t pos = 0;
+  auto next_line = [&](std::string* line) -> bool {
+    if (pos >= buf.size()) return false;
+    size_t end = buf.find('\n', pos);
+    if (end == std::string::npos) end = buf.size();
+    size_t len = end - pos;
+    while (len > 0 && (buf[pos + len - 1] == '\r')) --len;
+    line->assign(buf, pos, len);
+    pos = end + 1;
+    return true;
+  };
+  std::string line;
+  if (!next_line(&line) || line != "ply") {
+    data->error = "Failed to open PLY file: bad magic.";
+    return;
+  }
+  std::string fmt;
+  std::vector<PlyElement> elements;
+  bool header_done = false;
+  while (next_line(&line)) {
+    const char* q = skip_ws(line.c_str());
+    std::string tok;
+    const char* rest = next_token(q, &tok);
+    if (tok == "format") {
+      next_token(rest, &fmt);
+    } else if (tok == "element") {
+      PlyElement el;
+      rest = next_token(rest, &el.name);
+      std::string cnt;
+      next_token(rest, &cnt);
+      el.count = strtoll(cnt.c_str(), nullptr, 10);
+      elements.push_back(el);
+    } else if (tok == "property") {
+      if (elements.empty()) continue;
+      PlyProp prop;
+      std::string t1;
+      rest = next_token(rest, &t1);
+      if (t1 == "list") {
+        prop.is_list = true;
+        std::string ct, vt;
+        rest = next_token(rest, &ct);
+        rest = next_token(rest, &vt);
+        prop.count_type = ply_type_from(ct);
+        prop.value_type = ply_type_from(vt);
+      } else {
+        prop.value_type = ply_type_from(t1);
+      }
+      next_token(rest, &prop.name);
+      if (prop.value_type == T_BAD || (prop.is_list && prop.count_type == T_BAD)) {
+        data->error = "Failed to open PLY file: unknown property type.";
+        return;
+      }
+      elements.back().props.push_back(prop);
+    } else if (tok == "end_header") {
+      header_done = true;
+      break;
+    }  // comment / obj_info / blank: ignore
+  }
+  if (!header_done || (fmt != "ascii" && fmt != "binary_little_endian" &&
+                       fmt != "binary_big_endian")) {
+    data->error = "Failed to open PLY file: truncated or bad header.";
+    return;
+  }
+  const bool is_ascii = fmt == "ascii";
+  // this code targets little-endian hosts (x86/arm); swap iff file is BE
+  const bool swap = fmt == "binary_big_endian";
+
+  const unsigned char* bp =
+      reinterpret_cast<const unsigned char*>(buf.data()) + pos;
+  const unsigned char* bend =
+      reinterpret_cast<const unsigned char*>(buf.data()) + buf.size();
+  const char* ap = buf.c_str() + pos;
+
+  // ascii scalar tokenizer; sets ascii_ok=false instead of yielding zeros
+  // when the body runs out of numeric tokens (truncated/corrupt file)
+  bool ascii_ok = true;
+  auto ascii_value = [&]() -> double {
+    char* end = nullptr;
+    while (ap < buf.c_str() + buf.size() &&
+           (*ap == ' ' || *ap == '\t' || *ap == '\r' || *ap == '\n'))
+      ++ap;
+    double out = strtod(ap, &end);
+    if (end == ap) ascii_ok = false;
+    ap = end;
+    return out;
+  };
+
+  std::vector<double> row;
+  std::vector<int64_t> poly;
+  for (const auto& el : elements) {
+    const bool is_vertex = el.name == "vertex";
+    const bool is_face = el.name == "face";
+    if (el.count < 0) {
+      data->error = "Failed to open PLY file: bad element count.";
+      return;
+    }
+    // per-name scalar column indices within the vertex element (property
+    // order is arbitrary in the format; do not assume x,y,z adjacency)
+    int col[9];
+    for (int i = 0; i < 9; ++i) col[i] = -1;
+    static const char* kNames[9] = {"x",  "y",  "z",   "nx",    "ny",
+                                    "nz", "red", "green", "blue"};
+    {
+      int n_scalar = 0;
+      int64_t min_row_bytes = 0;
+      for (size_t i = 0; i < el.props.size(); ++i) {
+        if (!el.props[i].is_list) {
+          for (int k = 0; k < 9; ++k)
+            if (el.props[i].name == kNames[k]) col[k] = n_scalar;
+          ++n_scalar;
+          min_row_bytes += ply_type_size(el.props[i].value_type);
+        } else {
+          min_row_bytes += ply_type_size(el.props[i].count_type);
+        }
+      }
+      // sanity-bound the declared count against the remaining bytes before
+      // any reserve(), so a malformed header cannot drive allocation
+      if (!is_ascii && min_row_bytes > 0 &&
+          el.count > (bend - bp) / min_row_bytes + 1) {
+        data->error = "Failed to open PLY file: truncated body.";
+        return;
+      }
+      if (is_ascii && el.count > static_cast<int64_t>(buf.size())) {
+        data->error = "Failed to open PLY file: truncated body.";
+        return;
+      }
+    }
+    const bool has_xyz = col[0] >= 0 && col[1] >= 0 && col[2] >= 0;
+    const bool has_n = col[3] >= 0 && col[4] >= 0 && col[5] >= 0;
+    const bool has_c = col[6] >= 0 && col[7] >= 0 && col[8] >= 0;
+    if (is_vertex) {
+      if (has_xyz) data->pts.reserve(el.count * 3);
+      if (has_n) data->normals.reserve(el.count * 3);
+      if (has_c) data->color.reserve(el.count * 3);
+    }
+    for (int64_t r = 0; r < el.count; ++r) {
+      row.clear();
+      for (const auto& prop : el.props) {
+        if (!prop.is_list) {
+          double val;
+          if (is_ascii) {
+            val = ascii_value();
+            if (!ascii_ok) {
+              data->error = "Failed to open PLY file: truncated body.";
+              return;
+            }
+          } else {
+            if (bp + ply_type_size(prop.value_type) > bend) {
+              data->error = "Failed to open PLY file: truncated body.";
+              return;
+            }
+            val = read_binary(bp, prop.value_type, swap);
+          }
+          if (is_vertex) row.push_back(val);
+        } else {
+          int64_t n;
+          if (is_ascii) {
+            n = static_cast<int64_t>(ascii_value());
+            if (!ascii_ok) {
+              data->error = "Failed to open PLY file: truncated body.";
+              return;
+            }
+          } else {
+            if (bp + ply_type_size(prop.count_type) > bend) {
+              data->error = "Failed to open PLY file: truncated body.";
+              return;
+            }
+            n = static_cast<int64_t>(read_binary(bp, prop.count_type, swap));
+          }
+          if (n < 0 || (!is_ascii && n > bend - bp)) {
+            data->error = "Failed to open PLY file: truncated body.";
+            return;
+          }
+          poly.clear();
+          for (int64_t i = 0; i < n; ++i) {
+            double val;
+            if (is_ascii) {
+              val = ascii_value();
+              if (!ascii_ok) {
+                data->error = "Failed to open PLY file: truncated body.";
+                return;
+              }
+            } else {
+              if (bp + ply_type_size(prop.value_type) > bend) {
+                data->error = "Failed to open PLY file: truncated body.";
+                return;
+              }
+              val = read_binary(bp, prop.value_type, swap);
+            }
+            if (is_face) poly.push_back(static_cast<int64_t>(val));
+          }
+          if (is_face) {
+            for (size_t i = 1; i + 1 < poly.size(); ++i) {
+              data->tri.push_back(poly[0]);
+              data->tri.push_back(poly[i]);
+              data->tri.push_back(poly[i + 1]);
+            }
+          }
+        }
+      }
+      if (is_vertex) {
+        const int nrow = static_cast<int>(row.size());
+        if (has_xyz && col[0] < nrow && col[1] < nrow && col[2] < nrow) {
+          data->pts.push_back(row[col[0]]);
+          data->pts.push_back(row[col[1]]);
+          data->pts.push_back(row[col[2]]);
+        }
+        if (has_n && col[3] < nrow && col[4] < nrow && col[5] < nrow) {
+          data->normals.push_back(row[col[3]]);
+          data->normals.push_back(row[col[4]]);
+          data->normals.push_back(row[col[5]]);
+        }
+        if (has_c && col[6] < nrow && col[7] < nrow && col[8] < nrow) {
+          data->color.push_back(row[col[6]]);
+          data->color.push_back(row[col[7]]);
+          data->color.push_back(row[col[8]]);
+        }
+      }
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+PlyData* ply_load(const char* path) {
+  // exceptions (bad_alloc/length_error from malformed headers) must not
+  // cross the C ABI into ctypes; surface them as the standard error string
+  auto* data = new PlyData();
+  try {
+    ply_parse(path, data);
+  } catch (const std::exception& e) {
+    data->pts.clear();
+    data->tri.clear();
+    data->normals.clear();
+    data->color.clear();
+    data->error = std::string("Failed to open PLY file: ") + e.what();
+  }
+  return data;
+}
+
+void ply_free(PlyData* data) { delete data; }
+
+const char* ply_error(PlyData* data) { return data->error.c_str(); }
+
+void ply_counts(PlyData* data, int64_t* out) {
+  out[0] = data->pts.size() / 3;
+  out[1] = data->tri.size() / 3;
+  out[2] = data->normals.size() / 3;
+  out[3] = data->color.size() / 3;
+}
+
+void ply_copy(PlyData* data, double* pts, int64_t* tri, double* normals,
+              double* color) {
+  if (pts) memcpy(pts, data->pts.data(), data->pts.size() * sizeof(double));
+  if (tri) memcpy(tri, data->tri.data(), data->tri.size() * sizeof(int64_t));
+  if (normals)
+    memcpy(normals, data->normals.data(), data->normals.size() * sizeof(double));
+  if (color)
+    memcpy(color, data->color.data(), data->color.size() * sizeof(double));
+}
+
+}  // extern "C"

@@ -73,3 +73,78 @@ class TestNativeObj:
         nat = Mesh()
         nat.load_from_obj(path, use_native=True)
         assert nat.landm == {"nose": 0}
+
+
+@needs_native
+class TestNativePly:
+    """Native PLY reader parity with the pure-Python reader (the reference
+    reads PLY in C via plyutils.c + rply.c; same division of labor here)."""
+
+    def _roundtrip(self, tmp_path, **write_kwargs):
+        from mesh_tpu.serialization.ply import read_ply, write_ply_data
+
+        rng = np.random.RandomState(7)
+        v = rng.randn(23, 3)
+        f = rng.randint(0, 23, (31, 3))
+        vn = rng.randn(23, 3)
+        vc = rng.rand(23, 3)
+        path = str(tmp_path / "t.ply")
+        write_ply_data(path, v, f, vc=vc, vn=vn, **write_kwargs)
+        py = read_ply(path)
+        nat = native.load_ply_native(path)
+        np.testing.assert_allclose(nat["pts"], py["pts"], atol=1e-6)
+        np.testing.assert_array_equal(nat["tri"], py["tri"])
+        np.testing.assert_allclose(nat["normals"], py["normals"], atol=1e-6)
+        np.testing.assert_array_equal(nat["color"], py["color"])
+
+    def test_binary_little_endian(self, tmp_path):
+        self._roundtrip(tmp_path, ascii=False, little_endian=True)
+
+    def test_binary_big_endian(self, tmp_path):
+        self._roundtrip(tmp_path, ascii=False, little_endian=False)
+
+    def test_ascii(self, tmp_path):
+        self._roundtrip(tmp_path, ascii=True)
+
+    def test_polygon_fan_and_extra_props(self, tmp_path):
+        """Quads fan-triangulate; unknown elements/properties are skipped."""
+        path = str(tmp_path / "quad.ply")
+        with open(path, "w") as fp:
+            fp.write("\n".join([
+                "ply", "format ascii 1.0",
+                "comment made by hand",
+                "element vertex 4",
+                "property float x", "property float y", "property float z",
+                "property float quality",             # extra scalar, skipped
+                "element face 1",
+                "property list uchar int vertex_indices",
+                "element edge 2",                      # unknown element
+                "property int v1", "property int v2",
+                "end_header",
+                "0 0 0 0.5", "1 0 0 0.5", "1 1 0 0.5", "0 1 0 0.5",
+                "4 0 1 2 3",
+                "0 1", "2 3",
+            ]) + "\n")
+        nat = native.load_ply_native(path)
+        np.testing.assert_array_equal(
+            nat["tri"], np.array([[0, 1, 2], [0, 2, 3]], np.uint32)
+        )
+        assert nat["pts"].shape == (4, 3)
+
+    def test_bad_magic_raises(self, tmp_path):
+        from mesh_tpu.errors import SerializationError
+
+        path = str(tmp_path / "bad.ply")
+        with open(path, "w") as fp:
+            fp.write("not a ply\n")
+        with pytest.raises(SerializationError, match="Failed to open PLY file"):
+            native.load_ply_native(path)
+
+    def test_mesh_load_uses_native(self, tmp_path):
+        v, f = box()
+        m = Mesh(v=v, f=f)
+        path = str(tmp_path / "m.ply")
+        m.write_ply(path)
+        m2 = Mesh(filename=path)
+        np.testing.assert_allclose(m2.v, m.v, atol=1e-6)
+        np.testing.assert_array_equal(m2.f, m.f)

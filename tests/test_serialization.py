@@ -153,3 +153,31 @@ class TestObj:
         assert data["name"] == "box"
         assert len(data["vertices"]) == 8
         assert len(data["faces"]) == 12
+
+
+class TestPlyBigEndianIntCounts:
+    def test_int_list_count_big_endian(self, tmp_path):
+        """List-count fields must honor the file's byte order (a BE file with
+        'property list int int' counts reads n=3, not 0x03000000)."""
+        import struct
+
+        from mesh_tpu.serialization.ply import read_ply
+
+        path = str(tmp_path / "be_int.ply")
+        header = "\n".join([
+            "ply", "format binary_big_endian 1.0",
+            "element vertex 3",
+            "property float x", "property float y", "property float z",
+            "element face 1",
+            "property list int int vertex_indices",
+            "end_header",
+        ]) + "\n"
+        with open(path, "wb") as fp:
+            fp.write(header.encode())
+            for xyz in ([0, 0, 0], [1, 0, 0], [0, 1, 0]):
+                fp.write(struct.pack(">3f", *xyz))
+            fp.write(struct.pack(">i", 3))
+            fp.write(struct.pack(">3i", 0, 1, 2))
+        res = read_ply(path)
+        np.testing.assert_array_equal(res["tri"], [[0, 1, 2]])
+        assert res["pts"].shape == (3, 3)
