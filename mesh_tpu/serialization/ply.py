@@ -178,9 +178,18 @@ def read_ply(filename):
             else:
                 rows = []
                 for _ in range(count):
-                    n = int(tokens[pos]); pos += 1
-                    rows.append([int(t) for t in tokens[pos:pos + n]])
-                    pos += n
+                    kept = None
+                    for pname, kind in props:
+                        if not isinstance(kind, tuple):
+                            pos += 1
+                            continue
+                        n = int(tokens[pos]); pos += 1
+                        vals = [int(t) for t in tokens[pos:pos + n]]
+                        pos += n
+                        if pname in ("vertex_indices", "vertex_index"):
+                            kept = vals
+                    if kept is not None:
+                        rows.append(kept)
                 _extract_face_rows(out, name, rows)
     else:
         bo = "<" if fmt == "binary_little_endian" else ">"
@@ -220,14 +229,28 @@ def read_ply(filename):
                     if name == "face":
                         out["tri"] = tri3.astype(np.uint32)
                 else:
+                    # General walk: every property of the row is consumed in
+                    # declaration order; only the vertex-index list is kept.
                     rows = []
                     for _ in range(count):
-                        n = int(np.frombuffer(body, dtype=bo + cdt, count=1, offset=offset)[0])
-                        offset += cnt_size
-                        rows.append(
-                            np.frombuffer(body, dtype=bo + idt, count=n, offset=offset).tolist()
-                        )
-                        offset += idx_size * n
+                        kept = None
+                        for pname, kind in props:
+                            if not isinstance(kind, tuple):
+                                offset += np.dtype(kind).itemsize
+                                continue
+                            _, p_cdt, p_idt = kind
+                            n = int(np.frombuffer(
+                                body, dtype=bo + p_cdt, count=1, offset=offset
+                            )[0])
+                            offset += np.dtype(p_cdt).itemsize
+                            vals = np.frombuffer(
+                                body, dtype=bo + p_idt, count=n, offset=offset
+                            )
+                            offset += np.dtype(p_idt).itemsize * n
+                            if pname in ("vertex_indices", "vertex_index"):
+                                kept = vals.tolist()
+                        if kept is not None:
+                            rows.append(kept)
                     _extract_face_rows(out, name, rows)
     return out
 

@@ -32,8 +32,6 @@ def _load_obj_dict(filename, use_native=True):
     """Parse with the native C++ core when available (the reference's
     use_cpp=True default, serialization.py:414-418), else pure Python."""
     if use_native:
-        from . import native
-
         if native.available():
             return native.load_obj_native(filename)
     return load_obj(filename)

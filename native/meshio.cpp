@@ -427,7 +427,7 @@ void ply_parse(const char* path, PlyData* data) {
   std::vector<int64_t> poly;
   for (const auto& el : elements) {
     const bool is_vertex = el.name == "vertex";
-    const bool is_face = el.name == "face";
+    const bool el_is_face = el.name == "face";
     if (el.count < 0) {
       data->error = "Failed to open PLY file: bad element count.";
       return;
@@ -474,6 +474,11 @@ void ply_parse(const char* path, PlyData* data) {
     for (int64_t r = 0; r < el.count; ++r) {
       row.clear();
       for (const auto& prop : el.props) {
+        // only the index list yields triangles; other face lists (e.g. a
+        // texcoord list) are consumed but ignored
+        const bool is_face =
+            el_is_face && (prop.name == "vertex_indices" ||
+                           prop.name == "vertex_index");
         if (!prop.is_list) {
           double val;
           if (is_ascii) {

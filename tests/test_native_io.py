@@ -144,7 +144,8 @@ class TestNativePly:
         v, f = box()
         m = Mesh(v=v, f=f)
         path = str(tmp_path / "m.ply")
-        m.write_ply(path)
+        # ascii: that is the format the dispatcher routes to the native reader
+        m.write_ply(path, ascii=True)
         m2 = Mesh(filename=path)
         np.testing.assert_allclose(m2.v, m.v, atol=1e-6)
         np.testing.assert_array_equal(m2.f, m.f)

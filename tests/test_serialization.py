@@ -181,3 +181,58 @@ class TestPlyBigEndianIntCounts:
         res = read_ply(path)
         np.testing.assert_array_equal(res["tri"], [[0, 1, 2]])
         assert res["pts"].shape == (3, 3)
+
+
+class TestPlyMultiPropertyFaceElement:
+    """Face elements with sibling properties next to the index list must not
+    misalign the parse (exporters add e.g. per-face flags or texcoords)."""
+
+    def _check(self, res):
+        np.testing.assert_array_equal(res["tri"], [[0, 1, 2], [0, 2, 3]])
+        assert res["pts"].shape == (4, 3)
+
+    def test_binary_scalar_after_list(self, tmp_path):
+        import struct
+
+        from mesh_tpu.serialization.ply import read_ply
+
+        path = str(tmp_path / "multi.ply")
+        header = "\n".join([
+            "ply", "format binary_little_endian 1.0",
+            "element vertex 4",
+            "property float x", "property float y", "property float z",
+            "element face 2",
+            "property list uchar int vertex_indices",
+            "property uchar flags",
+            "end_header",
+        ]) + "\n"
+        with open(path, "wb") as fp:
+            fp.write(header.encode())
+            for xyz in ([0, 0, 0], [1, 0, 0], [1, 1, 0], [0, 1, 0]):
+                fp.write(struct.pack("<3f", *xyz))
+            for idx in ([0, 1, 2], [0, 2, 3]):
+                fp.write(struct.pack("<B3i", 3, *idx))
+                fp.write(struct.pack("<B", 7))  # flags byte
+        self._check(read_ply(path))
+
+    def test_ascii_second_list_ignored(self, tmp_path):
+        from mesh_tpu.serialization.ply import read_ply
+        from mesh_tpu.serialization import native
+
+        path = str(tmp_path / "twolist.ply")
+        with open(path, "w") as fp:
+            fp.write("\n".join([
+                "ply", "format ascii 1.0",
+                "element vertex 4",
+                "property float x", "property float y", "property float z",
+                "element face 2",
+                "property list uchar int vertex_indices",
+                "property list uchar float texcoord",
+                "end_header",
+                "0 0 0", "1 0 0", "1 1 0", "0 1 0",
+                "3 0 1 2 6 0 0 1 0 1 1",
+                "3 0 2 3 6 0 0 1 1 0 1",
+            ]) + "\n")
+        self._check(read_ply(path))
+        if native.available():
+            self._check(native.load_ply_native(path))
