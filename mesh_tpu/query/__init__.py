@@ -10,6 +10,11 @@ from .culled import (  # noqa: F401
     triangle_bounds,
 )
 from .normal_weighted import nearest_normal_weighted  # noqa: F401
+
+# Pallas kernels (pallas_closest.closest_point_pallas,
+# pallas_culled.closest_point_pallas_culled) are intentionally not imported
+# here: accelerator users import them from their modules, mirroring the
+# reference's lazy compiled-extension boundary (search.py:22-24).
 from .ray import (  # noqa: F401
     ray_triangle_hits,
     nearest_alongnormal,

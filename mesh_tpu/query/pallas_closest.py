@@ -93,7 +93,7 @@ def _sqdist_tile(px, py, pz, ax, ay, az, bx, by, bz, cx, cy, cz):
 
 
 def _kernel(px, py, pz, ax, ay, az, bx, by, bz, cx, cy, cz,
-            out_d, out_i, acc_d, acc_i):
+            out_i, acc_d, acc_i):
     j = pl.program_id(1)
     n_j = pl.num_programs(1)
 
@@ -115,7 +115,6 @@ def _kernel(px, py, pz, ax, ay, az, bx, by, bz, cx, cy, cz,
 
     @pl.when(j == n_j - 1)
     def _write():
-        out_d[:] = acc_d[:]
         out_i[:] = acc_i[:]
 
 
@@ -159,21 +158,15 @@ def closest_point_pallas(v, f, points, tile_q=256, tile_f=2048, interpret=False)
     f_pad = tri_rows[0].shape[1]
     grid = (q_pad // tile_q, f_pad // tile_f)
 
-    out_d, out_i = pl.pallas_call(
+    out_i = pl.pallas_call(
         _kernel,
         grid=grid,
         in_specs=[
             *[pl.BlockSpec((tile_q, 1), lambda i, j: (i, 0)) for _ in range(3)],
             *[pl.BlockSpec((1, tile_f), lambda i, j: (0, j)) for _ in range(9)],
         ],
-        out_specs=[
-            pl.BlockSpec((tile_q, 1), lambda i, j: (i, 0)),
-            pl.BlockSpec((tile_q, 1), lambda i, j: (i, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((q_pad, 1), jnp.float32),
-            jax.ShapeDtypeStruct((q_pad, 1), jnp.int32),
-        ],
+        out_specs=pl.BlockSpec((tile_q, 1), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((q_pad, 1), jnp.int32),
         scratch_shapes=[
             pltpu.VMEM((tile_q, 1), jnp.float32),
             pltpu.VMEM((tile_q, 1), jnp.int32),
