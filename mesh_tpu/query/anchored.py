@@ -1,0 +1,186 @@
+"""Vertex-anchored candidate tables: fast exact closest-point for scan-scale
+query counts.
+
+The reference answers scan-registration queries by descending a CGAL AABB
+tree per point (mesh/src/spatialsearchmodule.cpp:129-218) — O(log F) per
+query but recursive and pointer-chasing, the opposite of what XLA wants.
+This module gets the same effect with fixed shapes and gathers only:
+
+  setup (per mesh, jit, ~tens of ms — the analog of the reference's
+  ``aabbtree_compute`` tree build):
+    for every vertex ``vi`` rank all faces by the conservative bound
+        lbv(vi, f) = |vi - centroid_f| - bounding_radius_f  <=  dist(vi, f)
+    and store the K smallest as ``table[vi]`` plus the (K+1)-th value as
+    ``safe[vi]`` — no face outside the table can be closer to ``vi`` than
+    ``safe[vi]``.
+
+  query (jit):
+    1. anchor: a near-nearest vertex ``vi`` per query via one (Q, 3) x
+       (3, V) matmul (MXU) + row argmin; ``dhat = |q - v_vi|``.
+    2. exact branch-free Ericson test on the K table faces only.
+    3. certificate: the true closest point p* satisfies |q - p*| <= dhat,
+       so any face containing p* has dist(vi, f) <= |vi - p*| <= 2*dhat.
+       If ``2*dhat < safe[vi]`` every such face is in the table and the
+       answer is provably the global optimum (``tight``).  The anchor does
+       NOT need to be the true nearest vertex for this to hold.
+
+  ``closest_point_anchored_auto`` re-runs the rare non-tight queries through
+  the exact brute-force path, so results are always exact while per-query
+  work drops from O(F) to O(K).
+
+Numerics note: the anchor argmin uses the matmul expansion of |q - v|^2,
+whose f32 rounding can mis-rank near-tied vertices — harmless, since the
+certificate only uses the recomputed true distance to the chosen anchor.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .point_triangle import closest_point_on_triangle
+
+_CERT_SLACK_REL = 1e-5  # slack per unit scene scale, keeps the cert conservative
+
+
+@partial(jax.jit, static_argnames=("k", "vchunk"))
+def build_anchor_tables(v, f, k=128, vchunk=512):
+    """Per-vertex K-nearest-face tables by conservative lower bound.
+
+    :returns: ``(table, safe)`` — ``table`` [V, k] int32 face ids sorted by
+        increasing bound; ``safe`` [V] f32, the (k+1)-th smallest bound
+        (``+inf`` when k >= F: the table is exhaustive).
+    """
+    v = jnp.asarray(v, jnp.float32)
+    f = jnp.asarray(f, jnp.int32)
+    n_v, n_f = v.shape[0], f.shape[0]
+    k = min(k, n_f)
+
+    # the bounds are translation-invariant; centering matches the query-side
+    # conditioning so f32 rounding in `safe` stays scene-relative
+    v = v - jnp.mean(v, axis=0)
+    tri = v[f]
+    cen = jnp.mean(tri, axis=1)
+    rad = jnp.sqrt(jnp.max(jnp.sum((tri - cen[:, None]) ** 2, axis=-1), axis=1))
+
+    def chunk_tables(vc):
+        # iterative min-extraction: k+1 passes over [C, F] (no lax.top_k —
+        # measured ~50x slower than this on TPU at these shapes)
+        d = jnp.sqrt(jnp.sum((vc[:, None, :] - cen[None]) ** 2, axis=-1))
+        lbv = d - rad[None]                      # [C, F]
+        c_rows = jnp.arange(vc.shape[0])
+
+        def body(_, carry):
+            lbv, tab, val, j = carry
+            am = jnp.argmin(lbv, axis=-1)        # [C]
+            m = lbv[c_rows, am]
+            tab = tab.at[:, j].set(am.astype(jnp.int32))
+            val = val.at[:, j].set(m)
+            lbv = lbv.at[c_rows, am].set(jnp.inf)
+            return lbv, tab, val, j + 1
+
+        tab = jnp.zeros((vc.shape[0], k + 1), jnp.int32)
+        val = jnp.zeros((vc.shape[0], k + 1), jnp.float32)
+        n_extract = min(k + 1, n_f)
+        lbv, tab, val, _ = jax.lax.fori_loop(
+            0, n_extract, body, (lbv, tab, val, 0)
+        )
+        safe = val[:, k] if n_extract > k else jnp.full((vc.shape[0],), jnp.inf)
+        return tab[:, :k], safe
+
+    pad = (-n_v) % vchunk
+    vp = jnp.pad(v, ((0, pad), (0, 0)))
+    tab, safe = jax.lax.map(chunk_tables, vp.reshape(-1, vchunk, 3))
+    return tab.reshape(-1, k)[:n_v], safe.reshape(-1)[:n_v]
+
+
+@partial(jax.jit, static_argnames=("chunk",))
+def closest_point_anchored(v, f, points, table, safe, chunk=8192):
+    """Anchored closest point on mesh; same contract as
+    ``closest_faces_and_points`` plus a ``tight`` certificate mask.
+    """
+    v = jnp.asarray(v, jnp.float32)
+    points = jnp.asarray(points, jnp.float32)
+    center = jnp.mean(v, axis=0)
+    vc = v - center
+    pts = points - center
+    n_q = pts.shape[0]
+
+    tri = vc[jnp.asarray(f, jnp.int32)]
+    a_, b_, c_ = tri[:, 0], tri[:, 1], tri[:, 2]
+    vn2 = jnp.sum(vc * vc, axis=-1)
+    # slack scales with the scene so f32 rounding in dhat/safe can never
+    # out-grow it (an absolute constant would break at e.g. millimeter units)
+    slack = _CERT_SLACK_REL * jnp.maximum(jnp.max(jnp.abs(vc)), 1.0)
+
+    def one_chunk(p):
+        # anchor vertex: matmul-form distances ride the MXU
+        d2v = (
+            jnp.sum(p * p, axis=-1)[:, None]
+            + vn2[None]
+            - 2.0 * p @ vc.T
+        )                                               # [C, V]
+        vi = jnp.argmin(d2v, axis=-1)
+        dhat = jnp.sqrt(
+            jnp.maximum(jnp.sum((p - vc[vi]) ** 2, axis=-1), 0.0)
+        )                                               # true anchor distance
+        cand = table[vi]                                # [C, K]
+        pt, sq, part = closest_point_on_triangle(
+            p[:, None, :], a_[cand], b_[cand], c_[cand]
+        )
+        j = jnp.argmin(sq, axis=-1)
+        rows = jnp.arange(p.shape[0])
+        tight = 2.0 * dhat < safe[vi] - slack
+        return (
+            cand[rows, j].astype(jnp.int32),
+            part[rows, j],
+            pt[rows, j],
+            sq[rows, j],
+            tight,
+        )
+
+    pad = (-n_q) % chunk
+    pp = jnp.pad(pts, ((0, pad), (0, 0)))
+    face, part, pt, sq, tight = jax.lax.map(
+        one_chunk, pp.reshape(-1, chunk, 3)
+    )
+    return {
+        "face": face.reshape(-1)[:n_q],
+        "part": part.reshape(-1)[:n_q],
+        "point": pt.reshape(-1, 3)[:n_q] + center,
+        "sqdist": sq.reshape(-1)[:n_q],
+        "tight": tight.reshape(-1)[:n_q],
+    }
+
+
+def closest_point_anchored_auto(v, f, points, tables=None, k=128, chunk=8192):
+    """Exact anchored closest point: non-tight queries re-run through the
+    brute-force path (Pallas on accelerators, XLA elsewhere).  Host-boundary
+    function, returns numpy.  Pass ``tables=build_anchor_tables(v, f, k)`` to
+    amortize setup across calls (the reference's cached AabbTree pattern,
+    mesh/search.py:21-24).
+    """
+    if tables is None:
+        tables = build_anchor_tables(v, f, k=k)
+    table, safe = tables
+    res = closest_point_anchored(v, f, points, table, safe, chunk=chunk)
+    out = {key: np.asarray(val) for key, val in res.items()}
+    tight = out.pop("tight")
+    loose = np.nonzero(~tight)[0]
+    if loose.size:
+        loose_pts = np.asarray(points)[loose]
+        if jax.devices()[0].platform == "cpu":
+            from .closest_point import closest_faces_and_points
+
+            fix = closest_faces_and_points(v, f, loose_pts)
+        else:
+            from .pallas_closest import closest_point_pallas
+
+            fix = closest_point_pallas(v, f, loose_pts)
+        for key in ("face", "part", "sqdist"):
+            out[key] = out[key].copy()
+            out[key][loose] = np.asarray(fix[key])
+        out["point"] = out["point"].copy()
+        out["point"][loose] = np.asarray(fix["point"])
+    return out

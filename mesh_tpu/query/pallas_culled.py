@@ -129,10 +129,12 @@ def _prologue(vc, f, pts, tile_q, tile_f):
 
 
 def _culled_kernel(
-    qcx, qcy, qcz, qr, fcx, fcy, fcz, fr, seed,
+    qsph, fsph, seed,
     px, py, pz, ax, ay, az, bx, by, bz, cx, cy, cz,
-    out_i, acc_d, acc_i,
+    out_i, acc_d, acc_i, worst,
 ):
+    b = pl.program_id(0)
+    i = pl.program_id(1)
     j = pl.program_id(2)
     n_j = pl.num_programs(2)
 
@@ -140,14 +142,16 @@ def _culled_kernel(
     def _init():
         acc_d[:] = seed[0]
         acc_i[:] = jnp.zeros_like(acc_i)
+        worst[0] = jnp.max(seed[0])
 
-    dx = qcx[0, 0] - fcx[0, 0]
-    dy = qcy[0, 0] - fcy[0, 0]
-    dz = qcz[0, 0] - fcz[0, 0]
+    # sphere-to-sphere lower bound from SMEM tile metadata (scalar ALU only)
+    dx = qsph[b, i, 0] - fsph[b, j, 0]
+    dy = qsph[b, i, 1] - fsph[b, j, 1]
+    dz = qsph[b, i, 2] - fsph[b, j, 2]
     dist = jnp.sqrt(dx * dx + dy * dy + dz * dz)
-    lb = jnp.maximum(dist - qr[0, 0] - fr[0, 0], 0.0) * (1.0 - _MARGIN)
+    lb = jnp.maximum(dist - qsph[b, i, 3] - fsph[b, j, 3], 0.0) * (1.0 - _MARGIN)
 
-    @pl.when(lb * lb <= jnp.max(acc_d[:]))
+    @pl.when(lb * lb <= worst[0])
     def _exact_tile():
         d2 = _sqdist_tile(
             px[0], py[0], pz[0], ax[0], ay[0], az[0],
@@ -159,6 +163,7 @@ def _culled_kernel(
         better = tile_min < acc_d[:]
         acc_d[:] = jnp.where(better, tile_min, acc_d[:])
         acc_i[:] = jnp.where(better, tile_arg, acc_i[:])
+        worst[0] = jnp.max(acc_d[:])
 
     @pl.when(j == n_j - 1)
     def _write():
@@ -193,8 +198,10 @@ def closest_point_pallas_culled(
     q_pad = pro["pts_s"].shape[1]
     grid = (b_n, q_pad // tile_q, f_pad // tile_f)
 
-    qsph = [pro["qc"][..., 0], pro["qc"][..., 1], pro["qc"][..., 2], pro["qr"]]
-    fsph = [pro["fc"][..., 0], pro["fc"][..., 1], pro["fc"][..., 2], pro["fr"]]
+    # tile-sphere metadata lives whole in SMEM (scalar loads by program id;
+    # (1, 1) VMEM blocks are not a legal Mosaic tiling)
+    qsph = jnp.concatenate([pro["qc"], pro["qr"][..., None]], axis=-1)
+    fsph = jnp.concatenate([pro["fc"], pro["fr"][..., None]], axis=-1)
     seed = pro["seed"][..., None]              # (B, Qp, 1)
     p_planes = [pro["pts_s"][..., k:k + 1] for k in range(3)]  # (B, Qp, 1)
     t_planes = [
@@ -203,8 +210,7 @@ def closest_point_pallas_culled(
         for k in range(3)
     ]
 
-    qtile_spec = pl.BlockSpec((1, 1), lambda b, i, j: (b, i))
-    ftile_spec = pl.BlockSpec((1, 1), lambda b, i, j: (b, j))
+    smem_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
     qcol_spec = pl.BlockSpec((1, tile_q, 1), lambda b, i, j: (b, i, 0))
     frow_spec = pl.BlockSpec((1, 1, tile_f), lambda b, i, j: (b, 0, j))
 
@@ -212,8 +218,8 @@ def closest_point_pallas_culled(
         _culled_kernel,
         grid=grid,
         in_specs=[
-            *[qtile_spec] * 4,
-            *[ftile_spec] * 4,
+            smem_spec,
+            smem_spec,
             qcol_spec,
             *[qcol_spec] * 3,
             *[frow_spec] * 9,
@@ -223,9 +229,10 @@ def closest_point_pallas_culled(
         scratch_shapes=[
             pltpu.VMEM((tile_q, 1), jnp.float32),
             pltpu.VMEM((tile_q, 1), jnp.int32),
+            pltpu.SMEM((1,), jnp.float32),
         ],
         interpret=interpret,
-    )(*qsph, *fsph, seed, *p_planes, *t_planes)
+    )(qsph, fsph, seed, *p_planes, *t_planes)
 
     def _epilogue(best_sorted, face_ids, qorder, pm, vm):
         # winner in sorted-face space -> original face index, sorted-query

@@ -1,1 +1,2 @@
 from .arrays import row, col, sparse, asarray_f32, asarray_i32  # noqa: F401
+from .profiling import Timer, host_sync, time_fn, trace  # noqa: F401

@@ -1,0 +1,98 @@
+"""Timing / profiling utilities (SURVEY.md section 5: the reference has no
+tracing or profiling subsystem — a gap to fill, not parity to match).
+
+Three layers:
+
+- ``host_sync(tree)``: materialize every jax leaf on the host.  The honest
+  synchronization primitive on backends where ``jax.block_until_ready``
+  returns early (observed on the experimental `axon` TPU tunnel: a scalar
+  read after block_until_ready still waited tens of ms).
+- ``Timer``: a wall-clock context manager with optional jax sync on exit.
+- ``time_fn(fn, ...)``: warmup + N pipelined repetitions with one final
+  host read, the measurement loop used by bench.py and benchmarks/.
+- ``trace(path)``: thin wrapper over ``jax.profiler.trace`` for capturing
+  a TensorBoard-viewable device trace.
+"""
+
+import contextlib
+import time
+
+
+def host_sync(out):
+    """Force full host materialization of every jax array in ``out``.
+
+    Returns ``out`` unchanged, so it can wrap a call site inline:
+    ``res = host_sync(fn(x))``.
+    """
+    import numpy as np
+    import jax
+
+    for leaf in jax.tree.leaves(out):
+        if hasattr(leaf, "block_until_ready") or hasattr(leaf, "device"):
+            np.asarray(leaf)
+    return out
+
+
+class Timer:
+    """Wall-clock timer context manager.
+
+    >>> with Timer("normals") as t:
+    ...     out = vert_normals(v, f)
+    >>> t.elapsed  # seconds; sync=True (default) host-syncs `out` via t.watch
+    """
+
+    def __init__(self, name="", sync=True, log=None):
+        self.name = name
+        self.sync = sync
+        self.log = log
+        self.elapsed = None
+        self._watched = None
+
+    def watch(self, out):
+        """Register values to host-sync before the clock stops."""
+        self._watched = out
+        return out
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if self.sync and self._watched is not None:
+            host_sync(self._watched)
+        self.elapsed = time.perf_counter() - self._t0
+        if self.log is not None:
+            self.log("%s: %.3f ms" % (self.name or "timer", self.elapsed * 1e3))
+        return False
+
+
+def time_fn(fn, reps=10, warmup=1):
+    """Average seconds per call of ``fn()`` (jax-aware).
+
+    Runs ``warmup`` untimed calls (compile), then ``reps`` pipelined calls
+    with a single host read at the end — the read cost is amortized across
+    the repetitions, and dead-code elimination cannot drop any call because
+    dispatch happens eagerly per call.
+    """
+    out = None
+    for _ in range(max(warmup, 1)):
+        out = fn()
+    host_sync(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn()
+    host_sync(out)
+    return (time.perf_counter() - t0) / reps
+
+
+@contextlib.contextmanager
+def trace(log_dir):
+    """Capture a device trace viewable in TensorBoard/Perfetto.
+
+    >>> with trace("/tmp/jax-trace"):
+    ...     host_sync(workload())
+    """
+    import jax
+
+    with jax.profiler.trace(str(log_dir)):
+        yield

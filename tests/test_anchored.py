@@ -1,0 +1,137 @@
+"""Vertex-anchored candidate-table closest point (query/anchored.py).
+
+Correctness bar mirrors the other closest-point backends: distances must
+match the exact brute force everywhere (after the auto fallback), and the
+certificate must never vouch for a wrong answer — every ``tight`` query must
+already equal the brute-force distance without any fallback.
+"""
+
+import numpy as np
+
+from mesh_tpu.query import closest_faces_and_points
+from mesh_tpu.query.anchored import (
+    build_anchor_tables,
+    closest_point_anchored,
+    closest_point_anchored_auto,
+)
+from tests.fixtures import icosphere
+
+
+def _surface_scan(v, f, n, noise, seed=0):
+    rng = np.random.RandomState(seed)
+    idx = rng.randint(0, len(f), n)
+    bary = rng.dirichlet([1.0, 1.0, 1.0], n)
+    pts = (v[f[idx]] * bary[:, :, None]).sum(1)
+    return (pts + rng.randn(n, 3) * noise).astype(np.float32)
+
+
+class TestAnchorTables:
+    def test_table_entries_are_sorted_lower_bounds(self):
+        v, f = icosphere(3)
+        k = 16
+        table, safe = build_anchor_tables(v, f, k=k)
+        table, safe = np.asarray(table), np.asarray(safe)
+        tri = v[f]
+        cen = tri.mean(1)
+        rad = np.sqrt(((tri - cen[:, None]) ** 2).sum(-1).max(1))
+        lbv = (
+            np.sqrt(((v[:, None] - cen[None]) ** 2).sum(-1)) - rad[None]
+        )  # [V, F]
+        for vi in (0, 7, len(v) // 2, len(v) - 1):
+            row = np.sort(lbv[vi])
+            got = lbv[vi][table[vi]]
+            # table holds the k smallest bounds, in increasing order
+            np.testing.assert_allclose(got, row[:k], atol=1e-5)
+            assert np.all(np.diff(got) >= -1e-5)
+            # safe is the (k+1)-th smallest
+            np.testing.assert_allclose(safe[vi], row[k], atol=1e-5)
+
+    def test_small_mesh_table_is_exhaustive(self):
+        v, f = icosphere(0)  # 20 faces < k
+        table, safe = build_anchor_tables(v, f, k=128)
+        assert table.shape == (len(v), 20)
+        assert np.all(np.isinf(np.asarray(safe)))
+
+    def test_exhaustive_table_certifies_everything(self):
+        v, f = icosphere(1)
+        tables = build_anchor_tables(v, f, k=1024)  # k > F: exhaustive
+        rng = np.random.RandomState(3)
+        pts = rng.randn(500, 3).astype(np.float32)
+        res = closest_point_anchored(v, f, pts, *tables, chunk=256)
+        assert np.asarray(res["tight"]).all()
+        ref = closest_faces_and_points(v, f, pts)
+        np.testing.assert_allclose(
+            np.asarray(res["sqdist"]), np.asarray(ref["sqdist"]), atol=1e-5
+        )
+
+
+class TestAnchoredQueries:
+    def test_certificate_never_vouches_for_wrong_answer(self):
+        v, f = icosphere(3)
+        scan = _surface_scan(v, f, 2000, noise=0.02)
+        tables = build_anchor_tables(v, f, k=64)
+        res = closest_point_anchored(v, f, scan, *tables, chunk=512)
+        ref = closest_faces_and_points(v, f, scan)
+        tight = np.asarray(res["tight"])
+        assert tight.mean() > 0.5  # the cert must actually fire on scans
+        np.testing.assert_allclose(
+            np.asarray(res["sqdist"])[tight],
+            np.asarray(ref["sqdist"])[tight],
+            atol=1e-6,
+            rtol=1e-5,
+        )
+
+    def test_auto_is_exact_everywhere(self):
+        v, f = icosphere(3)
+        # adversarial mix: surface points, far points, interior points
+        rng = np.random.RandomState(1)
+        scan = np.concatenate(
+            [
+                _surface_scan(v, f, 700, noise=0.05),
+                rng.randn(200, 3).astype(np.float32) * 2.0,
+                rng.randn(100, 3).astype(np.float32) * 0.2,
+            ]
+        )
+        out = closest_point_anchored_auto(v, f, scan, k=64)
+        ref = closest_faces_and_points(v, f, scan)
+        np.testing.assert_allclose(
+            out["sqdist"], np.asarray(ref["sqdist"]), atol=1e-6, rtol=1e-5
+        )
+        # closest points agree wherever the winning face agrees (ties aside)
+        same = out["face"] == np.asarray(ref["face"])
+        assert same.mean() > 0.9
+        np.testing.assert_allclose(
+            out["point"][same], np.asarray(ref["point"])[same], atol=1e-5
+        )
+        np.testing.assert_array_equal(
+            out["part"][same], np.asarray(ref["part"])[same]
+        )
+
+    def test_certificate_safe_at_millimeter_scale(self):
+        # scene scaled to coords ~1000: f32 rounding in dhat/safe is ~1e-4
+        # absolute, so the cert slack must scale with the scene or it vouches
+        # for wrong answers
+        v, f = icosphere(3)
+        scale = 1000.0
+        vs = v * scale
+        scan = _surface_scan(vs, f, 1500, noise=0.02 * scale, seed=2)
+        tables = build_anchor_tables(vs, f, k=64)
+        res = closest_point_anchored(vs, f, scan, *tables, chunk=512)
+        ref = closest_faces_and_points(vs, f, scan)
+        tight = np.asarray(res["tight"])
+        assert tight.mean() > 0.5
+        np.testing.assert_allclose(
+            np.sqrt(np.asarray(res["sqdist"])[tight]),
+            np.sqrt(np.asarray(ref["sqdist"])[tight]),
+            atol=1e-3 * scale,
+            rtol=1e-4,
+        )
+
+    def test_amortized_tables_match_fresh(self):
+        v, f = icosphere(2)
+        scan = _surface_scan(v, f, 300, noise=0.01, seed=5)
+        tables = build_anchor_tables(v, f, k=64)
+        a = closest_point_anchored_auto(v, f, scan, tables=tables)
+        b = closest_point_anchored_auto(v, f, scan, k=64)
+        np.testing.assert_array_equal(a["face"], b["face"])
+        np.testing.assert_allclose(a["sqdist"], b["sqdist"], atol=0)

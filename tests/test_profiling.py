@@ -1,0 +1,41 @@
+"""Timing utilities (utils/profiling.py) — SURVEY.md section 5 gap-fill."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from mesh_tpu.utils.profiling import Timer, host_sync, time_fn
+
+
+class TestHostSync:
+    def test_returns_input_and_materializes(self):
+        tree = {"a": jnp.arange(4), "b": [jnp.ones(2), 3.0, None]}
+        out = host_sync(tree)
+        assert out is tree
+
+    def test_accepts_plain_python(self):
+        assert host_sync([1, "x", None]) == [1, "x", None]
+
+
+class TestTimer:
+    def test_measures_elapsed(self):
+        with Timer("t") as t:
+            x = t.watch(jnp.sum(jnp.arange(100)))
+        assert t.elapsed > 0
+        assert int(x) == 4950
+
+    def test_log_callback(self):
+        lines = []
+        with Timer("named", log=lines.append):
+            pass
+        assert len(lines) == 1 and lines[0].startswith("named:")
+
+
+class TestTimeFn:
+    def test_times_jax_fn(self):
+        v = jnp.ones((64, 3))
+        t = time_fn(lambda: (v * 2).sum(), reps=3, warmup=1)
+        assert 0 < t < 10
+
+    def test_times_plain_fn(self):
+        t = time_fn(lambda: np.ones(8).sum(), reps=2)
+        assert t >= 0
