@@ -17,10 +17,9 @@ import numpy as np
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
 
-# host_sync/time_fn handle the axon-backend caveat: jax.block_until_ready
-# returns before execution completes there, so timings synchronize by
-# reading values back (see mesh_tpu/utils/profiling.py)
-from mesh_tpu.utils.profiling import host_sync as _sync  # noqa: E402
+# time_fn handles the axon-backend caveat: jax.block_until_ready returns
+# before execution completes there, so timings synchronize by reading
+# values back (see mesh_tpu/utils/profiling.py)
 from mesh_tpu.utils.profiling import time_fn as _time  # noqa: E402
 
 

@@ -170,14 +170,16 @@ def closest_point_anchored_auto(v, f, points, tables=None, k=128, chunk=8192):
     loose = np.nonzero(~tight)[0]
     if loose.size:
         loose_pts = np.asarray(points)[loose]
-        if jax.devices()[0].platform == "cpu":
-            from .closest_point import closest_faces_and_points
-
-            fix = closest_faces_and_points(v, f, loose_pts)
-        else:
+        if jax.devices()[0].platform == "tpu":
             from .pallas_closest import closest_point_pallas
 
             fix = closest_point_pallas(v, f, loose_pts)
+        else:
+            # pure-XLA brute force runs on any backend (the Pallas kernel's
+            # Mosaic lowering is TPU-only)
+            from .closest_point import closest_faces_and_points
+
+            fix = closest_faces_and_points(v, f, loose_pts)
         for key in ("face", "part", "sqdist"):
             out[key] = out[key].copy()
             out[key][loose] = np.asarray(fix[key])

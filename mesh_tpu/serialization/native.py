@@ -57,6 +57,12 @@ def _build_and_load():
     lib.ply_error.argtypes = [ctypes.c_void_p]
     lib.ply_counts.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64)]
     lib.ply_copy.argtypes = [ctypes.c_void_p] + [ctypes.c_void_p] * 4
+    lib.ply_write.restype = ctypes.c_char_p
+    lib.ply_write.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int,
+        ctypes.c_char_p,
+    ]
     return lib
 
 
@@ -146,6 +152,57 @@ def load_obj_native(filename):
     if landm:
         out["landm"] = landm
     return out
+
+
+def write_ply_native(filename, v, f=None, vc=None, vn=None, ascii=False,
+                     little_endian=True, comments=()):
+    """Write a PLY through the native core; byte-identical to
+    ply.write_ply_data (which byte-matches the reference's rply output).
+
+    Same contract as write_ply_data: ``v`` (V,3) float, ``f`` (F,3) int or
+    None, ``vc`` colors in [0,1] (stored uchar), ``vn`` float normals.
+    """
+    from ..errors import SerializationError
+
+    lib = _get_lib()
+    if lib is None:
+        raise RuntimeError("native meshio unavailable")
+
+    v = np.ascontiguousarray(np.asarray(v, dtype=np.float64))
+    n_v = v.shape[0]
+    use_color = vc is not None and np.shape(vc)[0] == n_v
+    use_normals = vn is not None and np.shape(vn)[0] == n_v
+    if f is None or np.size(f) == 0:
+        f_arr, n_f = None, 0
+    else:
+        f_arr = np.ascontiguousarray(np.asarray(f, dtype=np.int32))
+        n_f = f_arr.shape[0]
+    vn_arr = (
+        np.ascontiguousarray(np.asarray(vn, dtype=np.float64))
+        if use_normals else None
+    )
+    vc_arr = (
+        np.ascontiguousarray(
+            (np.asarray(vc, dtype=np.float64) * 255).astype(int).astype(np.uint8)
+        )
+        if use_color else None
+    )
+    mode = 0 if ascii else (1 if little_endian else 2)
+    # an explicit empty-string comment must still emit a "comment " line,
+    # so gate on the sequence length, not the joined blob's truthiness
+    comments = list(comments)
+    comment_blob = "\n".join(comments) if len(comments) else None
+
+    def ptr(arr):
+        return arr.ctypes.data_as(ctypes.c_void_p) if arr is not None else None
+
+    err = lib.ply_write(
+        filename.encode(), n_v, ptr(v), ptr(vn_arr), ptr(vc_arr),
+        n_f, ptr(f_arr), mode,
+        comment_blob.encode() if comment_blob is not None else None,
+    )
+    if err:
+        raise SerializationError(err.decode())
 
 
 def load_ply_native(filename):

@@ -118,7 +118,13 @@ def write_ply(self, filename, flip_faces=False, ascii=False,
     faces = np.asarray(self.f) if hasattr(self, "f") else None
     if faces is not None and faces.size:
         faces = faces.reshape(-1, 3)[:, ::ff]
-    write_ply_data(
+    from . import native
+
+    # native writer is byte-identical to the Python one; prefer it when the
+    # toolchain built it (the reference's lazy compiled-extension seam,
+    # serialization.py:213-229 -> plyutils.write)
+    writer = native.write_ply_native if native.available() else write_ply_data
+    writer(
         filename,
         np.asarray(self.v, dtype=np.float64),
         faces,

@@ -72,12 +72,14 @@ def time_fn(fn, reps=10, warmup=1):
     Runs ``warmup`` untimed calls (compile), then ``reps`` pipelined calls
     with a single host read at the end — the read cost is amortized across
     the repetitions, and dead-code elimination cannot drop any call because
-    dispatch happens eagerly per call.
+    dispatch happens eagerly per call.  ``warmup=0`` measures cold start:
+    the first timed call then includes compilation.
     """
     out = None
-    for _ in range(max(warmup, 1)):
+    for _ in range(warmup):
         out = fn()
-    host_sync(out)
+    if warmup:
+        host_sync(out)
     t0 = time.perf_counter()
     for _ in range(reps):
         out = fn()

@@ -605,3 +605,148 @@ void ply_copy(PlyData* data, double* pts, int64_t* tri, double* normals,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// PLY writer — byte-identical to the layout the pure-Python writer emits
+// (serialization/ply.py:write_ply_data), which itself matches the rply
+// output of the reference (mesh/src/plyutils.c:140-246): float32 x/y/z
+// (+ float32 nx/ny/nz, uchar rgb), uchar-count int32-index face lists,
+// ascii values in printf "%g" with a trailing space per value.
+
+namespace {
+
+thread_local std::string g_write_error;
+
+inline void put_swapped4(std::string* out, const void* p) {
+  const unsigned char* b = static_cast<const unsigned char*>(p);
+  char sw[4] = {static_cast<char>(b[3]), static_cast<char>(b[2]),
+                static_cast<char>(b[1]), static_cast<char>(b[0])};
+  out->append(sw, 4);
+}
+
+inline void put_f32(std::string* out, float x, bool swap) {
+  if (swap) {
+    put_swapped4(out, &x);
+  } else {
+    out->append(reinterpret_cast<const char*>(&x), 4);
+  }
+}
+
+inline void put_i32(std::string* out, int32_t x, bool swap) {
+  if (swap) {
+    put_swapped4(out, &x);
+  } else {
+    out->append(reinterpret_cast<const char*>(&x), 4);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// mode: 0 = ascii, 1 = binary little-endian, 2 = binary big-endian.
+// v: n_v x 3 doubles (stored as float32); vn: n_v x 3 doubles or NULL;
+// vc: n_v x 3 uchars or NULL; f: n_f x 3 int32 or NULL;
+// comments: newline-separated string or NULL.
+// Returns NULL on success, an error message otherwise.
+const char* ply_write(const char* path, int64_t n_v, const double* v,
+                      const double* vn, const unsigned char* vc, int64_t n_f,
+                      const int32_t* f, int mode, const char* comments) {
+  const bool ascii_mode = mode == 0;
+  const bool big_endian = mode == 2;
+  std::string out;
+  out.reserve(static_cast<size_t>(n_v) * (ascii_mode ? 32 : 15) +
+              static_cast<size_t>(n_f) * (ascii_mode ? 16 : 13) + 512);
+
+  out += "ply\nformat ";
+  out += ascii_mode ? "ascii"
+                    : (big_endian ? "binary_big_endian" : "binary_little_endian");
+  out += " 1.0\n";
+  if (comments) {
+    // newline-SEPARATED blob: n separators mean n+1 comment lines, and
+    // empty segments still emit "comment " (matching the Python writer)
+    const char* p = comments;
+    while (true) {
+      const char* nl = strchr(p, '\n');
+      size_t len = nl ? static_cast<size_t>(nl - p) : strlen(p);
+      out += "comment ";
+      out.append(p, len);
+      out += "\n";
+      if (!nl) break;
+      p = nl + 1;
+    }
+  }
+  char line[128];
+  snprintf(line, sizeof(line), "element vertex %lld\n",
+           static_cast<long long>(n_v));
+  out += line;
+  out += "property float x\nproperty float y\nproperty float z\n";
+  if (vn) out += "property float nx\nproperty float ny\nproperty float nz\n";
+  if (vc) out += "property uchar red\nproperty uchar green\nproperty uchar blue\n";
+  snprintf(line, sizeof(line), "element face %lld\n",
+           static_cast<long long>(n_f));
+  out += line;
+  out += "property list uchar int vertex_indices\nend_header\n";
+
+  if (ascii_mode) {
+    char buf[64];
+    for (int64_t i = 0; i < n_v; ++i) {
+      for (int k = 0; k < 3; ++k) {
+        // match Python "%g" % float32(x): double-ized float32 through %g
+        snprintf(buf, sizeof(buf), "%g ",
+                 static_cast<double>(static_cast<float>(v[i * 3 + k])));
+        out += buf;
+      }
+      if (vn) {
+        for (int k = 0; k < 3; ++k) {
+          snprintf(buf, sizeof(buf), "%g ",
+                   static_cast<double>(static_cast<float>(vn[i * 3 + k])));
+          out += buf;
+        }
+      }
+      if (vc) {
+        for (int k = 0; k < 3; ++k) {
+          snprintf(buf, sizeof(buf), "%d ", vc[i * 3 + k]);
+          out += buf;
+        }
+      }
+      // each value above carries its separator, so the line already ends
+      // with the trailing space the Python writer emits
+      out += "\n";
+    }
+    for (int64_t i = 0; i < n_f; ++i) {
+      snprintf(buf, sizeof(buf), "3 %d %d %d \n", f[i * 3], f[i * 3 + 1],
+               f[i * 3 + 2]);
+      out += buf;
+    }
+  } else {
+    for (int64_t i = 0; i < n_v; ++i) {
+      for (int k = 0; k < 3; ++k)
+        put_f32(&out, static_cast<float>(v[i * 3 + k]), big_endian);
+      if (vn)
+        for (int k = 0; k < 3; ++k)
+          put_f32(&out, static_cast<float>(vn[i * 3 + k]), big_endian);
+      if (vc)
+        for (int k = 0; k < 3; ++k) out += static_cast<char>(vc[i * 3 + k]);
+    }
+    for (int64_t i = 0; i < n_f; ++i) {
+      out += static_cast<char>(3);
+      for (int k = 0; k < 3; ++k) put_i32(&out, f[i * 3 + k], big_endian);
+    }
+  }
+
+  FILE* fp = fopen(path, "wb");
+  if (!fp) {
+    g_write_error = std::string("could not open for writing: ") + path;
+    return g_write_error.c_str();
+  }
+  size_t written = fwrite(out.data(), 1, out.size(), fp);
+  int rc = fclose(fp);
+  if (written != out.size() || rc != 0) {
+    g_write_error = std::string("short write: ") + path;
+    return g_write_error.c_str();
+  }
+  return nullptr;
+}
+
+}  // extern "C"

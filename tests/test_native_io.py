@@ -149,3 +149,90 @@ class TestNativePly:
         m2 = Mesh(filename=path)
         np.testing.assert_allclose(m2.v, m.v, atol=1e-6)
         np.testing.assert_array_equal(m2.f, m.f)
+
+
+@needs_native
+class TestNativePlyWriter:
+    """Native PLY writer must be byte-identical to the pure-Python writer
+    (which byte-matches the reference's rply output, plyutils.c:140-246)."""
+
+    def _compare_bytes(self, tmp_path, v, f, vc, vn, **kwargs):
+        from mesh_tpu.serialization.ply import write_ply_data
+
+        py_path = str(tmp_path / "py.ply")
+        nat_path = str(tmp_path / "nat.ply")
+        write_ply_data(py_path, v, f, vc=vc, vn=vn, **kwargs)
+        native.write_ply_native(nat_path, v, f, vc=vc, vn=vn, **kwargs)
+        with open(py_path, "rb") as fp:
+            py_bytes = fp.read()
+        with open(nat_path, "rb") as fp:
+            nat_bytes = fp.read()
+        assert py_bytes == nat_bytes
+
+    def _cases(self):
+        rng = np.random.RandomState(11)
+        v = rng.randn(17, 3) * 3
+        f = rng.randint(0, 17, (29, 3))
+        vn = rng.randn(17, 3)
+        vc = rng.rand(17, 3)
+        return v, f, vc, vn
+
+    def test_ascii_byte_identical(self, tmp_path):
+        v, f, vc, vn = self._cases()
+        self._compare_bytes(tmp_path, v, f, vc, vn, ascii=True,
+                            comments=["one", "two"])
+
+    def test_little_endian_byte_identical(self, tmp_path):
+        v, f, vc, vn = self._cases()
+        self._compare_bytes(tmp_path, v, f, vc, vn, ascii=False,
+                            little_endian=True)
+
+    def test_big_endian_byte_identical(self, tmp_path):
+        v, f, vc, vn = self._cases()
+        self._compare_bytes(tmp_path, v, f, vc, vn, ascii=False,
+                            little_endian=False, comments=["be"])
+
+    def test_empty_and_trailing_comments_byte_identical(self, tmp_path):
+        rng = np.random.RandomState(4)
+        v = rng.randn(3, 3)
+        for comments in ([""], ["a", ""], ["", "b"]):
+            self._compare_bytes(tmp_path, v, None, None, None, ascii=True,
+                                comments=comments)
+
+    def test_plain_vertices_only(self, tmp_path):
+        rng = np.random.RandomState(2)
+        v = rng.randn(5, 3)
+        self._compare_bytes(tmp_path, v, None, None, None, ascii=True)
+        self._compare_bytes(tmp_path, v, None, None, None, ascii=False)
+
+    def test_roundtrip_through_both_readers(self, tmp_path):
+        from mesh_tpu.serialization.ply import read_ply
+
+        v, f, vc, vn = self._cases()
+        path = str(tmp_path / "rt.ply")
+        native.write_ply_native(path, v, f, vc=vc, vn=vn)
+        py = read_ply(path)
+        nat = native.load_ply_native(path)
+        np.testing.assert_allclose(py["pts"], v.astype(np.float32), atol=1e-7)
+        np.testing.assert_array_equal(py["tri"], f.astype(np.uint32))
+        np.testing.assert_allclose(nat["pts"], py["pts"], atol=0)
+
+    def test_unwritable_path_raises(self, tmp_path):
+        from mesh_tpu.errors import SerializationError
+
+        v, f, vc, vn = self._cases()
+        with pytest.raises(SerializationError, match="could not open"):
+            native.write_ply_native(
+                str(tmp_path / "no" / "dir" / "x.ply"), v, f
+            )
+
+    def test_mesh_write_ply_dispatches_native(self, tmp_path):
+        """Golden-file equality still holds through the Mesh facade (the
+        reference's byte-match test style, tests/test_mesh.py:67-87)."""
+        v, f = box()
+        m = Mesh(v=v, f=f)
+        path = str(tmp_path / "facade.ply")
+        m.write_ply(path, ascii=True, comments=["facade"])
+        m2 = Mesh(filename=path)
+        np.testing.assert_allclose(m2.v, m.v, atol=1e-6)
+        np.testing.assert_array_equal(m2.f, m.f)
