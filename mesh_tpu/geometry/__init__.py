@@ -9,3 +9,15 @@ from .vert_normals import vert_normals, vert_normals_scaled  # noqa: F401
 from .triangle_area import triangle_area  # noqa: F401
 from .barycentric import barycentric_coordinates_of_projection  # noqa: F401
 from .rodrigues import rodrigues, rodrigues2rotmat, rotmat2rodrigues  # noqa: F401
+from .compat import (  # noqa: F401  (reference chumpy-era names)
+    CrossProduct,
+    MatVecMult,
+    NormalizedNx3,
+    NormalizeRows,
+    TriEdges,
+    TriNormals,
+    TriNormalsScaled,
+    TriToScaledNormal,
+    VertNormals,
+    VertNormalsScaled,
+)

@@ -1,0 +1,88 @@
+"""Reference-named geometry API (chumpy-era naming and shapes).
+
+Downstream body-model pipelines import the reference's MATLAB-style symbols
+directly (``from psbody.mesh.geometry.tri_normals import TriNormals``), and
+those functions traffic in FLATTENED 1-D arrays between steps.  This module
+reproduces that exact surface — names, argument order, and output shapes —
+on top of the natural-shape JAX kernels:
+
+  reference mesh/geometry/tri_normals.py:19-72, vert_normals.py:14-34,
+  cross_product.py:10-32.
+
+Outputs are numpy arrays (these are host-side convenience entry points; the
+device-native API is the snake_case one in tri_normals.py / vert_normals.py).
+"""
+
+import numpy as np
+
+from .tri_normals import (
+    normalize_rows,
+    tri_edges,
+    tri_normals,
+    tri_normals_scaled,
+)
+from .vert_normals import vert_normals
+
+
+def CrossProduct(a, b):
+    """Row-wise cross of two (N*3,)-or-(N, 3) arrays, flattened
+    (reference cross_product.py:10-32)."""
+    a = np.asarray(a).reshape(-1, 3)
+    b = np.asarray(b).reshape(-1, 3)
+    return np.cross(a, b).flatten()
+
+
+def TriEdges(v, f, cplus, cminus):
+    """v[f[:, cplus]] - v[f[:, cminus]], raveled (tri_normals.py:35-43)."""
+    v = np.asarray(v).reshape(-1, 3)
+    return np.asarray(tri_edges(v, np.asarray(f), cplus, cminus)).ravel()
+
+
+def TriNormalsScaled(v, f):
+    """Unnormalized face normals, flattened (tri_normals.py:23-24)."""
+    v = np.asarray(v).reshape(-1, 3)
+    return np.asarray(tri_normals_scaled(v, np.asarray(f))).flatten()
+
+
+def TriNormals(v, f):
+    """Unit face normals, flattened (tri_normals.py:19-20)."""
+    v = np.asarray(v).reshape(-1, 3)
+    return np.asarray(tri_normals(v, np.asarray(f))).flatten()
+
+
+def NormalizedNx3(v):
+    """Row-normalize a flattened xyz array, flattened output with the
+    zero-row guard (tri_normals.py:27-32)."""
+    v = np.asarray(v, dtype=np.float64).reshape(-1, 3)
+    return np.asarray(normalize_rows(v)).flatten()
+
+
+def TriToScaledNormal(x, tri):
+    """Scaled face normals as (F, 3) — the one 2-D output in the reference
+    (tri_normals.py:46-53)."""
+    v = np.asarray(x).reshape(-1, 3)
+    return np.asarray(tri_normals_scaled(v, np.asarray(tri)))
+
+
+def NormalizeRows(x):
+    """Row-normalize a 2-D array, 2-D output (tri_normals.py:68-72)."""
+    x = np.asarray(x, dtype=np.float64)
+    return np.asarray(normalize_rows(x))
+
+
+def MatVecMult(mtx, vec):
+    """Sparse matrix times flattened vector, flattened
+    (vert_normals.py:14-15)."""
+    return mtx.dot(np.asarray(vec).reshape(-1, 1)).flatten()
+
+
+def VertNormals(v, f):
+    """Unit vertex normals, flattened (vert_normals.py:18-19)."""
+    v = np.asarray(v).reshape(-1, 3)
+    return np.asarray(vert_normals(v, np.asarray(f))).flatten()
+
+
+def VertNormalsScaled(v, f):
+    """Reference quirk preserved: despite the name it normalizes the
+    accumulated normals too (vert_normals.py:22-34 ends in NormalizedNx3)."""
+    return VertNormals(v, f)

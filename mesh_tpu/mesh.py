@@ -368,11 +368,7 @@ class Mesh(object):
         return self.keep_vertices(np.setdiff1d(np.arange(self.v.shape[0]), v_list))
 
     def point_cloud(self):
-        return (
-            Mesh(v=self.v, f=[], vc=self.vc)
-            if hasattr(self, "vc")
-            else Mesh(v=self.v, f=[])
-        )
+        return processing.point_cloud(self)
 
     def remove_faces(self, face_indices_to_remove):
         return processing.remove_faces(self, face_indices_to_remove)

@@ -89,6 +89,16 @@ def remove_faces(self, face_indices_to_remove):
     return self
 
 
+def point_cloud(self):
+    """Copy with no faces, keeping vertex colors if any
+    (reference processing.py:62-64)."""
+    from .mesh import Mesh
+
+    if hasattr(self, "vc"):
+        return Mesh(v=self.v, f=[], vc=self.vc)
+    return Mesh(v=self.v, f=[])
+
+
 def flip_faces(self):
     self.f = np.asarray(self.f)[:, ::-1].copy()
     if hasattr(self, "ft"):

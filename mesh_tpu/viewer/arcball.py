@@ -87,6 +87,32 @@ def Matrix3fSetRotationFromQuat4f(q):
     return R.T
 
 
+def Vector3fDot(u, v):
+    """Dot product of two 3-vectors (reference arcball.py:133-136)."""
+    return np.dot(u, v)
+
+
+def Vector3fCross(u, v):
+    """Cross product of two 3-vectors (reference arcball.py:139-148)."""
+    return np.cross(u, v).astype("f")
+
+
+def Vector3fLength(u):
+    """Euclidean length of a 3-vector (reference arcball.py:151-154)."""
+    return float(np.sqrt(np.dot(u, u)))
+
+
+def Matrix3fSetIdentity():
+    """3x3 identity, float32 (reference arcball.py:157-158)."""
+    return np.identity(3, "f")
+
+
+def Matrix4fSVD(NewObj):
+    """Uniform scale of the rotation block: Frobenius norm / sqrt(3)
+    (reference arcball.py:165-172)."""
+    return float(np.sqrt((NewObj[0:3, 0:3] ** 2).sum() / 3.0))
+
+
 def Matrix3fMulMatrix3f(a, b):
     return np.matmul(a, b)
 

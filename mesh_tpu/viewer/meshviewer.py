@@ -119,10 +119,9 @@ class MeshSubwindow(object):
     def set_static_meshes(self, meshes, blocking=False):
         self._send("static_meshes", meshes, blocking)
 
-    # same as set_dynamic_meshes, kept for reference compat: dynamic models
-    # (body-model wrappers exposing .r as vertices) are sanitized client-side
     def set_dynamic_models(self, models, blocking=False):
-        self._send("dynamic_meshes", models, blocking)
+        # body-model wrappers exposing .r as vertices, sanitized client-side
+        self._send("dynamic_models", models, blocking)
 
     def set_dynamic_lines(self, lines, blocking=False):
         self._send("dynamic_lines", lines, blocking)
@@ -145,11 +144,23 @@ class MeshSubwindow(object):
     def save_snapshot(self, path, blocking=False):
         self.parent_window.save_snapshot(path, blocking)
 
+    def get_event(self):
+        """Next user event, keyboard or mouse (reference meshviewer.py:269-270)."""
+        return self.parent_window.get_event()
+
     def get_keypress(self):
-        return self.parent_window.get_keypress()
+        """Key character of the next keypress (the reference subwindow API
+        unwraps the event dict, meshviewer.py:272-273)."""
+        reply = self.parent_window.get_keypress()
+        return reply["key"] if isinstance(reply, dict) else reply
 
     def get_mouseclick(self):
         return self.parent_window.get_mouseclick()
+
+    def close(self):
+        # honor the parent's keepalive flag (terminating unconditionally
+        # would also kill sibling subwindows of a keepalive grid)
+        self.parent_window.close()
 
     background_color = property(
         fset=lambda self, v: self.set_background_color(v), doc="Background color (r, g, b)"
@@ -245,7 +256,7 @@ class MeshViewerLocal(object):
         ephemeral PULL socket (reference meshviewer.py:770-804)."""
         import zmq
 
-        if label in ("dynamic_meshes", "static_meshes"):
+        if label in ("dynamic_meshes", "dynamic_models", "static_meshes"):
             obj = _sanitize_meshes(obj)
         msg = {"label": label, "obj": obj, "which_window": which_window}
         if blocking:
@@ -294,24 +305,48 @@ class MeshViewerLocal(object):
     def get_event(self):
         return self._recv_reply("get_event")
 
+    def get_window_shape(self):
+        """(width, height) of the server window (reference
+        meshviewer.py:870-874, 1142-1148)."""
+        reply = self._recv_reply("get_window_shape")
+        return reply["shape"] if reply else None
+
     def save_snapshot(self, path, blocking=False):
         print("Saving snapshot to %s, please wait..." % path)
         self._send_pyobj("save_snapshot", path, blocking)
 
-    def set_dynamic_meshes(self, meshes, blocking=False):
-        self._send_pyobj("dynamic_meshes", meshes, blocking)
+    def set_dynamic_meshes(self, meshes, blocking=False, which_window=(0, 0)):
+        self._send_pyobj("dynamic_meshes", meshes, blocking, which_window)
 
-    def set_static_meshes(self, meshes, blocking=False):
-        self._send_pyobj("static_meshes", meshes, blocking)
+    def set_static_meshes(self, meshes, blocking=False, which_window=(0, 0)):
+        self._send_pyobj("static_meshes", meshes, blocking, which_window)
 
-    def set_dynamic_lines(self, lines, blocking=False):
-        self._send_pyobj("dynamic_lines", lines, blocking)
+    def set_dynamic_models(self, models, blocking=False, which_window=(0, 0)):
+        """Body-model wrappers exposing .r vertices; sanitized like meshes
+        (reference meshviewer.py:832-833)."""
+        self._send_pyobj("dynamic_models", models, blocking, which_window)
 
-    def set_static_lines(self, lines, blocking=False):
-        self._send_pyobj("static_lines", lines, blocking)
+    def set_dynamic_lines(self, lines, blocking=False, which_window=(0, 0)):
+        self._send_pyobj("dynamic_lines", lines, blocking, which_window)
 
-    def set_titlebar(self, titlebar, blocking=False):
-        self._send_pyobj("titlebar", titlebar, blocking)
+    def set_static_lines(self, lines, blocking=False, which_window=(0, 0)):
+        self._send_pyobj("static_lines", lines, blocking, which_window)
+
+    def set_titlebar(self, titlebar, blocking=False, which_window=(0, 0)):
+        self._send_pyobj("titlebar", titlebar, blocking, which_window)
+
+    def set_lighting_on(self, lighting_on, blocking=False, which_window=(0, 0)):
+        self._send_pyobj("lighting_on", lighting_on, blocking, which_window)
+
+    def set_autorecenter(self, autorecenter, blocking=False, which_window=(0, 0)):
+        self._send_pyobj("autorecenter", autorecenter, blocking, which_window)
+
+    def set_background_color(self, background_color, blocking=False,
+                             which_window=(0, 0)):
+        self._send_pyobj(
+            "background_color", np.asarray(background_color, np.float64),
+            blocking, which_window,
+        )
 
     def close(self):
         if not self.keepalive:

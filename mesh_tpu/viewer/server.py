@@ -73,6 +73,7 @@ class MeshViewerRemote(object):
         self.mouseclick_queue = []
         self.pending_keypress_port = None
         self.pending_mouseclick_port = None
+        self.pending_event_port = None  # get_event: next key OR click wins
         self.context = context
         self.init_opengl()
         self.activate()
@@ -161,6 +162,10 @@ class MeshViewerRemote(object):
         sub = self.subwindows[r][c]
         if label == "dynamic_meshes":
             sub.dynamic_meshes = obj
+        elif label == "dynamic_models":
+            # body-model wrappers are sanitized to meshes client-side
+            # (reference meshviewer.py:1164-1166)
+            sub.dynamic_meshes = obj
         elif label == "static_meshes":
             sub.static_meshes = obj
         elif label == "dynamic_lines":
@@ -187,6 +192,19 @@ class MeshViewerRemote(object):
             self.pending_mouseclick_port = msg.get("port")
             self._flush_mouseclick()
             return
+        elif label == "get_event":
+            # whichever user event fires first (key or click) answers; a
+            # queued event that already fired is served immediately
+            # (reference meshviewer.py:1028-1032, 1060-1062, 1196-1197)
+            self.pending_event_port = msg.get("port")
+            self._flush_event()
+            return
+        elif label == "get_window_shape":
+            self._reply(
+                msg.get("port"),
+                {"event_type": "window_shape", "shape": (self.width, self.height)},
+            )
+            return
         self.need_redraw = True
 
     def _reply(self, port, obj):
@@ -207,12 +225,28 @@ class MeshViewerRemote(object):
             self._reply(self.pending_mouseclick_port, self.mouseclick_queue.pop(0))
             self.pending_mouseclick_port = None
 
+    def _flush_event(self):
+        """Serve a get_event waiter from either queue, without stealing from
+        a dedicated get_keypress/get_mouseclick waiter."""
+        if self.pending_event_port is None:
+            return
+        if self.keypress_queue:
+            self._reply(self.pending_event_port, self.keypress_queue.pop(0))
+            self.pending_event_port = None
+        elif self.mouseclick_queue:
+            self._reply(self.pending_event_port, self.mouseclick_queue.pop(0))
+            self.pending_event_port = None
+
     # ------------------------------------------------------------------
     # Events
 
     def on_keypress(self, key, x, y):
-        self.keypress_queue.append(key.decode() if isinstance(key, bytes) else key)
+        self.keypress_queue.append({
+            "event_type": "keyboard",
+            "key": key.decode() if isinstance(key, bytes) else key,
+        })
         self._flush_keypress()
+        self._flush_event()
 
     def _subwindow_at(self, x, y):
         nx, ny = self.shape
@@ -228,12 +262,18 @@ class MeshViewerRemote(object):
         r, c = self._subwindow_at(x, y)
         sub = self.subwindows[r][c]
         if button_state == 0:  # press
-            if self.pending_mouseclick_port is not None:
+            if (self.pending_mouseclick_port is not None
+                    or self.pending_event_port is not None):
                 point = self.unproject(x, y)
                 self.mouseclick_queue.append(
-                    {"which_subwindow": (r, c), "point": point}
+                    {
+                        "event_type": "mouse_click",
+                        "which_subwindow": (r, c),
+                        "point": point,
+                    }
                 )
                 self._flush_mouseclick()
+                self._flush_event()
             sub.isdragging = True
             sub.arcball.setBounds(self.width, self.height)
             sub.arcball.click(Point2fT(x, y))

@@ -1,0 +1,100 @@
+"""Reference-named geometry API (geometry/compat.py).
+
+These are the chumpy-era symbols downstream body-model pipelines import
+directly; shapes must match the reference exactly (flattened 1-D between
+steps, reference tri_normals.py:19-72 / vert_normals.py:14-34 /
+cross_product.py:10-32).
+"""
+
+import numpy as np
+import scipy.sparse as sp
+
+from mesh_tpu.geometry import (
+    CrossProduct,
+    MatVecMult,
+    NormalizedNx3,
+    NormalizeRows,
+    TriEdges,
+    TriNormals,
+    TriNormalsScaled,
+    TriToScaledNormal,
+    VertNormals,
+    VertNormalsScaled,
+)
+from tests.fixtures import icosphere
+
+
+def _numpy_reference(v, f):
+    """Straight numpy re-derivation of the reference formulas."""
+    e10 = v[f[:, 1]] - v[f[:, 0]]
+    e20 = v[f[:, 2]] - v[f[:, 0]]
+    fn_scaled = np.cross(e10, e20)
+    norms = np.sqrt((fn_scaled ** 2).sum(1))
+    norms[norms == 0] = 1
+    fn = fn_scaled / norms[:, None]
+    vn = np.zeros_like(v)
+    for k in range(3):
+        np.add.at(vn, f[:, k], fn_scaled)
+    vnorms = np.sqrt((vn ** 2).sum(1))
+    vnorms[vnorms == 0] = 1
+    return fn_scaled, fn, vn / vnorms[:, None]
+
+
+class TestCompatShapes:
+    def test_flattened_shapes(self):
+        v, f = icosphere(1)
+        F, V = len(f), len(v)
+        assert TriNormals(v, f).shape == (F * 3,)
+        assert TriNormalsScaled(v, f).shape == (F * 3,)
+        assert TriEdges(v, f, 1, 0).shape == (F * 3,)
+        assert VertNormals(v, f).shape == (V * 3,)
+        assert TriToScaledNormal(v, f).shape == (F, 3)  # the one 2-D output
+        assert NormalizeRows(np.ones((4, 3))).shape == (4, 3)
+        assert NormalizedNx3(np.ones(12)).shape == (12,)
+
+    def test_accepts_flattened_input(self):
+        v, f = icosphere(1)
+        np.testing.assert_allclose(
+            TriNormals(v.flatten(), f), TriNormals(v, f), atol=0
+        )
+
+
+class TestCompatValues:
+    def test_tri_normals_match_numpy(self):
+        v, f = icosphere(2)
+        fn_scaled, fn, _ = _numpy_reference(v, f)
+        np.testing.assert_allclose(
+            TriNormalsScaled(v, f), fn_scaled.flatten(), atol=1e-6
+        )
+        np.testing.assert_allclose(TriNormals(v, f), fn.flatten(), atol=1e-6)
+        np.testing.assert_allclose(
+            TriToScaledNormal(v, f), fn_scaled, atol=1e-6
+        )
+
+    def test_vert_normals_match_numpy(self):
+        v, f = icosphere(2)
+        _, _, vn = _numpy_reference(v, f)
+        np.testing.assert_allclose(VertNormals(v, f), vn.flatten(), atol=1e-6)
+        np.testing.assert_allclose(
+            VertNormalsScaled(v, f), VertNormals(v, f), atol=0
+        )  # reference quirk: "scaled" variant normalizes too
+
+    def test_cross_product_matches_numpy(self):
+        rng = np.random.RandomState(0)
+        a, b = rng.randn(2, 30)
+        np.testing.assert_allclose(
+            CrossProduct(a, b),
+            np.cross(a.reshape(-1, 3), b.reshape(-1, 3)).flatten(),
+            atol=1e-12,
+        )
+
+    def test_normalized_nx3_zero_guard(self):
+        x = np.array([0.0, 0, 0, 3, 0, 0])
+        np.testing.assert_allclose(NormalizedNx3(x), [0, 0, 0, 1, 0, 0], atol=0)
+
+    def test_mat_vec_mult(self):
+        mtx = sp.csc_matrix(np.arange(12).reshape(3, 4))
+        vec = np.arange(4)
+        np.testing.assert_allclose(
+            MatVecMult(mtx, vec), mtx.toarray() @ vec, atol=0
+        )

@@ -173,6 +173,8 @@ class TestProtocolDispatch:
         r.mouseclick_queue = []
         r.pending_keypress_port = None
         r.pending_mouseclick_port = None
+        r.pending_event_port = None
+        r.width, r.height = 640, 480
         r.context = zmq.Context.instance()
         return r
 
@@ -211,7 +213,79 @@ class TestProtocolDispatch:
             assert r.pending_keypress_port == port  # queued, nothing yet
             r.on_keypress(b"a", 0, 0)
             msg = pull.recv_pyobj()  # flushed on the event
-            assert msg == "a"
+            assert msg == {"event_type": "keyboard", "key": "a"}
             assert r.pending_keypress_port is None
         finally:
             pull.close()
+
+    def test_get_event_answers_on_next_keypress(self):
+        import zmq
+
+        r = self._remote()
+        pull = r.context.socket(zmq.PULL)
+        port = pull.bind_to_random_port("tcp://127.0.0.1")
+        try:
+            r.handle_request({"label": "get_event", "port": port})
+            assert r.pending_event_port == port
+            r.on_keypress(b"x", 0, 0)
+            msg = pull.recv_pyobj()
+            assert msg == {"event_type": "keyboard", "key": "x"}
+            assert r.pending_event_port is None
+        finally:
+            pull.close()
+
+    def test_get_event_drains_already_queued_event(self):
+        import zmq
+
+        r = self._remote()
+        r.on_keypress(b"q", 0, 0)  # event fires BEFORE anyone asks
+        pull = r.context.socket(zmq.PULL)
+        port = pull.bind_to_random_port("tcp://127.0.0.1")
+        try:
+            r.handle_request({"label": "get_event", "port": port})
+            msg = pull.recv_pyobj()  # served immediately, no second event
+            assert msg == {"event_type": "keyboard", "key": "q"}
+            assert r.pending_event_port is None
+        finally:
+            pull.close()
+
+    def test_event_waiter_does_not_steal_from_keypress_waiter(self):
+        import zmq
+
+        r = self._remote()
+        pull_a = r.context.socket(zmq.PULL)
+        port_a = pull_a.bind_to_random_port("tcp://127.0.0.1")
+        pull_b = r.context.socket(zmq.PULL)
+        port_b = pull_b.bind_to_random_port("tcp://127.0.0.1")
+        try:
+            r.handle_request({"label": "get_keypress", "port": port_a})
+            r.handle_request({"label": "get_event", "port": port_b})
+            r.on_keypress(b"1", 0, 0)
+            assert pull_a.recv_pyobj()["key"] == "1"  # dedicated waiter wins
+            assert r.pending_keypress_port is None
+            assert r.pending_event_port == port_b     # still waiting
+            r.on_keypress(b"2", 0, 0)
+            assert pull_b.recv_pyobj()["key"] == "2"
+        finally:
+            pull_a.close()
+            pull_b.close()
+
+    def test_get_window_shape_replies_immediately(self):
+        import zmq
+
+        r = self._remote()
+        pull = r.context.socket(zmq.PULL)
+        port = pull.bind_to_random_port("tcp://127.0.0.1")
+        try:
+            r.handle_request({"label": "get_window_shape", "port": port})
+            msg = pull.recv_pyobj()
+            assert msg["event_type"] == "window_shape"
+            assert msg["shape"] == (r.width, r.height)
+        finally:
+            pull.close()
+
+    def test_dynamic_models_label_sets_meshes(self):
+        r = self._remote()
+        r.handle_request({"label": "dynamic_models", "obj": ["fake"],
+                          "which_window": (0, 0)})
+        assert r.subwindows[0][0].dynamic_meshes == ["fake"]
