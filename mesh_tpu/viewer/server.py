@@ -50,12 +50,21 @@ class Subwindow(object):
 
 
 class MeshViewerRemote(object):
-    def __init__(self, titlebar="Mesh Viewer", nx=1, ny=1, width=1280, height=960):
+    def __init__(self, titlebar="Mesh Viewer", nx=1, ny=1, width=1280,
+                 height=960, port=None):
         import zmq
 
         context = zmq.Context.instance()
         self.socket = context.socket(zmq.PULL)
-        self.port = self.socket.bind_to_random_port("tcp://%s" % ZMQ_HOST)
+        if port:
+            # fixed port for `meshviewer open -p N`: bind all interfaces so
+            # remote `view --host` clients can reach it (the reference binds
+            # ZMQ_HOST = "0.0.0.0" too, meshviewer.py:76; acks still flow to
+            # the server's loopback, so remote sends are fire-and-forget)
+            self.socket.bind("tcp://0.0.0.0:%d" % int(port))
+            self.port = int(port)
+        else:
+            self.port = self.socket.bind_to_random_port("tcp://%s" % ZMQ_HOST)
         # handshake BEFORE GL init so the client never blocks on a dead pipe
         # (reference meshviewer.py:937-940)
         sys.stdout.write("<PORT>%d</PORT>\n" % self.port)
@@ -141,7 +150,8 @@ class MeshViewerRemote(object):
                 t0 = time.time()
                 self.handle_request(msg)
                 if msg.get("port") is not None and msg["label"] not in (
-                    "get_keypress", "get_mouseclick", "get_event"
+                    "get_keypress", "get_mouseclick", "get_event",
+                    "get_window_shape",  # replies on the port itself
                 ):
                     push = self.context.socket(zmq.PUSH)
                     push.connect("tcp://%s:%d" % (ZMQ_HOST, msg["port"]))
@@ -159,6 +169,15 @@ class MeshViewerRemote(object):
         label = msg["label"]
         obj = msg.get("obj")
         r, c = msg.get("which_window", (0, 0))
+        if not (0 <= r < self.shape[0] and 0 <= c < self.shape[1]):
+            # treat a bad subwindow index as a handled no-op so the client
+            # still gets its ack instead of timing out on a "dead" server
+            print(
+                "meshviewer server: which_window (%s, %s) outside %sx%s grid"
+                % (r, c, self.shape[0], self.shape[1]),
+                file=sys.stderr,
+            )
+            return
         sub = self.subwindows[r][c]
         if label == "dynamic_meshes":
             sub.dynamic_meshes = obj

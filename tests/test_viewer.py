@@ -289,3 +289,85 @@ class TestProtocolDispatch:
         r.handle_request({"label": "dynamic_models", "obj": ["fake"],
                           "which_window": (0, 0)})
         assert r.subwindows[0][0].dynamic_meshes == ["fake"]
+
+
+class TestCliRemote:
+    """`meshviewer view/snap --port` talk the reference wire protocol to a
+    server started with `meshviewer open -p` (reference bin/meshviewer:
+    view/snap dispatch).  A bare PULL socket stands in for the server."""
+
+    def _run_cli(self, argv):
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        return subprocess.run(
+            [sys.executable, os.path.join(repo, "bin", "meshviewer")] + argv,
+            capture_output=True, text=True, timeout=60,
+            env={**os.environ, "PYTHONPATH": repo},
+        )
+
+    def test_view_remote_sends_sanitized_meshes(self, tmp_path):
+        import threading
+        import zmq
+
+        from mesh_tpu import Mesh
+        from tests.fixtures import box
+
+        v, f = box()
+        path = str(tmp_path / "box.ply")
+        Mesh(v=v, f=f).write_ply(path)
+
+        ctx = zmq.Context.instance()
+        server = ctx.socket(zmq.PULL)
+        port = server.bind_to_random_port("tcp://127.0.0.1")
+        got = {}
+
+        def serve():
+            msg = server.recv_pyobj()
+            got.update(msg)
+            if msg.get("port"):  # ack like the real server does
+                push = ctx.socket(zmq.PUSH)
+                push.connect("tcp://127.0.0.1:%d" % msg["port"])
+                push.send_pyobj(0.0)
+                push.close()
+
+        t = threading.Thread(target=serve, daemon=True)
+        t.start()
+        res = self._run_cli([
+            "view", path, "--port", str(port), "-ix", "1", "-iy", "0",
+            "--timeout", "0",
+        ])
+        t.join(timeout=30)
+        assert res.returncode == 0, res.stderr
+        assert got["label"] == "dynamic_meshes"
+        assert got["which_window"] == (0, 1)
+        assert len(got["obj"]) == 1
+        np.testing.assert_allclose(got["obj"][0].v, v, atol=1e-6)
+
+    def test_snap_remote_requests_snapshot(self, tmp_path):
+        import threading
+        import zmq
+
+        ctx = zmq.Context.instance()
+        server = ctx.socket(zmq.PULL)
+        port = server.bind_to_random_port("tcp://127.0.0.1")
+        got = {}
+
+        def serve():
+            msg = server.recv_pyobj()
+            got.update(msg)
+            if msg.get("port"):
+                push = ctx.socket(zmq.PUSH)
+                push.connect("tcp://127.0.0.1:%d" % msg["port"])
+                push.send_pyobj(0.0)
+                push.close()
+
+        t = threading.Thread(target=serve, daemon=True)
+        t.start()
+        out = str(tmp_path / "snap.png")
+        res = self._run_cli(["snap", out, "--port", str(port)])
+        t.join(timeout=30)
+        assert res.returncode == 0, res.stderr
+        assert got["label"] == "save_snapshot"
+        assert got["obj"] == out
