@@ -3,5 +3,14 @@ from .sharding import (  # noqa: F401
     shard_queries,
     sharded_closest_faces_and_points,
     sharded_batched_vert_normals,
+    sharded_visibility,
 )
-from .fit import FitState, make_fit_step, init_fit_state, fit_scan  # noqa: F401
+from .fit import (  # noqa: F401
+    FitState,
+    fit_scan,
+    init_fit_state,
+    landmark_arrays,
+    landmark_loss,
+    make_fit_step,
+    scan_to_model_loss,
+)

@@ -30,14 +30,54 @@ class FitState:
     opt_state: object
 
 
+def landmark_arrays(regressors, names=None, pad_to=None):
+    """Pack a ``landm_regressors`` dict (name -> (vert idxs, bary coeffs),
+    landmarks.py:45-65) into fixed-shape device arrays.
+
+    :returns: ``(idx [L, K] int32, bary [L, K] f32)`` — zero-padded so the
+        regression ``sum_k bary[l, k] * verts[idx[l, k]]`` is exact.
+    """
+    import numpy as np
+
+    names = list(names) if names is not None else sorted(regressors)
+    k = pad_to or max(len(regressors[n][0]) for n in names)
+    idx = np.zeros((len(names), k), np.int32)
+    bary = np.zeros((len(names), k), np.float32)
+    for li, name in enumerate(names):
+        vi, coeff = regressors[name]
+        idx[li, : len(vi)] = np.asarray(vi).ravel()
+        bary[li, : len(coeff)] = np.asarray(coeff).ravel()
+    return jnp.asarray(idx), jnp.asarray(bary)
+
+
+def landmark_loss(verts, landm_idx, landm_bary, target_xyz):
+    """Mean squared distance between regressed and observed landmarks.
+
+    ``verts``: (..., V, 3); ``landm_idx``/``landm_bary``: (L, K) packed
+    regressors; ``target_xyz``: (..., L, 3) observed landmark positions.
+    The regression is the on-device form of the reference's sparse
+    ``landm_xyz_linear_transform`` matvec (landmarks.py:15-33).
+    """
+    ring = verts[..., landm_idx, :]                   # (..., L, K, 3)
+    regressed = jnp.sum(ring * landm_bary[..., None], axis=-2)
+    return jnp.mean(jnp.sum((regressed - target_xyz) ** 2, axis=-1))
+
+
 def scan_to_model_loss(model, betas, pose, trans, target_points,
                        pose_prior_weight=1e-3, beta_prior_weight=1e-3,
+                       landmarks=None, landmark_weight=1.0,
                        precision=jax.lax.Precision.HIGHEST):
-    """Mean squared scan-to-nearest-vertex distance + L2 priors.
+    """Mean squared scan-to-nearest-vertex distance + L2 priors, optionally
+    anchored by named landmarks.
 
     target_points: (..., S, 3).  The min-over-vertices is exact and
     differentiable (d min / d argmin vertex), the standard ICP-style data
     term; O(S * V) pairs fused by XLA, sharded over S across devices.
+
+    landmarks: optional ``(idx, bary, target_xyz)`` triple (see
+    ``landmark_arrays``) adding ``landmark_weight * landmark_loss`` — the
+    standard way scan registrations are initialized/regularized (the
+    reference computes the same regressors host-side, landmarks.py:45-65).
     """
     verts, _ = lbs(model, betas, pose, trans, precision=precision)
     # (..., S, V) squared distances
@@ -48,7 +88,13 @@ def scan_to_model_loss(model, betas, pose, trans, target_points,
     prior = pose_prior_weight * jnp.mean(pose ** 2) + beta_prior_weight * jnp.mean(
         betas ** 2
     )
-    return data + prior
+    total = data + prior
+    if landmarks is not None:
+        idx, bary, target_xyz = landmarks
+        total = total + landmark_weight * landmark_loss(
+            verts, idx, bary, target_xyz
+        )
+    return total
 
 
 def init_fit_state(model, batch_size, optimizer=None, dtype=jnp.float32):
@@ -61,19 +107,22 @@ def init_fit_state(model, batch_size, optimizer=None, dtype=jnp.float32):
 
 
 def make_fit_step(model, optimizer, mesh=None, dp_axis="dp", sp_axis="sp",
+                  landmarks=None, landmark_weight=1.0,
                   precision=jax.lax.Precision.HIGHEST):
     """Build the jitted training step.
 
     With a device mesh, the batch axis is sharded over `dp_axis` and scan
     points over `sp_axis`; parameters are sharded with the batch.  Without a
-    mesh it is an ordinary single-device jit.
+    mesh it is an ordinary single-device jit.  ``landmarks`` is an optional
+    ``(idx, bary, target_xyz)`` triple (see ``landmark_arrays``).
     """
 
     def step(state, target_points):
         def loss_fn(params):
             return scan_to_model_loss(
                 model, params["betas"], params["pose"], params["trans"],
-                target_points, precision=precision,
+                target_points, landmarks=landmarks,
+                landmark_weight=landmark_weight, precision=precision,
             )
 
         params = {"betas": state.betas, "pose": state.pose, "trans": state.trans}
@@ -115,14 +164,18 @@ def make_fit_step(model, optimizer, mesh=None, dp_axis="dp", sp_axis="sp",
 
 
 def fit_scan(model, target_points, steps=100, batch_size=None, mesh=None,
-             optimizer=None, precision=jax.lax.Precision.HIGHEST):
-    """Convenience driver: fit the model to (B, S, 3) scan batches."""
+             optimizer=None, landmarks=None, landmark_weight=1.0,
+             precision=jax.lax.Precision.HIGHEST):
+    """Convenience driver: fit the model to (B, S, 3) scan batches,
+    optionally anchored by ``landmarks=(idx, bary, target_xyz)``
+    (see ``landmark_arrays``)."""
     target_points = jnp.asarray(target_points, jnp.float32)
     if target_points.ndim == 2:
         target_points = target_points[None]
     batch_size = batch_size or target_points.shape[0]
     state, optimizer = init_fit_state(model, batch_size, optimizer)
-    step = make_fit_step(model, optimizer, mesh=mesh, precision=precision)
+    step = make_fit_step(model, optimizer, mesh=mesh, landmarks=landmarks,
+                         landmark_weight=landmark_weight, precision=precision)
     loss = None
     for _ in range(steps):
         state, loss = step(state, target_points)

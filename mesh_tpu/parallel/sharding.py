@@ -100,6 +100,51 @@ def sharded_closest_faces_and_points(v, f, points, mesh, axis="dp", chunk=512):
     }
 
 
+def sharded_visibility(v, f, cams, n=None, mesh=None, axis="dp",
+                       min_dist=1e-3):
+    """Per-(camera, vertex) visibility with the vertex axis sharded over an
+    ICI mesh (the multi-chip form of the reference's per-camera TBB loop,
+    visibility.cpp:117-133).  Occluder triangles are replicated; each device
+    ray-casts its vertex shard against the full mesh.  Returns the same
+    (vis [C, V] uint32, n_dot_cam [C, V] f64) as visibility_compute.
+    """
+    from ..query.visibility import _visibility_kernel
+
+    n_shards = mesh.devices.size if axis == "dp" else mesh.shape[axis]
+    v_np = np.asarray(v, np.float32)
+    n_np = np.asarray(n, np.float32) if n is not None else np.zeros_like(v_np)
+    v_padded, pad = _pad_rows(v_np, n_shards)
+    n_padded, _ = _pad_rows(n_np, n_shards)
+    occ = v_np[np.asarray(f, np.int64)]
+    cams_j = jnp.atleast_2d(jnp.asarray(cams, jnp.float32))
+
+    chunk = min(1024, v_padded.shape[0] // n_shards)
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(), P(), P(), P()),
+        out_specs=(P(None, axis), P(None, axis)),
+    )
+    def _run(v_shard, n_shard, occ_a, occ_b, occ_c, cams_rep):
+        return _visibility_kernel(
+            v_shard, occ_a, occ_b, occ_c, cams_rep, n_shard, None,
+            jnp.float32(min_dist), chunk=chunk,
+        )
+
+    shard = NamedSharding(mesh, P(axis))
+    vis, ndc = jax.jit(_run)(
+        jax.device_put(v_padded, shard),
+        jax.device_put(n_padded, shard),
+        jnp.asarray(occ[:, 0]), jnp.asarray(occ[:, 1]), jnp.asarray(occ[:, 2]),
+        cams_j,
+    )
+    vis, ndc = np.asarray(vis), np.asarray(ndc, np.float64)
+    if pad:
+        vis, ndc = vis[:, :-pad], ndc[:, :-pad]
+    return vis.astype(np.uint32), ndc
+
+
 def sharded_batched_vert_normals(v_batch, f, mesh, axis="dp"):
     """Vertex normals for a batch of meshes, batch axis sharded over devices
     (BASELINE config 3 at multi-chip scale)."""

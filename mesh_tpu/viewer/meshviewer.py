@@ -175,6 +175,40 @@ class MeshSubwindow(object):
     autorecenter = property(fset=set_autorecenter, doc="Autorecenter on/off")
 
 
+def send_command(host, port, label, obj, which_window=(0, 0), wait_ack=10000):
+    """One-shot push of a wire-protocol command to a running viewer server
+    (the `meshviewer view/snap --host/--port` path, reference
+    bin/meshviewer dispatch).
+
+    Acks carry only a port number and the server connects to its own
+    loopback for them (reference protocol, meshviewer.py:770-804), so an ack
+    is only requested when the server runs on this machine; cross-machine
+    sends are fire-and-forget.  Returns True on success / ack received.
+    """
+    import zmq
+
+    local = host in ("127.0.0.1", "localhost", "0.0.0.0")
+    context = zmq.Context.instance()
+    client = context.socket(zmq.PUSH)
+    client.connect("tcp://%s:%d" % (host, port))
+    msg = {"label": label, "obj": obj, "which_window": which_window}
+    ack = None
+    if wait_ack and local:
+        ack = context.socket(zmq.PULL)
+        msg["port"] = ack.bind_to_random_port("tcp://%s" % ZMQ_HOST)
+    client.send_pyobj(msg)
+    ok = True
+    if ack is not None:
+        poller = zmq.Poller()
+        poller.register(ack, zmq.POLLIN)
+        ok = bool(poller.poll(wait_ack))
+        if ok:
+            ack.recv_pyobj()
+        ack.close()
+    client.close()
+    return ok
+
+
 def _sanitize_meshes(mesh_list):
     """Strip device arrays / lazy members down to picklable numpy attributes
     (reference meshviewer.py:742-768)."""

@@ -169,6 +169,41 @@ class MeshViewerRemote(object):
         label = msg["label"]
         obj = msg.get("obj")
         r, c = msg.get("which_window", (0, 0))
+
+        # window-global labels don't touch a subwindow — dispatch them before
+        # the bounds check so a stray which_window can't drop them
+        if label == "titlebar":
+            from OpenGL.GLUT import glutSetWindowTitle
+
+            glutSetWindowTitle(obj)
+            self.need_redraw = True
+            return
+        elif label == "save_snapshot":
+            self.save_snapshot(obj)
+            self.need_redraw = True
+            return
+        elif label == "get_keypress":
+            self.pending_keypress_port = msg.get("port")
+            self._flush_keypress()
+            return
+        elif label == "get_mouseclick":
+            self.pending_mouseclick_port = msg.get("port")
+            self._flush_mouseclick()
+            return
+        elif label == "get_event":
+            # whichever user event fires first (key or click) answers; a
+            # queued event that already fired is served immediately
+            # (reference meshviewer.py:1028-1032, 1060-1062, 1196-1197)
+            self.pending_event_port = msg.get("port")
+            self._flush_event()
+            return
+        elif label == "get_window_shape":
+            self._reply(
+                msg.get("port"),
+                {"event_type": "window_shape", "shape": (self.width, self.height)},
+            )
+            return
+
         if not (0 <= r < self.shape[0] and 0 <= c < self.shape[1]):
             # treat a bad subwindow index as a handled no-op so the client
             # still gets its ack instead of timing out on a "dead" server
@@ -191,39 +226,12 @@ class MeshViewerRemote(object):
             sub.dynamic_lines = obj or []
         elif label == "static_lines":
             sub.static_lines = obj or []
-        elif label == "titlebar":
-            from OpenGL.GLUT import glutSetWindowTitle
-
-            glutSetWindowTitle(obj)
         elif label == "background_color":
             sub.background_color = np.asarray(obj)
         elif label == "autorecenter":
             sub.autorecenter = bool(obj)
         elif label == "lighting_on":
             sub.lighting_on = bool(obj)
-        elif label == "save_snapshot":
-            self.save_snapshot(obj)
-        elif label == "get_keypress":
-            self.pending_keypress_port = msg.get("port")
-            self._flush_keypress()
-            return
-        elif label == "get_mouseclick":
-            self.pending_mouseclick_port = msg.get("port")
-            self._flush_mouseclick()
-            return
-        elif label == "get_event":
-            # whichever user event fires first (key or click) answers; a
-            # queued event that already fired is served immediately
-            # (reference meshviewer.py:1028-1032, 1060-1062, 1196-1197)
-            self.pending_event_port = msg.get("port")
-            self._flush_event()
-            return
-        elif label == "get_window_shape":
-            self._reply(
-                msg.get("port"),
-                {"event_type": "window_shape", "shape": (self.width, self.height)},
-            )
-            return
         self.need_redraw = True
 
     def _reply(self, port, obj):

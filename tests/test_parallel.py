@@ -135,3 +135,130 @@ class TestGraftEntry:
 
         mod = importlib.import_module("__graft_entry__")
         mod.dryrun_multichip(8)
+
+
+class TestLandmarkFit:
+    """Landmark-anchored registration: the device-side form of the
+    reference's landm_regressors (landmarks.py:45-65) driving the fit."""
+
+    def _tiny_model(self):
+        import numpy as np
+
+        from mesh_tpu.models import synthetic_body_model
+        from mesh_tpu.sphere import _icosphere
+
+        v, f = _icosphere(1)
+        return synthetic_body_model(
+            seed=0, n_betas=4, n_joints=6, template=(v, f.astype(np.int32))
+        )
+
+    def test_landmark_arrays_pack_regressors(self):
+        import numpy as np
+
+        from mesh_tpu.parallel import landmark_arrays
+
+        regs = {
+            "nose": (np.array([3, 7, 9]), np.array([0.2, 0.5, 0.3])),
+            "chin": (np.array([1]), np.array([1.0])),
+        }
+        idx, bary = landmark_arrays(regs)
+        assert idx.shape == (2, 3) and bary.shape == (2, 3)
+        # sorted order: chin first, zero-padded
+        np.testing.assert_array_equal(np.asarray(idx[0]), [1, 0, 0])
+        np.testing.assert_allclose(np.asarray(bary[0]), [1.0, 0, 0])
+        np.testing.assert_allclose(np.asarray(bary[1]), [0.2, 0.5, 0.3])
+
+    def test_landmark_loss_zero_at_truth(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from mesh_tpu.models import lbs
+        from mesh_tpu.parallel import landmark_arrays, landmark_loss
+
+        model = self._tiny_model()
+        betas = jnp.zeros((1, model.num_betas))
+        pose = jnp.zeros((1, model.num_joints, 3))
+        verts, _ = lbs(model, betas, pose)
+        regs = {
+            "a": (np.array([0, 1, 2]), np.array([0.3, 0.3, 0.4])),
+            "b": (np.array([10]), np.array([1.0])),
+        }
+        idx, bary = landmark_arrays(regs)
+        ring = np.asarray(verts)[0][np.asarray(idx)]
+        target = (ring * np.asarray(bary)[..., None]).sum(1)[None]
+        loss = landmark_loss(verts, idx, bary, jnp.asarray(target))
+        assert float(loss) < 1e-10
+
+    def test_landmarks_pull_fit_toward_targets(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from mesh_tpu.models import lbs
+        from mesh_tpu.parallel import (
+            init_fit_state,
+            landmark_arrays,
+            make_fit_step,
+            scan_to_model_loss,
+        )
+
+        model = self._tiny_model()
+        rng = np.random.RandomState(1)
+        true_betas = jnp.asarray(rng.randn(1, model.num_betas) * 0.5, jnp.float32)
+        true_pose = jnp.zeros((1, model.num_joints, 3))
+        target_verts, _ = lbs(model, true_betas, true_pose)
+        scan = target_verts[:, ::3]  # sparse "scan" of the target surface
+
+        regs = {"l%d" % i: (np.array([i * 7]), np.array([1.0])) for i in range(5)}
+        idx, bary = landmark_arrays(regs)
+        lm_target = jnp.asarray(np.asarray(target_verts)[:, [i * 7 for i in range(5)]])
+
+        state, optimizer = init_fit_state(model, 1)
+        step = make_fit_step(
+            model, optimizer, landmarks=(idx, bary, lm_target),
+            landmark_weight=10.0,
+        )
+        loss0 = None
+        for i in range(60):
+            state, loss = step(state, scan)
+            loss0 = loss0 if loss0 is not None else float(loss)
+        assert float(loss) < loss0 * 0.5  # optimization makes real progress
+        # fitted landmarks end up near their targets
+        verts, _ = lbs(model, state.betas, state.pose, state.trans)
+        got = np.asarray(verts)[0][[i * 7 for i in range(5)]]
+        err = np.linalg.norm(got - np.asarray(lm_target)[0], axis=1)
+        assert err.max() < 0.15
+
+
+@needs_devices
+class TestShardedVisibility:
+    def test_matches_single_device(self):
+        import numpy as np
+
+        from mesh_tpu.geometry import vert_normals
+        from mesh_tpu.parallel import make_device_mesh, sharded_visibility
+        from mesh_tpu.query import visibility_compute
+        from .fixtures import icosphere
+
+        v, f = icosphere(2)
+        n = np.asarray(vert_normals(v.astype(np.float32), f.astype(np.int32)))
+        cams = np.array([[0, 0, 3.0], [3.0, 0, 0]])
+        mesh = make_device_mesh(8)
+        vis_s, ndc_s = sharded_visibility(v, f, cams, n=n, mesh=mesh)
+        vis_1, ndc_1 = visibility_compute(v, f, cams, n=n)
+        np.testing.assert_array_equal(vis_s, vis_1)
+        np.testing.assert_allclose(ndc_s, ndc_1, atol=1e-6)
+
+    def test_non_divisible_vertex_count(self):
+        import numpy as np
+
+        from mesh_tpu.parallel import make_device_mesh, sharded_visibility
+        from mesh_tpu.query import visibility_compute
+        from .fixtures import icosphere
+
+        v, f = icosphere(1)  # 42 verts, not divisible by 8
+        cams = np.array([[0, 0, 3.0]])
+        mesh = make_device_mesh(8)
+        vis_s, _ = sharded_visibility(v, f, cams, mesh=mesh)
+        vis_1, _ = visibility_compute(v, f, cams)
+        assert vis_s.shape == vis_1.shape == (1, 42)
+        np.testing.assert_array_equal(vis_s, vis_1)

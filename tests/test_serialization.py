@@ -154,6 +154,39 @@ class TestObj:
         assert len(data["vertices"]) == 8
         assert len(data["faces"]) == 12
 
+    def test_three_json(self, tmp_path):
+        """three.js model v3.1 layout (reference serialization.py:232-280):
+        flat vertex floats, type-42 face records of v/uv/normal indices."""
+        import json
+
+        v, f = box()
+        m = Mesh(v=v, f=f)
+        m.vt = np.zeros((8, 2))
+        m.ft = np.asarray(f).copy()
+        m.vn = m.estimate_vertex_normals()
+        m.fn = np.asarray(f).copy()
+        path = str(tmp_path / "m.js")
+        m.write_three_json(path, name="boxy")
+        data = json.load(open(path))
+        assert data["metadata"]["formatVersion"] == 3.1
+        assert data["metadata"]["vertices"] == 8
+        assert data["metadata"]["faces"] == 12
+        assert len(data["vertices"]) == 24          # 8 * xyz
+        # each 11-int record: [42, v0 v1 v2, material, t0 t1 t2, n0 n1 n2]
+        faces = np.array(data["faces"]).reshape(12, 11)
+        assert (faces[:, 0] == 42).all()
+        np.testing.assert_array_equal(faces[:, 1:4], np.asarray(f))
+        assert len(data["materials"]) == 1
+
+    def test_write_mtl(self, tmp_path):
+        v, f = box()
+        m = Mesh(v=v, f=f)
+        path = str(tmp_path / "m.mtl")
+        m.write_mtl(path, "mat0", "tex.png")
+        body = open(path).read()
+        assert "newmtl mat0" in body
+        assert "map_Kd tex.png" in body
+
 
 class TestPlyBigEndianIntCounts:
     def test_int_list_count_big_endian(self, tmp_path):
