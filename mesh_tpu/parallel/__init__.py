@@ -5,6 +5,8 @@ from .sharding import (  # noqa: F401
     sharded_batched_vert_normals,
     sharded_visibility,
 )
+from .checkpoint import restore_fit_state, save_fit_state  # noqa: F401
+from .distributed import global_device_mesh, initialize_multihost  # noqa: F401
 from .fit import (  # noqa: F401
     FitState,
     fit_scan,

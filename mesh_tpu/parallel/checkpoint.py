@@ -1,0 +1,71 @@
+"""Checkpoint / resume for long-running fits (SURVEY.md section 5).
+
+The reference has no training-style checkpointing — its nearest analog is
+the crc32-keyed topology disk cache (connectivity.py:115-130).  Scan
+registration at fleet scale does need it, so the fit state (betas / pose /
+trans / optimizer moments) round-trips through orbax, the standard JAX
+checkpointing library; sharded arrays restore with their shardings.
+
+    state, opt = init_fit_state(model, batch)
+    save_fit_state(path, state, step=120)
+    state, step = restore_fit_state(path, state)   # template gives structure
+"""
+
+import os
+
+import jax
+import numpy as np
+
+from .fit import FitState
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def _as_payload(state, step):
+    # optax states are nested namedtuples, which do not round-trip through
+    # orbax's typed restore; store their leaves under stable indexed keys
+    opt_leaves = jax.tree.leaves(state.opt_state)
+    return {
+        "step": np.asarray(step, np.int64),
+        "betas": state.betas,
+        "pose": state.pose,
+        "trans": state.trans,
+        "opt": {"%04d" % i: leaf for i, leaf in enumerate(opt_leaves)},
+    }
+
+
+def save_fit_state(path, state, step=0, force=True):
+    """Write a FitState (+ step counter) to ``path`` (a directory)."""
+    path = os.path.abspath(str(path))
+    _checkpointer().save(path, _as_payload(state, step), force=force)
+    return path
+
+
+def restore_fit_state(path, template_state):
+    """Restore ``(FitState, step)`` from ``path``.
+
+    ``template_state`` (a FitState of the same shapes, e.g. fresh from
+    ``init_fit_state``) supplies the tree structure, dtypes, and shardings
+    to restore onto — the orbax idiom for typed restore.
+    """
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(str(path))
+    template = _as_payload(template_state, 0)
+    restored = _checkpointer().restore(
+        path, restore_args=ocp.checkpoint_utils.construct_restore_args(template)
+    )
+    opt_leaves = [restored["opt"][k] for k in sorted(restored["opt"])]
+    state = FitState(
+        betas=restored["betas"],
+        pose=restored["pose"],
+        trans=restored["trans"],
+        opt_state=jax.tree.unflatten(
+            jax.tree.structure(template_state.opt_state), opt_leaves
+        ),
+    )
+    return state, int(restored["step"])

@@ -1,0 +1,63 @@
+"""Multi-host (DCN) initialization helpers.
+
+The reference is a single-host library; its only inter-process channel is
+the viewer's ZMQ socket (SURVEY.md section 2.3).  Scaling the TPU framework
+past one host needs nothing hand-written either: `jax.distributed`
+bootstraps the process group, after which `jax.devices()` spans all hosts
+and every `shard_map`/`pjit` in this package runs unchanged with XLA
+routing collectives over ICI within a slice and DCN across slices.
+
+    initialize_multihost()            # no-op on single host / TPU auto-config
+    mesh = global_device_mesh(("dp", "sp"), (jax.device_count() // 2, 2))
+    step = make_fit_step(model, opt, mesh=mesh)
+"""
+
+import numpy as np
+
+import jax
+
+
+def initialize_multihost(coordinator_address=None, num_processes=None,
+                         process_id=None):
+    """Initialize jax.distributed when running under a multi-host launcher.
+
+    On TPU pods the three arguments are auto-detected from the environment;
+    pass them explicitly for CPU/GPU clusters.  Safe to call on a single
+    host with NO arguments: auto-detect failures degrade to single-process
+    operation.  With explicit arguments the caller clearly intends
+    multi-host, so initialization errors propagate instead of silently
+    running each host as an independent job.
+    Returns True when a multi-process group is live.
+    """
+    explicit = any(
+        arg is not None
+        for arg in (coordinator_address, num_processes, process_id)
+    )
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except Exception:
+        if explicit:
+            raise
+        return False
+    return jax.process_count() > 1
+
+
+def global_device_mesh(axis_names=("dp",), shape=None):
+    """A Mesh over every device of every process.
+
+    Within one host this matches parallel.make_device_mesh; across hosts the
+    leading axis should be the data-parallel one so its collectives ride DCN
+    only for the final reductions.
+    """
+    from jax.sharding import Mesh
+
+    devices = np.asarray(jax.devices())
+    if shape is None:
+        shape = (devices.size,) if len(axis_names) == 1 else None
+    if shape is None:
+        raise ValueError("shape is required for a multi-axis mesh")
+    return Mesh(devices.reshape(shape), axis_names)

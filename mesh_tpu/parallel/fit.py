@@ -34,7 +34,9 @@ def landmark_arrays(regressors, names=None, pad_to=None):
     """Pack a ``landm_regressors`` dict (name -> (vert idxs, bary coeffs),
     landmarks.py:45-65) into fixed-shape device arrays.
 
-    :returns: ``(idx [L, K] int32, bary [L, K] f32)`` — zero-padded so the
+    :returns: ``(idx [L, K] int32, bary [L, K] f32, names [L])`` — rows are
+        in ``names`` order (sorted when not given; returned so callers can
+        pair their ``target_xyz`` rows unambiguously), zero-padded so the
         regression ``sum_k bary[l, k] * verts[idx[l, k]]`` is exact.
     """
     import numpy as np
@@ -47,7 +49,7 @@ def landmark_arrays(regressors, names=None, pad_to=None):
         vi, coeff = regressors[name]
         idx[li, : len(vi)] = np.asarray(vi).ravel()
         bary[li, : len(coeff)] = np.asarray(coeff).ravel()
-    return jnp.asarray(idx), jnp.asarray(bary)
+    return jnp.asarray(idx), jnp.asarray(bary), names
 
 
 def landmark_loss(verts, landm_idx, landm_bary, target_xyz):

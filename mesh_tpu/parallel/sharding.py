@@ -15,7 +15,7 @@ On a v5e-8 slice `make_device_mesh()` yields an 8-way ("dp",) mesh or a 2D
 (DCN between hosts, ICI within).
 """
 
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -52,16 +52,10 @@ def _pad_rows(arr, multiple):
     return arr, pad
 
 
-def sharded_closest_faces_and_points(v, f, points, mesh, axis="dp", chunk=512):
-    """Closest-point query sharded over the query axis of an ICI mesh.
-
-    v/f are replicated to every device; each device runs the tiled
-    brute-force kernel on its query shard (BASELINE config 5: 100k-vert scan
-    vs SMPL over v5e-8).  Returns the same dict as closest_faces_and_points.
-    """
-    n_shards = mesh.devices.size if axis == "dp" else mesh.shape[axis]
-    points = np.asarray(points, np.float32)
-    points_padded, pad = _pad_rows(points, n_shards)
+@lru_cache(maxsize=32)
+def _closest_shard_fn(mesh, axis, chunk):
+    """Compiled sharded closest-point, cached per (mesh, axis, chunk) so
+    repeated calls reuse the executable instead of retracing."""
 
     @partial(
         jax.shard_map,
@@ -83,7 +77,21 @@ def sharded_closest_faces_and_points(v, f, points, mesh, axis="dp", chunk=512):
             axis=1,
         )
 
-    out = jax.jit(_run)(
+    return jax.jit(_run)
+
+
+def sharded_closest_faces_and_points(v, f, points, mesh, axis="dp", chunk=512):
+    """Closest-point query sharded over the query axis of an ICI mesh.
+
+    v/f are replicated to every device; each device runs the tiled
+    brute-force kernel on its query shard (BASELINE config 5: 100k-vert scan
+    vs SMPL over v5e-8).  Returns the same dict as closest_faces_and_points.
+    """
+    n_shards = mesh.shape[axis]
+    points = np.asarray(points, np.float32)
+    points_padded, pad = _pad_rows(points, n_shards)
+
+    out = _closest_shard_fn(mesh, axis, chunk)(
         jnp.asarray(v, jnp.float32), jnp.asarray(f, jnp.int32),
         jax.device_put(
             points_padded, NamedSharding(mesh, P(axis))
@@ -100,25 +108,9 @@ def sharded_closest_faces_and_points(v, f, points, mesh, axis="dp", chunk=512):
     }
 
 
-def sharded_visibility(v, f, cams, n=None, mesh=None, axis="dp",
-                       min_dist=1e-3):
-    """Per-(camera, vertex) visibility with the vertex axis sharded over an
-    ICI mesh (the multi-chip form of the reference's per-camera TBB loop,
-    visibility.cpp:117-133).  Occluder triangles are replicated; each device
-    ray-casts its vertex shard against the full mesh.  Returns the same
-    (vis [C, V] uint32, n_dot_cam [C, V] f64) as visibility_compute.
-    """
+@lru_cache(maxsize=32)
+def _visibility_shard_fn(mesh, axis, chunk, min_dist):
     from ..query.visibility import _visibility_kernel
-
-    n_shards = mesh.devices.size if axis == "dp" else mesh.shape[axis]
-    v_np = np.asarray(v, np.float32)
-    n_np = np.asarray(n, np.float32) if n is not None else np.zeros_like(v_np)
-    v_padded, pad = _pad_rows(v_np, n_shards)
-    n_padded, _ = _pad_rows(n_np, n_shards)
-    occ = v_np[np.asarray(f, np.int64)]
-    cams_j = jnp.atleast_2d(jnp.asarray(cams, jnp.float32))
-
-    chunk = min(1024, v_padded.shape[0] // n_shards)
 
     @partial(
         jax.shard_map,
@@ -132,8 +124,29 @@ def sharded_visibility(v, f, cams, n=None, mesh=None, axis="dp",
             jnp.float32(min_dist), chunk=chunk,
         )
 
+    return jax.jit(_run)
+
+
+def sharded_visibility(v, f, cams, n=None, mesh=None, axis="dp",
+                       min_dist=1e-3):
+    """Per-(camera, vertex) visibility with the vertex axis sharded over an
+    ICI mesh (the multi-chip form of the reference's per-camera TBB loop,
+    visibility.cpp:117-133).  Occluder triangles are replicated; each device
+    ray-casts its vertex shard against the full mesh.  Returns the same
+    (vis [C, V] uint32, n_dot_cam [C, V] f64) as visibility_compute.
+    """
+    n_shards = mesh.shape[axis]
+    v_np = np.asarray(v, np.float32)
+    n_np = np.asarray(n, np.float32) if n is not None else np.zeros_like(v_np)
+    v_padded, pad = _pad_rows(v_np, n_shards)
+    n_padded, _ = _pad_rows(n_np, n_shards)
+    occ = v_np[np.asarray(f, np.int64)]
+    cams_j = jnp.atleast_2d(jnp.asarray(cams, jnp.float32))
+
+    chunk = min(1024, v_padded.shape[0] // n_shards)
+
     shard = NamedSharding(mesh, P(axis))
-    vis, ndc = jax.jit(_run)(
+    vis, ndc = _visibility_shard_fn(mesh, axis, chunk, float(min_dist))(
         jax.device_put(v_padded, shard),
         jax.device_put(n_padded, shard),
         jnp.asarray(occ[:, 0]), jnp.asarray(occ[:, 1]), jnp.asarray(occ[:, 2]),
@@ -145,10 +158,8 @@ def sharded_visibility(v, f, cams, n=None, mesh=None, axis="dp",
     return vis.astype(np.uint32), ndc
 
 
-def sharded_batched_vert_normals(v_batch, f, mesh, axis="dp"):
-    """Vertex normals for a batch of meshes, batch axis sharded over devices
-    (BASELINE config 3 at multi-chip scale)."""
-
+@lru_cache(maxsize=32)
+def _normals_shard_fn(mesh, axis):
     @partial(
         jax.shard_map,
         mesh=mesh,
@@ -158,7 +169,14 @@ def sharded_batched_vert_normals(v_batch, f, mesh, axis="dp"):
     def _run(vb, f_rep):
         return vert_normals(vb, f_rep)
 
-    return jax.jit(_run)(
+    return jax.jit(_run)
+
+
+def sharded_batched_vert_normals(v_batch, f, mesh, axis="dp"):
+    """Vertex normals for a batch of meshes, batch axis sharded over devices
+    (BASELINE config 3 at multi-chip scale)."""
+
+    return _normals_shard_fn(mesh, axis)(
         jax.device_put(
             jnp.asarray(v_batch, jnp.float32), NamedSharding(mesh, P(axis))
         ),

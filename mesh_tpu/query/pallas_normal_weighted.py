@@ -1,0 +1,110 @@
+"""Pallas TPU kernel for normal-weighted nearest neighbor.
+
+The blended metric ``cost = |p - q| + eps * (1 - n_p . n_tri)`` is the
+registration workhorse the reference built 300 lines of custom CGAL traits
+for (mesh/src/AABB_n_tree.h:40-84, with a random-hint warm start noted
+"slow" in-source).  The plain-JAX path (normal_weighted.py) materializes
+(chunk, F, 3) closest-point intermediates in HBM; this kernel fuses the
+Ericson distance, the normal penalty (an outer-product of query-normal and
+face-normal component planes), and the running argmin into one VMEM-resident
+(TQ, TF) tile pass — the same structure as pallas_closest.
+
+eps is compile-time static (one kernel per eps value, cached by jit).
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..geometry.tri_normals import tri_normals
+from .pallas_closest import _BIG, _pad_cols, _pad_rows, _sqdist_tile
+from .point_triangle import closest_point_on_triangle
+
+
+def _nw_kernel(eps, px, py, pz, qnx, qny, qnz,
+               ax, ay, az, bx, by, bz, cx, cy, cz, tnx, tny, tnz,
+               out_i, acc_d, acc_i):
+    j = pl.program_id(1)
+    n_j = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_d[:] = jnp.full_like(acc_d, _BIG)
+        acc_i[:] = jnp.zeros_like(acc_i)
+
+    d2 = _sqdist_tile(
+        px[:], py[:], pz[:], ax[:], ay[:], az[:],
+        bx[:], by[:], bz[:], cx[:], cy[:], cz[:],
+    )  # (TQ, TF)
+    ndot = qnx[:] * tnx[:] + qny[:] * tny[:] + qnz[:] * tnz[:]
+    cost = jnp.sqrt(d2) + eps * (1.0 - ndot)
+    tf = cost.shape[1]
+    tile_min = jnp.min(cost, axis=1, keepdims=True)
+    tile_arg = jnp.argmin(cost, axis=1).astype(jnp.int32)[:, None] + j * tf
+    better = tile_min < acc_d[:]
+    acc_d[:] = jnp.where(better, tile_min, acc_d[:])
+    acc_i[:] = jnp.where(better, tile_arg, acc_i[:])
+
+    @pl.when(j == n_j - 1)
+    def _write():
+        out_i[:] = acc_i[:]
+
+
+@partial(jax.jit, static_argnames=("eps", "tile_q", "tile_f", "interpret"))
+def nearest_normal_weighted_pallas(v, f, points, normals, eps=0.1,
+                                   tile_q=256, tile_f=2048, interpret=False):
+    """Pallas-accelerated AabbNormalsTree.nearest.
+
+    Same contract as normal_weighted.nearest_normal_weighted: returns
+    ``(face [Q] int32, point [Q, 3])`` minimizing the blended metric.  Query
+    normals are used as given (the reference does not normalize them,
+    search.py:96-100); triangle normals are unit.
+    """
+    v = jnp.asarray(v, jnp.float32)
+    points = jnp.asarray(points, jnp.float32)
+    normals = jnp.asarray(normals, jnp.float32)
+    center = jnp.mean(v, axis=0)
+    vc = v - center
+    pts = points - center
+
+    tri = vc[f]  # (F, 3, 3)
+    tn = tri_normals(vc, f)  # (F, 3) unit
+    n_q = pts.shape[0]
+
+    p_cols = [_pad_rows(pts[:, k:k + 1], tile_q, 0.0) for k in range(3)]
+    n_cols = [_pad_rows(normals[:, k:k + 1], tile_q, 0.0) for k in range(3)]
+    tri_rows = [
+        _pad_cols(tri[:, corner, k][None, :], tile_f, _BIG)
+        for corner in range(3)
+        for k in range(3)
+    ]
+    # padded faces get a zero normal: their penalty is eps, but their
+    # distance to any query is ~_BIG, so they can never win
+    tn_rows = [_pad_cols(tn[:, k][None, :], tile_f, 0.0) for k in range(3)]
+    q_pad = p_cols[0].shape[0]
+    f_pad = tri_rows[0].shape[1]
+    grid = (q_pad // tile_q, f_pad // tile_f)
+
+    out_i = pl.pallas_call(
+        partial(_nw_kernel, float(eps)),  # static python float: baked literal
+        grid=grid,
+        in_specs=[
+            *[pl.BlockSpec((tile_q, 1), lambda i, j: (i, 0)) for _ in range(6)],
+            *[pl.BlockSpec((1, tile_f), lambda i, j: (0, j)) for _ in range(12)],
+        ],
+        out_specs=pl.BlockSpec((tile_q, 1), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((q_pad, 1), jnp.int32),
+        scratch_shapes=[
+            pltpu.VMEM((tile_q, 1), jnp.float32),
+            pltpu.VMEM((tile_q, 1), jnp.int32),
+        ],
+        interpret=interpret,
+    )(*p_cols, *n_cols, *tri_rows, *tn_rows)
+
+    best = out_i[:n_q, 0]
+    a, b, c = tri[:, 0], tri[:, 1], tri[:, 2]
+    point, _, _ = closest_point_on_triangle(pts, a[best], b[best], c[best])
+    return best, point + center

@@ -114,12 +114,22 @@ class AabbNormalsTree(object):
         self.eps = eps
 
     def nearest(self, v_samples, n_samples):
-        face, point = query.nearest_normal_weighted(
-            self.v, self.f,
-            np.asarray(v_samples, np.float32).reshape(-1, 3),
-            np.asarray(n_samples, np.float32).reshape(-1, 3),
-            eps=self.eps,
-        )
+        import jax
+
+        pts = np.asarray(v_samples, np.float32).reshape(-1, 3)
+        nrm = np.asarray(n_samples, np.float32).reshape(-1, 3)
+        if jax.devices()[0].platform == "tpu":
+            from .query.pallas_normal_weighted import (
+                nearest_normal_weighted_pallas,
+            )
+
+            face, point = nearest_normal_weighted_pallas(
+                self.v, self.f, pts, nrm, eps=float(self.eps)
+            )
+        else:
+            face, point = query.nearest_normal_weighted(
+                self.v, self.f, pts, nrm, eps=self.eps
+            )
         return (
             np.asarray(face).astype(np.uint32).reshape(-1, 1),
             np.asarray(point, dtype=np.float64),

@@ -198,10 +198,12 @@ class MeshViewerRemote(object):
             self._flush_event()
             return
         elif label == "get_window_shape":
-            self._reply(
-                msg.get("port"),
-                {"event_type": "window_shape", "shape": (self.width, self.height)},
-            )
+            if msg.get("port") is not None:  # portless (fire-and-forget) send
+                self._reply(
+                    msg["port"],
+                    {"event_type": "window_shape",
+                     "shape": (self.width, self.height)},
+                )
             return
 
         if not (0 <= r < self.shape[0] and 0 <= c < self.shape[1]):

@@ -1,0 +1,76 @@
+"""Normal-weighted Pallas kernel parity (interpret mode, CPU).
+
+Same bar as the other Pallas kernels: must agree with the plain-JAX
+normal_weighted path on the blended cost everywhere, and on faces up to
+exact cost ties.
+"""
+
+import numpy as np
+
+from mesh_tpu.geometry import tri_normals
+from mesh_tpu.query import nearest_normal_weighted
+from mesh_tpu.query.pallas_normal_weighted import nearest_normal_weighted_pallas
+from tests.fixtures import icosphere
+
+
+def _blended_cost(v, f, points, normals, face, point, eps):
+    tn = np.asarray(tri_normals(v.astype(np.float32), f))
+    d = np.linalg.norm(points - point, axis=-1)
+    pen = eps * (1.0 - np.sum(normals * tn[face], axis=-1))
+    return d + pen
+
+
+class TestNormalWeightedPallas:
+    def _case(self, n=500, seed=0):
+        v, f = icosphere(2)
+        rng = np.random.RandomState(seed)
+        points = rng.randn(n, 3).astype(np.float32) * 0.8
+        normals = rng.randn(n, 3).astype(np.float32)
+        normals /= np.linalg.norm(normals, axis=1, keepdims=True)
+        return v.astype(np.float32), f.astype(np.int32), points, normals
+
+    def test_matches_xla_path(self):
+        v, f, points, normals = self._case()
+        eps = 0.1
+        face_p, point_p = nearest_normal_weighted_pallas(
+            v, f, points, normals, eps=eps, tile_q=128, tile_f=256,
+            interpret=True,
+        )
+        face_x, point_x = nearest_normal_weighted(v, f, points, normals, eps=eps)
+        cost_p = _blended_cost(v, f, points, normals,
+                               np.asarray(face_p), np.asarray(point_p), eps)
+        cost_x = _blended_cost(v, f, points, normals,
+                               np.asarray(face_x), np.asarray(point_x), eps)
+        np.testing.assert_allclose(cost_p, cost_x, atol=1e-5, rtol=1e-5)
+        assert (np.asarray(face_p) == np.asarray(face_x)).mean() > 0.95
+
+    def test_eps_zero_reduces_to_closest_point(self):
+        from mesh_tpu.query import closest_faces_and_points
+
+        v, f, points, normals = self._case(n=300, seed=1)
+        face, point = nearest_normal_weighted_pallas(
+            v, f, points, normals, eps=0.0, tile_q=128, tile_f=256,
+            interpret=True,
+        )
+        ref = closest_faces_and_points(v, f, points)
+        d_p = np.linalg.norm(points - np.asarray(point), axis=-1)
+        d_r = np.sqrt(np.asarray(ref["sqdist"]))
+        np.testing.assert_allclose(d_p, d_r, atol=1e-5, rtol=1e-4)
+
+    def test_eps_flips_winner_toward_aligned_normal(self):
+        # reference semantic test (tests/test_aabb_n_tree.py:41-52): with a
+        # large eps the chosen face aligns with the query normal even when a
+        # nearer face exists
+        v, f, _, _ = self._case()
+        point = np.array([[0.0, 0.0, 1.05]], np.float32)  # just above +z pole
+        toward_x = np.array([[1.0, 0.0, 0.0]], np.float32)
+        f0, _ = nearest_normal_weighted_pallas(
+            v, f, point, toward_x, eps=0.0, tile_q=128, tile_f=256,
+            interpret=True,
+        )
+        f_big, _ = nearest_normal_weighted_pallas(
+            v, f, point, toward_x, eps=5.0, tile_q=128, tile_f=256,
+            interpret=True,
+        )
+        tn = np.asarray(tri_normals(v, f))
+        assert tn[int(f_big[0])] @ toward_x[0] > tn[int(f0[0])] @ toward_x[0]
