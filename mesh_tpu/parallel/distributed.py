@@ -42,7 +42,13 @@ def initialize_multihost(coordinator_address=None, num_processes=None,
     except Exception:
         if explicit:
             raise
-        return False
+        # auto-detect failure — or jax.distributed was already initialized
+        # (by a launcher or an earlier call), in which case the group is
+        # live and the documented contract must still report it
+        try:
+            return jax.process_count() > 1
+        except Exception:
+            return False
     return jax.process_count() > 1
 
 

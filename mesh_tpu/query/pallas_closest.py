@@ -92,30 +92,45 @@ def _sqdist_tile(px, py, pz, ax, ay, az, bx, by, bz, cx, cy, cz):
     return dx * dx + dy * dy + dz * dz
 
 
-def _kernel(px, py, pz, ax, ay, az, bx, by, bz, cx, cy, cz,
-            out_i, acc_d, acc_i):
-    j = pl.program_id(1)
-    n_j = pl.num_programs(1)
+def make_argmin_kernel(cost_tile):
+    """Running min/argmin kernel scaffold shared by the brute-force and
+    normal-weighted kernels.
 
-    @pl.when(j == 0)
-    def _init():
-        acc_d[:] = jnp.full_like(acc_d, _BIG)
-        acc_i[:] = jnp.zeros_like(acc_i)
+    ``cost_tile(*planes) -> (TQ, TF)`` computes the per-pair cost from the
+    input plane blocks.  Invariants the scaffold encodes once: grid dim 1
+    (faces) is innermost so the VMEM accumulators survive across j; the
+    strict ``<`` merge keeps the lowest face index on exact ties (matching
+    the XLA paths' argmin); accumulators init to ``_BIG`` at j == 0 and the
+    winner index is written at the last face tile.
+    """
 
-    d2 = _sqdist_tile(
-        px[:], py[:], pz[:], ax[:], ay[:], az[:],
-        bx[:], by[:], bz[:], cx[:], cy[:], cz[:],
-    )  # (TQ, TF)
-    tf = d2.shape[1]
-    tile_min = jnp.min(d2, axis=1, keepdims=True)            # (TQ, 1)
-    tile_arg = jnp.argmin(d2, axis=1).astype(jnp.int32)[:, None] + j * tf
-    better = tile_min < acc_d[:]
-    acc_d[:] = jnp.where(better, tile_min, acc_d[:])
-    acc_i[:] = jnp.where(better, tile_arg, acc_i[:])
+    def kernel(*refs):
+        ins = refs[:-3]
+        out_i, acc_d, acc_i = refs[-3:]
+        j = pl.program_id(1)
+        n_j = pl.num_programs(1)
 
-    @pl.when(j == n_j - 1)
-    def _write():
-        out_i[:] = acc_i[:]
+        @pl.when(j == 0)
+        def _init():
+            acc_d[:] = jnp.full_like(acc_d, _BIG)
+            acc_i[:] = jnp.zeros_like(acc_i)
+
+        cost = cost_tile(*[r[:] for r in ins])           # (TQ, TF)
+        tf = cost.shape[1]
+        tile_min = jnp.min(cost, axis=1, keepdims=True)  # (TQ, 1)
+        tile_arg = jnp.argmin(cost, axis=1).astype(jnp.int32)[:, None] + j * tf
+        better = tile_min < acc_d[:]
+        acc_d[:] = jnp.where(better, tile_min, acc_d[:])
+        acc_i[:] = jnp.where(better, tile_arg, acc_i[:])
+
+        @pl.when(j == n_j - 1)
+        def _write():
+            out_i[:] = acc_i[:]
+
+    return kernel
+
+
+_kernel = make_argmin_kernel(_sqdist_tile)
 
 
 def _pad_cols(x, multiple, fill):

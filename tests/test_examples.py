@@ -1,0 +1,22 @@
+"""The examples must keep running end-to-end (subprocess, small sizes)."""
+
+import os
+import subprocess
+import sys
+
+
+def test_register_scan_example(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    res = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(repo, "examples", "register_scan.py"),
+            "--steps", "20", "--out", str(tmp_path),
+        ],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "PYTHONPATH": repo},
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "surface error" in res.stdout
+    assert (tmp_path / "fitted.ply").exists()
+    assert (tmp_path / "scan.ply").exists()

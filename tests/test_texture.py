@@ -1,0 +1,99 @@
+"""Texture subsystem (mesh_tpu/texture.py; reference mesh/texture.py)."""
+
+import numpy as np
+import pytest
+
+from mesh_tpu import Mesh
+
+from .fixtures import box
+
+cv2 = pytest.importorskip("cv2")
+
+
+def _textured_box():
+    v, f = box()
+    m = Mesh(v=v, f=f)
+    rng = np.random.RandomState(0)
+    m.vt = rng.rand(8, 2)
+    m.ft = np.asarray(f).copy().astype(np.uint32)
+    m.texture_filepath = None
+    return m
+
+
+class TestTransferTexture:
+    def test_identical_topology_copies(self):
+        src = _textured_box()
+        v, f = box()
+        dst = Mesh(v=v + 1.0, f=f)
+        dst.transfer_texture(src)
+        np.testing.assert_array_equal(dst.vt, src.vt)
+        np.testing.assert_array_equal(dst.ft, src.ft)
+
+    def test_flipped_faces_flip_ft(self):
+        src = _textured_box()
+        v, f = box()
+        dst = Mesh(v=v, f=np.fliplr(np.asarray(f)))
+        dst.transfer_texture(src)
+        np.testing.assert_array_equal(dst.ft, np.fliplr(np.asarray(src.ft)))
+
+    def test_reordered_faces_remap(self):
+        src = _textured_box()
+        v, f = box()
+        f = np.asarray(f)
+        perm = np.random.RandomState(1).permutation(len(f))
+        dst = Mesh(v=v, f=f[perm])
+        dst.transfer_texture(src)
+        # per-corner UVs must land on the same 3D vertices as in the source
+        src_map = {}
+        for face, ft_row in zip(np.asarray(src.f), np.asarray(src.ft)):
+            for vid, tid in zip(face, ft_row):
+                src_map[int(vid)] = int(tid)
+        for face, ft_row in zip(np.asarray(dst.f), np.asarray(dst.ft)):
+            for vid, tid in zip(face, ft_row):
+                assert src_map[int(vid)] == int(tid)
+
+    def test_topology_mismatch_raises(self):
+        src = _textured_box()
+        v, f = box()
+        dst = Mesh(v=v[:4], f=np.asarray(f)[:3])
+        with pytest.raises(ValueError, match="topology mismatch"):
+            dst.transfer_texture(src)
+
+
+class TestTextureImage:
+    def _image_mesh(self, tmp_path):
+        m = _textured_box()
+        # 64x64 BGR ramp: blue = x position, green = y position
+        img = np.zeros((64, 64, 3), np.uint8)
+        img[:, :, 0] = np.arange(64)[None, :] * 4      # B ramps with x
+        img[:, :, 1] = np.arange(64)[:, None] * 4      # G ramps with y
+        path = str(tmp_path / "tex.png")
+        cv2.imwrite(path, img)
+        m.set_texture_image(path)
+        return m
+
+    def test_reload_pads_to_power_of_two_table(self, tmp_path):
+        m = self._image_mesh(tmp_path)
+        assert m.texture_image.shape[0] == 64  # 64 is in the size table
+
+    def test_texture_rgb_vec_matches_scalar(self, tmp_path):
+        m = self._image_mesh(tmp_path)
+        coords = np.array([[0.1, 0.2], [0.9, 0.8], [0.5, 0.5], [0.0, 1.0]])
+        vec = m.texture_rgb_vec(coords)
+        for i, c in enumerate(coords):
+            np.testing.assert_allclose(vec[i], m.texture_rgb(c), atol=0)
+
+    def test_texture_coordinates_by_vertex(self, tmp_path):
+        m = self._image_mesh(tmp_path)
+        per_vertex = m.texture_coordinates_by_vertex()
+        assert len(per_vertex) == len(np.asarray(m.v))
+        # every UV listed for vertex vid appears in some face containing vid
+        ft = np.asarray(m.ft)
+        f = np.asarray(m.f)
+        vt = np.asarray(m.vt)
+        for vid, uvs in enumerate(per_vertex):
+            assert len(uvs) >= 1
+            for uv in uvs:
+                rows, cols = np.where(f == vid)
+                candidates = vt[ft[rows, cols]]
+                assert any(np.allclose(uv, cand) for cand in candidates)
