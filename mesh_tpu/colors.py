@@ -81,6 +81,24 @@ def jet(val):
     return np.clip(rgb, 0.0, 1.0).reshape(1, 3)
 
 
+def expand_colors(color, n_rows):
+    """Expand `color` into an (n_rows, 3) float rgb array.
+
+    Accepts a color name, an rgb triple, an (N, 3) per-row array, or N
+    scalar weights (each mapped through the jet colormap).  Shared backend
+    of Mesh.colors_like / Lines.colors_like (reference mesh.py:129-145,
+    lines.py:28-48).
+    """
+    rgb = (
+        name_to_rgb[color]
+        if isinstance(color, str)
+        else np.asarray(color, dtype=np.float64)
+    )
+    if rgb.ndim >= 1 and rgb.shape[0] == rgb.size == n_rows:
+        rgb = np.vstack([jet(w) for w in rgb.ravel()])
+    return np.broadcast_to(rgb, (n_rows, 3)).astype(np.float64).copy()
+
+
 def main():
     """Generate static dict code from an X11-format rgb.txt, as the
     reference's generator does (colors.py:17-31)."""

@@ -2,46 +2,38 @@
 
 import numpy as np
 
-from . import colors
-from .colors import jet as _jet
-from .utils import col
+from .colors import expand_colors
 
 
 class Lines(object):
-    """Collection of 3D lines.
+    """Collection of 3D line segments.
 
     Attributes: v (Vx3 vertices), e (Ex2 edges), optional vc/ec colors.
     """
 
     def __init__(self, v, e, vc=None, ec=None):
-        self.v = np.array(v)
-        self.e = np.array(e)
-        if vc is not None:
-            self.set_vertex_colors(vc)
-        if ec is not None:
-            self.set_edge_colors(ec)
+        self.v = np.asarray(v).copy()
+        self.e = np.asarray(e).copy()
+        for given, setter in ((vc, self.set_vertex_colors),
+                              (ec, self.set_edge_colors)):
+            if given is not None:
+                setter(given)
 
     def colors_like(self, color, arr):
-        """Scalar weights map through the jet colormap; names/lists broadcast
-        (reference lines.py:28-48)."""
-        if isinstance(color, str):
-            color = colors.name_to_rgb[color]
-        elif isinstance(color, list):
-            color = np.array(color)
-        if color.shape == (arr.shape[0],):
-            color = col(color)
-            color = np.concatenate([_jet(color[i]) for i in range(color.size)], axis=0)
-        return np.ones((arr.shape[0], 3)) * color
+        """One rgb row per row of `arr`; scalar weights map through the jet
+        colormap (reference lines.py:28-48 semantics)."""
+        return expand_colors(color, np.asarray(arr).shape[0])
 
     def set_vertex_colors(self, vc):
-        self.vc = self.colors_like(vc, self.v)
+        self.vc = expand_colors(vc, len(self.v))
 
     def set_edge_colors(self, ec):
-        self.ec = self.colors_like(ec, self.e)
+        self.ec = expand_colors(ec, len(self.e))
 
     def write_obj(self, filename):
-        with open(filename, "w") as fi:
-            for r in self.v:
-                fi.write("v %f %f %f\n" % (r[0], r[1], r[2]))
-            for e in self.e:
-                fi.write("l %d %d\n" % (e[0] + 1, e[1] + 1))
+        """Wavefront export: `v` records then 1-based `l` segment records
+        (reference lines.py:56-61 format)."""
+        records = ["v %f %f %f\n" % tuple(xyz) for xyz in self.v]
+        records += ["l %d %d\n" % (int(a) + 1, int(b) + 1) for a, b in self.e]
+        with open(filename, "w") as fh:
+            fh.writelines(records)

@@ -11,7 +11,6 @@ working; conversion to device arrays happens at the kernel boundary
 """
 
 import os
-from functools import reduce
 
 import numpy as np
 
@@ -41,35 +40,38 @@ class Mesh(object):
                  vc=None, fc=None, vscale=None, landmarks=None):
         if filename is not None:
             self.load_from_file(filename)
-            if hasattr(self, "f"):
-                self.f = np.require(self.f, dtype=np.uint32)
-            self.v = np.require(self.v, dtype=np.float64)
             self.filename = filename
-            if vscale is not None:
-                self.v *= vscale
         if v is not None:
-            self.v = np.array(v, dtype=np.float64)
-            if vscale is not None:
-                self.v *= vscale
+            self.v = np.array(v)           # copy: callers may mutate mesh.v
         if f is not None:
-            self.f = np.require(f, dtype=np.uint32)
+            self.f = f
+        # normalize dtypes of whatever source provided the geometry
+        # (reference mesh.py:68-70: v float64, f uint32)
+        if hasattr(self, "v"):
+            self.v = np.require(self.v, dtype=np.float64)
+            if vscale is not None:
+                self.v = self.v * vscale
+        if hasattr(self, "f"):
+            self.f = np.require(self.f, dtype=np.uint32)
 
-        self.basename = basename
-        if self.basename is None and filename is not None:
+        if basename is not None:
+            self.basename = basename
+        elif filename is not None:
             self.basename = os.path.splitext(os.path.basename(filename))[0]
+        else:
+            self.basename = None
 
         if segm is not None:
             self.segm = segm
-        if landmarks is not None:
-            self.set_landmark_indices_from_any(landmarks)
-        if ppfilename is not None:
-            self.set_landmark_indices_from_ppfile(ppfilename)
-        if lmrkfilename is not None:
-            self.set_landmark_indices_from_lmrkfile(lmrkfilename)
-        if vc is not None:
-            self.set_vertex_colors(vc)
-        if fc is not None:
-            self.set_face_colors(fc)
+        for source, setter in (
+            (landmarks, self.set_landmark_indices_from_any),
+            (ppfilename, self.set_landmark_indices_from_ppfile),
+            (lmrkfilename, self.set_landmark_indices_from_lmrkfile),
+            (vc, self.set_vertex_colors),
+            (fc, self.set_face_colors),
+        ):
+            if source is not None:
+                setter(source)
 
     # ------------------------------------------------------------------
     # Device export
@@ -89,115 +91,118 @@ class Mesh(object):
     # Visualization helpers
 
     def edges_as_lines(self, copy_vertices=False):
+        """Wireframe Lines primitive: each face contributes its three
+        directed edges (v0-v1, v1-v2, v2-v0)."""
         from .lines import Lines
 
-        edges = self.f[:, [0, 1, 1, 2, 2, 0]].flatten().reshape(-1, 2)
-        verts = self.v.copy() if copy_vertices else self.v
-        return Lines(v=verts, e=edges)
+        f = self.f.astype(np.int64)
+        edges = np.stack([f, np.roll(f, -1, axis=1)], axis=2).reshape(-1, 2)
+        return Lines(v=self.v.copy() if copy_vertices else self.v, e=edges)
 
     def show(self, mv=None, meshes=[], lines=[]):
+        """Display this mesh (plus landmark markers, extra meshes, lines)
+        in a viewer window (reference mesh.py:98-128)."""
         from .viewer import MeshViewer
-        from .utils import row
 
-        if mv is None:
-            mv = MeshViewer(keepalive=True)
-
-        if hasattr(self, "landm"):
-            from .sphere import Sphere
-
-            sphere = Sphere(np.zeros((3)), 1.0).to_mesh()
-            scalefactor = (
-                1e-2
-                * np.max(np.max(self.v) - np.min(self.v))
-                / np.max(np.max(sphere.v) - np.min(sphere.v))
-            )
-            sphere.v = sphere.v * scalefactor
-            spheres = [
-                Mesh(vc="SteelBlue", f=sphere.f,
-                     v=sphere.v + row(np.array(self.landm_raw_xyz[k])))
-                for k in self.landm.keys()
-            ]
-            mv.set_dynamic_meshes([self] + spheres + meshes, blocking=True)
-        else:
-            mv.set_dynamic_meshes([self] + meshes, blocking=True)
+        mv = mv if mv is not None else MeshViewer(keepalive=True)
+        scene = [self] + self._landmark_marker_meshes() + list(meshes)
+        mv.set_dynamic_meshes(scene, blocking=True)
         mv.set_dynamic_lines(lines)
         return mv
+
+    def _landmark_marker_meshes(self):
+        """Small blue sphere meshes marking each raw landmark position,
+        scaled to ~1% of this mesh's coordinate extent."""
+        if not hasattr(self, "landm"):
+            return []
+        from .sphere import Sphere
+
+        proto = Sphere(np.zeros(3), 1.0).to_mesh()
+        radius = 0.01 * np.ptp(self.v) / np.ptp(proto.v)
+        markers = []
+        for name in self.landm:
+            center = np.asarray(self.landm_raw_xyz[name], np.float64).reshape(1, 3)
+            markers.append(
+                Mesh(v=proto.v * radius + center, f=proto.f, vc="SteelBlue")
+            )
+        return markers
 
     # ------------------------------------------------------------------
     # Colors
 
     def colors_like(self, color, arr=None):
-        from .utils import row, col
-
-        if arr is None:
-            arr = np.zeros(self.v.shape)
-        if arr.ndim == 1 or arr.shape[1] == 1:
-            arr = arr.reshape(-1, 3)
-        if isinstance(color, str):
-            color = colors.name_to_rgb[color]
-        elif isinstance(color, list):
-            color = np.array(color)
-        if color.shape[0] == arr.shape[0] and color.shape[0] == color.size:
-            color = col(color)
-            color = np.concatenate(
-                [colors.jet(color[i]) for i in range(color.size)], axis=0
-            )
-        return np.ones_like(arr) * color
+        """Expand `color` into one rgb row per row of `arr` (default: per
+        vertex).  Accepts a color name, an rgb triple, an (N,3) array, or N
+        scalar weights (mapped through the jet colormap) — reference
+        mesh.py:129-145 semantics."""
+        reference = self.v if arr is None else np.asarray(arr)
+        n_rows = (
+            reference.shape[0]
+            if reference.ndim == 2 and reference.shape[1] == 3
+            else reference.size // 3
+        )
+        return colors.expand_colors(color, n_rows)
 
     def set_vertex_colors(self, vc, vertex_indices=None):
-        if vertex_indices is not None:
-            self.vc[vertex_indices] = self.colors_like(vc, self.v[vertex_indices])
+        if vertex_indices is None:
+            self.vc = colors.expand_colors(vc, len(self.v))
         else:
-            self.vc = self.colors_like(vc, self.v)
+            # size by the actual selection so boolean masks work too
+            n_selected = len(self.v[vertex_indices])
+            self.vc[vertex_indices] = colors.expand_colors(vc, n_selected)
         return self
 
     def set_vertex_colors_from_weights(self, weights, scale_to_range_1=True, color=True):
+        """Per-vertex scalar weights -> vertex colors, via matplotlib's jet
+        colormap (color=True) or as gray levels."""
         if weights is None:
             return self
+        w = np.asarray(weights, dtype=np.float64)
         if scale_to_range_1:
-            weights = weights - np.min(weights)
-            weights = weights / np.max(weights)
+            w = w - w.min()
+            w = w / w.max()
         if color:
             from matplotlib import cm
 
-            self.vc = cm.jet(weights)[:, :3]
+            self.vc = cm.jet(w)[:, :3]
         else:
-            self.vc = np.tile(np.reshape(weights, (len(weights), 1)), (1, 3))
+            self.vc = np.repeat(w[:, None], 3, axis=1)
         return self
 
     def scale_vertex_colors(self, weights, w_min=0.0, w_max=1.0):
+        """Darken existing vertex colors by per-vertex weights rescaled into
+        [w_min, w_max]."""
         if weights is None:
             return self
-        weights = weights - np.min(weights)
-        weights = (w_max - w_min) * weights / np.max(weights) + w_min
-        self.vc = (weights * self.vc.T).T
+        w = np.asarray(weights, dtype=np.float64)
+        w = w - w.min()
+        w = w_min + (w_max - w_min) * (w / w.max())
+        self.vc = self.vc * w[:, None]
         return self
 
     def set_face_colors(self, fc):
-        self.fc = self.colors_like(fc, self.f)
+        self.fc = colors.expand_colors(fc, len(self.f))
         return self
 
     # ------------------------------------------------------------------
     # Geometry
 
     def faces_by_vertex(self, as_sparse_matrix=False):
-        """V->F incidence (reference mesh.py:193-206)."""
+        """Faces touching each vertex: list-of-lists, or the (V, F)
+        incidence matrix in CSR form (reference mesh.py:193-206)."""
         import scipy.sparse as sp
 
-        if not as_sparse_matrix:
-            faces_by_vertex = [[] for _ in range(len(self.v))]
-            for i, face in enumerate(self.f):
-                faces_by_vertex[face[0]].append(i)
-                faces_by_vertex[face[1]].append(i)
-                faces_by_vertex[face[2]].append(i)
-        else:
-            row = self.f.flatten()
-            col = np.array([range(self.f.shape[0])] * 3).T.flatten()
-            data = np.ones(len(col))
-            faces_by_vertex = sp.csr_matrix(
-                (data, (row, col)), shape=(self.v.shape[0], self.f.shape[0])
+        nv, nf = len(self.v), len(self.f)
+        vert_ids = self.f.astype(np.int64).ravel()       # 3F corner vertices
+        face_ids = np.repeat(np.arange(nf), 3)           # their face indices
+        if as_sparse_matrix:
+            return sp.csr_matrix(
+                (np.ones(vert_ids.size), (vert_ids, face_ids)), shape=(nv, nf)
             )
-        return faces_by_vertex
+        incident = [[] for _ in range(nv)]
+        for vid, fid in zip(vert_ids.tolist(), face_ids.tolist()):
+            incident[vid].append(fid)
+        return incident
 
     def estimate_vertex_normals(self, face_to_verts_sparse_matrix=None):
         """Area-weighted vertex normals on the TPU kernel
@@ -210,65 +215,62 @@ class Mesh(object):
         )
 
     def barycentric_coordinates_for_points(self, points, face_indices):
+        """(corner vertex ids, barycentric coeffs) of each point projected
+        onto its given face (reference mesh.py:218-222)."""
         from .geometry import barycentric_coordinates_of_projection
 
-        face_indices = np.asarray(face_indices)
-        vertex_indices = self.f[face_indices.flatten(), :]
-        tri = np.array([
-            self.v[vertex_indices[:, 0]],
-            self.v[vertex_indices[:, 1]],
-            self.v[vertex_indices[:, 2]],
-        ])
+        corners = self.f[np.asarray(face_indices).ravel()]
+        a, b, c = (self.v[corners[:, k].astype(np.int64)] for k in range(3))
         coeffs = np.asarray(
             barycentric_coordinates_of_projection(
-                np.asarray(points, np.float64), tri[0],
-                tri[1] - tri[0], tri[2] - tri[0],
+                np.asarray(points, np.float64), a, b - a, c - a
             )
         )
-        return vertex_indices, coeffs
+        return corners, coeffs
 
     # ------------------------------------------------------------------
     # Segmentation
 
     def transfer_segm(self, mesh, exclude_empty_parts=True):
+        """Adopt `mesh`'s segmentation: each of our faces joins the part of
+        the donor face nearest its centroid (reference mesh.py:224-237)."""
         self.segm = {}
-        if hasattr(mesh, "segm"):
-            face_centers = self.v[self.f.astype(np.int64)].mean(axis=1)
-            closest_faces, _ = mesh.closest_faces_and_points(face_centers)
-            mesh_parts_by_face = mesh.parts_by_face()
-            parts_by_face = [
-                mesh_parts_by_face[face] for face in np.asarray(closest_faces).flatten()
-            ]
-            self.segm = dict((part, []) for part in mesh.segm.keys())
-            for face, part in enumerate(parts_by_face):
-                self.segm[part].append(face)
-            for part in list(self.segm.keys()):
-                self.segm[part].sort()
-                if exclude_empty_parts and not self.segm[part]:
-                    del self.segm[part]
+        if not hasattr(mesh, "segm"):
+            return
+        centroids = self.v[self.f.astype(np.int64)].mean(axis=1)
+        donor_faces = np.asarray(mesh.closest_faces_and_points(centroids)[0]).ravel()
+        donor_part_of = mesh.parts_by_face()
+        grouped = {part: [] for part in mesh.segm}
+        for our_face, donor_face in enumerate(donor_faces):
+            part = donor_part_of[donor_face]
+            if part:        # donor faces outside any part contribute nothing
+                grouped[part].append(our_face)
+        # enumeration order keeps each list sorted already
+        self.segm = {
+            part: members for part, members in grouped.items()
+            if members or not exclude_empty_parts
+        }
 
     @property
     def verts_by_segm(self):
-        return dict(
-            (segment, sorted(set(self.f[indices].flatten())))
-            for segment, indices in self.segm.items()
-        )
+        """Part name -> sorted unique vertex ids used by that part's faces."""
+        f = self.f.astype(np.int64)
+        return {
+            part: np.unique(f[np.asarray(faces, np.int64)]).tolist()
+            for part, faces in self.segm.items()
+        }
 
     def parts_by_face(self):
-        segments_by_face = [""] * len(self.f)
-        for part in self.segm.keys():
-            for face in self.segm[part]:
-                segments_by_face[face] = part
-        return segments_by_face
+        """Per-face part name ('' where unsegmented)."""
+        names = np.full(len(self.f), "", dtype=object)
+        for part, faces in self.segm.items():
+            names[np.asarray(faces, np.int64)] = part
+        return names.tolist()
 
     def verts_in_common(self, segments):
-        """All vertex indices common to each segment in segments."""
-        return sorted(
-            reduce(
-                lambda s0, s1: s0.intersection(s1),
-                [set(self.verts_by_segm[segm]) for segm in segments],
-            )
-        )
+        """Vertex indices shared by every named segment."""
+        by_segm = self.verts_by_segm
+        return sorted(set.intersection(*(set(by_segm[s]) for s in segments)))
 
     # ------------------------------------------------------------------
     # Joints
@@ -279,37 +281,42 @@ class Mesh(object):
 
     @property
     def joint_xyz(self):
-        joint_locations = {}
-        for name in self.joint_names:
-            joint_locations[name] = self.joint_regressors[name]["offset"] + np.sum(
-                self.v[self.joint_regressors[name]["v_indices"]].T
-                * self.joint_regressors[name]["coeff"],
-                axis=1,
-            )
-        return joint_locations
+        """Regress each named joint from its vertex ring:
+        offset + coeff @ v[ring] (reference mesh.py:265-271)."""
+        return {
+            name: np.asarray(reg["offset"], np.float64)
+            + np.asarray(reg["coeff"], np.float64)
+            @ self.v[np.asarray(reg["v_indices"], np.int64)]
+            for name, reg in self.joint_regressors.items()
+        }
 
     def set_joints(self, joint_names, vertex_indices):
-        """Equal-weight joint regressors from vertex rings
+        """Define joints as uniform averages over vertex rings
         (reference mesh.py:275-280)."""
-        self.joint_regressors = {}
-        for name, indices in zip(joint_names, vertex_indices):
-            self.joint_regressors[name] = {
-                "v_indices": indices,
-                "coeff": [1.0 / len(indices)] * len(indices),
-                "offset": np.array([0.0, 0.0, 0.0]),
+        self.joint_regressors = {
+            name: {
+                "v_indices": ring,
+                "coeff": np.full(len(ring), 1.0 / len(ring)),
+                "offset": np.zeros(3),
             }
+            for name, ring in zip(joint_names, vertex_indices)
+        }
 
     # ------------------------------------------------------------------
     # Visibility
 
     def vertex_visibility(self, camera, normal_threshold=None,
                           omni_directional_camera=False, binary_visiblity=True):
+        """Per-vertex visibility from `camera`; optionally gated on the
+        normal-to-camera dot product.  The `binary_visiblity` keyword keeps
+        the reference's spelling (mesh.py:282) for drop-in compatibility;
+        when False the visibility is weighted by n.dir."""
         vis, n_dot_cam = self.vertex_visibility_and_normals(
             camera, omni_directional_camera
         )
         if normal_threshold is not None:
-            vis = np.logical_and(vis, n_dot_cam > normal_threshold)
-        return np.squeeze(vis) if binary_visiblity else np.squeeze(vis * n_dot_cam)
+            vis = vis.astype(bool) & (n_dot_cam > normal_threshold)
+        return np.squeeze(vis if binary_visiblity else vis * n_dot_cam)
 
     def vertex_visibility_and_normals(self, camera, omni_directional_camera=False):
         from .query import visibility_compute
@@ -327,19 +334,18 @@ class Mesh(object):
         arguments["n"] = self.vn if hasattr(self, "vn") else self.estimate_vertex_normals()
         return visibility_compute(**arguments)
 
-    def visibile_mesh(self, camera=[0.0, 0.0, 0.0]):
-        vis = self.vertex_visibility(camera)
-        faces_to_keep = [
-            face for face in self.f if vis[face[0]] * vis[face[1]] * vis[face[2]]
-        ]
-        vertex_indices_to_keep = np.nonzero(vis)[0]
-        vertices_to_keep = self.v[vertex_indices_to_keep]
-        old_to_new_indices = np.zeros(len(vis))
-        old_to_new_indices[vertex_indices_to_keep] = range(len(vertex_indices_to_keep))
-        return Mesh(
-            v=vertices_to_keep,
-            f=np.array([old_to_new_indices[face] for face in faces_to_keep]),
-        )
+    def visible_mesh(self, camera=[0.0, 0.0, 0.0]):
+        """Submesh of the vertices visible from `camera`; a face survives
+        only if all three corners are visible (reference mesh.py:330-342,
+        where it is spelled `visibile_mesh` — kept below as an alias)."""
+        vis = np.asarray(self.vertex_visibility(camera)).astype(bool).ravel()
+        f = self.f.astype(np.int64)
+        surviving = f[vis[f].all(axis=1)]
+        renumber = np.cumsum(vis) - 1      # old id -> new id where visible
+        return Mesh(v=self.v[vis], f=renumber[surviving])
+
+    #: reference drop-in alias, preserving the reference's spelling
+    visibile_mesh = visible_mesh
 
     def estimate_circumference(self, plane_normal, plane_distance,
                                partNamesAllowed=None, want_edges=False):
@@ -365,7 +371,9 @@ class Mesh(object):
         return processing.keep_vertices(self, keep_list)
 
     def remove_vertices(self, v_list):
-        return self.keep_vertices(np.setdiff1d(np.arange(self.v.shape[0]), v_list))
+        keep = np.ones(len(self.v), dtype=bool)
+        keep[np.asarray(v_list, dtype=np.int64)] = False
+        return self.keep_vertices(np.flatnonzero(keep))
 
     def point_cloud(self):
         return processing.point_cloud(self)
@@ -404,25 +412,20 @@ class Mesh(object):
 
     @property
     def landm_names(self):
-        names = []
-        if hasattr(self, "landm_regressors") or hasattr(self, "landm"):
-            names = (
-                self.landm_regressors.keys()
-                if hasattr(self, "landm_regressors")
-                else self.landm.keys()
-            )
-        return list(names)
+        """Landmark names, preferring the regressor table when present."""
+        for table in ("landm_regressors", "landm"):
+            if hasattr(self, table):
+                return list(getattr(self, table).keys())
+        return []
 
     @property
-    def landm_xyz(self, ordering=None):
-        landmark_order = ordering if ordering else self.landm_names
-        transform = self.landm_xyz_linear_transform(landmark_order)
-        if landmark_order:
-            locations = (transform * self.v.flatten()).reshape(-1, 3)
-            return dict(
-                (landmark_order[i], xyz) for i, xyz in enumerate(locations)
-            )
-        return {}
+    def landm_xyz(self):
+        order = self.landm_names
+        if not order:
+            return {}
+        transform = self.landm_xyz_linear_transform(order)
+        locations = (transform * self.v.flatten()).reshape(-1, 3)
+        return dict(zip(order, locations))
 
     def set_landmarks_from_xyz(self, landm_raw_xyz):
         landmarks.set_landmarks_from_xyz(self, landm_raw_xyz)

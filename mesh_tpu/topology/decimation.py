@@ -20,24 +20,33 @@ from .linear_mesh_transform import LinearMeshTransform
 
 
 def remove_redundant_verts(v, f, eps=1e-10):
-    """Merge colocated vertices and drop the unused ones
-    (reference decimation.py:15-40)."""
-    fshape = f.shape
-    dist_mtx = scipy.spatial.distance.squareform(scipy.spatial.distance.pdist(v))
-    redundant = np.asarray(dist_mtx < eps, np.uint32)
-    f = np.asarray(f).flatten()
-    for i in range(redundant.shape[0]):
-        which_verts = np.nonzero(redundant[i, :])[0]
-        if len(which_verts) < 2:
-            continue
-        which_facelocs = np.nonzero(np.in1d(f, which_verts))[0]
-        f[which_facelocs] = np.min(which_verts)
-    vertidxs_left = np.unique(f)
-    repl = np.arange(np.max(f) + 1)
-    repl[vertidxs_left] = np.arange(len(vertidxs_left))
-    v = v[vertidxs_left]
-    f = repl[f].reshape((-1, fshape[1]))
-    return (v, f)
+    """Collapse vertices closer than `eps` onto one representative and
+    renumber faces compactly (reference decimation.py:15-40 behavior,
+    re-derived: KD-tree near-pair graph + connected components instead of
+    the reference's dense O(V^2) pdist loop).
+
+    Vertices not referenced by any face after merging are dropped, matching
+    the reference.
+    """
+    import scipy.sparse.csgraph as csgraph
+
+    v = np.asarray(v)
+    f = np.asarray(f, dtype=np.int64)
+    n = len(v)
+    near = scipy.spatial.cKDTree(v).query_pairs(eps, output_type="ndarray")
+    graph = sp.coo_matrix(
+        (np.ones(len(near)), (near[:, 0], near[:, 1])), shape=(n, n)
+    )
+    _, component = csgraph.connected_components(graph, directed=False)
+    # each duplicate group collapses onto its smallest member index
+    representative = np.full(component.max() + 1, n, dtype=np.int64)
+    np.minimum.at(representative, component, np.arange(n))
+    merged_faces = representative[component[f]]
+
+    kept = np.unique(merged_faces)
+    renumber = np.zeros(n, dtype=np.int64)
+    renumber[kept] = np.arange(kept.size)
+    return v[kept], renumber[merged_faces]
 
 
 def vertex_quadrics(mesh):
@@ -144,19 +153,19 @@ def qslim_decimator(mesh, factor=None, n_verts_desired=None):
 
 
 def _get_sparse_transform(faces, num_original_verts):
-    """Selection matrix from original to surviving vertices + reindexed faces
-    (reference decimation.py:204-223)."""
-    verts_left = np.unique(faces.flatten())
-    IS = np.arange(len(verts_left))
-    JS = verts_left
-    mp = np.arange(0, np.max(faces.flatten()) + 1)
-    mp[JS] = IS
-    new_faces = mp[faces.copy().flatten()].reshape((-1, 3))
-    IS3 = np.concatenate((IS * 3, IS * 3 + 1, IS * 3 + 2))
-    JS3 = np.concatenate((JS * 3, JS * 3 + 1, JS * 3 + 2))
-    data = np.ones(len(JS3))
+    """Renumber `faces` onto their surviving vertices and build the sparse
+    (3V' x 3V) selection matrix that picks those vertices' flattened xyz
+    coordinates out of the original array (reference decimation.py:204-223).
+    """
+    survivors = np.unique(faces)            # sorted original vertex ids
+    lookup = np.full(num_original_verts, -1, dtype=np.int64)
+    lookup[survivors] = np.arange(survivors.size)
+    new_faces = lookup[np.asarray(faces, dtype=np.int64)]
+    # flat coordinate 3i+k of new vertex i reads 3*survivors[i]+k
+    out_coords = np.arange(3 * survivors.size)
+    in_coords = (3 * survivors[:, None] + np.arange(3)).ravel()
     mtx = sp.csc_matrix(
-        (data, np.vstack((IS3, JS3))),
-        shape=(len(verts_left) * 3, num_original_verts * 3),
+        (np.ones(out_coords.size), (out_coords, in_coords)),
+        shape=(3 * survivors.size, 3 * num_original_verts),
     )
-    return (new_faces, mtx)
+    return new_faces, mtx

@@ -10,7 +10,7 @@ transform as device arrays for on-TPU application inside jitted pipelines.
 
 import numpy as np
 
-from ..utils import col, row
+from ..utils import col
 from .connectivity import vertices_to_edges_matrix
 
 
@@ -39,47 +39,56 @@ class LinearMeshTransform(object):
             np.asarray(coo.data, np.float32),
         )
 
+    def _matrix_for(self, n_coords, want_edges):
+        """Pick the sparse matrix mapping an input with `n_coords` flat
+        coordinates to the requested output, or None for identity (input is
+        already at the target resolution and vertices were asked for)."""
+        at_target = n_coords == self.mtx.shape[0]
+        if want_edges:
+            return (
+                self.remeshed_vtx_to_remeshed_edge_mtx
+                if at_target
+                else self.vtx_to_edge_mtx
+            )
+        return None if at_target else self.mtx
+
     def __call__(self, a, want_edges=False):
         from ..mesh import Mesh
 
         if not isinstance(a, Mesh):
             return self.chained_obj_for(a, want_edges)
-
-        a_is_subdivided = a.v.size == self.mtx.shape[0]
+        mtx = self._matrix_for(a.v.size, want_edges)
         if want_edges:
-            if a_is_subdivided:
-                return self.remeshed_vtx_to_remeshed_edge_mtx.dot(
-                    col(a.v)
-                ).reshape((-1, 3))
-            return self.vtx_to_edge_mtx.dot(col(a.v)).reshape((-1, 3))
-
-        if a_is_subdivided:
+            return (mtx @ col(a.v)).reshape(-1, 3)
+        if mtx is None:
             return a
-        result = Mesh(
-            v=self.mtx.dot(col(a.v)).reshape((-1, 3)), f=self.faces.copy()
-        )
-        if hasattr(a, "segm"):
-            result.transfer_segm(a)
-        if hasattr(a, "landm"):
-            result.landm = dict(
-                (k, np.argmin(np.sum((result.v - row(a.v[v])) ** 2, axis=1)))
-                for k, v in a.landm.items()
-            )
-        if hasattr(self, "ft"):
-            result.ft = self.ft
-        if hasattr(self, "vt"):
-            result.vt = self.vt
-        return result
+        return self._remeshed(a, mtx)
+
+    def _remeshed(self, source, mtx):
+        """Mesh at the target resolution, carrying over segmentation,
+        landmarks (snapped to nearest new vertex), and texture coords."""
+        from ..mesh import Mesh
+
+        out = Mesh(v=(mtx @ col(source.v)).reshape(-1, 3), f=self.faces.copy())
+        if hasattr(source, "segm"):
+            out.transfer_segm(source)
+        if hasattr(source, "landm"):
+            out.landm = {
+                name: int(
+                    np.argmin(((out.v - source.v[idx]) ** 2).sum(axis=1))
+                )
+                for name, idx in source.landm.items()
+            }
+        for attr in ("vt", "ft"):
+            if hasattr(self, attr):
+                setattr(out, attr, getattr(self, attr))
+        return out
 
     def chained_obj_for(self, a, want_edges):
-        a_len = len(a.r) if hasattr(a, "r") else a.size
-        a_is_subdivided = a_len == self.mtx.shape[0]
-        if a_is_subdivided and not want_edges:
+        """Apply to a raw array or an autodiff-style chained object with a
+        `.r` value attribute; returns flat coordinates."""
+        n_coords = len(a.r) if hasattr(a, "r") else a.size
+        mtx = self._matrix_for(n_coords, want_edges)
+        if mtx is None:
             return a
-        if not want_edges:
-            mtx = self.mtx
-        elif a_is_subdivided:
-            mtx = self.remeshed_vtx_to_remeshed_edge_mtx
-        else:
-            mtx = self.vtx_to_edge_mtx
-        return mtx.dot(col(np.asarray(a))).flatten()
+        return np.asarray(mtx @ col(np.asarray(a))).ravel()
