@@ -33,10 +33,10 @@ def get_textureid_with_text(text, fgcolor, bgcolor):
     (reference fonts.py:50-87)."""
     from OpenGL.GL import (
         GL_LINEAR, GL_LINEAR_MIPMAP_LINEAR, GL_RGB, GL_TEXTURE_2D,
-        GL_TEXTURE_MAG_FILTER, GL_TEXTURE_MIN_FILTER, GL_UNSIGNED_BYTE,
-        glBindTexture, glGenTextures, glTexParameterf,
+        GL_TEXTURE_MAG_FILTER, GL_TEXTURE_MIN_FILTER, GL_UNPACK_ALIGNMENT,
+        GL_UNSIGNED_BYTE, glBindTexture, glGenTextures, glGenerateMipmap,
+        glPixelStorei, glTexImage2D, glTexParameterf,
     )
-    from OpenGL.GLU import gluBuild2DMipmaps
 
     key = zlib.crc32(
         text.encode() + np.asarray(fgcolor, "f").tobytes() + np.asarray(bgcolor, "f").tobytes()
@@ -49,9 +49,14 @@ def get_textureid_with_text(text, fgcolor, bgcolor):
     glBindTexture(GL_TEXTURE_2D, texture_id)
     glTexParameterf(GL_TEXTURE_2D, GL_TEXTURE_MAG_FILTER, GL_LINEAR)
     glTexParameterf(GL_TEXTURE_2D, GL_TEXTURE_MIN_FILTER, GL_LINEAR_MIPMAP_LINEAR)
-    gluBuild2DMipmaps(
-        GL_TEXTURE_2D, GL_RGB, im.shape[1], im.shape[0], GL_RGB,
+    # glGenerateMipmap (GL 3.0) replaces gluBuild2DMipmaps: GLU is not
+    # guaranteed present on headless boxes.  Rows are tight 3-byte pixels of
+    # arbitrary width — disable GL's default 4-byte row alignment
+    glPixelStorei(GL_UNPACK_ALIGNMENT, 1)
+    glTexImage2D(
+        GL_TEXTURE_2D, 0, GL_RGB, im.shape[1], im.shape[0], 0, GL_RGB,
         GL_UNSIGNED_BYTE, np.ascontiguousarray(im),
     )
+    glGenerateMipmap(GL_TEXTURE_2D)
     _texture_cache[key] = texture_id
     return texture_id

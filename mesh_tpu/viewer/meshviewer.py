@@ -141,6 +141,16 @@ class MeshSubwindow(object):
     def set_background_color(self, background_color, blocking=False):
         self._send("background_color", np.asarray(background_color, np.float64), blocking)
 
+    def set_texture(self, texture, blocking=False):
+        """Attach a texture to the subwindow's current dynamic meshes:
+        a filepath string or a BGR uint8 image array.  Meshes must carry
+        vt/ft uv coordinates to render it."""
+        self._send(
+            "set_texture",
+            texture if isinstance(texture, str) else np.asarray(texture, np.uint8),
+            blocking,
+        )
+
     def save_snapshot(self, path, blocking=False):
         self.parent_window.save_snapshot(path, blocking)
 
@@ -235,6 +245,10 @@ def _sanitize_meshes(mesh_list):
             for attr in ("texture_filepath", "v_to_text"):
                 if hasattr(m, attr):
                     setattr(out, attr, getattr(m, attr))
+            # ship already-loaded texture pixels so the server need not (and
+            # for remote servers, cannot) re-read the file
+            if getattr(m, "_texture_image", None) is not None:
+                out._texture_image = np.asarray(m._texture_image, np.uint8)
         sanitized.append(out)
     return sanitized
 
@@ -340,10 +354,16 @@ class MeshViewerLocal(object):
         return self._recv_reply("get_event")
 
     def get_window_shape(self):
-        """(width, height) of the server window (reference
-        meshviewer.py:870-874, 1142-1148)."""
+        """(rows, cols) subwindow grid of the server window — the reference
+        contract (meshviewer.py:949, 1146-1147).  For pixel dimensions use
+        get_window_size()."""
         reply = self._recv_reply("get_window_shape")
         return reply["shape"] if reply else None
+
+    def get_window_size(self):
+        """(width, height) pixel size of the server window."""
+        reply = self._recv_reply("get_window_size")
+        return reply["size"] if reply else None
 
     def save_snapshot(self, path, blocking=False):
         print("Saving snapshot to %s, please wait..." % path)

@@ -1,0 +1,172 @@
+"""Headless offscreen rendering via EGL pbuffers.
+
+The reference viewer can only render into a real GLUT window, so a headless
+machine cannot produce snapshots at all (its tests skip,
+reference tests/test_meshviewer.py).  Mesa's EGL + llvmpipe exposes a full
+compatibility-profile GL context with no display attached, which lets the
+same `SceneRenderer` draw-mesh/texture/label code render into a pbuffer.
+Used by the `meshviewer snap`/`view --snapshot` headless fallback and by the
+render tests.
+"""
+
+import ctypes
+
+import numpy as np
+
+from .server import SceneRenderer
+
+
+class OffscreenContext(object):
+    """An EGL pbuffer + compatibility-profile GL context, current on this
+    thread for its lifetime.  Use as a context manager."""
+
+    def __init__(self, width=640, height=480):
+        import os
+        import sys
+
+        os.environ.setdefault("EGL_PLATFORM", "surfaceless")
+        # PyOpenGL must use its EGL platform for context-aware calls
+        # (vertex-array retention etc.); the choice is fixed at first OpenGL
+        # import, so claim it while we still can
+        if "OpenGL" not in sys.modules:
+            os.environ.setdefault("PYOPENGL_PLATFORM", "egl")
+        elif os.environ.get("PYOPENGL_PLATFORM") != "egl":
+            raise RuntimeError(
+                "offscreen rendering needs PYOPENGL_PLATFORM=egl set before "
+                "the first OpenGL import (run in a fresh process, or export "
+                "the variable up front)"
+            )
+        from OpenGL import EGL
+        from OpenGL.EGL import (
+            EGL_BLUE_SIZE, EGL_DEFAULT_DISPLAY, EGL_DEPTH_SIZE,
+            EGL_GREEN_SIZE, EGL_HEIGHT, EGL_NONE, EGL_NO_CONTEXT,
+            EGL_NO_DISPLAY, EGL_OPENGL_API, EGL_OPENGL_BIT, EGL_PBUFFER_BIT,
+            EGL_RED_SIZE, EGL_RENDERABLE_TYPE, EGL_SURFACE_TYPE, EGL_WIDTH,
+            eglBindAPI, eglChooseConfig, eglCreateContext,
+            eglCreatePbufferSurface, eglGetDisplay, eglInitialize,
+            eglMakeCurrent,
+        )
+
+        self.width = int(width)
+        self.height = int(height)
+        self.display = eglGetDisplay(EGL_DEFAULT_DISPLAY)
+        if self.display == EGL_NO_DISPLAY:
+            raise RuntimeError("no EGL display")
+        major, minor = ctypes.c_long(), ctypes.c_long()
+        if not eglInitialize(self.display, major, minor):
+            raise RuntimeError("eglInitialize failed")
+        attribs = [
+            EGL_SURFACE_TYPE, EGL_PBUFFER_BIT,
+            EGL_RED_SIZE, 8, EGL_GREEN_SIZE, 8, EGL_BLUE_SIZE, 8,
+            EGL_DEPTH_SIZE, 24,
+            EGL_RENDERABLE_TYPE, EGL_OPENGL_BIT,
+            EGL_NONE,
+        ]
+        configs = (EGL.EGLConfig * 4)()
+        num = ctypes.c_long()
+        if not eglChooseConfig(
+            self.display, (EGL.EGLint * len(attribs))(*attribs),
+            configs, 4, num,
+        ) or num.value < 1:
+            raise RuntimeError("no usable EGL config")
+        eglBindAPI(EGL_OPENGL_API)
+        self.context = eglCreateContext(
+            self.display, configs[0], EGL_NO_CONTEXT, None
+        )
+        if not self.context:
+            raise RuntimeError("eglCreateContext failed")
+        surf_attribs = (EGL.EGLint * 5)(
+            EGL_WIDTH, self.width, EGL_HEIGHT, self.height, EGL_NONE
+        )
+        self.surface = eglCreatePbufferSurface(
+            self.display, configs[0], surf_attribs
+        )
+        if not self.surface:
+            raise RuntimeError("eglCreatePbufferSurface failed")
+        if not eglMakeCurrent(
+            self.display, self.surface, self.surface, self.context
+        ):
+            raise RuntimeError("eglMakeCurrent failed")
+
+    def close(self):
+        from OpenGL.EGL import (
+            EGL_NO_CONTEXT, EGL_NO_SURFACE, eglDestroyContext,
+            eglDestroySurface, eglMakeCurrent,
+        )
+
+        from .server import clear_gl_caches
+
+        # texture ids cached by the draw code die with this context
+        clear_gl_caches()
+        eglMakeCurrent(self.display, EGL_NO_SURFACE, EGL_NO_SURFACE,
+                       EGL_NO_CONTEXT)
+        eglDestroySurface(self.display, self.surface)
+        eglDestroyContext(self.display, self.context)
+        # the display is process-global: leave it initialized for reuse
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def offscreen_available():
+    """True when an EGL software context can actually be created."""
+    try:
+        with OffscreenContext(8, 8):
+            return True
+    except Exception:
+        return False
+
+
+def render_grid(scenes, shape, width=640, height=480,
+                background_color=None, lighting_on=True, autorecenter=True,
+                transform=None):
+    """Render a grid of subwindow scenes into an offscreen buffer.
+
+    `scenes[r][c]` is a dict with optional keys `meshes` and `lines` for
+    subwindow (r, c) of the `shape` grid.  Returns (H, W, 3) uint8 pixels
+    (top row first).
+    """
+    with OffscreenContext(width, height):
+        renderer = SceneRenderer(shape=shape, width=width, height=height)
+        for r in range(shape[0]):
+            for c in range(shape[1]):
+                sub = renderer.subwindows[r][c]
+                scene = scenes[r][c] if r < len(scenes) and c < len(scenes[r]) else {}
+                sub.dynamic_meshes = list(scene.get("meshes", ()))
+                sub.dynamic_lines = list(scene.get("lines", ()))
+                sub.lighting_on = lighting_on
+                sub.autorecenter = autorecenter
+                if background_color is not None:
+                    sub.background_color = np.asarray(
+                        background_color, np.float64
+                    )
+                if transform is not None:
+                    sub.transform = np.asarray(transform, np.float32)
+        renderer.setup_gl_state()
+        renderer.render()
+        return renderer.read_pixels()
+
+
+def render_scene(meshes=(), lines=(), width=640, height=480, **kw):
+    """Render meshes/lines into a single offscreen viewport; returns
+    (H, W, 3) uint8 pixels (top row first)."""
+    return render_grid(
+        [[{"meshes": meshes, "lines": lines}]], (1, 1), width, height, **kw
+    )
+
+
+def save_snapshot(path, meshes=(), lines=(), width=640, height=480,
+                  scenes=None, shape=(1, 1), **kw):
+    """Offscreen render straight to an image file.  Pass either flat
+    `meshes`/`lines` (single viewport) or `scenes` + `shape` for a grid."""
+    from PIL import Image
+
+    if scenes is not None:
+        pixels = render_grid(scenes, shape, width, height, **kw)
+    else:
+        pixels = render_scene(meshes, lines, width, height, **kw)
+    Image.fromarray(pixels).save(path)

