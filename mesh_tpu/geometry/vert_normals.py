@@ -16,6 +16,9 @@ from .tri_normals import tri_normals_scaled, normalize_rows
 
 def vert_normals_scaled(v, f):
     """Sum of incident scaled face normals per vertex -> [..., V, 3]."""
+    # canonicalize first: allocating with a raw numpy float64 dtype below
+    # would warn-and-truncate on x64-less platforms
+    v = jnp.asarray(v)
     fn = tri_normals_scaled(v, f)                    # [..., F, 3]
     num_v = v.shape[-2]
     contrib = jnp.repeat(fn[..., None, :], 3, axis=-2)  # [..., F, 3corner, 3xyz]

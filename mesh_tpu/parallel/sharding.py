@@ -135,6 +135,11 @@ def sharded_visibility(v, f, cams, n=None, mesh=None, axis="dp",
     ray-casts its vertex shard against the full mesh.  Returns the same
     (vis [C, V] uint32, n_dot_cam [C, V] f64) as visibility_compute.
     """
+    if mesh is None:
+        raise ValueError(
+            "sharded_visibility requires a jax.sharding.Mesh via mesh=... "
+            "(keyword kept optional only for signature symmetry)"
+        )
     n_shards = mesh.shape[axis]
     v_np = np.asarray(v, np.float32)
     n_np = np.asarray(n, np.float32) if n is not None else np.zeros_like(v_np)

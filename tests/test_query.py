@@ -14,6 +14,8 @@ from mesh_tpu.query import (
     intersections_mask,
     self_intersection_count,
 )
+from mesh_tpu import Mesh
+
 from .fixtures import box, cylinder, icosphere
 
 
@@ -281,3 +283,31 @@ class TestCulledClosestPoint:
             np.asarray(culled["part"])[same_face],
             np.asarray(exact["part"])[same_face],
         )
+
+
+class TestSearchTreeShapeParity:
+    """Drop-in users of the reference get its exact return shapes
+    (reference search.py:59-86: both closest-point trees return 1-D
+    index and distance sequences of length Q)."""
+
+    def test_closest_point_trees_return_flat_length_q(self):
+        rng = np.random.RandomState(3)
+        m = Mesh(v=rng.randn(20, 3), f=np.array([[0, 1, 2], [3, 4, 5]], np.uint32))
+        queries = rng.randn(7, 3)
+        for use_cgal in (False, True):
+            idx, dist = m.compute_closest_point_tree(use_cgal).nearest(queries)
+            idx, dist = np.asarray(idx), np.asarray(dist)
+            assert idx.shape == (7,), (use_cgal, idx.shape)
+            assert dist.shape == (7,), (use_cgal, dist.shape)
+            # distances match the indexed vertices
+            np.testing.assert_allclose(
+                dist, np.linalg.norm(m.v[idx] - queries, axis=1), atol=1e-5
+            )
+
+    def test_closest_vertices_matches_both_backends(self):
+        rng = np.random.RandomState(4)
+        m = Mesh(v=rng.randn(30, 3), f=np.array([[0, 1, 2]], np.uint32))
+        queries = rng.randn(9, 3)
+        idx_a, _ = m.closest_vertices(queries)
+        idx_b, _ = m.closest_vertices(queries, use_cgal=True)
+        np.testing.assert_array_equal(np.asarray(idx_a), np.asarray(idx_b))
