@@ -1,0 +1,164 @@
+"""meshviewer CLI (reference bin/meshviewer:1-379).
+
+Subcommands:
+  open  — start a standalone viewer server window on a known port
+  view  — display mesh files, locally or in a remote viewer
+  snap  — take a snapshot of a running viewer
+
+Examples:
+  meshviewer view body.ply
+  meshviewer view --nx 2 --ny 2 a.obj b.obj c.obj d.obj
+  meshviewer open --port 5555
+  meshviewer snap --port 5555 out.png
+"""
+
+import argparse
+import sys
+import time
+
+
+def cmd_open(args):
+    from mesh_tpu.viewer.server import MeshViewerRemote
+
+    MeshViewerRemote(
+        titlebar=args.titlebar, nx=args.nx, ny=args.ny,
+        width=args.width, height=args.height, port=args.port,
+    )
+
+
+def cmd_view(args):
+    from mesh_tpu import Mesh
+    from mesh_tpu.viewer import MeshViewers
+
+    from mesh_tpu.viewer import Dummy
+
+    meshes = [Mesh(filename=f) for f in args.files]
+    nx, ny = args.nx or 1, args.ny or 1
+
+    if args.port:  # remote viewer started with `meshviewer open`
+        from mesh_tpu.viewer.meshviewer import _sanitize_meshes, send_command as _send_remote
+
+        if args.nx or args.ny:
+            print("meshviewer: --nx/--ny are set by the server "
+                  "(`open --nx/--ny`); ignored with --port", file=sys.stderr)
+        which = (args.iy, args.ix)
+        if not _send_remote(args.host, args.port, "dynamic_meshes",
+                            _sanitize_meshes(meshes), which):
+            print("No response from viewer at %s:%d" % (args.host, args.port),
+                  file=sys.stderr)
+            sys.exit(1)
+        if args.titlebar:
+            _send_remote(args.host, args.port, "titlebar", args.titlebar, which)
+        if args.snapshot:
+            if not _send_remote(args.host, args.port, "save_snapshot",
+                                args.snapshot, which):
+                print("Snapshot request got no response", file=sys.stderr)
+                sys.exit(1)
+            print("Snapshot written to %s" % args.snapshot)
+        time.sleep(args.timeout)
+        return
+    mvs = MeshViewers(
+        shape=(nx, ny), titlebar=args.titlebar or "Mesh Viewer", keepalive=True
+    )
+    if isinstance(mvs, Dummy):
+        if args.snapshot:
+            # no window system, but snapshots don't need one: render the
+            # scene into an EGL pbuffer (software GL) instead, honoring the
+            # same nx-by-ny mesh distribution as the windowed path
+            try:
+                from mesh_tpu.viewer.offscreen import save_snapshot
+
+                per_window = max(1, (len(meshes) + nx * ny - 1) // (nx * ny))
+                scenes = [
+                    [
+                        {"meshes": meshes[(r * ny + c) * per_window:
+                                          (r * ny + c + 1) * per_window]}
+                        for c in range(ny)
+                    ]
+                    for r in range(nx)
+                ]
+                save_snapshot(args.snapshot, scenes=scenes, shape=(nx, ny),
+                              width=1280, height=960)
+                print("No display; rendered offscreen snapshot to %s"
+                      % args.snapshot)
+                return
+            except Exception as exc:
+                print("meshviewer: offscreen render failed: %s" % exc,
+                      file=sys.stderr)
+        print("meshviewer: no usable OpenGL (headless?); nothing to show",
+              file=sys.stderr)
+        sys.exit(1)
+    per_window = max(1, (len(meshes) + nx * ny - 1) // (nx * ny))
+    idx = 0
+    for r in range(nx):
+        for c in range(ny):
+            chunk = meshes[idx: idx + per_window]
+            if chunk:
+                mvs[r][c].set_dynamic_meshes(chunk, blocking=True)
+            idx += per_window
+    if args.snapshot:
+        mvs[0][0].save_snapshot(args.snapshot, blocking=True)
+    else:
+        print("Viewer running; press Ctrl-C to exit.")
+        try:
+            while True:
+                time.sleep(1)
+        except KeyboardInterrupt:
+            pass
+
+
+def cmd_snap(args):
+    from mesh_tpu.viewer.meshviewer import send_command as _send_remote
+
+    if _send_remote(args.host, args.port, "save_snapshot", args.output):
+        print("Snapshot written to %s" % args.output)
+    else:
+        print("No response from viewer at %s:%d" % (args.host, args.port),
+              file=sys.stderr)
+        sys.exit(1)
+
+
+def main():
+    parser = argparse.ArgumentParser(prog="meshviewer", description=__doc__)
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_open = sub.add_parser("open", help="start a viewer server")
+    p_open.add_argument("-p", "--port", type=int, default=None,
+                        help="listen on a fixed port (for view/snap --port)")
+    p_open.add_argument("--titlebar", default="Mesh Viewer")
+    p_open.add_argument("--nx", type=int, default=1)
+    p_open.add_argument("--ny", type=int, default=1)
+    p_open.add_argument("--width", type=int, default=1280)
+    p_open.add_argument("--height", type=int, default=960)
+    p_open.set_defaults(func=cmd_open)
+
+    p_view = sub.add_parser("view", help="view mesh files")
+    p_view.add_argument("files", nargs="+")
+    p_view.add_argument("--host", default="127.0.0.1",
+                        help="remote viewer host (with --port)")
+    p_view.add_argument("-p", "--port", type=int, default=None,
+                        help="send to a running `meshviewer open` server")
+    p_view.add_argument("-ix", "--ix", type=int, default=0,
+                        help="horizontal subwindow index (remote)")
+    p_view.add_argument("-iy", "--iy", type=int, default=0,
+                        help="vertical subwindow index (remote)")
+    p_view.add_argument("--timeout", type=float, default=0.5,
+                        help="seconds to wait after sending (remote)")
+    p_view.add_argument("--titlebar", default=None)
+    p_view.add_argument("--nx", type=int, default=None)
+    p_view.add_argument("--ny", type=int, default=None)
+    p_view.add_argument("--snapshot", default=None, help="write a PNG and exit")
+    p_view.set_defaults(func=cmd_view)
+
+    p_snap = sub.add_parser("snap", help="snapshot a running viewer")
+    p_snap.add_argument("output")
+    p_snap.add_argument("--host", default="127.0.0.1")
+    p_snap.add_argument("-p", "--port", type=int, required=True)
+    p_snap.set_defaults(func=cmd_snap)
+
+    args = parser.parse_args()
+    args.func(args)
+
+
+if __name__ == "__main__":
+    main()

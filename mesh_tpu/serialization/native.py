@@ -18,8 +18,10 @@ _lock = threading.Lock()
 _lib = None
 _tried = False
 
+# the C++ source ships inside the package (package-data in pyproject.toml)
+# so installed copies can still JIT-build it
 _SRC = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     "native",
     "meshio.cpp",
 )

@@ -1,5 +1,7 @@
 """Texture subsystem (mesh_tpu/texture.py; reference mesh/texture.py)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -97,3 +99,37 @@ class TestTextureImage:
                 rows, cols = np.where(f == vid)
                 candidates = vt[ft[rows, cols]]
                 assert any(np.allclose(uv, cand) for cand in candidates)
+
+
+class TestLoadTexture:
+    """Packaged texture templates make Mesh.load_texture reachable
+    (reference texture.py:39-55 + shipped textured_template assets)."""
+
+    def test_load_texture_low_template(self):
+        from mesh_tpu.sphere import _icosphere
+
+        v, f = _icosphere(1)
+        m = Mesh(v=v * 3.0, f=f.astype(np.uint32))
+        m.load_texture(0)
+        assert m.vt.shape == (np.asarray(m.f).size, 2)
+        assert np.asarray(m.ft).shape == np.asarray(m.f).shape
+        assert os.path.exists(m.texture_filepath)
+        # uv gather path works on the loaded image
+        rgb = m.texture_rgb_vec(np.array([[0.5, 0.5], [0.1, 0.9]]))
+        assert rgb.shape == (2, 3)
+
+    def test_load_texture_falls_back_to_high_template(self):
+        from mesh_tpu.sphere import _icosphere
+
+        v, f = _icosphere(3)    # matches the high template's topology
+        m = Mesh(v=v, f=f.astype(np.uint32))
+        m.load_texture(0)
+        assert "high" in os.path.basename(m.texture_filepath)
+
+    def test_missing_version_raises(self):
+        from mesh_tpu.sphere import _icosphere
+
+        v, f = _icosphere(1)
+        m = Mesh(v=v, f=f.astype(np.uint32))
+        with pytest.raises(Exception):
+            m.load_texture(99)

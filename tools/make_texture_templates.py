@@ -1,0 +1,80 @@
+"""Generate the packaged texture templates under mesh_tpu/ressources/textures.
+
+The reference ships SCAPE-derived `textured_template_{low,high}_v*.obj`
+bodies it cannot redistribute here (texture.py:39-55 loads them by version
+number).  This repo ships procedurally generated equivalents instead: unit
+icospheres with per-wedge spherical uv (seam-safe because every face corner
+gets its own vt row) plus a deterministic checker/gradient texture, enough
+for `Mesh.load_texture(0)` to work on any icosphere-topology mesh and for
+texture-pipeline tests.
+
+Run from the repo root:  python tools/make_texture_templates.py
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mesh_tpu import Mesh, texture_path  # noqa: E402
+from mesh_tpu.sphere import _icosphere  # noqa: E402
+
+
+def spherical_uv_per_wedge(v, f):
+    """(vt, ft): one uv row per face corner from lat/lon of the direction."""
+    corners = v[f.reshape(-1)]
+    d = corners / np.linalg.norm(corners, axis=1, keepdims=True)
+    u = 0.5 + np.arctan2(d[:, 1], d[:, 0]) / (2 * np.pi)
+    w = 0.5 + np.arcsin(np.clip(d[:, 2], -1, 1)) / np.pi
+    # unwrap the +-pi seam inside each face: shift corners that are more
+    # than half the texture away from the face's first corner
+    u = u.reshape(-1, 3)
+    anchor = u[:, :1]
+    u = u + np.round(anchor - u)
+    vt = np.column_stack([u.reshape(-1), w])
+    ft = np.arange(len(vt), dtype=np.uint32).reshape(-1, 3)
+    return vt, ft
+
+
+def make_texture(path, size=256):
+    """Deterministic checker + gradient, BGR, written with cv2."""
+    import cv2
+
+    yy, xx = np.mgrid[0:size, 0:size]
+    checker = (((xx // 16) + (yy // 16)) % 2).astype(np.float64)
+    img = np.stack([
+        64 + 128 * checker,                 # blue channel
+        yy * 255.0 / size,                  # green gradient
+        xx * 255.0 / size,                  # red gradient
+    ], axis=2).astype(np.uint8)
+    cv2.imwrite(path, img)
+
+
+def make_template(version, subdiv, name, texture_file):
+    v, f = _icosphere(subdiv)
+    m = Mesh(v=v, f=f.astype(np.uint32))
+    m.vt, m.ft = spherical_uv_per_wedge(m.v, m.f.astype(np.int64))
+    m.texture_filepath = texture_file
+    out = os.path.join(texture_path, "%s_v%d.obj" % (name, version))
+    m.write_obj(out)      # also writes the .mtl and copies the texture
+    print("wrote", out)
+
+
+def main():
+    import tempfile
+
+    os.makedirs(texture_path, exist_ok=True)
+    for version in (0,):
+        # write_obj copies the texture next to each template, so the source
+        # image only needs a temporary home
+        with tempfile.TemporaryDirectory() as tmp:
+            src = os.path.join(tmp, "texture.png")
+            make_texture(src)
+            make_template(version, 1, "textured_template_low", src)
+            make_template(version, 3, "textured_template_high", src)
+
+
+if __name__ == "__main__":
+    main()
