@@ -13,21 +13,26 @@ TPU verification runs use the default environment instead (see
 
 import os
 
-os.environ["PALLAS_AXON_POOL_IPS"] = ""     # disable the axon TPU hook
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ.setdefault("JAX_NUM_CPU_DEVICES", "8")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-# The axon sitecustomize registers its plugin at interpreter start and calls
-# jax.config.update("jax_platforms", "axon,cpu"), overriding the env var —
-# counter-update the config here, before any backend is initialized.
-import jax
+if os.environ.get("MESH_TPU_TEST_TPU"):
+    # compiled-mode TPU run (`MESH_TPU_TEST_TPU=1 pytest -m tpu`): keep the
+    # default backend — the real chip — instead of the virtual CPU platform
+    pass
+else:
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""     # disable the axon TPU hook
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault("JAX_NUM_CPU_DEVICES", "8")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    # The axon sitecustomize registers its plugin at interpreter start and
+    # calls jax.config.update("jax_platforms", "axon,cpu"), overriding the
+    # env var — counter-update the config here, before backend init.
+    import jax
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
 
 # per-test-session topology cache (reference Makefile:9-25 uses a throwaway
 # PSBODY_MESH_CACHE for the same reason)

@@ -1,0 +1,116 @@
+"""Compiled-mode Pallas kernel tests on the real TPU chip.
+
+The CPU suite exercises every Pallas kernel in interpret mode only
+(test_pallas*.py); these tests assert the *compiled* kernels against the
+plain-XLA reference path on the actual device — the coverage VERDICT.md
+item 6 asked for.  They are excluded from the CPU suite (tests/conftest.py
+forces a virtual CPU platform) and run via:
+
+    MESH_TPU_TEST_TPU=1 python -m pytest tests/test_tpu_compiled.py -m tpu
+
+(the env var makes conftest keep the default TPU backend).
+"""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.tpu
+
+
+def _on_tpu():
+    import jax
+
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+requires_tpu = pytest.mark.skipif(
+    not _on_tpu(), reason="needs the real TPU backend (MESH_TPU_TEST_TPU=1)"
+)
+
+
+def _random_mesh(n_v=200, n_f=380, seed=0):
+    rng = np.random.RandomState(seed)
+    v = rng.randn(n_v, 3).astype(np.float32)
+    f = rng.randint(0, n_v, size=(n_f, 3)).astype(np.int32)
+    return v, f
+
+
+@requires_tpu
+class TestCompiledPallasParity:
+    def test_closest_point_compiled_matches_xla(self):
+        from mesh_tpu.query import closest_faces_and_points
+        from mesh_tpu.query.pallas_closest import closest_point_pallas
+
+        v, f = _random_mesh()
+        rng = np.random.RandomState(1)
+        pts = rng.randn(500, 3).astype(np.float32)
+        out = closest_point_pallas(v, f, pts)                  # compiled
+        ref = closest_faces_and_points(v, f, pts)
+        # distinct argmin tie-breaks can pick different but equidistant
+        # faces; the distances must match everywhere
+        d_p = np.linalg.norm(np.asarray(out["point"]) - pts, axis=1)
+        d_r = np.linalg.norm(np.asarray(ref["point"]) - pts, axis=1)
+        np.testing.assert_allclose(d_p, d_r, atol=1e-5)
+        # the random mesh has many near-coincident triangles, so a few
+        # argmin ties legitimately break differently; the distance check
+        # above is the exact assertion
+        agree = (np.asarray(out["face"]) == np.asarray(ref["face"])).mean()
+        assert agree > 0.9, f"face agreement only {agree:.3f}"
+
+    def test_culled_compiled_matches_xla(self):
+        from mesh_tpu.query import closest_faces_and_points
+        from mesh_tpu.query.pallas_culled import closest_point_pallas_culled
+
+        v, f = _random_mesh(n_v=400, n_f=800, seed=2)
+        rng = np.random.RandomState(3)
+        pts = rng.randn(600, 3).astype(np.float32)
+        out = closest_point_pallas_culled(v, f, pts)
+        ref = closest_faces_and_points(v, f, pts)
+        d_c = np.linalg.norm(np.asarray(out["point"]) - pts, axis=1)
+        d_r = np.linalg.norm(np.asarray(ref["point"]) - pts, axis=1)
+        np.testing.assert_allclose(d_c, d_r, atol=1e-5)
+
+    def test_normal_weighted_compiled_matches_xla(self):
+        from mesh_tpu.query import nearest_normal_weighted
+        from mesh_tpu.query.pallas_normal_weighted import (
+            nearest_normal_weighted_pallas,
+        )
+
+        v, f = _random_mesh(seed=4)
+        rng = np.random.RandomState(5)
+        pts = rng.randn(300, 3).astype(np.float32)
+        nrm = rng.randn(300, 3).astype(np.float32)
+        nrm /= np.linalg.norm(nrm, axis=1, keepdims=True)
+        face_p, point_p = nearest_normal_weighted_pallas(v, f, pts, nrm, eps=0.1)
+        face_r, point_r = nearest_normal_weighted(v, f, pts, nrm, eps=0.1)
+        agree = (np.asarray(face_p) == np.asarray(face_r)).mean()
+        assert agree > 0.99, f"face agreement only {agree:.3f}"
+        same = np.asarray(face_p) == np.asarray(face_r)
+        np.testing.assert_allclose(
+            np.asarray(point_p)[same], np.asarray(point_r)[same], atol=1e-4
+        )
+
+    def test_search_facade_takes_pallas_branch_on_tpu(self):
+        """search.py AabbNormalsTree routes to the compiled Pallas kernel
+        when the backend is TPU — exercise that exact branch."""
+        from mesh_tpu import Mesh
+        from mesh_tpu.query import nearest_normal_weighted
+
+        v, f = _random_mesh(seed=6)
+        m = Mesh(v=np.asarray(v, np.float64), f=f.astype(np.uint32))
+        tree = m.compute_aabb_normals_tree()
+        rng = np.random.RandomState(7)
+        pts = rng.randn(100, 3)
+        nrm = rng.randn(100, 3)
+        nrm /= np.linalg.norm(nrm, axis=1, keepdims=True)
+        face_t, point_t = tree.nearest(pts, nrm)
+        assert face_t.shape == (100, 1)           # reference return shape
+        face_r, _ = nearest_normal_weighted(
+            np.asarray(v), f, np.asarray(pts, np.float32),
+            np.asarray(nrm, np.float32), eps=0.1,
+        )
+        agree = (face_t.ravel() == np.asarray(face_r).ravel()).mean()
+        assert agree > 0.99
