@@ -21,10 +21,8 @@ import time
 
 import numpy as np
 
-try:
-    import mesh_tpu  # noqa: F401  (installed package)
-except ImportError:
-    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+# checkout-first: run THIS source tree even when mesh_tpu is installed
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():

@@ -9,6 +9,7 @@ a sparse matrix and then row-normalize; tests assert they agree to 1e-15
 sorted scatter — and it batches over leading mesh axes for free.
 """
 
+import jax
 import jax.numpy as jnp
 
 from .tri_normals import tri_normals_scaled, normalize_rows
@@ -36,3 +37,9 @@ def vert_normals(v, f):
     the zero vector (zero-guard in normalize_rows).
     """
     return normalize_rows(vert_normals_scaled(v, f))
+
+
+#: single-dispatch form for host-facing callers: eager `vert_normals` issues
+#: one device round trip per op, which dominates on a high-latency link
+#: (the facade path, Mesh.estimate_vertex_normals)
+vert_normals_jit = jax.jit(vert_normals)

@@ -76,6 +76,36 @@ class Mesh(object):
     # ------------------------------------------------------------------
     # Device export
 
+    def device_arrays(self):
+        """(v_dev f32 [V,3], f_dev int32 [F,3]) on the default device,
+        cached across facade calls.
+
+        Repeated facade queries (estimate_vertex_normals,
+        closest_faces_and_points, ...) would otherwise re-upload the mesh on
+        every call.  Validity is checked by a crc32 of the current v/f
+        buffers — ~100x cheaper than the upload it saves, and safe against
+        both reassignment (`m.v = ...`) and in-place edits (`m.v *= s`).
+        """
+        import zlib
+
+        import jax.numpy as jnp
+
+        v = np.ascontiguousarray(self.v)
+        f = np.ascontiguousarray(self.f)   # AttributeError for face-less
+                                           # meshes, as before the cache
+        key = (
+            zlib.crc32(v.tobytes()), zlib.crc32(f.tobytes()),
+            v.shape, f.shape,
+        )
+        cached = getattr(self, "_device_cache", None)
+        if cached is None or cached[0] != key:
+            self._device_cache = (
+                key,
+                jnp.asarray(v, jnp.float32),
+                jnp.asarray(f.astype(np.int64), jnp.int32),
+            )
+        return self._device_cache[1], self._device_cache[2]
+
     def arrays(self, dtype=None):
         """Export to the functional `MeshArrays` pytree (device f32)."""
         import jax.numpy as jnp
@@ -206,13 +236,13 @@ class Mesh(object):
 
     def estimate_vertex_normals(self, face_to_verts_sparse_matrix=None):
         """Area-weighted vertex normals on the TPU kernel
-        (reference mesh.py:208-216; kernel: geometry/vert_normals.py)."""
-        from .geometry import vert_normals
+        (reference mesh.py:208-216; kernel: geometry/vert_normals.py).
+        Uses the cached device copy of v/f, so repeated calls skip the
+        host->device upload."""
+        from .geometry.vert_normals import vert_normals_jit
 
-        return np.asarray(
-            vert_normals(self.v.astype(np.float32), self.f.astype(np.int32)),
-            dtype=np.float64,
-        )
+        vj, fj = self.device_arrays()
+        return np.asarray(vert_normals_jit(vj, fj), dtype=np.float64)
 
     def barycentric_coordinates_for_points(self, points, face_indices):
         """(corner vertex ids, barycentric coeffs) of each point projected

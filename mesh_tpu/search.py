@@ -18,6 +18,14 @@ _NO_HIT_SENTINEL = 1e100  # reference spatialsearchmodule.cpp:309-311
 
 
 def _mesh_vf(m):
+    """f32 vertices + int32 faces for the query kernels.
+
+    Mesh facade objects hand out their cached device arrays (skipping a
+    fresh host->device upload per tree build); anything else (raw arrays,
+    duck-typed meshes) converts on the host as before.
+    """
+    if hasattr(m, "device_arrays"):
+        return m.device_arrays()
     v = np.asarray(m.v, dtype=np.float32)
     f = np.asarray(m.f, dtype=np.int32)
     return v, f

@@ -155,3 +155,44 @@ class TestVisibilityWrapper:
         vm = m.visibile_mesh(camera=[0.0, 0.0, 5.0])
         assert vm.v.shape[0] == 4  # the +z face
         assert np.all(vm.v[:, 2] > 0)
+
+
+class TestDeviceArrayCache:
+    """Facade device-array cache: reused across calls, invalidated by both
+    reassignment and in-place edits of v/f."""
+
+    def _mesh(self):
+        from .fixtures import icosphere
+
+        v, f = icosphere(1)
+        return Mesh(v=v, f=f.astype(np.uint32))
+
+    def test_cache_reused(self):
+        m = self._mesh()
+        v1, f1 = m.device_arrays()
+        v2, f2 = m.device_arrays()
+        assert v1 is v2 and f1 is f2
+
+    def test_reassignment_invalidates(self):
+        m = self._mesh()
+        v1, _ = m.device_arrays()
+        m.v = m.v * 2.0
+        v2, _ = m.device_arrays()
+        assert v2 is not v1
+        np.testing.assert_allclose(np.asarray(v2), m.v, atol=1e-6)
+
+    def test_inplace_edit_invalidates(self):
+        m = self._mesh()
+        v1, _ = m.device_arrays()
+        m.v *= 3.0                      # in-place: same array identity
+        v2, _ = m.device_arrays()
+        assert v2 is not v1
+        np.testing.assert_allclose(np.asarray(v2), m.v, atol=1e-5)
+
+    def test_normals_follow_edits(self):
+        m = self._mesh()
+        n1 = m.estimate_vertex_normals()
+        m.v[:, 2] *= -1.0               # mirror: normals must flip too
+        m.f = np.fliplr(m.f)            # keep orientation consistent
+        n2 = m.estimate_vertex_normals()
+        np.testing.assert_allclose(n2[:, 2], -n1[:, 2], atol=1e-5)
