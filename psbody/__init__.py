@@ -1,0 +1,10 @@
+"""`psbody` namespace shim.
+
+The reference package installs as `psbody.mesh` (psbody-mesh-namespace/
+__init__.py declares the namespace).  This shim lets code written against
+the reference run unchanged on top of mesh_tpu:
+
+    from psbody.mesh import Mesh, MeshViewer      # works as before
+
+Every submodule re-exports the mesh_tpu implementation of the same name.
+"""

@@ -1,0 +1,15 @@
+"""Drop-in `psbody.mesh` facade over mesh_tpu (reference mesh/__init__.py).
+
+Exports the reference package surface — `Mesh`, `MeshViewer`, `MeshViewers`,
+`texture_path`, `mesh_package_cache_folder` — plus submodules mirroring the
+reference layout (psbody.mesh.meshviewer, .geometry.tri_normals, ...), each
+a thin re-export of the corresponding mesh_tpu module.
+"""
+
+from mesh_tpu import (  # noqa: F401
+    Mesh,
+    MeshArrays,
+    mesh_package_cache_folder,
+    texture_path,
+)
+from mesh_tpu.viewer import MeshViewer, MeshViewers  # noqa: F401

@@ -1,0 +1,10 @@
+"""reference mesh/arcball.py surface."""
+from mesh_tpu.viewer.arcball import (  # noqa: F401
+    ArcBallT,
+    Matrix3fMulMatrix3f,
+    Matrix3fSetRotationFromQuat4f,
+    Matrix3fT,
+    Matrix4fSetRotationFromMatrix3f,
+    Matrix4fT,
+    Point2fT,
+)

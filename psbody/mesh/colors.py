@@ -1,0 +1,2 @@
+"""reference mesh/colors.py surface."""
+from mesh_tpu.colors import main, name_to_rgb  # noqa: F401

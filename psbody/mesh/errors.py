@@ -1,0 +1,2 @@
+"""reference mesh/errors.py surface."""
+from mesh_tpu.errors import MeshError, SerializationError  # noqa: F401

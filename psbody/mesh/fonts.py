@@ -1,0 +1,5 @@
+"""reference mesh/fonts.py surface."""
+from mesh_tpu.viewer.fonts import (  # noqa: F401
+    get_image_with_text,
+    get_textureid_with_text,
+)

@@ -1,0 +1,1 @@
+"""reference mesh/geometry package surface."""

@@ -1,0 +1,4 @@
+"""reference mesh/geometry/barycentric_coordinates_of_projection.py surface."""
+from mesh_tpu.geometry import (  # noqa: F401
+    barycentric_coordinates_of_projection,
+)

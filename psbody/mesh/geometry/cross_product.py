@@ -1,0 +1,2 @@
+"""reference mesh/geometry/cross_product.py surface."""
+from mesh_tpu.geometry.compat import CrossProduct  # noqa: F401

@@ -1,0 +1,2 @@
+"""reference mesh/geometry/rodrigues.py surface."""
+from mesh_tpu.geometry import rodrigues, rodrigues2rotmat  # noqa: F401

@@ -1,0 +1,9 @@
+"""reference mesh/geometry/tri_normals.py surface (chumpy-era flat API)."""
+from mesh_tpu.geometry.compat import (  # noqa: F401
+    NormalizedNx3,
+    NormalizeRows,
+    TriEdges,
+    TriNormals,
+    TriNormalsScaled,
+    TriToScaledNormal,
+)

@@ -1,0 +1,2 @@
+"""reference mesh/geometry/triangle_area.py surface."""
+from mesh_tpu.geometry import triangle_area  # noqa: F401

@@ -1,0 +1,6 @@
+"""reference mesh/geometry/vert_normals.py surface."""
+from mesh_tpu.geometry.compat import (  # noqa: F401
+    MatVecMult,
+    VertNormals,
+    VertNormalsScaled,
+)

@@ -1,0 +1,7 @@
+"""reference mesh/landmarks.py surface."""
+from mesh_tpu.landmarks import (  # noqa: F401
+    landm_xyz_linear_transform,
+    recompute_landmark_indices,
+    set_landmarks_from_raw,
+    set_landmarks_from_xyz,
+)

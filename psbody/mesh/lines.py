@@ -1,0 +1,2 @@
+"""reference mesh/lines.py surface."""
+from mesh_tpu.lines import Lines  # noqa: F401

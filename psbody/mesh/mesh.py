@@ -1,0 +1,2 @@
+"""reference mesh/mesh.py surface."""
+from mesh_tpu.mesh import Mesh  # noqa: F401

@@ -1,0 +1,10 @@
+"""reference mesh/meshviewer.py surface."""
+from mesh_tpu.viewer.meshviewer import (  # noqa: F401
+    Dummy,
+    MeshSubwindow,
+    MeshViewer,
+    MeshViewerLocal,
+    MeshViewers,
+    test_for_opengl,
+)
+from mesh_tpu.viewer.server import MeshViewerRemote  # noqa: F401

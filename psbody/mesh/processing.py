@@ -1,0 +1,16 @@
+"""reference mesh/processing.py surface."""
+from mesh_tpu.processing import (  # noqa: F401
+    concatenate_mesh,
+    flip_faces,
+    keep_vertices,
+    point_cloud,
+    remove_faces,
+    reorder_vertices,
+    reset_face_normals,
+    reset_normals,
+    rotate_vertices,
+    scale_vertices,
+    subdivide_triangles,
+    translate_vertices,
+    uniquified_mesh,
+)

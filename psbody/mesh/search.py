@@ -1,0 +1,7 @@
+"""reference mesh/search.py surface."""
+from mesh_tpu.search import (  # noqa: F401
+    AabbNormalsTree,
+    AabbTree,
+    CGALClosestPointTree,
+    ClosestPointTree,
+)

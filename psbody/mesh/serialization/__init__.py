@@ -1,0 +1,2 @@
+"""reference mesh/serialization package surface."""
+from . import serialization  # noqa: F401

@@ -1,0 +1,15 @@
+"""reference mesh/serialization/serialization.py surface."""
+from mesh_tpu.serialization.serialization import (  # noqa: F401
+    load_from_file,
+    load_from_obj,
+    load_from_obj_cpp,
+    load_from_ply,
+    set_landmark_indices_from_any,
+    set_landmark_indices_from_lmrkfile,
+    set_landmark_indices_from_ppfile,
+    write_json,
+    write_mtl,
+    write_obj,
+    write_ply,
+    write_three_json,
+)

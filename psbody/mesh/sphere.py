@@ -1,0 +1,2 @@
+"""reference mesh/sphere.py surface."""
+from mesh_tpu.sphere import Sphere  # noqa: F401

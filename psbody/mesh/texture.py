@@ -1,0 +1,10 @@
+"""reference mesh/texture.py surface."""
+from mesh_tpu.texture import (  # noqa: F401
+    load_texture,
+    reload_texture_image,
+    set_texture_image,
+    texture_coordinates_by_vertex,
+    texture_rgb,
+    texture_rgb_vec,
+    transfer_texture,
+)

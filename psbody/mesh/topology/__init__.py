@@ -1,0 +1,1 @@
+"""reference mesh/topology package surface."""

@@ -1,0 +1,9 @@
+"""reference mesh/topology/connectivity.py surface."""
+from mesh_tpu.topology.connectivity import (  # noqa: F401
+    get_faces_per_edge,
+    get_vert_connectivity,
+    get_vert_opposites_per_edge,
+    get_vertices_per_edge,
+    vertices_in_common,
+    vertices_to_edges_matrix,
+)

@@ -1,0 +1,4 @@
+"""reference mesh/topology/linear_mesh_transform.py surface."""
+from mesh_tpu.topology.linear_mesh_transform import (  # noqa: F401
+    LinearMeshTransform,
+)

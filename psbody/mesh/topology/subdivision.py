@@ -1,0 +1,2 @@
+"""reference mesh/topology/subdivision.py surface."""
+from mesh_tpu.topology.subdivision import loop_subdivider  # noqa: F401

@@ -1,0 +1,2 @@
+"""reference mesh/utils.py surface."""
+from mesh_tpu.utils import col, row, sparse  # noqa: F401

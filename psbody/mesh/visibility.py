@@ -1,0 +1,3 @@
+"""reference compiled `visibility` extension surface
+(py_visibility.cpp:24-30): visibility_compute(cams=..., v=..., f=..., ...)."""
+from mesh_tpu.query import visibility_compute  # noqa: F401
