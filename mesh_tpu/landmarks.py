@@ -85,6 +85,19 @@ def recompute_landmark_indices(self, landmark_fname=None, safe_mode=True):
             )
 
 
+def landm_xyz(self, ordering=None):
+    """Current landmark locations as a name -> xyz dict, evaluated through
+    the sparse regressor so they track vertex deformation (reference
+    landmarks.py:37-42)."""
+    order = ordering if ordering else self.landm_names
+    if not order:
+        return {}
+    locations = (
+        landm_xyz_linear_transform(self, order) * np.asarray(self.v).flatten()
+    ).reshape(-1, 3)
+    return dict(zip(order, locations))
+
+
 def set_landmarks_from_xyz(self, landm_raw_xyz):
     self.landm_raw_xyz = (
         landm_raw_xyz

@@ -119,6 +119,15 @@ def get_faces_per_edge(mesh):
     return _cached("edgecache_new", faces, build)
 
 
+def get_faces_per_edge_old(mesh):
+    """Legacy spelling kept for reference compat (connectivity.py:164-200).
+    The reference retains two generations of this computation whose only
+    contract is "one row per interior edge, the two adjacent face ids";
+    both are served by the modern implementation here (row order is not part
+    of the contract and differs between the reference's own two versions)."""
+    return get_faces_per_edge(mesh)
+
+
 def vertices_to_edges_matrix(mesh, want_xyz=True):
     """Sparse matrix M with e = M.dot(v): per-edge difference operator
     (reference connectivity.py:57-80)."""

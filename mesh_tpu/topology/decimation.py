@@ -152,6 +152,16 @@ def qslim_decimator(mesh, factor=None, n_verts_desired=None):
     return LinearMeshTransform(mtx, new_faces)
 
 
+def qslim_decimator_fast(mesh, factor=None, n_verts_desired=None):
+    """Decimate and return the simplified mesh directly (reference
+    decimation.py:71-75).  The reference version shells out to an external
+    `experiments.qslim` package that it does not ship; here the vectorized
+    quadric pipeline above is already the fast path, so this simply applies
+    the transform and hands back the coarse mesh."""
+    xform = qslim_decimator(mesh, factor=factor, n_verts_desired=n_verts_desired)
+    return xform(mesh)
+
+
 def _get_sparse_transform(faces, num_original_verts):
     """Renumber `faces` onto their surviving vertices and build the sparse
     (3V' x 3V) selection matrix that picks those vertices' flattened xyz

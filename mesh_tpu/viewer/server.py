@@ -786,24 +786,34 @@ class MeshViewerRemote(SceneRenderer):
             if (self.pending_mouseclick_port is None
                     and self.pending_event_port is None):
                 return
-            point = self.unproject(x, y)
-            # u/v are pixel offsets inside the clicked subwindow's viewport,
-            # measured from its bottom-left (reference meshviewer.py:1112-1117)
-            w_sub = self.width // self.shape[1]
-            h_sub = self.height // self.shape[0]
-            self.mouseclick_queue.append(
-                {
-                    "event_type": "mouse_click_%sbutton"
-                    % ("middle" if button == 1 else "right"),
-                    "u": x - c * w_sub,
-                    "v": (self.height - y) - (self.shape[0] - 1 - r) * h_sub,
-                    "x": point[0], "y": point[1], "z": point[2],
-                    "which_subwindow": (r, c),
-                    "point": point,     # convenience vector form
-                }
+            self.send_mouseclick_to_caller(
+                x, y, "middle" if button == 1 else "right"
             )
-            self._flush_mouseclick()
-            self._flush_event()
+
+    def send_mouseclick_to_caller(self, cursor_x, cursor_y, button="right"):
+        """Unproject a click to 3D and serve it to the waiting
+        get_mouseclick/get_event client (reference meshviewer.py:1076-1120;
+        there the reply socket is dedicated, here it flushes the shared
+        pending-port queues)."""
+        r, c = self._subwindow_at(cursor_x, cursor_y)
+        point = self.unproject(cursor_x, cursor_y)
+        # u/v are pixel offsets inside the clicked subwindow's viewport,
+        # measured from its bottom-left (reference meshviewer.py:1112-1117)
+        w_sub = self.width // self.shape[1]
+        h_sub = self.height // self.shape[0]
+        self.mouseclick_queue.append(
+            {
+                "event_type": "mouse_click_%sbutton" % button,
+                "u": cursor_x - c * w_sub,
+                "v": (self.height - cursor_y)
+                    - (self.shape[0] - 1 - r) * h_sub,
+                "x": point[0], "y": point[1], "z": point[2],
+                "which_subwindow": (r, c),
+                "point": point,     # convenience vector form
+            }
+        )
+        self._flush_mouseclick()
+        self._flush_event()
 
     def on_drag(self, x, y):
         for row in self.subwindows:
@@ -841,6 +851,141 @@ class MeshViewerRemote(SceneRenderer):
         self.width, self.height = width, height
         glViewport(0, 0, width, height)
         self.need_redraw = True
+
+    def send_window_shape(self, port):
+        """Push the subwindow grid shape to a waiting client port
+        (reference meshviewer.py:1142-1148)."""
+        self._reply(port, {"event_type": "window_shape", "shape": self.shape})
+
+    # ------------------------------------------------------------------
+    # Reference-named compat aliases, for code that drives or subclasses
+    # the reference MeshViewerRemote directly (meshviewer.py:907-1258).
+    checkQueue = check_queue
+    on_resize_window = on_resize
+    snapshot = save_snapshot
+
+
+class MeshViewerSingle(Subwindow):
+    """One subwindow that can draw itself into the current GL context,
+    matching the reference class of the same name (meshviewer.py:291-513).
+
+    Our architecture splits that class into scene state (`Subwindow`) and GL
+    drawing (`SceneRenderer`); this adapter rejoins the halves for code that
+    instantiates the reference class directly.  The constructor takes the
+    subwindow's position and size as fractions of the enclosing GLUT window,
+    exactly like the reference.
+    """
+
+    def __init__(self, x1_pct, y1_pct, width_pct, height_pct):
+        if width_pct > 1 or height_pct > 1:
+            raise ValueError("subwindow fractions must be <= 1")
+        Subwindow.__init__(self)
+        self.x1_pct = x1_pct
+        self.y1_pct = y1_pct
+        self.width_pct = width_pct
+        self.height_pct = height_pct
+        self._renderer = SceneRenderer(shape=(1, 1))
+        self._renderer.subwindows[0][0] = self
+
+    def get_dimensions(self):
+        """Pixel geometry of this subwindow inside the live GLUT window
+        (reference meshviewer.py:309-317)."""
+        from OpenGL.GLUT import GLUT_WINDOW_HEIGHT, GLUT_WINDOW_WIDTH, glutGet
+
+        win_w = glutGet(GLUT_WINDOW_WIDTH)
+        win_h = glutGet(GLUT_WINDOW_HEIGHT)
+        return {
+            "window_width": win_w,
+            "window_height": win_h,
+            "subwindow_width": self.width_pct * win_w,
+            "subwindow_height": self.height_pct * win_h,
+            "subwindow_origin_x": self.x1_pct * win_w,
+            "subwindow_origin_y": self.y1_pct * win_h,
+        }
+
+    def on_draw(self, transform, want_camera=False):
+        """Set up this subwindow's viewport + camera and draw its scene
+        (reference meshviewer.py:320-365).  `transform` is the 4x4 modelview
+        the caller accumulated (e.g. from an arcball)."""
+        from OpenGL.GL import (
+            GL_MODELVIEW, GL_PROJECTION, glLoadIdentity, glMatrixMode,
+            glMultMatrixf, glTranslatef, glViewport,
+        )
+
+        d = self.get_dimensions()
+        w = max(int(d["subwindow_width"]), 1)
+        h = max(int(d["subwindow_height"]), 1)
+        glViewport(int(d["subwindow_origin_x"]), int(d["subwindow_origin_y"]),
+                   w, h)
+        glMatrixMode(GL_PROJECTION)
+        glLoadIdentity()
+        glMultMatrixf(perspective_matrix(45.0, float(w) / h, 0.1, 100.0))
+        glMatrixMode(GL_MODELVIEW)
+        glLoadIdentity()
+        glTranslatef(0.0, 0.0, -2.5)
+        glMultMatrixf(np.asarray(transform, np.float32))
+        self._renderer.draw_scene(self)
+        if want_camera:
+            from OpenGL.GL import (
+                GL_MODELVIEW_MATRIX, GL_PROJECTION_MATRIX, glGetDoublev,
+            )
+
+            return {
+                "modelview_matrix": glGetDoublev(GL_MODELVIEW_MATRIX),
+                "projection_matrix": glGetDoublev(GL_PROJECTION_MATRIX),
+                "viewport": [int(d["subwindow_origin_x"]),
+                             int(d["subwindow_origin_y"]), w, h],
+            }
+
+    def draw_primitives_recentered(self, want_camera=False):
+        prev = self.autorecenter
+        self.autorecenter = True
+        try:
+            self._renderer.draw_scene(self)
+        finally:
+            self.autorecenter = prev
+
+    def draw_primitives(self, scalefactor=1.0, center=None,
+                        recenter=False, want_camera=False):
+        prev = self.autorecenter
+        self.autorecenter = bool(recenter)
+        try:
+            self._renderer.draw_scene(self)
+        finally:
+            self.autorecenter = prev
+
+    def set_texture(self, m):
+        """Upload the mesh's texture image as a GL texture now (reference
+        staticmethod meshviewer.py:381-388; here it reuses the renderer's
+        crc32-keyed cache and also exposes the id as `m.textureID`)."""
+        tid = self._renderer._texture_id_for(m)
+        if tid is not None:
+            m.textureID = tid
+        return tid
+
+    @staticmethod
+    def set_shaders(m):
+        """Attach a trivial pass-through shader program to the mesh
+        (reference meshviewer.py:371-378)."""
+        from OpenGL.GL import GL_FRAGMENT_SHADER, GL_VERTEX_SHADER, shaders
+
+        vert = shaders.compileShader(
+            "void main(){gl_Position=gl_ModelViewProjectionMatrix*gl_Vertex;}",
+            GL_VERTEX_SHADER)
+        frag = shaders.compileShader(
+            "void main(){gl_FragColor=vec4(0.,1.,0.,1.);}",
+            GL_FRAGMENT_SHADER)
+        m.shaders = shaders.compileProgram(vert, frag)
+
+    def draw_mesh(self, m, lighting_on=True):
+        from OpenGL.GL import GL_LIGHTING, glDisable, glEnable
+
+        (glEnable if lighting_on else glDisable)(GL_LIGHTING)
+        self._renderer.draw_mesh(m)
+
+    def draw_lines(self, l):
+        self._renderer.draw_lines(l)
+
 
 def _test_for_opengl():
     try:

@@ -2,9 +2,17 @@
 from mesh_tpu.viewer.arcball import (  # noqa: F401
     ArcBallT,
     Matrix3fMulMatrix3f,
+    Matrix3fSetIdentity,
     Matrix3fSetRotationFromQuat4f,
     Matrix3fT,
+    Matrix4fSVD,
     Matrix4fSetRotationFromMatrix3f,
+    Matrix4fSetRotationScaleFromMatrix3f,
     Matrix4fT,
     Point2fT,
+    Quat4fT,
+    Vector3fCross,
+    Vector3fDot,
+    Vector3fLength,
+    Vector3fT,
 )

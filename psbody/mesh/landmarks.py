@@ -1,5 +1,8 @@
 """reference mesh/landmarks.py surface."""
 from mesh_tpu.landmarks import (  # noqa: F401
+    is_index,
+    is_vertex,
+    landm_xyz,
     landm_xyz_linear_transform,
     recompute_landmark_indices,
     set_landmarks_from_raw,

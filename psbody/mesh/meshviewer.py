@@ -7,4 +7,7 @@ from mesh_tpu.viewer.meshviewer import (  # noqa: F401
     MeshViewers,
     test_for_opengl,
 )
-from mesh_tpu.viewer.server import MeshViewerRemote  # noqa: F401
+from mesh_tpu.viewer.server import (  # noqa: F401
+    MeshViewerRemote,
+    MeshViewerSingle,
+)

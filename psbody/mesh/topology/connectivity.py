@@ -1,6 +1,7 @@
 """reference mesh/topology/connectivity.py surface."""
 from mesh_tpu.topology.connectivity import (  # noqa: F401
     get_faces_per_edge,
+    get_faces_per_edge_old,
     get_vert_connectivity,
     get_vert_opposites_per_edge,
     get_vertices_per_edge,

@@ -1,6 +1,7 @@
 """reference mesh/topology/decimation.py surface."""
 from mesh_tpu.topology.decimation import (  # noqa: F401
     qslim_decimator,
+    qslim_decimator_fast,
     qslim_decimator_transformer,
     remove_redundant_verts,
     vertex_quadrics,

@@ -1,0 +1,96 @@
+"""Automated API-parity audit: every public symbol the reference's Python
+modules define must be importable from the `psbody.mesh` drop-in shim.
+
+The expected surface is extracted from the reference sources by AST (never
+imported — the reference's compiled extensions don't exist here), so this
+test IS the line-by-line completeness check: a reference symbol we drop
+shows up as a named failure, and new reference-surface code can't regress
+silently.
+"""
+
+import ast
+import importlib
+import os
+
+import pytest
+
+REFERENCE_ROOT = "/root/reference/mesh"
+
+# reference module -> shim module that must expose its public surface
+MODULE_MAP = {
+    "mesh.py": "psbody.mesh.mesh",
+    "search.py": "psbody.mesh.search",
+    "lines.py": "psbody.mesh.lines",
+    "sphere.py": "psbody.mesh.sphere",
+    "colors.py": "psbody.mesh.colors",
+    "texture.py": "psbody.mesh.texture",
+    "arcball.py": "psbody.mesh.arcball",
+    "landmarks.py": "psbody.mesh.landmarks",
+    "processing.py": "psbody.mesh.processing",
+    "utils.py": "psbody.mesh.utils",
+    "errors.py": "psbody.mesh.errors",
+    "fonts.py": "psbody.mesh.fonts",
+    "meshviewer.py": "psbody.mesh.meshviewer",
+    "geometry/barycentric_coordinates_of_projection.py":
+        "psbody.mesh.geometry.barycentric_coordinates_of_projection",
+    "geometry/triangle_area.py": "psbody.mesh.geometry.triangle_area",
+    "geometry/cross_product.py": "psbody.mesh.geometry.cross_product",
+    "geometry/tri_normals.py": "psbody.mesh.geometry.tri_normals",
+    "geometry/rodrigues.py": "psbody.mesh.geometry.rodrigues",
+    "geometry/vert_normals.py": "psbody.mesh.geometry.vert_normals",
+    "topology/linear_mesh_transform.py":
+        "psbody.mesh.topology.linear_mesh_transform",
+    "topology/decimation.py": "psbody.mesh.topology.decimation",
+    "topology/connectivity.py": "psbody.mesh.topology.connectivity",
+    "topology/subdivision.py": "psbody.mesh.topology.subdivision",
+    "serialization/serialization.py":
+        "psbody.mesh.serialization.serialization",
+}
+
+
+def reference_surface(relpath):
+    """(classes {name: [public methods]}, [public functions]) of a reference
+    module, by parsing its source."""
+    path = os.path.join(REFERENCE_ROOT, relpath)
+    import warnings
+
+    with warnings.catch_warnings():
+        # the reference's own sources contain pre-3.12 escape sequences
+        warnings.simplefilter("ignore", SyntaxWarning)
+        tree = ast.parse(open(path, encoding="utf-8", errors="ignore").read())
+    classes, functions = {}, []
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and not node.name.startswith("_"):
+            classes[node.name] = [
+                n.name for n in node.body
+                if isinstance(n, ast.FunctionDef)
+                and not n.name.startswith("_")
+            ]
+        elif isinstance(node, ast.FunctionDef) and not node.name.startswith("_"):
+            functions.append(node.name)
+    return classes, functions
+
+
+@pytest.mark.skipif(
+    not os.path.isdir(REFERENCE_ROOT), reason="reference checkout not present"
+)
+@pytest.mark.parametrize("relpath", sorted(MODULE_MAP))
+def test_shim_module_covers_reference(relpath):
+    classes, functions = reference_surface(relpath)
+    mod = importlib.import_module(MODULE_MAP[relpath])
+    missing = []
+    for fn in functions:
+        if not hasattr(mod, fn):
+            missing.append(fn)
+    for cls_name, methods in classes.items():
+        cls = getattr(mod, cls_name, None)
+        if cls is None:
+            missing.append(cls_name)
+            continue
+        missing.extend(
+            "%s.%s" % (cls_name, m) for m in methods if not hasattr(cls, m)
+        )
+    assert not missing, (
+        "shim %s is missing reference symbols: %s"
+        % (MODULE_MAP[relpath], ", ".join(missing))
+    )
