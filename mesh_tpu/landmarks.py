@@ -8,9 +8,13 @@ through the Mesh facade (landmarks.py:45-65 in the reference runs the C++
 AABB stack here).
 """
 
+import logging
+
 import numpy as np
 
 from .utils import col, sparse
+
+log = logging.getLogger(__name__)
 
 
 def landm_xyz_linear_transform(self, ordering=None):
@@ -58,9 +62,10 @@ def recompute_landmark_indices(self, landmark_fname=None, safe_mode=True):
         else self.landm_raw_xyz.items()
     )
     if len(filtered_landmarks) != len(self.landm_raw_xyz):
-        print(
-            "WARNING: %d landmarks in file %s are positioned at (0.0, 0.0, 0.0) and were ignored"
-            % (len(self.landm_raw_xyz) - len(filtered_landmarks), landmark_fname)
+        log.warning(
+            "%d landmarks in file %s are positioned at (0.0, 0.0, 0.0)"
+            " and were ignored",
+            len(self.landm_raw_xyz) - len(filtered_landmarks), landmark_fname,
         )
     self.landm = {}
     self.landm_regressors = {}

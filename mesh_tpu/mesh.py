@@ -379,8 +379,14 @@ class Mesh(object):
 
     def estimate_circumference(self, plane_normal, plane_distance,
                                partNamesAllowed=None, want_edges=False):
-        raise NotImplementedError(
-            "estimate_circumference lives in body-model packages, not here"
+        """Length of the plane/mesh cross-section.  The reference stubs this
+        out with a pointer to an external package (reference mesh.py:313-314);
+        here it is implemented natively (metrics.py)."""
+        from . import metrics
+
+        return metrics.circumference(
+            self, plane_normal, plane_distance,
+            part_names_allowed=partNamesAllowed, want_edges=want_edges,
         )
 
     # ------------------------------------------------------------------

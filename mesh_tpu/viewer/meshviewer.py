@@ -366,7 +366,7 @@ class MeshViewerLocal(object):
         return reply["size"] if reply else None
 
     def save_snapshot(self, path, blocking=False):
-        print("Saving snapshot to %s, please wait..." % path)
+        log.info("Saving snapshot to %s, please wait...", path)
         self._send_pyobj("save_snapshot", path, blocking)
 
     def set_dynamic_meshes(self, meshes, blocking=False, which_window=(0, 0)):

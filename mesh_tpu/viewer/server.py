@@ -7,6 +7,7 @@ socket on a 20 ms timer.  `TEST_FOR_OPENGL` mode just probes GL context
 creation and prints success/failure (reference meshviewer.py:96-108).
 """
 
+import logging
 import sys
 import time
 import traceback
@@ -21,6 +22,8 @@ from .arcball import (
     Matrix4fT,
     Point2fT,
 )
+
+log = logging.getLogger(__name__)
 
 ZMQ_HOST = "127.0.0.1"
 
@@ -679,10 +682,9 @@ class MeshViewerRemote(SceneRenderer):
         if not (0 <= r < self.shape[0] and 0 <= c < self.shape[1]):
             # treat a bad subwindow index as a handled no-op so the client
             # still gets its ack instead of timing out on a "dead" server
-            print(
-                "meshviewer server: which_window (%s, %s) outside %sx%s grid"
-                % (r, c, self.shape[0], self.shape[1]),
-                file=sys.stderr,
+            log.warning(
+                "which_window (%s, %s) outside %sx%s grid",
+                r, c, self.shape[0], self.shape[1],
             )
             return
         sub = self.subwindows[r][c]

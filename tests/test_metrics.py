@@ -1,0 +1,113 @@
+"""Plane-section circumference (mesh_tpu/metrics.py) — the measurement the
+reference removed from its core package (reference mesh.py:313-314) —
+plus return-shape parity assertions for the search trees (reference
+search.py:52-100 conventions)."""
+
+import numpy as np
+import pytest
+
+from mesh_tpu import Mesh
+from mesh_tpu.metrics import circumference, plane_section
+
+from .fixtures import box, cylinder, icosphere
+
+
+class TestPlaneSection:
+    def test_box_midslice_is_square_perimeter(self):
+        v, f = box(size=2.0)
+        m = Mesh(v=v, f=f)
+        c = m.estimate_circumference([0.0, 0.0, 1.0], 0.0)
+        assert c == pytest.approx(8.0, rel=1e-12)
+
+    def test_cylinder_slice_matches_polygon_perimeter(self):
+        n = 64
+        v, f = cylinder(n=n, radius=1.0, height=2.0)
+        # the n-gon ring has perimeter 2*n*sin(pi/n), not 2*pi
+        expected = 2 * n * np.sin(np.pi / n)
+        c = circumference(Mesh(v=v, f=f), [0, 0, 1], 0.3)
+        assert c == pytest.approx(expected, rel=1e-9)
+
+    def test_sphere_slice_approaches_great_circle(self):
+        v, f = icosphere(subdivisions=3)
+        c = circumference(Mesh(v=v, f=f), [1.0, 0.0, 0.0], 0.0)
+        assert c == pytest.approx(2 * np.pi, rel=0.01)
+
+    def test_offset_slice_is_smaller_circle(self):
+        v, f = icosphere(subdivisions=3)
+        d = 0.5
+        c = circumference(Mesh(v=v, f=f), [0.0, 0.0, 1.0], d)
+        assert c == pytest.approx(2 * np.pi * np.sqrt(1 - d * d), rel=0.01)
+
+    def test_edges_lie_on_plane(self):
+        v, f = icosphere(subdivisions=2)
+        n = np.array([1.0, 2.0, 3.0])
+        n = n / np.linalg.norm(n)
+        total, edges = circumference(Mesh(v=v, f=f), n, 0.25, want_edges=True)
+        assert edges.shape[1:] == (2, 3)
+        assert total > 0
+        np.testing.assert_allclose(edges.reshape(-1, 3) @ n, 0.25, atol=1e-9)
+
+    def test_missing_plane_returns_zero(self):
+        v, f = box(size=1.0)
+        assert circumference(Mesh(v=v, f=f), [0, 0, 1], 5.0) == 0.0
+
+    def test_part_restriction(self):
+        v, f = box(size=2.0)
+        m = Mesh(v=v, f=f)
+        # side walls only: drop the z-normal caps (which the z=0 plane
+        # misses anyway) -> same perimeter; empty selection -> zero
+        m.segm = {"walls": np.arange(4, 12), "caps": np.arange(0, 4)}
+        assert m.estimate_circumference(
+            [0, 0, 1], 0.0, partNamesAllowed=["walls"]
+        ) == pytest.approx(8.0)
+        assert m.estimate_circumference(
+            [0, 0, 1], 0.0, partNamesAllowed=["caps"]
+        ) == 0.0
+        assert m.estimate_circumference(
+            [0, 0, 1], 0.0, partNamesAllowed=["nope"]
+        ) == 0.0
+
+    def test_on_plane_vertices_do_not_crash(self):
+        # a vertex exactly on the plane exercises the eps tie-break
+        v, f = box(size=2.0)
+        c = plane_section(v, f, [0, 0, 1], 1.0)
+        assert c[0].shape[1] == 3
+
+
+class TestSearchReturnShapeParity:
+    """The reference's tree classes have exact return conventions
+    (search.py:26-30, 59-65, 78-86); drop-in callers index into them."""
+
+    def setup_method(self):
+        v, f = icosphere(subdivisions=1)
+        self.m = Mesh(v=v, f=f)
+        self.q = np.random.RandomState(7).randn(5, 3)
+
+    def test_aabb_tree_nearest_shapes(self):
+        # reference: f_idxs (1, S), f_part (1, S), points (S, 3)
+        tree = self.m.compute_aabb_tree()
+        f_idxs, points = tree.nearest(self.q)
+        assert np.asarray(f_idxs).shape == (1, 5)
+        assert np.asarray(points).shape == (5, 3)
+        f_idxs, f_part, points = tree.nearest(self.q, nearest_part=True)
+        assert np.asarray(f_part).shape == (1, 5)
+
+    def test_closest_point_tree_shapes(self):
+        tree = self.m.compute_closest_point_tree()
+        idx, dist = tree.nearest(self.q)
+        assert np.asarray(idx).shape == (5,)
+        assert np.asarray(dist).shape == (5,)
+        assert tree.nearest_vertices(self.q).shape == (5, 3)
+
+    def test_cgal_closest_point_tree_shapes(self):
+        tree = self.m.compute_closest_point_tree(use_cgal=True)
+        idx, dist = tree.nearest(self.q)
+        assert np.asarray(idx).shape == (5,)
+        assert np.asarray(dist).shape == (5,)
+        assert tree.nearest_vertices(self.q).shape == (5, 3)
+
+    def test_closest_faces_and_points_shapes(self):
+        faces, points = self.m.closest_faces_and_points(self.q)
+        # reference mesh.py:454-455 returns column face ids + (S, 3) points
+        assert np.asarray(points).shape == (5, 3)
+        assert np.asarray(faces).size == 5
