@@ -22,17 +22,13 @@ __all__ = ["Mesh"]
 
 
 class Mesh(object):
-    """3d Triangulated Mesh class.
+    """Triangle mesh with the reference package's attribute conventions.
 
-    Attributes:
-        v: Vx3 array of vertices
-        f: Fx3 array of faces
-
-    Optional attributes:
-        fc: Fx3 array of face colors
-        vc: Vx3 array of vertex colors
-        vn: Vx3 array of vertex normals
-        segm: dictionary of part names to triangle indices
+    Core data: ``v`` ([V, 3] float64 vertex positions) and ``f`` ([F, 3]
+    uint32 triangles).  Optional per-element data uses the reference's
+    names — ``vn``/``fn`` normals, ``vc``/``fc`` colors, ``vt``/``ft``
+    texture coordinates, ``segm`` (part name -> triangle index list) and
+    ``landm``/``landm_regressors`` landmarks.
     """
 
     def __init__(self, v=None, f=None, segm=None, filename=None,
@@ -45,19 +41,20 @@ class Mesh(object):
             self.v = np.array(v)           # copy: callers may mutate mesh.v
         if f is not None:
             self.f = f
-        # normalize dtypes of whatever source provided the geometry
-        # (reference mesh.py:68-70: v float64, f uint32)
+        # whatever source supplied the geometry, coerce to the reference's
+        # canonical dtypes (mesh.py:68-70): f64 positions, u32 faces
         if hasattr(self, "v"):
-            self.v = np.require(self.v, dtype=np.float64)
+            self.v = np.asarray(self.v, dtype=np.float64)
             if vscale is not None:
                 self.v = self.v * vscale
         if hasattr(self, "f"):
-            self.f = np.require(self.f, dtype=np.uint32)
+            self.f = np.asarray(self.f, dtype=np.uint32)
 
         if basename is not None:
             self.basename = basename
         elif filename is not None:
-            self.basename = os.path.splitext(os.path.basename(filename))[0]
+            base = os.path.basename(filename)
+            self.basename = os.path.splitext(base)[0]
         else:
             self.basename = None
 

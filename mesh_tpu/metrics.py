@@ -25,8 +25,11 @@ def plane_section(v, f, plane_normal, plane_distance, eps=1e-12):
     v = np.asarray(v, dtype=np.float64)
     f = np.asarray(f, dtype=np.int64)
     n = np.asarray(plane_normal, dtype=np.float64)
-    n = n / np.linalg.norm(n)
-    s = v @ n - float(plane_distance)          # signed vertex-plane distance
+    scale = np.linalg.norm(n)
+    # rescale BOTH so the cut stays the documented {x: dot(n, x) = d} for a
+    # non-unit normal, while s keeps true euclidean-distance units
+    n = n / scale
+    s = v @ n - float(plane_distance) / scale  # signed vertex-plane distance
     s = np.where(np.abs(s) < eps, eps, s)      # break on-plane ties
     sf = s[f]                                  # [F, 3]
 

@@ -2,6 +2,7 @@ from .sharding import (  # noqa: F401
     make_device_mesh,
     shard_queries,
     sharded_closest_faces_and_points,
+    sharded_closest_faces_sharded_topology,
     sharded_batched_vert_normals,
     sharded_visibility,
 )

@@ -109,6 +109,89 @@ def sharded_closest_faces_and_points(v, f, points, mesh, axis="dp", chunk=512):
 
 
 @lru_cache(maxsize=32)
+def _closest_fsharded_fn(mesh, axis, chunk):
+    """Compiled closest-point with the TRIANGLES sharded across devices.
+
+    Each device scans its face shard for every query and the winners merge
+    with one cross-device argmin — the "final gather/argmin if a tree/grid
+    is sharded" collective SURVEY.md section 5 calls for.  This is the
+    shape that scales when the occluder mesh itself is too large for one
+    device (queries are replicated, O(F) state is sharded)."""
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(axis), P()),
+        out_specs=(P(), P()),
+        # the all_gather + argmin below produces identical values on every
+        # device, but the static varying-axes analysis cannot prove it
+        check_vma=False,
+    )
+    def _run(v_rep, f_shard, pts_rep):
+        local = closest_faces_and_points(v_rep, f_shard, pts_rep, chunk=chunk)
+        shard_id = jax.lax.axis_index(axis)
+        packed = jnp.stack(
+            [
+                local["sqdist"],
+                local["part"].astype(jnp.float32),
+                local["point"][:, 0],
+                local["point"][:, 1],
+                local["point"][:, 2],
+            ],
+            axis=1,
+        )                                           # [Q, 5] per device
+        # face ids travel as int32 (a float32 lane would corrupt ids past
+        # 2^24 — exactly the huge-F regime this function is for)
+        faces_global = local["face"] + shard_id * f_shard.shape[0]
+        everyone = jax.lax.all_gather(packed, axis)       # [n_shards, Q, 5]
+        all_faces = jax.lax.all_gather(faces_global, axis)  # [n_shards, Q]
+        winner = jnp.argmin(everyone[:, :, 0], axis=0)    # [Q]
+        best = jnp.take_along_axis(
+            everyone, winner[None, :, None], axis=0
+        )[0]                                              # [Q, 5]
+        best_face = jnp.take_along_axis(all_faces, winner[None, :], axis=0)[0]
+        return best, best_face
+
+    return jax.jit(_run)
+
+
+def sharded_closest_faces_sharded_topology(v, f, points, mesh, axis="dp",
+                                           chunk=512):
+    """Closest-point query with the face axis sharded over the ICI mesh.
+
+    The dual of `sharded_closest_faces_and_points`: query points are
+    replicated, the triangle soup is split across devices, and the global
+    winner per query is found by an all-gather + argmin collective.  Use
+    this when F is the large axis (e.g. querying a sparse landmark set
+    against a 1M-face scan on a v5e-8).  Returns the same dict as
+    closest_faces_and_points.
+    """
+    n_shards = mesh.shape[axis]
+    n_faces = np.asarray(f).shape[0]
+    # pad with copies of the last face: harmless duplicates that can
+    # only tie, never beat, the true winner (strict < keeps lowest id)
+    f_np, _ = _pad_rows(np.asarray(f, np.int64), n_shards)
+
+    out, face = _closest_fsharded_fn(mesh, axis, chunk)(
+        jnp.asarray(v, jnp.float32),
+        jax.device_put(
+            jnp.asarray(f_np, jnp.int32), NamedSharding(mesh, P(axis))
+        ),
+        jnp.asarray(points, jnp.float32),
+    )
+    out = np.asarray(out)
+    face = np.asarray(face, np.int64)
+    # a padded duplicate can win a tie against its original; map it back
+    face = np.where(face >= n_faces, n_faces - 1, face)
+    return {
+        "face": face.astype(np.int32),
+        "part": out[:, 1].astype(np.int32),
+        "sqdist": out[:, 0],
+        "point": out[:, 2:5],
+    }
+
+
+@lru_cache(maxsize=32)
 def _visibility_shard_fn(mesh, axis, chunk, min_dist):
     from ..query.visibility import _visibility_kernel
 

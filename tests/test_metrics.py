@@ -47,6 +47,14 @@ class TestPlaneSection:
         assert total > 0
         np.testing.assert_allclose(edges.reshape(-1, 3) @ n, 0.25, atol=1e-9)
 
+    def test_unnormalized_normal_keeps_plane_equation(self):
+        # dot([0,0,2], x) = 1  is the plane z = 0.5, whatever ||n|| is
+        v, f = box(size=2.0)
+        m = Mesh(v=v, f=f)
+        c_unit = m.estimate_circumference([0.0, 0.0, 1.0], 0.5)
+        c_scaled = m.estimate_circumference([0.0, 0.0, 2.0], 1.0)
+        assert c_scaled == pytest.approx(c_unit, rel=1e-12)
+
     def test_missing_plane_returns_zero(self):
         v, f = box(size=1.0)
         assert circumference(Mesh(v=v, f=f), [0, 0, 1], 5.0) == 0.0

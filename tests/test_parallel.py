@@ -12,6 +12,7 @@ from mesh_tpu.parallel import (
     make_fit_step,
     sharded_batched_vert_normals,
     sharded_closest_faces_and_points,
+    sharded_closest_faces_sharded_topology,
 )
 from mesh_tpu.geometry import vert_normals
 from mesh_tpu.query import closest_faces_and_points
@@ -45,6 +46,47 @@ class TestShardedQueries:
         # faces can differ only at exact ties; parts/points must agree
         agree = sharded["face"] == np.asarray(single["face"])
         assert agree.mean() > 0.99
+
+    def test_face_sharded_matches_single_device(self):
+        """Topology-sharded dual: triangles split across devices, winners
+        merged by the cross-device argmin collective."""
+        rng = np.random.RandomState(3)
+        v, f = icosphere(2)
+        points = rng.randn(200, 3).astype(np.float32)
+        mesh = make_device_mesh(8, ("dp",))
+        sharded = sharded_closest_faces_sharded_topology(
+            v.astype(np.float32), f.astype(np.int32), points, mesh, chunk=64
+        )
+        single = closest_faces_and_points(
+            v.astype(np.float32), f.astype(np.int32), points, chunk=64
+        )
+        np.testing.assert_allclose(
+            sharded["sqdist"], np.asarray(single["sqdist"]), atol=1e-6
+        )
+        np.testing.assert_allclose(
+            sharded["point"], np.asarray(single["point"]), atol=1e-5
+        )
+        agree = sharded["face"] == np.asarray(single["face"])
+        assert agree.mean() > 0.99
+
+    def test_face_sharded_non_divisible_face_count(self):
+        # icosphere(1) has 80 faces; 80 % 8 == 0, so drop a few to force the
+        # duplicate-face padding path
+        rng = np.random.RandomState(4)
+        v, f = icosphere(1)
+        f = f[:77]                                  # 77 % 8 != 0
+        points = rng.randn(50, 3).astype(np.float32)
+        mesh = make_device_mesh(8, ("dp",))
+        sharded = sharded_closest_faces_sharded_topology(
+            v.astype(np.float32), f.astype(np.int32), points, mesh, chunk=16
+        )
+        single = closest_faces_and_points(
+            v.astype(np.float32), f.astype(np.int32), points, chunk=16
+        )
+        np.testing.assert_allclose(
+            sharded["sqdist"], np.asarray(single["sqdist"]), atol=1e-6
+        )
+        assert sharded["face"].max() < 77
 
     def test_non_divisible_query_count(self):
         rng = np.random.RandomState(1)
