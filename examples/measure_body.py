@@ -1,0 +1,79 @@
+"""Body measurement: girths from plane sections of a posed body model.
+
+    python examples/measure_body.py [--batch 8]
+
+The classic downstream use of a body-mesh library is anthropometry — chest /
+waist / hip circumference on an SMPL-family mesh.  The reference package
+removed this capability from its core (reference mesh.py:313-314 raises with
+a pointer to an external module); here `Mesh.estimate_circumference` is
+native, so the whole pipeline is:
+
+1. Pose a batch of bodies with random shapes (LBS on the default device).
+2. Slice each body at several heights and sum the section lengths.
+3. Print a small measurement table and write one sectioned body with its
+   measurement curves as OBJ (mesh) + OBJ lines for inspection.
+
+Everything here is public mesh_tpu API; no reference code involved.
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+# checkout-first: run THIS source tree even when mesh_tpu is installed
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--batch", type=int, default=8)
+    parser.add_argument("--out", default="/tmp/measured_body")
+    args = parser.parse_args()
+
+    import jax.numpy as jnp
+
+    from mesh_tpu import Mesh
+    from mesh_tpu.lines import Lines
+    from mesh_tpu.models import lbs, synthetic_body_model
+
+    model = synthetic_body_model(seed=0)
+    rng = np.random.RandomState(7)
+    betas = jnp.asarray(rng.randn(args.batch, model.num_betas) * 0.3)
+    pose = jnp.zeros((args.batch, model.num_joints, 3))
+    verts, _ = lbs(model, betas, pose)
+    verts = np.asarray(verts, np.float64)
+    faces = np.asarray(model.faces, np.uint32)
+
+    z_lo, z_hi = verts[..., 2].min(), verts[..., 2].max()
+    stations = {
+        "chest": z_lo + 0.72 * (z_hi - z_lo),
+        "waist": z_lo + 0.58 * (z_hi - z_lo),
+        "hip": z_lo + 0.45 * (z_hi - z_lo),
+    }
+
+    header = "body  " + "  ".join("%8s" % s for s in stations)
+    print(header)
+    for i in range(args.batch):
+        m = Mesh(v=verts[i], f=faces)
+        girths = [
+            m.estimate_circumference([0.0, 0.0, 1.0], z) for z in stations.values()
+        ]
+        print("%4d  " % i + "  ".join("%7.3fm" % g for g in girths))
+
+    # write body 0 with its measurement curves for visual inspection
+    m = Mesh(v=verts[0], f=faces)
+    m.write_obj(args.out + ".obj")
+    segments = [
+        m.estimate_circumference([0.0, 0.0, 1.0], z, want_edges=True)[1]
+        for z in stations.values()
+    ]
+    v_all = np.vstack([s.reshape(-1, 3) for s in segments])
+    e_all = np.arange(len(v_all)).reshape(-1, 2)   # consecutive point pairs
+    Lines(v=v_all, e=e_all).write_obj(args.out + "_curves.obj")
+    print("wrote %s.obj and %s_curves.obj" % (args.out, args.out))
+
+
+if __name__ == "__main__":
+    main()

@@ -210,44 +210,52 @@ class SceneRenderer(object):
         glEnable(GL_BLEND)
         glBlendFunc(GL_SRC_ALPHA, GL_ONE_MINUS_SRC_ALPHA)
 
+    def setup_subwindow_view(self, sub, x0, y0, w, h):
+        """Viewport + scissored clear + camera for one subwindow region.
+
+        The single definition of the viewer camera (45deg fov, 0.1/100 clip,
+        eye at z=+2.5) and clear protocol, shared by the grid render loop
+        and the MeshViewerSingle compat adapter.  Leaves the modelview at
+        the camera transform — the caller multiplies in its scene transform.
+        """
+        from OpenGL.GL import (
+            GL_COLOR_BUFFER_BIT, GL_DEPTH_BUFFER_BIT, GL_MODELVIEW,
+            GL_PROJECTION, GL_SCISSOR_TEST, glClear, glClearColor,
+            glDisable, glEnable, glLoadIdentity, glMatrixMode, glMultMatrixf,
+            glScissor, glTranslatef, glViewport,
+        )
+
+        glViewport(x0, y0, w, h)
+        glEnable(GL_SCISSOR_TEST)
+        glScissor(x0, y0, w, h)
+        bg = sub.background_color
+        glClearColor(bg[0], bg[1], bg[2], 1.0)
+        glClear(GL_COLOR_BUFFER_BIT | GL_DEPTH_BUFFER_BIT)
+        glDisable(GL_SCISSOR_TEST)
+        glMatrixMode(GL_PROJECTION)
+        glLoadIdentity()
+        glMultMatrixf(perspective_matrix(45.0, float(w) / max(h, 1), 0.1, 100.0))
+        glMatrixMode(GL_MODELVIEW)
+        glLoadIdentity()
+        glTranslatef(0.0, 0.0, -2.5)
+
     def render(self):
         """Draw every subwindow into the current GL context (the reference
         on_draw loop, meshviewer.py:1122-1135, minus the buffer swap, which
         belongs to the window system driving this renderer)."""
-        from OpenGL.GL import (
-            GL_COLOR_BUFFER_BIT, GL_DEPTH_BUFFER_BIT, GL_MODELVIEW,
-            GL_PROJECTION, glClear, glClearColor, glLoadIdentity,
-            glLoadMatrixf, glMatrixMode, glMultMatrixf, glTranslatef,
-            glViewport, glScissor, GL_SCISSOR_TEST, glEnable, glDisable,
-        )
+        from OpenGL.GL import glMultMatrixf
 
         nx, ny = self.shape
         w_sub = self.width // ny
         h_sub = self.height // nx
-        glEnable(GL_SCISSOR_TEST)
         for r in range(nx):
             for c in range(ny):
                 sub = self.subwindows[r][c]
                 x0 = c * w_sub
                 y0 = (nx - 1 - r) * h_sub
-                glViewport(x0, y0, w_sub, h_sub)
-                glScissor(x0, y0, w_sub, h_sub)
-                bg = sub.background_color
-                glClearColor(bg[0], bg[1], bg[2], 1.0)
-                glClear(GL_COLOR_BUFFER_BIT | GL_DEPTH_BUFFER_BIT)
-                glMatrixMode(GL_PROJECTION)
-                glLoadIdentity()
-                glMultMatrixf(
-                    perspective_matrix(
-                        45.0, float(w_sub) / max(h_sub, 1), 0.1, 100.0
-                    )
-                )
-                glMatrixMode(GL_MODELVIEW)
-                glLoadIdentity()
-                glTranslatef(0.0, 0.0, -2.5)
+                self.setup_subwindow_view(sub, x0, y0, w_sub, h_sub)
                 glMultMatrixf(sub.transform)
                 self.draw_scene(sub)
-        glDisable(GL_SCISSOR_TEST)
 
     def draw_scene(self, sub):
         from OpenGL.GL import GL_LIGHTING, glDisable, glEnable, glPushMatrix, glPopMatrix, glScalef, glTranslatef
@@ -886,16 +894,36 @@ class MeshViewerSingle(Subwindow):
         self.y1_pct = y1_pct
         self.width_pct = width_pct
         self.height_pct = height_pct
+        self._window_size = None
         self._renderer = SceneRenderer(shape=(1, 1))
         self._renderer.subwindows[0][0] = self
 
+    @property
+    def window_size(self):
+        """(w, h) to size against a windowless GL context (EGL pbuffer)
+        instead of the live GLUT window.  Assigning also resizes the
+        internal renderer (read_pixels, label placement)."""
+        return self._window_size
+
+    @window_size.setter
+    def window_size(self, value):
+        self._window_size = value
+        if value is not None:
+            self._renderer.width, self._renderer.height = value
+
     def get_dimensions(self):
         """Pixel geometry of this subwindow inside the live GLUT window
-        (reference meshviewer.py:309-317)."""
-        from OpenGL.GLUT import GLUT_WINDOW_HEIGHT, GLUT_WINDOW_WIDTH, glutGet
+        (reference meshviewer.py:309-317), or inside the explicitly given
+        `window_size` when rendering without a window system."""
+        if self._window_size is not None:
+            win_w, win_h = self._window_size
+        else:
+            from OpenGL.GLUT import (
+                GLUT_WINDOW_HEIGHT, GLUT_WINDOW_WIDTH, glutGet,
+            )
 
-        win_w = glutGet(GLUT_WINDOW_WIDTH)
-        win_h = glutGet(GLUT_WINDOW_HEIGHT)
+            win_w = glutGet(GLUT_WINDOW_WIDTH)
+            win_h = glutGet(GLUT_WINDOW_HEIGHT)
         return {
             "window_width": win_w,
             "window_height": win_h,
@@ -909,22 +937,15 @@ class MeshViewerSingle(Subwindow):
         """Set up this subwindow's viewport + camera and draw its scene
         (reference meshviewer.py:320-365).  `transform` is the 4x4 modelview
         the caller accumulated (e.g. from an arcball)."""
-        from OpenGL.GL import (
-            GL_MODELVIEW, GL_PROJECTION, glLoadIdentity, glMatrixMode,
-            glMultMatrixf, glTranslatef, glViewport,
-        )
+        from OpenGL.GL import glMultMatrixf
 
         d = self.get_dimensions()
         w = max(int(d["subwindow_width"]), 1)
         h = max(int(d["subwindow_height"]), 1)
-        glViewport(int(d["subwindow_origin_x"]), int(d["subwindow_origin_y"]),
-                   w, h)
-        glMatrixMode(GL_PROJECTION)
-        glLoadIdentity()
-        glMultMatrixf(perspective_matrix(45.0, float(w) / h, 0.1, 100.0))
-        glMatrixMode(GL_MODELVIEW)
-        glLoadIdentity()
-        glTranslatef(0.0, 0.0, -2.5)
+        self._renderer.setup_subwindow_view(
+            self, int(d["subwindow_origin_x"]), int(d["subwindow_origin_y"]),
+            w, h,
+        )
         glMultMatrixf(np.asarray(transform, np.float32))
         self._renderer.draw_scene(self)
         if want_camera:

@@ -20,3 +20,21 @@ def test_register_scan_example(tmp_path):
     assert "surface error" in res.stdout
     assert (tmp_path / "fitted.ply").exists()
     assert (tmp_path / "scan.ply").exists()
+
+
+def test_measure_body_example(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = str(tmp_path / "body")
+    res = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(repo, "examples", "measure_body.py"),
+            "--batch", "2", "--out", out,
+        ],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "PYTHONPATH": repo},
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "chest" in res.stdout and "waist" in res.stdout
+    assert (tmp_path / "body.obj").exists()
+    assert (tmp_path / "body_curves.obj").exists()

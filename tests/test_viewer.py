@@ -580,6 +580,33 @@ print("OK")
 """)
         assert "OK" in out
 
+    def test_meshviewer_single_draws_into_context(self):
+        """The reference-compat MeshViewerSingle adapter renders a real
+        frame: its own viewport from pct coordinates + the shared
+        draw_scene path (reference meshviewer.py:291-365)."""
+        out = self._run("""
+import numpy as np
+from mesh_tpu.sphere import Sphere
+from mesh_tpu.viewer.offscreen import OffscreenContext
+from mesh_tpu.viewer.server import MeshViewerSingle
+from mesh_tpu.viewer.arcball import Matrix4fT
+m = Sphere(np.zeros(3), 1.0).to_mesh()
+m.set_vertex_colors("red")
+with OffscreenContext(width=128, height=64):
+    s = MeshViewerSingle(0.0, 0.0, 0.5, 1.0)   # left half of the window
+    s.window_size = (128, 64)
+    s._renderer.setup_gl_state()
+    s.dynamic_meshes = [m]
+    d = s.get_dimensions()
+    assert d['subwindow_width'] == 64.0, d
+    s.on_draw(Matrix4fT())
+    im = s._renderer.read_pixels()
+assert (im[32, 32] == [255, 0, 0]).all(), im[32, 32]   # sphere in left half
+assert not (im[32, 96] == [255, 0, 0]).all()           # right half untouched
+print("OK")
+""")
+        assert "OK" in out
+
     def test_labeled_mesh_renders_label(self):
         out = self._run("""
 import numpy as np
