@@ -13,6 +13,8 @@ import os
 from .core import MeshArrays  # noqa: F401
 from .mesh import Mesh  # noqa: F401
 
+__version__ = "0.2.0"          # keep in step with pyproject.toml
+
 texture_path = os.path.abspath(
     os.path.join(os.path.dirname(__file__), "ressources", "textures")
 )
