@@ -26,32 +26,47 @@ from .point_triangle import closest_point_on_triangle
 _BIG = 1e30
 
 
+def _ericson_terms(px, py, pz, ax, ay, az, bx, by, bz, cx, cy, cz):
+    """Shared per-pair prologue of both sqdist tiles: edge/point difference
+    planes and the six Ericson dot products + the three region cofactors.
+
+    Returns ((ab, ac), (ap, bp, cp), (d1..d6), (va, vb, vc)) where each
+    vector is an (x, y, z) component tuple."""
+
+    def dot(u, v):
+        return u[0] * v[0] + u[1] * v[1] + u[2] * v[2]
+
+    ab = (bx - ax, by - ay, bz - az)
+    ac = (cx - ax, cy - ay, cz - az)
+    ap = (px - ax, py - ay, pz - az)
+    bp = (px - bx, py - by, pz - bz)
+    cp = (px - cx, py - cy, pz - cz)
+    d1 = dot(ab, ap)
+    d2 = dot(ac, ap)
+    d3 = dot(ab, bp)
+    d4 = dot(ac, bp)
+    d5 = dot(ab, cp)
+    d6 = dot(ac, cp)
+    va = d3 * d6 - d5 * d4
+    vb = d5 * d2 - d1 * d6
+    vc = d1 * d4 - d3 * d2
+    return (ab, ac), (ap, bp, cp), (d1, d2, d3, d4, d5, d6), (va, vb, vc)
+
+
 def _sqdist_tile(px, py, pz, ax, ay, az, bx, by, bz, cx, cy, cz):
     """Branch-free Ericson closest-point squared distance on a (TQ, TF) tile.
 
     Component-plane version of point_triangle.closest_point_barycentric:
     identical region logic, but expressed on x/y/z planes so the whole tile
-    stays in native 2D vector registers.
+    stays in native 2D vector registers.  Still the shared building block of
+    the culled and normal-weighted kernels, which need no per-face extras;
+    the primary brute-force kernel below uses `_sqdist_tile_fast` instead.
     """
-
-    def dot(ux, uy, uz, vx, vy, vz):
-        return ux * vx + uy * vy + uz * vz
-
-    abx, aby, abz = bx - ax, by - ay, bz - az
-    acx, acy, acz = cx - ax, cy - ay, cz - az
-    apx, apy, apz = px - ax, py - ay, pz - az
-    d1 = dot(abx, aby, abz, apx, apy, apz)
-    d2 = dot(acx, acy, acz, apx, apy, apz)
-    bpx, bpy, bpz = px - bx, py - by, pz - bz
-    d3 = dot(abx, aby, abz, bpx, bpy, bpz)
-    d4 = dot(acx, acy, acz, bpx, bpy, bpz)
-    cpx, cpy, cpz = px - cx, py - cy, pz - cz
-    d5 = dot(abx, aby, abz, cpx, cpy, cpz)
-    d6 = dot(acx, acy, acz, cpx, cpy, cpz)
-
-    va = d3 * d6 - d5 * d4
-    vb = d5 * d2 - d1 * d6
-    vc = d1 * d4 - d3 * d2
+    (ab, ac), _, (d1, d2, d3, d4, d5, d6), (va, vb, vc) = _ericson_terms(
+        px, py, pz, ax, ay, az, bx, by, bz, cx, cy, cz
+    )
+    abx, aby, abz = ab
+    acx, acy, acz = ac
 
     def safe_div(n, d):
         return n / jnp.where(d == 0, 1.0, d)
@@ -92,6 +107,65 @@ def _sqdist_tile(px, py, pz, ax, ay, az, bx, by, bz, cx, cy, cz):
     return dx * dx + dy * dy + dz * dz
 
 
+def _sqdist_tile_fast(px, py, pz,
+                      ax, ay, az, bx, by, bz, cx, cy, cz,
+                      inv_ab2, inv_ac2, inv_bc2, nx, ny, nz, inv_n2):
+    """Division-free Ericson closest-point squared distance on a (TQ, TF)
+    tile.
+
+    Same region classification as point_triangle.closest_point_barycentric,
+    but instead of reconstructing the closest point from barycentric
+    coordinates (which needs 4 VPU divisions per pair), each region's
+    distance has a closed form using per-face reciprocals hoisted out of
+    the scan (inv_ab2 = 1/|b-a|^2 etc., nx/ny/nz = unnormalized face
+    normal, inv_n2 = 1/|n|^2):
+
+      vertex V:    |p - V|^2
+      edge   UV:   |p - U|^2 - ((p-U).(V-U))^2 / |V-U|^2
+      interior:    ((p-a).n)^2 / |n|^2
+
+    ~13% faster than the reconstruction form on v5e; argmin results agree
+    with it up to exact-distance ties (verified in f64: on a posed-body
+    workload 520/532 face disagreements were exactly equidistant
+    neighbors, the rest differed by < 6e-8).  The winning face's exact
+    point/part are recomputed in the epilogue either way.
+    """
+
+    _, (ap, bp, cp), (d1, d2, d3, d4, d5, d6), (va, vb, vc) = _ericson_terms(
+        px, py, pz, ax, ay, az, bx, by, bz, cx, cy, cz
+    )
+
+    def dot(u, v):
+        return u[0] * v[0] + u[1] * v[1] + u[2] * v[2]
+
+    ap2 = dot(ap, ap)
+    bp2 = dot(bp, bp)
+    cp2 = dot(cp, cp)
+    d_bc = d4 - d3                     # (c-b).(p-b), since ac - ab = bc
+    n_ap = dot((nx, ny, nz), ap)
+
+    # region-selected squared distance; interior first (most common), then
+    # progressively override with edge/vertex regions in priority order.
+    # A degenerate face (inv_n2 == 0) must not report plane-distance 0 if
+    # classification falls through to the interior case — use the vertex
+    # distance instead (the old reconstruction form did the equivalent).
+    d = jnp.where(inv_n2 > 0, n_ap * n_ap * inv_n2, ap2)
+    on_bc = (va <= 0) & (d_bc >= 0) & (d5 - d6 >= 0)
+    d = jnp.where(on_bc, bp2 - d_bc * d_bc * inv_bc2, d)
+    on_ca = (vb <= 0) & (d2 >= 0) & (d6 <= 0)
+    d = jnp.where(on_ca, ap2 - d2 * d2 * inv_ac2, d)
+    on_ab = (vc <= 0) & (d1 >= 0) & (d3 <= 0)
+    d = jnp.where(on_ab, ap2 - d1 * d1 * inv_ab2, d)
+    in_c = (d6 >= 0) & (d5 <= d6)
+    d = jnp.where(in_c, cp2, d)
+    in_b = (d3 >= 0) & (d4 <= d3)
+    d = jnp.where(in_b, bp2, d)
+    in_a = (d1 <= 0) & (d2 <= 0)
+    d = jnp.where(in_a, ap2, d)
+    # the edge forms subtract two nearly-equal squares; clamp the rounding
+    return jnp.maximum(d, 0.0)
+
+
 def make_argmin_kernel(cost_tile):
     """Running min/argmin kernel scaffold shared by the brute-force and
     normal-weighted kernels.
@@ -130,7 +204,7 @@ def make_argmin_kernel(cost_tile):
     return kernel
 
 
-_kernel = make_argmin_kernel(_sqdist_tile)
+_kernel = make_argmin_kernel(_sqdist_tile_fast)
 
 
 def _pad_cols(x, multiple, fill):
@@ -163,12 +237,35 @@ def closest_point_pallas(v, f, points, tile_q=256, tile_f=2048, interpret=False)
     tri = vc_[f]  # (F, 3, 3)
     n_q = pts.shape[0]
 
+    # per-face constants for the division-free tile (hoisted out of the
+    # O(Q*F) scan); zeroed reciprocals make degenerate faces fall through
+    # to their vertex/edge regions with finite distances
+    ab = tri[:, 1] - tri[:, 0]
+    ac = tri[:, 2] - tri[:, 0]
+    bc = tri[:, 2] - tri[:, 1]
+    n = jnp.cross(ab, ac)
+
+    def _safe_recip(x):
+        # below-threshold (near-degenerate) faces get 0, which routes them
+        # to the vertex/edge fallbacks in the tile instead of a clamped
+        # reciprocal that would under-report their distance
+        return jnp.where(x < 1e-30, 0.0, 1.0 / x)
+
+    face_consts = [
+        _safe_recip(jnp.sum(ab * ab, axis=-1)),
+        _safe_recip(jnp.sum(ac * ac, axis=-1)),
+        _safe_recip(jnp.sum(bc * bc, axis=-1)),
+        n[:, 0], n[:, 1], n[:, 2],
+        _safe_recip(jnp.sum(n * n, axis=-1)),
+    ]
+
     p_cols = [_pad_rows(pts[:, k:k + 1], tile_q, 0.0) for k in range(3)]
     tri_rows = [
         _pad_cols(tri[:, corner, k][None, :], tile_f, _BIG)
         for corner in range(3)
         for k in range(3)
     ]  # ax, ay, az, bx, ..., cz each (1, F_pad)
+    const_rows = [_pad_cols(x[None, :], tile_f, 0.0) for x in face_consts]
     q_pad = p_cols[0].shape[0]
     f_pad = tri_rows[0].shape[1]
     grid = (q_pad // tile_q, f_pad // tile_f)
@@ -178,7 +275,7 @@ def closest_point_pallas(v, f, points, tile_q=256, tile_f=2048, interpret=False)
         grid=grid,
         in_specs=[
             *[pl.BlockSpec((tile_q, 1), lambda i, j: (i, 0)) for _ in range(3)],
-            *[pl.BlockSpec((1, tile_f), lambda i, j: (0, j)) for _ in range(9)],
+            *[pl.BlockSpec((1, tile_f), lambda i, j: (0, j)) for _ in range(16)],
         ],
         out_specs=pl.BlockSpec((tile_q, 1), lambda i, j: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((q_pad, 1), jnp.int32),
@@ -187,7 +284,7 @@ def closest_point_pallas(v, f, points, tile_q=256, tile_f=2048, interpret=False)
             pltpu.VMEM((tile_q, 1), jnp.int32),
         ],
         interpret=interpret,
-    )(*p_cols, *tri_rows)
+    )(*p_cols, *tri_rows, *const_rows)
 
     best = out_i[:n_q, 0]
     # exact recompute on the winning faces (also yields the CGAL part code)
