@@ -58,9 +58,9 @@ def _sqdist_tile(px, py, pz, ax, ay, az, bx, by, bz, cx, cy, cz):
 
     Component-plane version of point_triangle.closest_point_barycentric:
     identical region logic, but expressed on x/y/z planes so the whole tile
-    stays in native 2D vector registers.  Still the shared building block of
-    the culled and normal-weighted kernels, which need no per-face extras;
-    the primary brute-force kernel below uses `_sqdist_tile_fast` instead.
+    stays in native 2D vector registers.  Only the culled kernel still uses
+    this form (its exact tile takes no per-face extras); the brute-force and
+    normal-weighted kernels use `_sqdist_tile_fast`.
     """
     (ab, ac), _, (d1, d2, d3, d4, d5, d6), (va, vb, vc) = _ericson_terms(
         px, py, pz, ax, ay, az, bx, by, bz, cx, cy, cz
@@ -214,6 +214,32 @@ def _pad_cols(x, multiple, fill):
     return x
 
 
+def _face_const_rows(tri, tile_f):
+    """The seven (1, F_pad) per-face constant planes `_sqdist_tile_fast`
+    consumes, hoisted out of the O(Q*F) scan: inv_ab2, inv_ac2, inv_bc2,
+    nx, ny, nz, inv_n2.  Zeroed reciprocals route degenerate faces to
+    their vertex/edge regions with finite distances."""
+    ab = tri[:, 1] - tri[:, 0]
+    ac = tri[:, 2] - tri[:, 0]
+    bc = tri[:, 2] - tri[:, 1]
+    n = jnp.cross(ab, ac)
+
+    def _safe_recip(x):
+        # below-threshold (near-degenerate) faces get 0, which routes them
+        # to the vertex/edge fallbacks in the tile instead of a clamped
+        # reciprocal that would under-report their distance
+        return jnp.where(x < 1e-30, 0.0, 1.0 / x)
+
+    face_consts = [
+        _safe_recip(jnp.sum(ab * ab, axis=-1)),
+        _safe_recip(jnp.sum(ac * ac, axis=-1)),
+        _safe_recip(jnp.sum(bc * bc, axis=-1)),
+        n[:, 0], n[:, 1], n[:, 2],
+        _safe_recip(jnp.sum(n * n, axis=-1)),
+    ]
+    return [_pad_cols(x[None, :], tile_f, 0.0) for x in face_consts]
+
+
 def _pad_rows(x, multiple, fill):
     pad = (-x.shape[0]) % multiple
     if pad:
@@ -237,35 +263,13 @@ def closest_point_pallas(v, f, points, tile_q=256, tile_f=2048, interpret=False)
     tri = vc_[f]  # (F, 3, 3)
     n_q = pts.shape[0]
 
-    # per-face constants for the division-free tile (hoisted out of the
-    # O(Q*F) scan); zeroed reciprocals make degenerate faces fall through
-    # to their vertex/edge regions with finite distances
-    ab = tri[:, 1] - tri[:, 0]
-    ac = tri[:, 2] - tri[:, 0]
-    bc = tri[:, 2] - tri[:, 1]
-    n = jnp.cross(ab, ac)
-
-    def _safe_recip(x):
-        # below-threshold (near-degenerate) faces get 0, which routes them
-        # to the vertex/edge fallbacks in the tile instead of a clamped
-        # reciprocal that would under-report their distance
-        return jnp.where(x < 1e-30, 0.0, 1.0 / x)
-
-    face_consts = [
-        _safe_recip(jnp.sum(ab * ab, axis=-1)),
-        _safe_recip(jnp.sum(ac * ac, axis=-1)),
-        _safe_recip(jnp.sum(bc * bc, axis=-1)),
-        n[:, 0], n[:, 1], n[:, 2],
-        _safe_recip(jnp.sum(n * n, axis=-1)),
-    ]
-
     p_cols = [_pad_rows(pts[:, k:k + 1], tile_q, 0.0) for k in range(3)]
     tri_rows = [
         _pad_cols(tri[:, corner, k][None, :], tile_f, _BIG)
         for corner in range(3)
         for k in range(3)
     ]  # ax, ay, az, bx, ..., cz each (1, F_pad)
-    const_rows = [_pad_cols(x[None, :], tile_f, 0.0) for x in face_consts]
+    const_rows = _face_const_rows(tri, tile_f)
     q_pad = p_cols[0].shape[0]
     f_pad = tri_rows[0].shape[1]
     grid = (q_pad // tile_q, f_pad // tile_f)

@@ -20,12 +20,16 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..geometry.tri_normals import tri_normals
-from .pallas_closest import _BIG, _pad_cols, _pad_rows, _sqdist_tile
+from .pallas_closest import (
+    _BIG, _face_const_rows, _pad_cols, _pad_rows, _sqdist_tile_fast,
+)
 from .point_triangle import closest_point_on_triangle
 
 
 def _nw_kernel(eps, px, py, pz, qnx, qny, qnz,
-               ax, ay, az, bx, by, bz, cx, cy, cz, tnx, tny, tnz,
+               ax, ay, az, bx, by, bz, cx, cy, cz,
+               inv_ab2, inv_ac2, inv_bc2, nx, ny, nz, inv_n2,
+               tnx, tny, tnz,
                out_i, acc_d, acc_i):
     j = pl.program_id(1)
     n_j = pl.num_programs(1)
@@ -35,9 +39,10 @@ def _nw_kernel(eps, px, py, pz, qnx, qny, qnz,
         acc_d[:] = jnp.full_like(acc_d, _BIG)
         acc_i[:] = jnp.zeros_like(acc_i)
 
-    d2 = _sqdist_tile(
+    d2 = _sqdist_tile_fast(
         px[:], py[:], pz[:], ax[:], ay[:], az[:],
         bx[:], by[:], bz[:], cx[:], cy[:], cz[:],
+        inv_ab2[:], inv_ac2[:], inv_bc2[:], nx[:], ny[:], nz[:], inv_n2[:],
     )  # (TQ, TF)
     ndot = qnx[:] * tnx[:] + qny[:] * tny[:] + qnz[:] * tnz[:]
     cost = jnp.sqrt(d2) + eps * (1.0 - ndot)
@@ -81,6 +86,7 @@ def nearest_normal_weighted_pallas(v, f, points, normals, eps=0.1,
         for corner in range(3)
         for k in range(3)
     ]
+    const_rows = _face_const_rows(tri, tile_f)
     # padded faces get a zero normal: their penalty is eps, but their
     # distance to any query is ~_BIG, so they can never win
     tn_rows = [_pad_cols(tn[:, k][None, :], tile_f, 0.0) for k in range(3)]
@@ -93,7 +99,7 @@ def nearest_normal_weighted_pallas(v, f, points, normals, eps=0.1,
         grid=grid,
         in_specs=[
             *[pl.BlockSpec((tile_q, 1), lambda i, j: (i, 0)) for _ in range(6)],
-            *[pl.BlockSpec((1, tile_f), lambda i, j: (0, j)) for _ in range(12)],
+            *[pl.BlockSpec((1, tile_f), lambda i, j: (0, j)) for _ in range(19)],
         ],
         out_specs=pl.BlockSpec((tile_q, 1), lambda i, j: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((q_pad, 1), jnp.int32),
@@ -102,7 +108,7 @@ def nearest_normal_weighted_pallas(v, f, points, normals, eps=0.1,
             pltpu.VMEM((tile_q, 1), jnp.int32),
         ],
         interpret=interpret,
-    )(*p_cols, *n_cols, *tri_rows, *tn_rows)
+    )(*p_cols, *n_cols, *tri_rows, *const_rows, *tn_rows)
 
     best = out_i[:n_q, 0]
     a, b, c = tri[:, 0], tri[:, 1], tri[:, 2]
