@@ -1,0 +1,48 @@
+# Makefile for mesh_tpu — same targets as the reference package's Makefile
+# (all / import_tests / unit_tests / tests / sdist / wheel / documentation /
+# clean, reference Makefile:4-45), adapted to the pyproject build: there is
+# no CGAL/Boost machinery to configure, and the native I/O core compiles
+# itself on first use.
+package_name := mesh_tpu
+
+all:
+	@echo "----- [ ${package_name} ] Installing with `which python`"
+	@pip install --upgrade .
+
+import_tests:
+	@echo "----- [ ${package_name} ] Performing import tests"
+	@MESH_TPU_CACHE=`mktemp -d -t mesh_tpu.XXXXXXXXXX` python -c "from mesh_tpu import Mesh"
+	@python -c "from psbody.mesh.mesh import Mesh"
+	@python -c "from mesh_tpu.viewer import MeshViewers"
+	@echo "----- [ ${package_name} ] OK import tests"
+
+unit_tests:
+	@echo "----- [ ${package_name} ] Running pytest (virtual 8-device CPU platform)"
+	@MESH_TPU_CACHE=`mktemp -d -t mesh_tpu.XXXXXXXXXX` python -m pytest tests/ -q
+
+tpu_tests:
+	@echo "----- [ ${package_name} ] Compiled-kernel tests on the real chip"
+	@MESH_TPU_TEST_TPU=1 python -m pytest tests/test_tpu_compiled.py -m tpu -q
+
+tests: import_tests unit_tests
+
+bench:
+	@python bench.py
+
+sdist:
+	@echo "----- [ ${package_name} ] Creating the source distribution"
+	@python -m build --sdist
+
+wheel:
+	@echo "----- [ ${package_name} ] Creating the wheel distribution"
+	@pip wheel --no-deps -w dist .
+
+documentation:
+	@echo "----- [ ${package_name} ] API map is generated, not Sphinx-built"
+	@python tools/gen_parity_map.py > PARITY.md
+	@echo "wrote PARITY.md"
+
+clean:
+	@rm -rf build dist *.egg-info
+
+.PHONY: all import_tests unit_tests tpu_tests tests bench sdist wheel documentation clean

@@ -105,42 +105,70 @@ def qslim_decimator_transformer(mesh, factor=None, n_verts_desired=None):
         dc, dr, _ = collapse_cost(r, c)
         heapq.heappush(queue, (min(dc, dr), (r, c)))
 
-    faces = np.asarray(mesh.f, dtype=np.int64).copy()
-    nverts_total = len(mesh.v)
+    faces = np.asarray(mesh.f, dtype=np.int64)
+    # merged-vertex forest: heap entries keep their original endpoint ids
+    # and are canonicalized through find() on pop, so a collapse is
+    # O(log E) instead of rewriting + re-heapifying the whole queue
+    parent = np.arange(len(mesh.v))
+
+    def find(x):
+        root = x
+        while parent[root] != root:
+            root = parent[root]
+        while parent[x] != root:        # path compression
+            parent[x], x = root, parent[x]
+        return root
+
+    def live_vertex_count():
+        """Exact count of vertices still referenced by a non-degenerate
+        face under the current merges (what the pre-union-find code
+        recomputed every iteration)."""
+        remapped = np.array([find(i) for i in range(len(parent))])[faces]
+        alive = ~(
+            (remapped[:, 0] == remapped[:, 1])
+            | (remapped[:, 1] == remapped[:, 2])
+            | (remapped[:, 2] == remapped[:, 0])
+        )
+        return len(np.unique(remapped[alive]))
+
+    nverts_total = len(np.unique(faces))
+    since_resync = 0
     while nverts_total > n_verts_desired and queue:
-        cost0, (r, c) = heapq.heappop(queue)
+        cost0, (r0, c0) = heapq.heappop(queue)
+        r, c = find(r0), find(c0)
         if r == c:
             continue
+        if r > c:
+            r, c = c, r
         dc, dr, Qsum = collapse_cost(r, c)
         if min(dc, dr) > cost0:
             # stale entry: re-push with the fresh cost (lazy-deletion heap)
             heapq.heappush(queue, (min(dc, dr), (r, c)))
             continue
         to_keep, to_destroy = (r, c) if dc < dr else (c, r)
-
-        np.place(faces, faces == to_destroy, to_keep)
-        # rewrite queue entries touching the destroyed vertex
-        queue = [
-            (
-                cost,
-                (
-                    to_keep if e0 == to_destroy else e0,
-                    to_keep if e1 == to_destroy else e1,
-                ),
-            )
-            for cost, (e0, e1) in queue
-        ]
-        heapq.heapify(queue)
+        parent[to_destroy] = to_keep
         Qv[r] = Qsum
         Qv[c] = Qsum
+        # a collapse merges two live face-vertices, but can also orphan
+        # others by degenerating all their faces — decrement optimistically
+        # and resync the exact count periodically and near the target
+        nverts_total -= 1
+        since_resync += 1
+        if since_resync >= 64 or nverts_total <= n_verts_desired:
+            nverts_total = live_vertex_count()
+            since_resync = 0
 
-        degenerate = (
-            (faces[:, 0] == faces[:, 1])
-            | (faces[:, 1] == faces[:, 2])
-            | (faces[:, 2] == faces[:, 0])
-        )
-        faces = faces[~degenerate].copy()
-        nverts_total = len(np.unique(faces.flatten()))
+    # apply all merges to the faces at once, then drop collapsed faces
+    remap = np.empty(len(parent), dtype=np.int64)
+    for i in range(len(parent)):
+        remap[i] = find(i)
+    faces = remap[faces]
+    degenerate = (
+        (faces[:, 0] == faces[:, 1])
+        | (faces[:, 1] == faces[:, 2])
+        | (faces[:, 2] == faces[:, 0])
+    )
+    faces = faces[~degenerate]
 
     return _get_sparse_transform(faces, len(mesh.v))
 

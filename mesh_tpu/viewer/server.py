@@ -257,7 +257,11 @@ class SceneRenderer(object):
                 glMultMatrixf(sub.transform)
                 self.draw_scene(sub)
 
-    def draw_scene(self, sub):
+    def draw_scene(self, sub, want_camera=False):
+        """Draw one subwindow's meshes/lines under its recenter transform.
+        With ``want_camera`` the GL camera is captured while that transform
+        is still applied (like the reference, meshviewer.py:593-598), so the
+        caller can unproject clicks against the drawn geometry."""
         from OpenGL.GL import GL_LIGHTING, glDisable, glEnable, glPushMatrix, glPopMatrix, glScalef, glTranslatef
 
         meshes = sub.all_meshes()
@@ -280,7 +284,24 @@ class SceneRenderer(object):
             self.draw_mesh(m)
         for l in lines:
             self.draw_lines(l)
+        camera = self.current_camera() if want_camera else None
         glPopMatrix()
+        return camera
+
+    def current_camera(self):
+        """The GL camera state a caller needs to unproject clicks
+        (reference draw_primitives' want_camera dict, meshviewer.py:557-567).
+        """
+        from OpenGL.GL import (
+            GL_MODELVIEW_MATRIX, GL_PROJECTION_MATRIX, GL_VIEWPORT,
+            glGetDoublev, glGetIntegerv,
+        )
+
+        return {
+            "modelview_matrix": glGetDoublev(GL_MODELVIEW_MATRIX),
+            "projection_matrix": glGetDoublev(GL_PROJECTION_MATRIX),
+            "viewport": [int(x) for x in glGetIntegerv(GL_VIEWPORT)],
+        }
 
     def _texture_id_for(self, m):
         """GL texture id for the mesh's texture image, uploading (and
@@ -947,35 +968,42 @@ class MeshViewerSingle(Subwindow):
             w, h,
         )
         glMultMatrixf(np.asarray(transform, np.float32))
-        self._renderer.draw_scene(self)
+        camera = self._renderer.draw_scene(self, want_camera=want_camera)
         if want_camera:
-            from OpenGL.GL import (
-                GL_MODELVIEW_MATRIX, GL_PROJECTION_MATRIX, glGetDoublev,
-            )
-
-            return {
-                "modelview_matrix": glGetDoublev(GL_MODELVIEW_MATRIX),
-                "projection_matrix": glGetDoublev(GL_PROJECTION_MATRIX),
-                "viewport": [int(d["subwindow_origin_x"]),
-                             int(d["subwindow_origin_y"]), w, h],
-            }
+            return camera
 
     def draw_primitives_recentered(self, want_camera=False):
-        prev = self.autorecenter
-        self.autorecenter = True
-        try:
-            self._renderer.draw_scene(self)
-        finally:
-            self.autorecenter = prev
+        return self.draw_primitives(recenter=True, want_camera=want_camera)
 
     def draw_primitives(self, scalefactor=1.0, center=None,
                         recenter=False, want_camera=False):
+        """Draw this subwindow's primitives; with ``center`` (and no
+        recenter) the reference's explicit view transform is applied —
+        scale by 1/scalefactor then translate by -center
+        (meshviewer.py:585-590).  The want_camera dict is captured with
+        whichever transform was in effect."""
+        from OpenGL.GL import glPopMatrix, glPushMatrix, glScalef, glTranslatef
+
         prev = self.autorecenter
         self.autorecenter = bool(recenter)
         try:
-            self._renderer.draw_scene(self)
+            if not recenter and center is not None:
+                glPushMatrix()
+                s = 1.0 / scalefactor if scalefactor else 1.0
+                glScalef(s, s, s)
+                glTranslatef(-center[0], -center[1], -center[2])
+                camera = self._renderer.draw_scene(
+                    self, want_camera=want_camera
+                )
+                glPopMatrix()
+            else:
+                camera = self._renderer.draw_scene(
+                    self, want_camera=want_camera
+                )
         finally:
             self.autorecenter = prev
+        if want_camera:
+            return camera
 
     def set_texture(self, m):
         """Upload the mesh's texture image as a GL texture now (reference

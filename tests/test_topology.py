@@ -120,10 +120,12 @@ class TestQslim:
 
         v, f = smpl_sized_sphere()
         m = Mesh(v=v, f=f)
-        t0 = time.perf_counter()
+        # process_time: immune to machine load (the suite may share the box
+        # with benchmark runs), still fails on a complexity regression
+        t0 = time.process_time()
         dec = qslim_decimator_fast(m, n_verts_desired=700)
-        elapsed = time.perf_counter() - t0
-        assert elapsed < 60, "decimation took %.1fs" % elapsed
+        elapsed = time.process_time() - t0
+        assert elapsed < 30, "decimation burned %.1fs CPU" % elapsed
         assert dec.v.shape[0] <= 720
         # no face may collapse to a repeated vertex
         df = np.asarray(dec.f, np.int64)

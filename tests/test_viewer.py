@@ -599,7 +599,9 @@ with OffscreenContext(width=128, height=64):
     s.dynamic_meshes = [m]
     d = s.get_dimensions()
     assert d['subwindow_width'] == 64.0, d
-    s.on_draw(Matrix4fT())
+    cam = s.on_draw(Matrix4fT(), want_camera=True)
+    assert cam['viewport'] == [0, 0, 64, 64], cam['viewport']
+    assert cam['projection_matrix'].shape == (4, 4)
     im = s._renderer.read_pixels()
 assert (im[32, 32] == [255, 0, 0]).all(), im[32, 32]   # sphere in left half
 assert not (im[32, 96] == [255, 0, 0]).all()           # right half untouched
