@@ -119,11 +119,23 @@ def qslim_decimator_transformer(mesh, factor=None, n_verts_desired=None):
             parent[x], x = root, parent[x]
         return root
 
+    def resolve_all():
+        """Vectorized find() for every vertex via pointer doubling
+        (O(log depth) rounds), writing the fully-compressed forest back so
+        later resyncs and scalar find() calls start from depth 1."""
+        remap = parent[parent]
+        while True:
+            nxt = remap[remap]
+            if np.array_equal(nxt, remap):
+                parent[:] = remap
+                return remap
+            remap = nxt
+
     def live_vertex_count():
         """Exact count of vertices still referenced by a non-degenerate
         face under the current merges (what the pre-union-find code
         recomputed every iteration)."""
-        remapped = np.array([find(i) for i in range(len(parent))])[faces]
+        remapped = resolve_all()[faces]
         alive = ~(
             (remapped[:, 0] == remapped[:, 1])
             | (remapped[:, 1] == remapped[:, 2])
@@ -159,10 +171,7 @@ def qslim_decimator_transformer(mesh, factor=None, n_verts_desired=None):
             since_resync = 0
 
     # apply all merges to the faces at once, then drop collapsed faces
-    remap = np.empty(len(parent), dtype=np.int64)
-    for i in range(len(parent)):
-        remap[i] = find(i)
-    faces = remap[faces]
+    faces = resolve_all()[faces]
     degenerate = (
         (faces[:, 0] == faces[:, 1])
         | (faces[:, 1] == faces[:, 2])

@@ -88,6 +88,24 @@ class TestShardedQueries:
         )
         assert sharded["face"].max() < 77
 
+    def test_face_sharded_fewer_faces_than_shards(self):
+        # 5 faces over 8 devices: three shards hold only padded duplicates
+        rng = np.random.RandomState(5)
+        v = np.array([[0, 0, 0], [1, 0, 0], [0, 1, 0], [1, 1, 0],
+                      [2, 0, 0], [2, 1, 0], [0, 2, 0]], np.float32)
+        f = np.array([[0, 1, 2], [1, 3, 2], [1, 4, 3], [4, 5, 3],
+                      [2, 3, 6]], np.int32)
+        points = rng.randn(13, 3).astype(np.float32)
+        mesh = make_device_mesh(8, ("dp",))
+        sharded = sharded_closest_faces_sharded_topology(
+            v, f, points, mesh, chunk=8
+        )
+        single = closest_faces_and_points(v, f, points, chunk=8)
+        np.testing.assert_allclose(
+            sharded["sqdist"], np.asarray(single["sqdist"]), atol=1e-6
+        )
+        assert sharded["face"].max() < 5 and sharded["face"].min() >= 0
+
     def test_non_divisible_query_count(self):
         rng = np.random.RandomState(1)
         v, f = icosphere(1)
