@@ -188,7 +188,62 @@ def cpu_baseline(model, betas, pose, queries, n_meshes=4):
     return per_mesh * BATCH
 
 
+def backend_responsive(probe_timeout=150, attempts=3):
+    """(ok, reason): whether a throwaway subprocess can init the jax backend
+    and run a tiny computation.  The axon TPU tunnel can wedge so hard that
+    jax.devices() blocks forever *in-process* (observed 2026-07-29 after
+    two processes shared the chip); probing in a killable child is the only
+    way to avoid hanging the caller."""
+    import subprocess
+
+    reason = "unknown"
+    for attempt in range(attempts):
+        proc = subprocess.Popen(
+            [sys.executable, "-c",
+             "import jax, jax.numpy as jnp;"
+             "print(float(jnp.ones((8, 8)).sum()))"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True,
+        )
+        try:
+            _, err = proc.communicate(timeout=probe_timeout)
+            if proc.returncode == 0:
+                return True, ""
+            tail = (err or "").strip().splitlines()
+            reason = "probe exited %d: %s" % (
+                proc.returncode, tail[-1] if tail else "no stderr"
+            )
+        except subprocess.TimeoutExpired:
+            reason = "probe hung > %ds (backend init blocked)" % probe_timeout
+            proc.kill()
+            try:
+                # a child stuck in uninterruptible device I/O may not even
+                # die on SIGKILL; give up on reaping rather than block here
+                proc.communicate(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+        log("backend probe %d/%d failed: %s" % (attempt + 1, attempts, reason))
+        if attempt < attempts - 1:
+            time.sleep(20)
+    return False, reason
+
+
 def main():
+    ok, reason = backend_responsive()
+    if not ok:
+        # one honest JSON line beats a driver-side timeout with no record
+        print(
+            json.dumps(
+                {
+                    "metric": "batch256_smpl_normals_plus_closest_point",
+                    "value": 0,
+                    "unit": "queries/sec",
+                    "vs_baseline": 0,
+                    "error": "jax backend probe failed, no measurement "
+                             "possible (%s)" % reason,
+                }
+            )
+        )
+        return
     elapsed, total_queries, out, model, betas, pose, queries = tpu_workload()
     qps = total_queries / elapsed
     cpu_total = cpu_baseline(model, betas, pose, queries)
