@@ -221,6 +221,11 @@ def backend_responsive(probe_timeout=150, attempts=3):
                 proc.communicate(timeout=10)
             except subprocess.TimeoutExpired:
                 pass
+            # a hang means the device session is wedged: more probes can't
+            # help, and starting another backend while the undead child may
+            # still hold the chip is the documented wedge trigger itself
+            log("backend probe %d/%d hung: %s" % (attempt + 1, attempts, reason))
+            break
         log("backend probe %d/%d failed: %s" % (attempt + 1, attempts, reason))
         if attempt < attempts - 1:
             time.sleep(20)
@@ -230,20 +235,21 @@ def backend_responsive(probe_timeout=150, attempts=3):
 def main():
     ok, reason = backend_responsive()
     if not ok:
-        # one honest JSON line beats a driver-side timeout with no record
+        # one honest JSON line beats a driver-side timeout with no record;
+        # null values (not 0) so metric collectors can't ingest a fake 0
         print(
             json.dumps(
                 {
                     "metric": "batch256_smpl_normals_plus_closest_point",
-                    "value": 0,
+                    "value": None,
                     "unit": "queries/sec",
-                    "vs_baseline": 0,
+                    "vs_baseline": None,
                     "error": "jax backend probe failed, no measurement "
                              "possible (%s)" % reason,
                 }
             )
         )
-        return
+        sys.exit(1)
     elapsed, total_queries, out, model, betas, pose, queries = tpu_workload()
     qps = total_queries / elapsed
     cpu_total = cpu_baseline(model, betas, pose, queries)

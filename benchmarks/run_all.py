@@ -331,6 +331,15 @@ def config5():
 
 
 def main():
+    from bench import backend_responsive
+
+    ok, reason = backend_responsive()
+    if not ok:
+        # the wedged-tunnel guard (bench.py): fail fast with a record
+        # instead of hanging inside the first config's backend init
+        print(json.dumps({"suite": "baseline_configs", "results": [],
+                          "error": "jax backend probe failed: %s" % reason}))
+        sys.exit(1)
     results = []
     for cfg in (config1, config2, config3, config4, config5):
         try:
