@@ -1,0 +1,69 @@
+"""Self-audit for incidental source overlap with the reference package.
+
+    python tools/check_overlap.py [threshold]
+
+For every Python file in this repo, finds the reference file (same name, or
+any reference file) with the highest stripped-line overlap and prints files
+above the threshold (default 0.30).  "Stripped" = whitespace-normalized,
+comment-free, non-empty lines.  Delegation one-liners and file-format
+constants overlap unavoidably; anything high here should be re-derived or
+consciously documented.
+"""
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REFERENCE = "/root/reference"
+
+
+def stripped_lines(path):
+    out = []
+    for line in open(path, encoding="utf-8", errors="ignore"):
+        s = re.sub(r"\s+", " ", line.strip())
+        if s and not s.startswith("#"):
+            out.append(s)
+    return out
+
+
+def collect(root, skip_dirs=()):
+    files = {}
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames
+                       if d not in skip_dirs and d != "__pycache__"]
+        for fn in filenames:
+            if fn.endswith(".py"):
+                p = os.path.join(dirpath, fn)
+                files[os.path.relpath(p, root)] = stripped_lines(p)
+    return files
+
+
+def main():
+    threshold = float(sys.argv[1]) if len(sys.argv) > 1 else 0.30
+    ours = collect(REPO, skip_dirs=(".git", "tests"))
+    refs = collect(REFERENCE, skip_dirs=(".git",))
+    ref_sets = {rel: set(lines) for rel, lines in refs.items()}
+
+    rows = []
+    for rel, lines in sorted(ours.items()):
+        if len(lines) < 20:
+            continue
+        best_frac, best_ref = 0.0, ""
+        for ref_rel, ref_set in ref_sets.items():
+            ov = sum(1 for l in lines if l in ref_set)
+            frac = ov / len(lines)
+            if frac > best_frac:
+                best_frac, best_ref = frac, ref_rel
+        if best_frac >= threshold:
+            rows.append((best_frac, rel, best_ref))
+
+    for frac, rel, ref_rel in sorted(rows, reverse=True):
+        print("%5.0f%%  %-50s  vs %s" % (frac * 100, rel, ref_rel))
+    if not rows:
+        print("no files at or above %.0f%% overlap" % (threshold * 100))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
