@@ -52,6 +52,8 @@ class Mesh(object):
 
         if basename is not None:
             self.basename = basename
+        elif getattr(self, "basename", None):
+            pass                        # a loader set it (e.g. JSON 'name')
         elif filename is not None:
             base = os.path.basename(filename)
             self.basename = os.path.splitext(base)[0]
@@ -548,6 +550,9 @@ class Mesh(object):
 
     def load_from_ply(self, filename):
         serialization.load_from_ply(self, filename)
+
+    def load_from_json(self, filename):
+        serialization.load_from_json(self, filename)
 
     def load_from_obj(self, filename, use_native=False):
         serialization.load_from_obj(self, filename, use_native=use_native)

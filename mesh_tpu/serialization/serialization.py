@@ -23,7 +23,7 @@ __all__ = [
     "load_from_obj", "load_from_obj_cpp", "write_obj", "write_mtl",
     "write_json", "write_three_json",
     "set_landmark_indices_from_ppfile", "set_landmark_indices_from_lmrkfile",
-    "load_from_ply", "load_from_file", "write_ply",
+    "load_from_ply", "load_from_file", "load_from_json", "write_ply",
     "set_landmark_indices_from_any",
 ]
 
@@ -102,8 +102,45 @@ def load_from_file(self, filename, use_cpp=True):
         self.load_from_ply(filename)
     elif re.search(".obj$", filename):
         load_from_obj(self, filename, use_native=use_cpp)
+    elif re.search(".json$", filename):
+        load_from_json(self, filename)
     else:
         raise NotImplementedError("Unknown mesh file format.")
+
+
+def load_from_json(self, filename):
+    """Read the plain-JSON dump produced by write_json.  The reference
+    treats JSON as write-only (serialization.py:282-326 has no loader);
+    round-tripping it makes the format actually usable for interchange.
+    """
+    try:
+        with open(filename, "r") as fp:
+            data = json.load(fp)
+    except (OSError, ValueError) as exc:
+        raise SerializationError("Failed to load JSON mesh %s: %s"
+                                 % (filename, exc))
+    if not isinstance(data, dict) or "vertices" not in data:
+        raise SerializationError(
+            "JSON mesh %s has no 'vertices' key" % filename
+        )
+    if "metadata" in data or (
+        data["vertices"] and not isinstance(data["vertices"][0], list)
+    ):
+        # three.js models (write_three_json) store flat float/int streams;
+        # reshaping those would build garbage geometry
+        raise SerializationError(
+            "%s looks like a three.js model; only plain write_json output "
+            "can be loaded" % filename
+        )
+    try:
+        self.v = np.asarray(data["vertices"], np.float64).reshape(-1, 3)
+        if data.get("faces") is not None:
+            self.f = np.asarray(data["faces"], np.uint32).reshape(-1, 3)
+    except (TypeError, ValueError) as exc:
+        raise SerializationError("Malformed JSON mesh %s: %s"
+                                 % (filename, exc))
+    if data.get("name"):
+        self.basename = data["name"]
 
 
 def write_ply(self, filename, flip_faces=False, ascii=False,

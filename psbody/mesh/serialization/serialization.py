@@ -1,6 +1,7 @@
 """reference mesh/serialization/serialization.py surface."""
 from mesh_tpu.serialization.serialization import (  # noqa: F401
     load_from_file,
+    load_from_json,
     load_from_obj,
     load_from_obj_cpp,
     load_from_ply,

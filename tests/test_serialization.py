@@ -154,6 +154,52 @@ class TestObj:
         assert len(data["vertices"]) == 8
         assert len(data["faces"]) == 12
 
+    def test_json_roundtrip(self, tmp_path):
+        """JSON is write-only in the reference; here Mesh(filename=...)
+        reads write_json output back."""
+        v, f = box()
+        path = str(tmp_path / "m.json")
+        Mesh(v=v, f=f, basename="box").write_json(path)
+        m = Mesh(filename=path)
+        np.testing.assert_allclose(m.v, v)
+        np.testing.assert_array_equal(m.f, f)
+        assert m.f.dtype == np.uint32 and m.v.dtype == np.float64
+        assert m.basename == "box"
+
+    def test_json_loader_errors(self, tmp_path):
+        from mesh_tpu.errors import SerializationError
+
+        bad = tmp_path / "bad.json"
+        bad.write_text("{not json")
+        with pytest.raises(SerializationError, match="Failed to load"):
+            Mesh(filename=str(bad))
+        empty = tmp_path / "empty.json"
+        empty.write_text("{}")
+        with pytest.raises(SerializationError, match="no 'vertices'"):
+            Mesh(filename=str(empty))
+        scalar = tmp_path / "scalar.json"
+        scalar.write_text("42")
+        with pytest.raises(SerializationError, match="no 'vertices'"):
+            Mesh(filename=str(scalar))
+        ragged = tmp_path / "ragged.json"
+        ragged.write_text('{"vertices": [[0, 0], [1, 1, 1]]}')
+        with pytest.raises(SerializationError, match="Malformed"):
+            Mesh(filename=str(ragged))
+
+    def test_three_json_not_loadable(self, tmp_path):
+        v, f = box()
+        m = Mesh(v=v, f=f)
+        m.vt = np.zeros((8, 2))
+        m.ft = np.asarray(f).copy()
+        m.vn = m.estimate_vertex_normals()
+        m.fn = np.asarray(f).copy()
+        path = str(tmp_path / "three.json")
+        m.write_three_json(path)
+        from mesh_tpu.errors import SerializationError
+
+        with pytest.raises(SerializationError, match="three.js"):
+            Mesh(filename=path)
+
     def test_three_json(self, tmp_path):
         """three.js model v3.1 layout (reference serialization.py:232-280):
         flat vertex floats, type-42 face records of v/uv/normal indices."""

@@ -41,6 +41,11 @@ def collect(root, skip_dirs=()):
 
 def main():
     threshold = float(sys.argv[1]) if len(sys.argv) > 1 else 0.30
+    if not os.path.isdir(REFERENCE):
+        # an absent reference must not read as a clean bill of health
+        print("error: reference checkout not found at %s" % REFERENCE,
+              file=sys.stderr)
+        return 2
     ours = collect(REPO, skip_dirs=(".git", "tests"))
     refs = collect(REFERENCE, skip_dirs=(".git",))
     ref_sets = {rel: set(lines) for rel, lines in refs.items()}
@@ -62,7 +67,8 @@ def main():
         print("%5.0f%%  %-50s  vs %s" % (frac * 100, rel, ref_rel))
     if not rows:
         print("no files at or above %.0f%% overlap" % (threshold * 100))
-    return 0
+        return 0
+    return 1        # nonzero so CI can gate on a caller-chosen threshold
 
 
 if __name__ == "__main__":
