@@ -98,11 +98,11 @@ def load_from_ply(self, filename):
 
 
 def load_from_file(self, filename, use_cpp=True):
-    if re.search(".ply$", filename):
+    if re.search(r"\.ply$", filename):
         self.load_from_ply(filename)
-    elif re.search(".obj$", filename):
+    elif re.search(r"\.obj$", filename):
         load_from_obj(self, filename, use_native=use_cpp)
-    elif re.search(".json$", filename):
+    elif re.search(r"\.json$", filename):
         load_from_json(self, filename)
     else:
         raise NotImplementedError("Unknown mesh file format.")
@@ -123,19 +123,40 @@ def load_from_json(self, filename):
         raise SerializationError(
             "JSON mesh %s has no 'vertices' key" % filename
         )
-    if "metadata" in data or (
-        data["vertices"] and not isinstance(data["vertices"][0], list)
-    ):
+    verts = data["vertices"]
+    if not isinstance(verts, list):
+        raise SerializationError(
+            "JSON mesh %s: 'vertices' must be a list of xyz rows" % filename
+        )
+    if "metadata" in data or (verts and not isinstance(verts[0], list)):
         # three.js models (write_three_json) store flat float/int streams;
         # reshaping those would build garbage geometry
         raise SerializationError(
             "%s looks like a three.js model; only plain write_json output "
             "can be loaded" % filename
         )
+
+    def rows_of_3(value, dtype, what):
+        arr = np.asarray(value, dtype)
+        if arr.size == 0:
+            return arr.reshape(0, 3)
+        if arr.ndim != 2 or arr.shape[1] != 3:
+            raise SerializationError(
+                "Malformed JSON mesh %s: %s rows must have 3 entries, got "
+                "shape %s" % (filename, what, arr.shape)
+            )
+        return arr
+
     try:
-        self.v = np.asarray(data["vertices"], np.float64).reshape(-1, 3)
+        self.v = rows_of_3(verts, np.float64, "vertex")
         if data.get("faces") is not None:
-            self.f = np.asarray(data["faces"], np.uint32).reshape(-1, 3)
+            faces = rows_of_3(data["faces"], np.int64, "face")
+            if faces.size and (faces.min() < 0 or faces.max() >= len(self.v)):
+                raise SerializationError(
+                    "Malformed JSON mesh %s: face indices out of range"
+                    % filename
+                )
+            self.f = faces.astype(np.uint32)
     except (TypeError, ValueError) as exc:
         raise SerializationError("Malformed JSON mesh %s: %s"
                                  % (filename, exc))

@@ -185,6 +185,20 @@ class TestObj:
         ragged.write_text('{"vertices": [[0, 0], [1, 1, 1]]}')
         with pytest.raises(SerializationError, match="Malformed"):
             Mesh(filename=str(ragged))
+        wide = tmp_path / "wide.json"
+        wide.write_text('{"vertices": [[0, 0, 0, 0], [1, 1, 1, 1], [2, 2, 2, 2]]}')
+        with pytest.raises(SerializationError, match="3 entries"):
+            Mesh(filename=str(wide))
+        nonlist = tmp_path / "nonlist.json"
+        nonlist.write_text('{"vertices": 5}')
+        with pytest.raises(SerializationError, match="list of xyz"):
+            Mesh(filename=str(nonlist))
+        badface = tmp_path / "badface.json"
+        badface.write_text(
+            '{"vertices": [[0,0,0],[1,0,0],[0,1,0]], "faces": [[0,1,7]]}'
+        )
+        with pytest.raises(SerializationError, match="out of range"):
+            Mesh(filename=str(badface))
 
     def test_three_json_not_loadable(self, tmp_path):
         v, f = box()
