@@ -1,0 +1,84 @@
+"""Multi-device scan fitting on a sharded jax mesh.
+
+    # on real hardware (a TPU slice):
+    python examples/fit_multichip.py
+    # anywhere, on a virtual 8-device CPU mesh:
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu JAX_NUM_CPU_DEVICES=8 \
+        python examples/fit_multichip.py
+
+Fits a batch of body models to synthetic scans with the training step
+sharded data-parallel over bodies and sequence-parallel over scan points
+(dp x sp mesh), checkpoints the state with orbax, restores it, and
+verifies the restored fit resumes bit-identically.
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+# checkout-first: run THIS source tree even when mesh_tpu is installed
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--steps", type=int, default=60)
+    parser.add_argument("--ckpt", default="/tmp/fit_multichip_ckpt")
+    args = parser.parse_args()
+    if args.steps < 2:
+        parser.error("--steps must be >= 2 (fit halves around a checkpoint)")
+
+    import jax
+    import jax.numpy as jnp
+
+    from mesh_tpu.models import lbs, synthetic_body_model
+    from mesh_tpu.parallel import (
+        init_fit_state, make_device_mesh, make_fit_step,
+        restore_fit_state, save_fit_state,
+    )
+
+    n_dev = len(jax.devices())
+    sp = 2 if n_dev % 2 == 0 and n_dev > 1 else 1
+    mesh = make_device_mesh(n_dev, ("dp", "sp"), shape=(n_dev // sp, sp))
+    print("device mesh:", dict(mesh.shape), "on", jax.devices()[0].platform)
+
+    model = synthetic_body_model(seed=0)
+    batch = mesh.shape["dp"] * 2
+    n_scan = mesh.shape["sp"] * 512
+
+    # ground truth scans: posed bodies with random shapes + noise
+    rng = np.random.RandomState(3)
+    true_betas = jnp.asarray(rng.randn(batch, model.num_betas) * 0.3)
+    true_pose = jnp.asarray(rng.randn(batch, model.num_joints, 3) * 0.05)
+    verts, _ = lbs(model, true_betas, true_pose)
+    pick = rng.randint(0, model.num_vertices, size=(batch, n_scan))
+    scans = jnp.take_along_axis(verts, jnp.asarray(pick)[..., None], axis=1)
+    scans = scans + jnp.asarray(rng.randn(batch, n_scan, 3) * 1e-3)
+
+    state, optimizer = init_fit_state(model, batch)
+    step = make_fit_step(model, optimizer, mesh=mesh)
+
+    half = args.steps // 2
+    for i in range(half):
+        state, loss = step(state, scans)
+    print("step %3d  loss %.6f" % (half, float(loss)))
+
+    # checkpoint mid-fit, restore into a fresh template, resume both
+    save_fit_state(args.ckpt, state, step=half)
+    template, _ = init_fit_state(model, batch)
+    restored, restored_step = restore_fit_state(args.ckpt, template)
+    assert restored_step == half
+    for i in range(args.steps - half):
+        state, loss_a = step(state, scans)
+        restored, loss_b = step(restored, scans)
+    print("step %3d  loss %.6f" % (args.steps, float(loss_a)))
+    assert float(loss_a) == float(loss_b), "restore did not resume identically"
+    err = float(jnp.abs(state.betas - true_betas).mean())
+    print("mean |betas - truth| = %.4f (started from 0)" % err)
+    print("checkpoint resume bit-identical: ok")
+
+
+if __name__ == "__main__":
+    main()

@@ -147,12 +147,26 @@ def make_fit_step(model, optimizer, mesh=None, dp_axis="dp", sp_axis="sp",
     batch_sharding = NamedSharding(mesh, P(dp_axis))
     point_sharding = NamedSharding(mesh, P(dp_axis, sp_axis))
 
+    replicated = NamedSharding(mesh, P())
+
     def place(state, target_points):
+        n_batch = state.betas.shape[0]
+
+        def place_opt_leaf(leaf):
+            # adam's mu/nu mirror the parameter shapes -> shard with them;
+            # scalars (step count) replicate.  Placement must be explicit:
+            # a state restored from checkpoint arrives with committed
+            # devices, and mixing those with mesh-sharded params is an error
+            sharded = getattr(leaf, "ndim", 0) >= 1 and leaf.shape[0] == n_batch
+            return jax.device_put(
+                leaf, batch_sharding if sharded else replicated
+            )
+
         state = FitState(
             betas=jax.device_put(state.betas, batch_sharding),
             pose=jax.device_put(state.pose, batch_sharding),
             trans=jax.device_put(state.trans, batch_sharding),
-            opt_state=jax.device_put(state.opt_state),
+            opt_state=jax.tree_util.tree_map(place_opt_leaf, state.opt_state),
         )
         return state, jax.device_put(target_points, point_sharding)
 

@@ -22,6 +22,21 @@ def test_register_scan_example(tmp_path):
     assert (tmp_path / "scan.ply").exists()
 
 
+def test_fit_multichip_example(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    res = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(repo, "examples", "fit_multichip.py"),
+            "--steps", "8", "--ckpt", str(tmp_path / "ckpt"),
+        ],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "PYTHONPATH": repo},
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "checkpoint resume bit-identical: ok" in res.stdout
+
+
 def test_measure_body_example(tmp_path):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     out = str(tmp_path / "body")
