@@ -1,0 +1,85 @@
+"""MeshArrays: the functional pytree container (mesh_tpu/core.py) — the
+TPU-native data model under every kernel (SURVEY.md section 7.1 / P5
+multi-mesh batching).  These tests pin its contract: pytree registration,
+dtype policy, batching, and transform composition."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mesh_tpu.core import MeshArrays
+from mesh_tpu.geometry import vert_normals
+
+from .fixtures import box, icosphere
+
+
+def _arrays():
+    v, f = box()
+    return MeshArrays.create(v, f)
+
+
+class TestMeshArrays:
+    def test_create_dtypes(self):
+        m = _arrays()
+        assert m.v.dtype == jnp.float32 and m.f.dtype == jnp.int32
+        assert m.num_vertices == 8 and m.num_faces == 12
+        assert m.batch_shape == ()
+        assert m.vn is None and m.vt is None
+
+    def test_is_a_pytree(self):
+        m = _arrays()
+        doubled = jax.tree_util.tree_map(lambda x: x * 2, m)
+        assert isinstance(doubled, MeshArrays)
+        np.testing.assert_allclose(doubled.v, np.asarray(m.v) * 2)
+        leaves = jax.tree_util.tree_leaves(m)
+        assert len(leaves) == 2            # v and f; None fields drop out
+
+    def test_jit_through(self):
+        m = _arrays()
+
+        @jax.jit
+        def scale(mesh, s):
+            return mesh.with_vertices(mesh.v * s)
+
+        out = scale(m, 3.0)
+        assert isinstance(out, MeshArrays)
+        np.testing.assert_allclose(out.v, np.asarray(m.v) * 3.0)
+        np.testing.assert_array_equal(out.f, np.asarray(m.f))
+
+    def test_batched_vertices_shared_topology(self):
+        v, f = icosphere(1)
+        batch = jnp.stack([jnp.asarray(v, jnp.float32) * s
+                           for s in (1.0, 2.0, 3.0)])
+        m = MeshArrays.create(batch, f)
+        assert m.batch_shape == (3,)
+        tri = m.tri()
+        assert tri.shape == (3, len(f), 3, 3)
+        # kernels consume the batch axis directly
+        n = vert_normals(m.v, m.f)
+        assert n.shape == (3, len(v), 3)
+        # scaled copies of the same mesh have identical unit normals
+        np.testing.assert_allclose(np.asarray(n[0]), np.asarray(n[2]),
+                                   atol=1e-6)
+
+    def test_grad_flows(self):
+        m = _arrays()
+
+        def total_area_proxy(mesh):
+            tri = mesh.tri()
+            e1 = tri[:, 1] - tri[:, 0]
+            e2 = tri[:, 2] - tri[:, 0]
+            n = jnp.cross(e1, e2)
+            return jnp.sum(n * n)
+
+        g = jax.grad(lambda v: total_area_proxy(m.with_vertices(v)))(m.v)
+        assert g.shape == m.v.shape
+        assert bool(jnp.any(g != 0))
+
+    def test_facade_export(self):
+        from mesh_tpu import Mesh
+
+        v, f = box()
+        host = Mesh(v=v, f=f)
+        dev = host.arrays()
+        assert isinstance(dev, MeshArrays)
+        np.testing.assert_allclose(np.asarray(dev.v), v, atol=1e-6)
