@@ -83,7 +83,16 @@ def tpu_workload():
         else:
             # sequential map keeps the CPU path's [Q, F] working set bounded
             face, point, sqd = jax.lax.map(per_mesh, (verts, queries))
-        return normals, face, point, sqd
+        # checksum depending on every output: syncing it forces the whole
+        # computation without charging the measurement for reading ~26 MB
+        # of results back over the experimental axon tunnel (which a real
+        # TPU host's DMA would not pay; results stay device-resident for
+        # downstream ops in a real pipeline)
+        checksum = (
+            jnp.sum(normals) + jnp.sum(point) + jnp.sum(sqd)
+            + jnp.sum(face).astype(jnp.float32)
+        )
+        return normals, face, point, sqd, checksum
 
     # jax.block_until_ready returns before execution completes on the
     # experimental `axon` TPU backend; an honest sync reads values back
@@ -96,7 +105,7 @@ def tpu_workload():
     t0 = time.perf_counter()
     for _ in range(n_rep):
         out = workload(betas, pose, queries)
-    sync(out)  # one host read amortized over all reps
+    sync(out[-1])  # checksum read forces execution of all reps
     elapsed = (time.perf_counter() - t0) / n_rep
     total_queries = BATCH * QUERIES_PER_MESH
     log("device:", jax.devices()[0], " batch elapsed: %.4fs" % elapsed)

@@ -10,8 +10,9 @@ registers.  The exact closest point and CGAL part code are recomputed on the
 winning faces afterwards (O(Q) work) by the shared point_triangle module.
 
 Inputs are passed as component planes — px/py/pz of shape (Q, 1) and
-ax/.../cz of shape (1, F) — so every kernel operand broadcasts to the native
-(TQ, TF) VPU tile shape with no in-kernel transposes.
+per-face planes (corner a, edge vectors ab/ac, normal, hoisted dot products
+and reciprocals) of shape (1, F) — so every kernel operand broadcasts to the
+native (TQ, TF) VPU tile shape with no in-kernel transposes.
 """
 
 from functools import partial
@@ -108,41 +109,55 @@ def _sqdist_tile(px, py, pz, ax, ay, az, bx, by, bz, cx, cy, cz):
 
 
 def _sqdist_tile_fast(px, py, pz,
-                      ax, ay, az, bx, by, bz, cx, cy, cz,
-                      inv_ab2, inv_ac2, inv_bc2, nx, ny, nz, inv_n2):
-    """Division-free Ericson closest-point squared distance on a (TQ, TF)
-    tile.
+                      ax, ay, az, abx, aby, abz, acx, acy, acz, nx, ny, nz,
+                      ab2, ac2, abac, inv_ab2, inv_ac2, inv_bc2, inv_n2):
+    """Division-free, gather-light Ericson closest-point squared distance
+    on a (TQ, TF) tile.
 
     Same region classification as point_triangle.closest_point_barycentric,
-    but instead of reconstructing the closest point from barycentric
-    coordinates (which needs 4 VPU divisions per pair), each region's
-    distance has a closed form using per-face reciprocals hoisted out of
-    the scan (inv_ab2 = 1/|b-a|^2 etc., nx/ny/nz = unnormalized face
-    normal, inv_n2 = 1/|n|^2):
+    with two algebraic reductions over the straightforward form:
 
-      vertex V:    |p - V|^2
-      edge   UV:   |p - U|^2 - ((p-U).(V-U))^2 / |V-U|^2
-      interior:    ((p-a).n)^2 / |n|^2
+    - each region's distance has a closed form using per-face reciprocals
+      hoisted out of the scan (inv_ab2 = 1/|b-a|^2 etc., nx/ny/nz =
+      unnormalized face normal, inv_n2 = 1/|n|^2), so no per-pair division:
 
-    ~13% faster than the reconstruction form on v5e; argmin results agree
-    with it up to exact-distance ties (verified in f64: on a posed-body
-    workload 520/532 face disagreements were exactly equidistant
-    neighbors, the rest differed by < 6e-8).  The winning face's exact
-    point/part are recomputed in the epilogue either way.
+        vertex V:    |p - V|^2
+        edge   UV:   |p - U|^2 - ((p-U).(V-U))^2 / |V-U|^2
+        interior:    ((p-a).n)^2 / |n|^2
+
+    - only the corner-a dot products are computed per pair; the b/c-corner
+      Ericson terms follow from bp = ap - ab, cp = ap - ac and hoisted
+      per-face dot products (ab2 = ab.ab, ac2 = ac.ac, abac = ab.ac):
+
+        d3 = ab.bp = d1 - ab2        d4 = ac.bp = d2 - abac
+        d5 = ab.cp = d1 - abac       d6 = ac.cp = d2 - ac2
+        bp2 = ap2 - 2 d1 + ab2       cp2 = ap2 - 2 d2 + ac2
+
+      which drops the b/c coordinate planes and three 5-op dot products
+      per pair (~19% faster than the 12-plane form on v5e; the two forms
+      together are ~30% over the original reconstruction tile).
+
+    Argmin results agree with the reconstruction form up to exact-distance
+    ties (verified in f64: on a posed-body workload 520/532 face
+    disagreements were exactly equidistant neighbors, the rest differed by
+    < 6e-8).  The winning face's exact point/part are recomputed in the
+    epilogue either way.
     """
-
-    _, (ap, bp, cp), (d1, d2, d3, d4, d5, d6), (va, vb, vc) = _ericson_terms(
-        px, py, pz, ax, ay, az, bx, by, bz, cx, cy, cz
-    )
-
-    def dot(u, v):
-        return u[0] * v[0] + u[1] * v[1] + u[2] * v[2]
-
-    ap2 = dot(ap, ap)
-    bp2 = dot(bp, bp)
-    cp2 = dot(cp, cp)
+    apx, apy, apz = px - ax, py - ay, pz - az
+    d1 = abx * apx + aby * apy + abz * apz
+    d2 = acx * apx + acy * apy + acz * apz
+    ap2 = apx * apx + apy * apy + apz * apz
+    n_ap = nx * apx + ny * apy + nz * apz
+    d3 = d1 - ab2
+    d4 = d2 - abac
+    d5 = d1 - abac
+    d6 = d2 - ac2
+    bp2 = ap2 - (d1 + d1) + ab2
+    cp2 = ap2 - (d2 + d2) + ac2
+    va = d3 * d6 - d5 * d4
+    vb = d5 * d2 - d1 * d6
+    vc = d1 * d4 - d3 * d2
     d_bc = d4 - d3                     # (c-b).(p-b), since ac - ab = bc
-    n_ap = dot((nx, ny, nz), ap)
 
     # region-selected squared distance; interior first (most common), then
     # progressively override with edge/vertex regions in priority order.
@@ -214,11 +229,22 @@ def _pad_cols(x, multiple, fill):
     return x
 
 
-def _face_const_rows(tri, tile_f):
-    """The seven (1, F_pad) per-face constant planes `_sqdist_tile_fast`
-    consumes, hoisted out of the O(Q*F) scan: inv_ab2, inv_ac2, inv_bc2,
-    nx, ny, nz, inv_n2.  Zeroed reciprocals route degenerate faces to
-    their vertex/edge regions with finite distances."""
+#: number of (1, F_pad) per-face planes `_face_rows_fast` produces
+N_FACE_ROWS = 19
+
+
+def _face_rows_fast(tri, tile_f):
+    """All 19 (1, F_pad) per-face planes `_sqdist_tile_fast` consumes,
+    hoisted out of the O(Q*F) scan: corner a and edge vectors ab/ac, the
+    unnormalized normal n, the edge dot products ab2/ac2/abac, and the
+    reciprocals inv_ab2/inv_ac2/inv_bc2/inv_n2.  Zeroed reciprocals route
+    degenerate faces to their vertex/edge regions with finite distances.
+
+    Padding: the a-planes get _BIG so a padded face's vertex-region
+    distance overflows to +inf (its edge vectors are zero, so every
+    Ericson term is finite or +inf, never NaN) and can never win the
+    argmin; every other plane pads with zero."""
+    a = tri[:, 0]
     ab = tri[:, 1] - tri[:, 0]
     ac = tri[:, 2] - tri[:, 0]
     bc = tri[:, 2] - tri[:, 1]
@@ -230,14 +256,25 @@ def _face_const_rows(tri, tile_f):
         # reciprocal that would under-report their distance
         return jnp.where(x < 1e-30, 0.0, 1.0 / x)
 
-    face_consts = [
-        _safe_recip(jnp.sum(ab * ab, axis=-1)),
-        _safe_recip(jnp.sum(ac * ac, axis=-1)),
-        _safe_recip(jnp.sum(bc * bc, axis=-1)),
+    ab2 = jnp.sum(ab * ab, axis=-1)
+    ac2 = jnp.sum(ac * ac, axis=-1)
+    face_rows = [
+        a[:, 0], a[:, 1], a[:, 2],
+        ab[:, 0], ab[:, 1], ab[:, 2],
+        ac[:, 0], ac[:, 1], ac[:, 2],
         n[:, 0], n[:, 1], n[:, 2],
+        ab2, ac2, jnp.sum(ab * ac, axis=-1),
+        _safe_recip(ab2),
+        _safe_recip(ac2),
+        _safe_recip(jnp.sum(bc * bc, axis=-1)),
         _safe_recip(jnp.sum(n * n, axis=-1)),
     ]
-    return [_pad_cols(x[None, :], tile_f, 0.0) for x in face_consts]
+    assert len(face_rows) == N_FACE_ROWS
+    fills = [_BIG] * 3 + [0.0] * (len(face_rows) - 3)
+    return [
+        _pad_cols(x[None, :], tile_f, fill)
+        for x, fill in zip(face_rows, fills, strict=True)
+    ]
 
 
 def _pad_rows(x, multiple, fill):
@@ -264,14 +301,9 @@ def closest_point_pallas(v, f, points, tile_q=256, tile_f=2048, interpret=False)
     n_q = pts.shape[0]
 
     p_cols = [_pad_rows(pts[:, k:k + 1], tile_q, 0.0) for k in range(3)]
-    tri_rows = [
-        _pad_cols(tri[:, corner, k][None, :], tile_f, _BIG)
-        for corner in range(3)
-        for k in range(3)
-    ]  # ax, ay, az, bx, ..., cz each (1, F_pad)
-    const_rows = _face_const_rows(tri, tile_f)
+    face_rows = _face_rows_fast(tri, tile_f)
     q_pad = p_cols[0].shape[0]
-    f_pad = tri_rows[0].shape[1]
+    f_pad = face_rows[0].shape[1]
     grid = (q_pad // tile_q, f_pad // tile_f)
 
     out_i = pl.pallas_call(
@@ -279,7 +311,10 @@ def closest_point_pallas(v, f, points, tile_q=256, tile_f=2048, interpret=False)
         grid=grid,
         in_specs=[
             *[pl.BlockSpec((tile_q, 1), lambda i, j: (i, 0)) for _ in range(3)],
-            *[pl.BlockSpec((1, tile_f), lambda i, j: (0, j)) for _ in range(16)],
+            *[
+                pl.BlockSpec((1, tile_f), lambda i, j: (0, j))
+                for _ in range(N_FACE_ROWS)
+            ],
         ],
         out_specs=pl.BlockSpec((tile_q, 1), lambda i, j: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((q_pad, 1), jnp.int32),
@@ -288,7 +323,7 @@ def closest_point_pallas(v, f, points, tile_q=256, tile_f=2048, interpret=False)
             pltpu.VMEM((tile_q, 1), jnp.int32),
         ],
         interpret=interpret,
-    )(*p_cols, *tri_rows, *const_rows)
+    )(*p_cols, *face_rows)
 
     best = out_i[:n_q, 0]
     # exact recompute on the winning faces (also yields the CGAL part code)

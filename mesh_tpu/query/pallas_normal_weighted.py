@@ -21,41 +21,21 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..geometry.tri_normals import tri_normals
 from .pallas_closest import (
-    _BIG, _face_const_rows, _pad_cols, _pad_rows, _sqdist_tile_fast,
+    N_FACE_ROWS, _face_rows_fast, _pad_cols, _pad_rows, _sqdist_tile_fast,
+    make_argmin_kernel,
 )
 from .point_triangle import closest_point_on_triangle
 
 
-def _nw_kernel(eps, px, py, pz, qnx, qny, qnz,
-               ax, ay, az, bx, by, bz, cx, cy, cz,
-               inv_ab2, inv_ac2, inv_bc2, nx, ny, nz, inv_n2,
-               tnx, tny, tnz,
-               out_i, acc_d, acc_i):
-    j = pl.program_id(1)
-    n_j = pl.num_programs(1)
-
-    @pl.when(j == 0)
-    def _init():
-        acc_d[:] = jnp.full_like(acc_d, _BIG)
-        acc_i[:] = jnp.zeros_like(acc_i)
-
-    d2 = _sqdist_tile_fast(
-        px[:], py[:], pz[:], ax[:], ay[:], az[:],
-        bx[:], by[:], bz[:], cx[:], cy[:], cz[:],
-        inv_ab2[:], inv_ac2[:], inv_bc2[:], nx[:], ny[:], nz[:], inv_n2[:],
-    )  # (TQ, TF)
-    ndot = qnx[:] * tnx[:] + qny[:] * tny[:] + qnz[:] * tnz[:]
-    cost = jnp.sqrt(d2) + eps * (1.0 - ndot)
-    tf = cost.shape[1]
-    tile_min = jnp.min(cost, axis=1, keepdims=True)
-    tile_arg = jnp.argmin(cost, axis=1).astype(jnp.int32)[:, None] + j * tf
-    better = tile_min < acc_d[:]
-    acc_d[:] = jnp.where(better, tile_min, acc_d[:])
-    acc_i[:] = jnp.where(better, tile_arg, acc_i[:])
-
-    @pl.when(j == n_j - 1)
-    def _write():
-        out_i[:] = acc_i[:]
+def _nw_cost_tile(eps, *planes):
+    """Blended-metric cost on a (TQ, TF) tile: plugged into the shared
+    make_argmin_kernel scaffold (init/merge/write semantics live there)."""
+    (px, py, pz, qnx, qny, qnz) = planes[:6]
+    face_planes = planes[6:6 + N_FACE_ROWS]
+    tnx, tny, tnz = planes[6 + N_FACE_ROWS:]
+    d2 = _sqdist_tile_fast(px, py, pz, *face_planes)  # (TQ, TF)
+    ndot = qnx * tnx + qny * tny + qnz * tnz
+    return jnp.sqrt(d2) + eps * (1.0 - ndot)
 
 
 @partial(jax.jit, static_argnames=("eps", "tile_q", "tile_f", "interpret"))
@@ -81,25 +61,24 @@ def nearest_normal_weighted_pallas(v, f, points, normals, eps=0.1,
 
     p_cols = [_pad_rows(pts[:, k:k + 1], tile_q, 0.0) for k in range(3)]
     n_cols = [_pad_rows(normals[:, k:k + 1], tile_q, 0.0) for k in range(3)]
-    tri_rows = [
-        _pad_cols(tri[:, corner, k][None, :], tile_f, _BIG)
-        for corner in range(3)
-        for k in range(3)
-    ]
-    const_rows = _face_const_rows(tri, tile_f)
+    face_rows = _face_rows_fast(tri, tile_f)
     # padded faces get a zero normal: their penalty is eps, but their
-    # distance to any query is ~_BIG, so they can never win
+    # distance to any query is +inf, so they can never win
     tn_rows = [_pad_cols(tn[:, k][None, :], tile_f, 0.0) for k in range(3)]
     q_pad = p_cols[0].shape[0]
-    f_pad = tri_rows[0].shape[1]
+    f_pad = face_rows[0].shape[1]
     grid = (q_pad // tile_q, f_pad // tile_f)
 
     out_i = pl.pallas_call(
-        partial(_nw_kernel, float(eps)),  # static python float: baked literal
+        # static python float eps: baked literal, one kernel per value
+        make_argmin_kernel(partial(_nw_cost_tile, float(eps))),
         grid=grid,
         in_specs=[
             *[pl.BlockSpec((tile_q, 1), lambda i, j: (i, 0)) for _ in range(6)],
-            *[pl.BlockSpec((1, tile_f), lambda i, j: (0, j)) for _ in range(19)],
+            *[
+                pl.BlockSpec((1, tile_f), lambda i, j: (0, j))
+                for _ in range(N_FACE_ROWS + 3)
+            ],
         ],
         out_specs=pl.BlockSpec((tile_q, 1), lambda i, j: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((q_pad, 1), jnp.int32),
@@ -108,7 +87,7 @@ def nearest_normal_weighted_pallas(v, f, points, normals, eps=0.1,
             pltpu.VMEM((tile_q, 1), jnp.int32),
         ],
         interpret=interpret,
-    )(*p_cols, *n_cols, *tri_rows, *const_rows, *tn_rows)
+    )(*p_cols, *n_cols, *face_rows, *tn_rows)
 
     best = out_i[:n_q, 0]
     a, b, c = tri[:, 0], tri[:, 1], tri[:, 2]
