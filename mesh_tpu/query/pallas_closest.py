@@ -27,87 +27,6 @@ from .point_triangle import closest_point_on_triangle
 _BIG = 1e30
 
 
-def _ericson_terms(px, py, pz, ax, ay, az, bx, by, bz, cx, cy, cz):
-    """Shared per-pair prologue of both sqdist tiles: edge/point difference
-    planes and the six Ericson dot products + the three region cofactors.
-
-    Returns ((ab, ac), (ap, bp, cp), (d1..d6), (va, vb, vc)) where each
-    vector is an (x, y, z) component tuple."""
-
-    def dot(u, v):
-        return u[0] * v[0] + u[1] * v[1] + u[2] * v[2]
-
-    ab = (bx - ax, by - ay, bz - az)
-    ac = (cx - ax, cy - ay, cz - az)
-    ap = (px - ax, py - ay, pz - az)
-    bp = (px - bx, py - by, pz - bz)
-    cp = (px - cx, py - cy, pz - cz)
-    d1 = dot(ab, ap)
-    d2 = dot(ac, ap)
-    d3 = dot(ab, bp)
-    d4 = dot(ac, bp)
-    d5 = dot(ab, cp)
-    d6 = dot(ac, cp)
-    va = d3 * d6 - d5 * d4
-    vb = d5 * d2 - d1 * d6
-    vc = d1 * d4 - d3 * d2
-    return (ab, ac), (ap, bp, cp), (d1, d2, d3, d4, d5, d6), (va, vb, vc)
-
-
-def _sqdist_tile(px, py, pz, ax, ay, az, bx, by, bz, cx, cy, cz):
-    """Branch-free Ericson closest-point squared distance on a (TQ, TF) tile.
-
-    Component-plane version of point_triangle.closest_point_barycentric:
-    identical region logic, but expressed on x/y/z planes so the whole tile
-    stays in native 2D vector registers.  Only the culled kernel still uses
-    this form (its exact tile takes no per-face extras); the brute-force and
-    normal-weighted kernels use `_sqdist_tile_fast`.
-    """
-    (ab, ac), _, (d1, d2, d3, d4, d5, d6), (va, vb, vc) = _ericson_terms(
-        px, py, pz, ax, ay, az, bx, by, bz, cx, cy, cz
-    )
-    abx, aby, abz = ab
-    acx, acy, acz = ac
-
-    def safe_div(n, d):
-        return n / jnp.where(d == 0, 1.0, d)
-
-    t_ab = safe_div(d1, d1 - d3)
-    t_ca = safe_div(d2, d2 - d6)
-    t_bc = safe_div(d4 - d3, (d4 - d3) + (d5 - d6))
-    denom = safe_div(jnp.ones_like(va), va + vb + vc)
-    v_in = vb * denom
-    w_in = vc * denom
-
-    # barycentric (b1, b2) per region, selected in priority order
-    b1 = v_in
-    b2 = w_in
-    on_bc = (va <= 0) & (d4 - d3 >= 0) & (d5 - d6 >= 0)
-    b1 = jnp.where(on_bc, 1.0 - t_bc, b1)
-    b2 = jnp.where(on_bc, t_bc, b2)
-    on_ca = (vb <= 0) & (d2 >= 0) & (d6 <= 0)
-    b1 = jnp.where(on_ca, 0.0, b1)
-    b2 = jnp.where(on_ca, t_ca, b2)
-    on_ab = (vc <= 0) & (d1 >= 0) & (d3 <= 0)
-    b1 = jnp.where(on_ab, t_ab, b1)
-    b2 = jnp.where(on_ab, 0.0, b2)
-    in_c = (d6 >= 0) & (d5 <= d6)
-    b1 = jnp.where(in_c, 0.0, b1)
-    b2 = jnp.where(in_c, 1.0, b2)
-    in_b = (d3 >= 0) & (d4 <= d3)
-    b1 = jnp.where(in_b, 1.0, b1)
-    b2 = jnp.where(in_b, 0.0, b2)
-    in_a = (d1 <= 0) & (d2 <= 0)
-    b1 = jnp.where(in_a, 0.0, b1)
-    b2 = jnp.where(in_a, 0.0, b2)
-
-    qx = ax + b1 * abx + b2 * acx
-    qy = ay + b1 * aby + b2 * acy
-    qz = az + b1 * abz + b2 * acz
-    dx, dy, dz = px - qx, py - qy, pz - qz
-    return dx * dx + dy * dy + dz * dz
-
-
 def _sqdist_tile_fast(px, py, pz,
                       ax, ay, az, abx, aby, abz, acx, acy, acz, nx, ny, nz,
                       ab2, ac2, abac, inv_ab2, inv_ac2, inv_bc2, inv_n2):
@@ -229,25 +148,25 @@ def _pad_cols(x, multiple, fill):
     return x
 
 
-#: number of (1, F_pad) per-face planes `_face_rows_fast` produces
+#: number of per-face planes `fast_tile_rows` produces
 N_FACE_ROWS = 19
 
 
-def _face_rows_fast(tri, tile_f):
-    """All 19 (1, F_pad) per-face planes `_sqdist_tile_fast` consumes,
-    hoisted out of the O(Q*F) scan: corner a and edge vectors ab/ac, the
-    unnormalized normal n, the edge dot products ab2/ac2/abac, and the
-    reciprocals inv_ab2/inv_ac2/inv_bc2/inv_n2.  Zeroed reciprocals route
-    degenerate faces to their vertex/edge regions with finite distances.
+def fast_tile_rows(tri):
+    """The 19 per-face quantities `_sqdist_tile_fast` consumes, hoisted
+    out of the O(Q*F) scan, in its exact face-parameter order: corner a
+    and edge vectors ab/ac, the unnormalized normal n, the edge dot
+    products ab2/ac2/abac, and the reciprocals
+    inv_ab2/inv_ac2/inv_bc2/inv_n2.  Zeroed reciprocals route degenerate
+    faces to their vertex/edge regions with finite distances.
 
-    Padding: the a-planes get _BIG so a padded face's vertex-region
-    distance overflows to +inf (its edge vectors are zero, so every
-    Ericson term is finite or +inf, never NaN) and can never win the
-    argmin; every other plane pads with zero."""
-    a = tri[:, 0]
-    ab = tri[:, 1] - tri[:, 0]
-    ac = tri[:, 2] - tri[:, 0]
-    bc = tri[:, 2] - tri[:, 1]
+    ``tri`` is ``[..., F, 3 corners, 3 xyz]``; returns a list of 19
+    ``[..., F]`` arrays.  Single source of truth for every kernel feeding
+    the fast tile (brute-force, normal-weighted, culled)."""
+    a = tri[..., 0, :]
+    ab = tri[..., 1, :] - a
+    ac = tri[..., 2, :] - a
+    bc = tri[..., 2, :] - tri[..., 1, :]
     n = jnp.cross(ab, ac)
 
     def _safe_recip(x):
@@ -258,18 +177,29 @@ def _face_rows_fast(tri, tile_f):
 
     ab2 = jnp.sum(ab * ab, axis=-1)
     ac2 = jnp.sum(ac * ac, axis=-1)
-    face_rows = [
-        a[:, 0], a[:, 1], a[:, 2],
-        ab[:, 0], ab[:, 1], ab[:, 2],
-        ac[:, 0], ac[:, 1], ac[:, 2],
-        n[:, 0], n[:, 1], n[:, 2],
+    rows = [
+        a[..., 0], a[..., 1], a[..., 2],
+        ab[..., 0], ab[..., 1], ab[..., 2],
+        ac[..., 0], ac[..., 1], ac[..., 2],
+        n[..., 0], n[..., 1], n[..., 2],
         ab2, ac2, jnp.sum(ab * ac, axis=-1),
         _safe_recip(ab2),
         _safe_recip(ac2),
         _safe_recip(jnp.sum(bc * bc, axis=-1)),
         _safe_recip(jnp.sum(n * n, axis=-1)),
     ]
-    assert len(face_rows) == N_FACE_ROWS
+    assert len(rows) == N_FACE_ROWS
+    return rows
+
+
+def _face_rows_fast(tri, tile_f):
+    """`fast_tile_rows` as padded (1, F_pad) planes for the 2D-grid kernels.
+
+    Padding: the a-planes get _BIG so a padded face's vertex-region
+    distance overflows to +inf (its edge vectors are zero, so every
+    Ericson term is finite or +inf, never NaN) and can never win the
+    argmin; every other plane pads with zero."""
+    face_rows = fast_tile_rows(tri)
     fills = [_BIG] * 3 + [0.0] * (len(face_rows) - 3)
     return [
         _pad_cols(x[None, :], tile_f, fill)

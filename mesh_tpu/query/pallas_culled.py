@@ -43,7 +43,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .pallas_closest import _sqdist_tile
+from .pallas_closest import N_FACE_ROWS, _sqdist_tile_fast, fast_tile_rows
 from .point_triangle import closest_point_on_triangle
 
 _SUB = 128          # sub-tile size for the seed upper bound
@@ -128,12 +128,10 @@ def _prologue(vc, f, pts, tile_q, tile_f):
     }
 
 
-def _culled_kernel(
-    qsph, fsph, seed,
-    px, py, pz, ax, ay, az, bx, by, bz, cx, cy, cz,
-    out_i, acc_d, acc_i, worst,
-):
-    b = pl.program_id(0)
+def _culled_kernel(*refs):
+    qsph, fsph, seed, px, py, pz = refs[:6]
+    face_refs = refs[6:6 + N_FACE_ROWS]
+    out_i, acc_d, acc_i, worst = refs[6 + N_FACE_ROWS:]
     i = pl.program_id(1)
     j = pl.program_id(2)
     n_j = pl.num_programs(2)
@@ -144,18 +142,19 @@ def _culled_kernel(
         acc_i[:] = jnp.zeros_like(acc_i)
         worst[0] = jnp.max(seed[0])
 
-    # sphere-to-sphere lower bound from SMEM tile metadata (scalar ALU only)
-    dx = qsph[b, i, 0] - fsph[b, j, 0]
-    dy = qsph[b, i, 1] - fsph[b, j, 1]
-    dz = qsph[b, i, 2] - fsph[b, j, 2]
+    # sphere-to-sphere lower bound from SMEM tile metadata (scalar ALU
+    # only); the metadata blocks are per-batch rows, so the batch index
+    # is already applied by the BlockSpec
+    dx = qsph[0, i, 0] - fsph[0, j, 0]
+    dy = qsph[0, i, 1] - fsph[0, j, 1]
+    dz = qsph[0, i, 2] - fsph[0, j, 2]
     dist = jnp.sqrt(dx * dx + dy * dy + dz * dz)
-    lb = jnp.maximum(dist - qsph[b, i, 3] - fsph[b, j, 3], 0.0) * (1.0 - _MARGIN)
+    lb = jnp.maximum(dist - qsph[0, i, 3] - fsph[0, j, 3], 0.0) * (1.0 - _MARGIN)
 
     @pl.when(lb * lb <= worst[0])
     def _exact_tile():
-        d2 = _sqdist_tile(
-            px[0], py[0], pz[0], ax[0], ay[0], az[0],
-            bx[0], by[0], bz[0], cx[0], cy[0], cz[0],
+        d2 = _sqdist_tile_fast(
+            px[0], py[0], pz[0], *[r[0] for r in face_refs]
         )  # (TQ, TF)
         tf = d2.shape[1]
         tile_min = jnp.min(d2, axis=1, keepdims=True)
@@ -198,19 +197,29 @@ def closest_point_pallas_culled(
     q_pad = pro["pts_s"].shape[1]
     grid = (b_n, q_pad // tile_q, f_pad // tile_f)
 
-    # tile-sphere metadata lives whole in SMEM (scalar loads by program id;
-    # (1, 1) VMEM blocks are not a legal Mosaic tiling)
+    # tile-sphere metadata lives in SMEM, blocked one batch row at a time —
+    # whole-array SMEM residency overflows SMEM at large B (scalar loads by
+    # program id; (1, 1) VMEM blocks are not a legal Mosaic tiling)
     qsph = jnp.concatenate([pro["qc"], pro["qr"][..., None]], axis=-1)
     fsph = jnp.concatenate([pro["fc"], pro["fr"][..., None]], axis=-1)
     seed = pro["seed"][..., None]              # (B, Qp, 1)
     p_planes = [pro["pts_s"][..., k:k + 1] for k in range(3)]  # (B, Qp, 1)
+    # the 19 per-face planes of the fast tile, from the shared builder;
+    # tri_s is edge-padded with real duplicated faces, so no sentinel fill
+    # is needed — a padded duplicate that wins a tie maps back to the same
+    # original face id
     t_planes = [
-        tri_s[:, :, corner, k].reshape(b_n, 1, f_pad)
-        for corner in range(3)
-        for k in range(3)
+        r.reshape(b_n, 1, f_pad) for r in fast_tile_rows(tri_s)
     ]
 
-    smem_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
+    qsph_spec = pl.BlockSpec(
+        (1,) + qsph.shape[1:], lambda b, i, j: (b, 0, 0),
+        memory_space=pltpu.SMEM,
+    )
+    fsph_spec = pl.BlockSpec(
+        (1,) + fsph.shape[1:], lambda b, i, j: (b, 0, 0),
+        memory_space=pltpu.SMEM,
+    )
     qcol_spec = pl.BlockSpec((1, tile_q, 1), lambda b, i, j: (b, i, 0))
     frow_spec = pl.BlockSpec((1, 1, tile_f), lambda b, i, j: (b, 0, j))
 
@@ -218,11 +227,11 @@ def closest_point_pallas_culled(
         _culled_kernel,
         grid=grid,
         in_specs=[
-            smem_spec,
-            smem_spec,
+            qsph_spec,
+            fsph_spec,
             qcol_spec,
             *[qcol_spec] * 3,
-            *[frow_spec] * 9,
+            *[frow_spec] * N_FACE_ROWS,
         ],
         out_specs=pl.BlockSpec((1, tile_q, 1), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((b_n, q_pad, 1), jnp.int32),

@@ -44,3 +44,46 @@ class TestPallasClosestPoint:
         np.testing.assert_allclose(
             np.asarray(out["point"]), [[0.3, 0.2, -1.0]], atol=1e-6
         )
+
+    def test_degenerate_faces_never_underreport(self):
+        """Zero-area and collinear faces must fall through to their
+        vertex/edge regions (zeroed reciprocals in _face_rows_fast), not
+        report a bogus interior plane distance that steals the argmin."""
+        rng = np.random.RandomState(3)
+        v, f = icosphere(1)
+        v = v.astype(np.float32)
+        f = f.astype(np.int32)
+        # graft pathological faces far from the sphere: a point triangle
+        # (all three corners equal) and a collinear sliver, both at z=+10
+        extra_v = np.array(
+            [[0.0, 0.0, 10.0],                      # point triangle corner
+             [-1.0, 0.0, 10.0], [1.0, 0.0, 10.0], [3.0, 0.0, 10.0]],
+            np.float32,
+        )
+        n0 = len(v)
+        v = np.vstack([v, extra_v])
+        f = np.vstack([
+            f,
+            [[n0, n0, n0], [n0 + 1, n0 + 2, n0 + 3]],
+        ]).astype(np.int32)
+        q = np.vstack([
+            (rng.randn(30, 3) * 0.8).astype(np.float32),   # near the sphere
+            [[0.0, 0.5, 10.0]],    # closest to the sliver's interior span
+            [[0.1, -0.2, 9.0]],    # closest to the point triangle
+        ]).astype(np.float32)
+        ref = closest_faces_and_points(v, f, q)
+        out = closest_point_pallas(v, f, q, tile_q=8, tile_f=128,
+                                   interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(out["sqdist"]), np.asarray(ref["sqdist"]), atol=1e-5
+        )
+        # the sphere-adjacent queries must not be captured by the
+        # degenerate faces
+        assert np.all(np.asarray(out["face"])[:30] < len(f) - 2)
+        # the far queries resolve to the grafted geometry at the exact
+        # segment distance (the collinear sliver acts as the segment
+        # [-1,0,10]..[3,0,10]; both queries project onto its interior)
+        for qi, expect in [(-2, 0.5 ** 2), (-1, 0.2 ** 2 + 1.0 ** 2)]:
+            np.testing.assert_allclose(
+                float(np.asarray(out["sqdist"])[qi]), expect, rtol=1e-5
+            )

@@ -123,3 +123,42 @@ def test_culled_nonmultiple_sizes():
         atol=1e-6,
         rtol=1e-5,
     )
+
+
+def test_culled_degenerate_faces_never_underreport():
+    """Same grafted-pathology case as the brute-force kernel's test
+    (test_pallas.py): a point triangle and a collinear sliver must fall
+    through to vertex/edge regions inside the culled kernel's fast tile,
+    and the sphere-bound pruning must stay exact around them."""
+    rng = np.random.RandomState(3)
+    v, f = icosphere(1)
+    v = v.astype(np.float32)
+    f = f.astype(np.int32)
+    extra_v = np.array(
+        [[0.0, 0.0, 10.0],
+         [-1.0, 0.0, 10.0], [1.0, 0.0, 10.0], [3.0, 0.0, 10.0]],
+        np.float32,
+    )
+    n0 = len(v)
+    v = np.vstack([v, extra_v])
+    f = np.vstack([
+        f,
+        [[n0, n0, n0], [n0 + 1, n0 + 2, n0 + 3]],
+    ]).astype(np.int32)
+    pts = np.vstack([
+        (rng.randn(30, 3) * 0.8).astype(np.float32),
+        [[0.0, 0.5, 10.0]],
+        [[0.1, -0.2, 9.0]],
+    ]).astype(np.float32)
+    ref = closest_faces_and_points(v, f, pts)
+    res = closest_point_pallas_culled(
+        v, f, pts, tile_q=8, tile_f=16, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(res["sqdist"]), np.asarray(ref["sqdist"]), atol=1e-5
+    )
+    # both far queries project onto the sliver segment [-1,0,10]..[3,0,10]
+    for qi, expect in [(-2, 0.5 ** 2), (-1, 0.2 ** 2 + 1.0 ** 2)]:
+        np.testing.assert_allclose(
+            float(np.asarray(res["sqdist"])[qi]), expect, rtol=1e-5
+        )
