@@ -126,7 +126,9 @@ def config2():
 
     import jax
 
-    from mesh_tpu.query.visibility import _visibility_kernel
+    from mesh_tpu.query.visibility import (
+        _visibility_kernel, _visibility_kernel_pallas,
+    )
 
     vj = jnp.asarray(v, jnp.float32)
     fj = jnp.asarray(f, jnp.int32)
@@ -141,18 +143,26 @@ def config2():
     )
 
     # device-resident path: the jitted kernel with device arrays, the way a
-    # TPU pipeline calls it
-    occ = vj[fj]
+    # TPU pipeline calls it (the Pallas any-hit kernel on accelerators,
+    # like visibility_compute's own dispatch)
+    occ = jax.device_put(vj[fj])
     occ_a = jax.device_put(occ[:, 0])
     occ_b = jax.device_put(occ[:, 1])
     occ_c = jax.device_put(occ[:, 2])
     cams_j = jax.device_put(cams.astype(np.float32))
+    on_accel = jax.devices()[0].platform != "cpu"
 
+    @jax.jit
     def work():
         tn = tri_normals(vj, fj)
-        vis, ndc = _visibility_kernel(
-            vj, occ_a, occ_b, occ_c, cams_j, nj, None, np.float32(1e-3)
-        )
+        if on_accel:
+            vis, ndc = _visibility_kernel_pallas(
+                vj, occ, cams_j, nj, None, np.float32(1e-3)
+            )
+        else:
+            vis, ndc = _visibility_kernel(
+                vj, occ_a, occ_b, occ_c, cams_j, nj, None, np.float32(1e-3)
+            )
         return tn, vis, ndc
 
     t = _time(work, reps=10)

@@ -1,0 +1,407 @@
+"""Pallas TPU kernels for ray / segment / triangle-triangle queries.
+
+TPU-shaped replacements for the XLA paths in ray.py and visibility.py,
+which materialize [chunk, F] hit/parameter matrices in HBM per tile —
+bandwidth-bound, like the closest-point scan before its Pallas kernel.
+Every kernel here streams face tiles through the VPU against a
+VMEM-resident per-query accumulator, so HBM traffic is O(Q + F):
+
+- ``ray_any_hit_pallas``  — blocked flag per ray (the visibility hot loop,
+  reference mesh/src/visibility.cpp:86-114);
+- ``nearest_alongnormal_pallas`` — nearest |t| hit along +/- the query
+  normal (reference spatialsearchmodule.cpp:222-323);
+- ``tri_tri_any_hit_pallas`` — does query triangle i intersect any mesh
+  triangle (reference spatialsearchmodule.cpp:326-417).
+
+The shared per-pair test is Moller-Trumbore in a division-free
+sign-carried form: with det = e1.(d x e2), every barycentric / ray-bound
+of ray.ray_triangle_hits's divided form
+
+    u >= -beps,  v >= -beps,  u + v <= 1 + beps,  t_lo <= t <= t_hi
+
+is multiplied through by |det| (positive), giving the equivalent
+
+    un*sign(det) >= -beps*|det|, ...,  tn*sign(det) >= t_lo*|det|
+
+with un = s.(d x e2), vn = d.(s x e1), tn = e2.(s x e1) — no reciprocal
+per pair.  Semantic parity with ray.ray_triangle_hits (and through it the
+reference's CGAL predicates) is asserted by the interpret-mode tests.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .pallas_closest import _BIG, _pad_cols, _pad_rows, make_argmin_kernel
+from .ray import _BARY_EPS, _EPS
+
+
+def _mt_terms(o, d, a, e1, e2):
+    """Division-free Moller-Trumbore terms on broadcastable plane triples.
+
+    ``o``/``d``/``a``/``e1``/``e2`` are (x, y, z) tuples of planes shaped
+    (TQ, 1) or (1, TF) in any mix; every output broadcasts to (TQ, TF).
+    Returns (ad, sd, un, vn, tn): |det|, sign(det), and the sign-carried
+    numerators of u, v, t."""
+    ox, oy, oz = o
+    dx, dy, dz = d
+    ax, ay, az = a
+    e1x, e1y, e1z = e1
+    e2x, e2y, e2z = e2
+    # pvec = d x e2
+    px = dy * e2z - dz * e2y
+    py = dz * e2x - dx * e2z
+    pz = dx * e2y - dy * e2x
+    det = e1x * px + e1y * py + e1z * pz
+    sd = jnp.sign(det)
+    ad = jnp.abs(det)
+    sx, sy, sz = ox - ax, oy - ay, oz - az
+    un = (sx * px + sy * py + sz * pz) * sd
+    # qvec = s x e1
+    qx = sy * e1z - sz * e1y
+    qy = sz * e1x - sx * e1z
+    qz = sx * e1y - sy * e1x
+    vn = (dx * qx + dy * qy + dz * qz) * sd
+    tn = (e2x * qx + e2y * qy + e2z * qz) * sd
+    return ad, sd, un, vn, tn
+
+
+def _mt_hit(o, d, a, e1, e2, eps, beps, t_lo, t_hi):
+    """Boolean hit tile; ``t_lo``/``t_hi`` are python floats or None
+    (unbounded).  Matches ray.ray_triangle_hits(...) & the t bounds."""
+    ad, _, un, vn, tn = _mt_terms(o, d, a, e1, e2)
+    tol = beps * ad
+    hit = (
+        (ad >= eps)
+        & (un >= -tol)
+        & (vn >= -tol)
+        & (un + vn <= ad + tol)
+    )
+    if t_lo is not None:
+        hit = hit & (tn >= t_lo * ad)
+    if t_hi is not None:
+        hit = hit & (tn <= t_hi * ad)
+    return hit
+
+
+def _any_hit_kernel(eps, beps, t_lo, t_hi, *refs):
+    o = tuple(r[:] for r in refs[:3])
+    d = tuple(r[:] for r in refs[3:6])
+    a = tuple(r[:] for r in refs[6:9])
+    e1 = tuple(r[:] for r in refs[9:12])
+    e2 = tuple(r[:] for r in refs[12:15])
+    out_b, acc_b = refs[15:]
+    j = pl.program_id(1)
+    n_j = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_b[:] = jnp.zeros_like(acc_b)
+
+    hit = _mt_hit(o, d, a, e1, e2, eps, beps, t_lo, t_hi)
+    acc_b[:] = acc_b[:] | jnp.any(hit, axis=1, keepdims=True).astype(
+        jnp.int32
+    )
+
+    @pl.when(j == n_j - 1)
+    def _write():
+        out_b[:] = acc_b[:]
+
+
+def _query_cols(arrs, tile_q):
+    """[Q, 3] arrays -> 3 (Q_pad, 1) planes each, zero-padded."""
+    return [
+        _pad_rows(arr[:, k:k + 1], tile_q, 0.0)
+        for arr in arrs
+        for k in range(3)
+    ]
+
+
+def _tri_rows(tri, tile_f):
+    """[F, 3, 3] triangles -> 9 (1, F_pad) planes (a, e1, e2); padded
+    faces are fully degenerate (zero edges): det == 0 -> never hit."""
+    a = tri[:, 0]
+    e1 = tri[:, 1] - tri[:, 0]
+    e2 = tri[:, 2] - tri[:, 0]
+    return [
+        _pad_cols(x[None, :], tile_f, 0.0)
+        for arr in (a, e1, e2)
+        for x in (arr[:, 0], arr[:, 1], arr[:, 2])
+    ]
+
+
+_QCOL = lambda tile_q: pl.BlockSpec((tile_q, 1), lambda i, j: (i, 0))  # noqa: E731
+_FROW = lambda tile_f: pl.BlockSpec((1, tile_f), lambda i, j: (0, j))  # noqa: E731
+
+
+@partial(jax.jit, static_argnames=("t_lo", "t_hi", "tile_q", "tile_f",
+                                   "interpret"))
+def ray_any_hit_pallas(origins, dirs, tri, t_lo=0.0, t_hi=None,
+                       tile_q=256, tile_f=2048, interpret=False):
+    """True per ray iff ``origins[i] + t * dirs[i]`` (t in [t_lo, t_hi],
+    None = unbounded) hits any triangle of ``tri`` [F, 3, 3].  Semantics
+    match ``ray.ray_triangle_hits(...)[1] & (t >= t_lo) ...`` reduced over
+    faces."""
+    origins = jnp.asarray(origins, jnp.float32)
+    dirs = jnp.asarray(dirs, jnp.float32)
+    tri = jnp.asarray(tri, jnp.float32)
+    n_q = origins.shape[0]
+
+    qcols = _query_cols([origins, dirs], tile_q)
+    frows = _tri_rows(tri, tile_f)
+    q_pad = qcols[0].shape[0]
+    f_pad = frows[0].shape[1]
+    grid = (q_pad // tile_q, f_pad // tile_f)
+
+    out_b = pl.pallas_call(
+        partial(_any_hit_kernel, float(_EPS), float(_BARY_EPS), t_lo, t_hi),
+        grid=grid,
+        in_specs=[*[_QCOL(tile_q)] * 6, *[_FROW(tile_f)] * 9],
+        out_specs=_QCOL(tile_q),
+        out_shape=jax.ShapeDtypeStruct((q_pad, 1), jnp.int32),
+        scratch_shapes=[pltpu.VMEM((tile_q, 1), jnp.int32)],
+        interpret=interpret,
+    )(*qcols, *frows)
+    return out_b[:n_q, 0].astype(bool)
+
+
+def _alongnormal_cost_tile(*planes):
+    """|t| per (ray, face) pair where hit, else _BIG — the argmin cost of
+    nearest_alongnormal (t unrestricted in sign: the line through the
+    point along +/- its normal, reference spatialsearchmodule.cpp:275-321).
+    One VPU division per pair (|t| = |tn| / |det|) — unavoidable, the
+    ray parameter itself is needed for the ordering."""
+    o = planes[:3]
+    d = planes[3:6]
+    a = planes[6:9]
+    e1 = planes[9:12]
+    e2 = planes[12:15]
+    ad, _, un, vn, tn = _mt_terms(o, d, a, e1, e2)
+    tol = _BARY_EPS * ad
+    hit = (
+        (ad >= _EPS)
+        & (un >= -tol)
+        & (vn >= -tol)
+        & (un + vn <= ad + tol)
+    )
+    t_abs = jnp.abs(tn) / jnp.where(ad == 0, 1.0, ad)
+    return jnp.where(hit, t_abs, _BIG)
+
+
+_alongnormal_kernel = make_argmin_kernel(_alongnormal_cost_tile)
+
+
+@partial(jax.jit, static_argnames=("tile_q", "tile_f", "interpret"))
+def nearest_alongnormal_pallas(v, f, points, normals, tile_q=256,
+                               tile_f=2048, interpret=False):
+    """Pallas path of ray.nearest_alongnormal: (distance [Q], face [Q]
+    int32, point [Q, 3]); distance is |t| * |n| with +inf when no triangle
+    is hit in either direction."""
+    from .ray import NO_HIT, ray_triangle_hits
+
+    v = jnp.asarray(v, jnp.float32)
+    points = jnp.asarray(points, jnp.float32)
+    normals = jnp.asarray(normals, jnp.float32)
+    tri = v[f]
+    n_q = points.shape[0]
+
+    qcols = _query_cols([points, normals], tile_q)
+    frows = _tri_rows(tri, tile_f)
+    q_pad = qcols[0].shape[0]
+    f_pad = frows[0].shape[1]
+    grid = (q_pad // tile_q, f_pad // tile_f)
+
+    out_i = pl.pallas_call(
+        _alongnormal_kernel,
+        grid=grid,
+        in_specs=[*[_QCOL(tile_q)] * 6, *[_FROW(tile_f)] * 9],
+        out_specs=_QCOL(tile_q),
+        out_shape=jax.ShapeDtypeStruct((q_pad, 1), jnp.int32),
+        scratch_shapes=[
+            pltpu.VMEM((tile_q, 1), jnp.float32),
+            pltpu.VMEM((tile_q, 1), jnp.int32),
+        ],
+        interpret=interpret,
+    )(*qcols, *frows)
+
+    best = out_i[:n_q, 0]
+    # exact recompute on the winning face (divided form, same as the XLA
+    # path); a no-hit winner (arbitrary index) recomputes as miss -> +inf
+    t, hit = ray_triangle_hits(
+        points, normals, tri[best, 0], tri[best, 1], tri[best, 2]
+    )
+    dist = jnp.where(hit, jnp.abs(t) * jnp.linalg.norm(normals, axis=-1),
+                     NO_HIT)
+    point = jnp.where(
+        hit[:, None], points + t[:, None] * normals, 0.0
+    )
+    return dist, best, point
+
+
+def _tri_tri_kernel(eps, *refs):
+    """Any-intersection per (query triangle, mesh triangle) tile,
+    OR-reduced into the per-query accumulator."""
+    qa = tuple(r[:] for r in refs[0:3])
+    qb = tuple(r[:] for r in refs[3:6])
+    qc = tuple(r[:] for r in refs[6:9])
+    ma = tuple(r[:] for r in refs[9:12])
+    me1 = tuple(r[:] for r in refs[12:15])
+    me2 = tuple(r[:] for r in refs[15:18])
+    out_b, acc_b = refs[18:]
+    j = pl.program_id(1)
+    n_j = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_b[:] = jnp.zeros_like(acc_b)
+
+    hit = _tri_tri_hit_tile(qa, qb, qc, ma, me1, me2, eps)
+    acc_b[:] = acc_b[:] | jnp.any(hit, axis=1, keepdims=True).astype(
+        jnp.int32
+    )
+
+    @pl.when(j == n_j - 1)
+    def _write():
+        out_b[:] = acc_b[:]
+
+
+def _tri_tri_hit_tile(qa, qb, qc, ma, me1, me2, eps):
+    """Any-intersection boolean tile between query triangles (corner
+    plane triples qa/qb/qc) and mesh triangles (a/e1/e2 plane triples):
+    the 3 query edges against the mesh face and the 3 mesh edges against
+    the query face — ray.tri_tri_intersects's segment formulation.
+    Shared by the intersection-mask and self-intersection kernels."""
+
+    def sub(u, w):
+        return tuple(ui - wi for ui, wi in zip(u, w))
+
+    mb = tuple(a + e for a, e in zip(ma, me1))
+    mc = tuple(a + e for a, e in zip(ma, me2))
+    # segment t in [-eps, 1+eps] with tight barycentric tolerance, exactly
+    # ray._segment_hits_triangles
+    seg = partial(_mt_hit, eps=eps, beps=eps, t_lo=-eps, t_hi=1.0 + eps)
+    hit = None
+    for s0, s1 in ((qa, qb), (qb, qc), (qc, qa)):
+        h = seg(s0, sub(s1, s0), ma, me1, me2)
+        hit = h if hit is None else hit | h
+    qe1 = sub(qb, qa)
+    qe2 = sub(qc, qa)
+    for s0, s1 in ((ma, mb), (mb, mc), (mc, ma)):
+        hit = hit | seg(s0, sub(s1, s0), qa, qe1, qe2)
+    return hit
+
+
+def _self_intersect_kernel(eps, *refs):
+    """Per-face count of intersecting other faces, excluding the face
+    itself and any vertex-sharing pair (reference
+    Do_intersect_noself_traits, AABB_n_tree.h:95-117)."""
+    qa = tuple(r[:] for r in refs[0:3])
+    qb = tuple(r[:] for r in refs[3:6])
+    qc = tuple(r[:] for r in refs[6:9])
+    qi = refs[9][:]                     # (TQ, 3) int32 vertex ids
+    ma = tuple(r[:] for r in refs[10:13])
+    me1 = tuple(r[:] for r in refs[13:16])
+    me2 = tuple(r[:] for r in refs[16:19])
+    mi = refs[19][:]                    # (3, TF) int32 vertex ids
+    out_c, acc_c = refs[20:]
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    n_j = pl.num_programs(1)
+    tq = qi.shape[0]
+    tf = mi.shape[1]
+
+    @pl.when(j == 0)
+    def _init():
+        acc_c[:] = jnp.zeros_like(acc_c)
+
+    hit = _tri_tri_hit_tile(qa, qb, qc, ma, me1, me2, eps)
+
+    # vertex-sharing exclusion: any of the 9 (row vertex, col vertex)
+    # index pairs equal; plus self-pair exclusion by global face id
+    shares = None
+    for r in range(3):
+        for c in range(3):
+            eq = qi[:, r:r + 1] == mi[c:c + 1, :]
+            shares = eq if shares is None else shares | eq
+    row_id = jax.lax.broadcasted_iota(jnp.int32, (tq, 1), 0) + i * tq
+    col_id = jax.lax.broadcasted_iota(jnp.int32, (1, tf), 1) + j * tf
+    not_self = row_id != col_id
+    counted = hit & ~shares & not_self
+    acc_c[:] = acc_c[:] + jnp.sum(
+        counted.astype(jnp.int32), axis=1, keepdims=True
+    )
+
+    @pl.when(j == n_j - 1)
+    def _write():
+        out_c[:] = acc_c[:]
+
+
+@partial(jax.jit, static_argnames=("tile_q", "tile_f", "interpret"))
+def self_intersection_count_pallas(v, f, tile_q=256, tile_f=512,
+                                   interpret=False):
+    """Pallas path of query.self_intersection_count: the number of ordered
+    intersecting face pairs, excluding vertex-sharing pairs."""
+    v = jnp.asarray(v, jnp.float32)
+    f = jnp.asarray(f, jnp.int32)
+    tri = v[f]
+    n_f = tri.shape[0]
+
+    qcols = _query_cols([tri[:, 0], tri[:, 1], tri[:, 2]], tile_q)
+    # vertex-id planes: padded rows/cols get distinct negative ids so a
+    # padded row never "shares" with a padded column; padded geometry is
+    # degenerate (zero) and never intersects anyway
+    qi = _pad_rows(f, tile_q, -1)
+    frows = _tri_rows(tri, tile_f)
+    mi = _pad_cols(f.T, tile_f, -2)
+    q_pad = qcols[0].shape[0]
+    f_pad = frows[0].shape[1]
+    grid = (q_pad // tile_q, f_pad // tile_f)
+
+    out_c = pl.pallas_call(
+        partial(_self_intersect_kernel, float(_EPS)),
+        grid=grid,
+        in_specs=[
+            *[_QCOL(tile_q)] * 9,
+            pl.BlockSpec((tile_q, 3), lambda i, j: (i, 0)),
+            *[_FROW(tile_f)] * 9,
+            pl.BlockSpec((3, tile_f), lambda i, j: (0, j)),
+        ],
+        out_specs=_QCOL(tile_q),
+        out_shape=jax.ShapeDtypeStruct((q_pad, 1), jnp.int32),
+        scratch_shapes=[pltpu.VMEM((tile_q, 1), jnp.int32)],
+        interpret=interpret,
+    )(*qcols, qi, *frows, mi)
+    return jnp.sum(out_c[:n_f, 0])
+
+
+@partial(jax.jit, static_argnames=("tile_q", "tile_f", "interpret"))
+def tri_tri_any_hit_pallas(q_tri, tri, tile_q=256, tile_f=512,
+                           interpret=False):
+    """True per query triangle iff it intersects any triangle of ``tri``
+    — the Pallas path of query.intersections_mask.  Both inputs are
+    [*, 3, 3] triangle arrays."""
+    q_tri = jnp.asarray(q_tri, jnp.float32)
+    tri = jnp.asarray(tri, jnp.float32)
+    n_q = q_tri.shape[0]
+
+    # query corners as columns (zero-padded: a degenerate query triangle
+    # has zero-length edges and a zero-normal face -> never intersects)
+    qcols = _query_cols([q_tri[:, 0], q_tri[:, 1], q_tri[:, 2]], tile_q)
+    frows = _tri_rows(tri, tile_f)
+    q_pad = qcols[0].shape[0]
+    f_pad = frows[0].shape[1]
+    grid = (q_pad // tile_q, f_pad // tile_f)
+
+    out_b = pl.pallas_call(
+        partial(_tri_tri_kernel, float(_EPS)),
+        grid=grid,
+        in_specs=[*[_QCOL(tile_q)] * 9, *[_FROW(tile_f)] * 9],
+        out_specs=_QCOL(tile_q),
+        out_shape=jax.ShapeDtypeStruct((q_pad, 1), jnp.int32),
+        scratch_shapes=[pltpu.VMEM((tile_q, 1), jnp.int32)],
+        interpret=interpret,
+    )(*qcols, *frows)
+    return out_b[:n_q, 0].astype(bool)

@@ -73,7 +73,6 @@ def ray_triangle_hits(o, d, a, b, c, eps=_EPS, bary_eps=_BARY_EPS):
     return t, hit
 
 
-@partial(jax.jit, static_argnames=("chunk",))
 def nearest_alongnormal(v, f, points, normals, chunk=512):
     """Nearest mesh hit along the line through each point in +/-normal.
 
@@ -81,8 +80,19 @@ def nearest_alongnormal(v, f, points, normals, chunk=512):
     returns (distance [Q], face [Q] int32, point [Q, 3]); distance is the
     euclidean distance from the query to the hit (|t| * |n|), +inf when no
     triangle is hit in either direction (the Mesh facade maps that to the
-    reference's 1e100 sentinel).
+    reference's 1e100 sentinel).  On accelerators the O(Q*F) scan runs in
+    the Pallas min-hit kernel (pallas_ray.py); the XLA tiling below is the
+    CPU/interpret path.
     """
+    if jax.devices()[0].platform == "tpu":
+        from .pallas_ray import nearest_alongnormal_pallas
+
+        return nearest_alongnormal_pallas(v, f, points, normals)
+    return _nearest_alongnormal_xla(v, f, points, normals, chunk=chunk)
+
+
+@partial(jax.jit, static_argnames=("chunk",))
+def _nearest_alongnormal_xla(v, f, points, normals, chunk=512):
     v = jnp.asarray(v)
     points = jnp.asarray(points, v.dtype)
     normals = jnp.asarray(normals, v.dtype)
@@ -146,13 +156,32 @@ def tri_tri_intersects(p, q, eps=_EPS):
     return out
 
 
-@partial(jax.jit, static_argnames=("chunk",))
 def intersections_mask(v, f, q_v, q_f, chunk=128):
     """Boolean mask over query faces: does q_f[i] intersect the (v, f) mesh?
 
     Fixed-shape replacement for AabbTree.intersections_indices
     (search.py:39-49); `np.nonzero(mask)` recovers the reference's index list.
+    On accelerators the O(QF*F) pair grid runs in the Pallas triangle-
+    triangle kernel (pallas_ray.py); the XLA tiling below is the
+    CPU/interpret path.
     """
+    if jax.devices()[0].platform == "tpu":
+        return _intersections_mask_pallas(v, f, q_v, q_f)
+    return _intersections_mask_xla(v, f, q_v, q_f, chunk=chunk)
+
+
+@jax.jit
+def _intersections_mask_pallas(v, f, q_v, q_f):
+    # one jitted dispatch: the gathers fuse into the same launch as the
+    # kernel instead of running as eager per-op round trips
+    from .pallas_ray import tri_tri_any_hit_pallas
+
+    v = jnp.asarray(v)
+    return tri_tri_any_hit_pallas(jnp.asarray(q_v, v.dtype)[q_f], v[f])
+
+
+@partial(jax.jit, static_argnames=("chunk",))
+def _intersections_mask_xla(v, f, q_v, q_f, chunk=128):
     v = jnp.asarray(v)
     tri_mesh = v[f]  # [F, 3, 3]
     q_tri = jnp.asarray(q_v, v.dtype)[q_f]  # [QF, 3, 3]
@@ -169,15 +198,24 @@ def intersections_mask(v, f, q_v, q_f, chunk=128):
     return mask.reshape(-1)[:n_q]
 
 
-@partial(jax.jit, static_argnames=("chunk",))
 def self_intersection_count(v, f, chunk=128):
     """Count of ordered intersecting face pairs, excluding vertex-sharing pairs.
 
     Parity with aabb_normals.aabbtree_n_selfintersects (aabb_normals.cpp:
     192-207): the CGAL traversal counts each unordered intersecting pair twice
     (tree vs own triangles), and pairs sharing any vertex index are excluded
-    (Do_intersect_noself_traits, AABB_n_tree.h:95-117).
+    (Do_intersect_noself_traits, AABB_n_tree.h:95-117).  On accelerators the
+    O(F^2) pair grid runs in the Pallas kernel (pallas_ray.py).
     """
+    if jax.devices()[0].platform == "tpu":
+        from .pallas_ray import self_intersection_count_pallas
+
+        return self_intersection_count_pallas(v, f)
+    return _self_intersection_count_xla(v, f, chunk=chunk)
+
+
+@partial(jax.jit, static_argnames=("chunk",))
+def _self_intersection_count_xla(v, f, chunk=128):
     v = jnp.asarray(v)
     tri = v[f]  # [F, 3, 3]
     n_f = tri.shape[0]

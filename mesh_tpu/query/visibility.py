@@ -20,6 +20,22 @@ import jax.numpy as jnp
 from .ray import ray_triangle_hits
 
 
+def _sensor_mask(vts, dirs, cam, sensor):
+    """True where the ray from vts along dirs lands within the camera's
+    sensor plane extents (the reference's 9-float sensor model,
+    visibility.cpp:96-113: x-axis, y-axis, z-axis rows of the plane)."""
+    xoff, yoff, zoff = sensor[0:3], sensor[3:6], -sensor[6:9]
+    planeoff = jnp.dot(zoff, cam + zoff)
+    denom = jnp.sum(zoff[None] * dirs, axis=-1)
+    denom = jnp.where(denom == 0, 1e-30, denom)
+    tt = -(vts @ zoff - planeoff) / denom
+    p_i = (vts + tt[:, None] * dirs) - (cam + zoff)[None]
+    return (
+        (jnp.abs(p_i @ xoff) < jnp.dot(xoff, xoff))
+        & (jnp.abs(p_i @ yoff) < jnp.dot(yoff, yoff))
+    )
+
+
 @partial(jax.jit, static_argnames=("chunk",))
 def _visibility_kernel(verts, occ_a, occ_b, occ_c, cams, normals, sensors, min_dist, chunk=1024):
     n_v = verts.shape[0]
@@ -41,17 +57,7 @@ def _visibility_kernel(verts, occ_a, occ_b, occ_c, cams, normals, sensors, min_d
             reach = ~blocked
             n_dot_cam = jnp.sum(nrm * dirs, axis=-1)
             if sensor is not None:
-                xoff, yoff, zoff = sensor[0:3], sensor[3:6], -sensor[6:9]
-                planeoff = jnp.dot(zoff, cam + zoff)
-                denom = jnp.sum(zoff[None] * dirs, axis=-1)
-                denom = jnp.where(denom == 0, 1e-30, denom)
-                tt = -(vts @ zoff - planeoff) / denom
-                p_i = (vts + tt[:, None] * dirs) - (cam + zoff)[None]
-                on_sensor = (
-                    (jnp.abs(p_i @ xoff) < jnp.dot(xoff, xoff))
-                    & (jnp.abs(p_i @ yoff) < jnp.dot(yoff, yoff))
-                )
-                reach = reach & on_sensor
+                reach = reach & _sensor_mask(vts, dirs, cam, sensor)
             return reach, n_dot_cam
 
         vis, ndc = jax.lax.map(
@@ -64,6 +70,31 @@ def _visibility_kernel(verts, occ_a, occ_b, occ_c, cams, normals, sensors, min_d
     else:
         vis, ndc = jax.vmap(per_cam)(cams, sensors)
     return vis, ndc
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def _visibility_kernel_pallas(verts, tri, cams, normals, sensors, min_dist,
+                              interpret=False):
+    """Accelerator path: the O(C*V*F) blocked test runs in the Pallas
+    any-hit kernel (VMEM-resident accumulators, one launch for all
+    cameras); the O(C*V) direction/sensor math stays in XLA."""
+    from .pallas_ray import ray_any_hit_pallas
+
+    dirs = cams[:, None, :] - verts[None]               # (C, V, 3)
+    dirs = dirs / jnp.linalg.norm(dirs, axis=-1, keepdims=True)
+    origins = verts[None] + min_dist * dirs
+    n_c, n_v = dirs.shape[:2]
+    blocked = ray_any_hit_pallas(
+        origins.reshape(-1, 3), dirs.reshape(-1, 3), tri,
+        interpret=interpret,
+    ).reshape(n_c, n_v)
+    reach = ~blocked
+    ndc = jnp.sum(normals[None] * dirs, axis=-1)
+    if sensors is not None:
+        reach = reach & jax.vmap(
+            lambda cam, sensor, d: _sensor_mask(verts, d, cam, sensor)
+        )(cams, sensors, dirs)
+    return reach, ndc
 
 
 def visibility_compute(
@@ -102,8 +133,13 @@ def visibility_compute(
         else jnp.zeros_like(v)
     )
     sens = None if sensors is None else jnp.atleast_2d(jnp.asarray(sensors, jnp.float32))
-    vis, ndc = _visibility_kernel(
-        v, occ[:, 0], occ[:, 1], occ[:, 2], cams, normals, sens,
-        jnp.float32(min_dist),
-    )
+    if jax.devices()[0].platform == "tpu":
+        vis, ndc = _visibility_kernel_pallas(
+            v, occ, cams, normals, sens, jnp.float32(min_dist)
+        )
+    else:
+        vis, ndc = _visibility_kernel(
+            v, occ[:, 0], occ[:, 1], occ[:, 2], cams, normals, sens,
+            jnp.float32(min_dist),
+        )
     return np.asarray(vis).astype(np.uint32), np.asarray(ndc, dtype=np.float64)

@@ -1,0 +1,169 @@
+"""Any-hit ray kernel correctness (interpret mode on the CPU test platform;
+the same kernel runs compiled on TPU inside visibility_compute — see
+tests/test_tpu_compiled.py)."""
+
+import numpy as np
+
+from mesh_tpu.query.pallas_ray import ray_any_hit_pallas
+from mesh_tpu.query.ray import ray_triangle_hits
+from mesh_tpu.query.visibility import (
+    _visibility_kernel, _visibility_kernel_pallas,
+)
+
+from .fixtures import box, icosphere
+
+
+def _xla_any_hit(origins, dirs, tri):
+    t, hit = ray_triangle_hits(
+        origins[:, None, :], dirs[:, None, :],
+        tri[None, :, 0], tri[None, :, 1], tri[None, :, 2],
+    )
+    return np.asarray(np.any(np.asarray(hit & (t >= 0.0)), axis=-1))
+
+
+class TestRayAnyHitPallas:
+    def test_matches_xla_reduction(self):
+        rng = np.random.RandomState(0)
+        v, f = icosphere(2)
+        tri = v[f].astype(np.float32)
+        # rays from random points in a shell, random directions: a mix of
+        # hits (inward) and misses (outward/tangent)
+        origins = (rng.randn(300, 3) * 1.5).astype(np.float32)
+        dirs = rng.randn(300, 3).astype(np.float32)
+        dirs /= np.linalg.norm(dirs, axis=1, keepdims=True)
+        ref = _xla_any_hit(origins, dirs, tri)
+        out = np.asarray(
+            ray_any_hit_pallas(origins, dirs, tri, tile_q=32, tile_f=64,
+                               interpret=True)
+        )
+        np.testing.assert_array_equal(out, ref)
+        assert ref.any() and not ref.all()  # the case exercises both sides
+
+    def test_ray_not_segment(self):
+        # a hit far along the ray (t >> 1) must still block: the reference
+        # casts CGAL Ray_3 to infinity (visibility.cpp:96-99)
+        v, f = box(2.0)
+        tri = v[f].astype(np.float32)
+        origins = np.array([[0.0, 0.0, -50.0]], np.float32)
+        dirs = np.array([[0.0, 0.0, 1.0]], np.float32)
+        out = ray_any_hit_pallas(origins, dirs, tri, tile_q=8, tile_f=16,
+                                 interpret=True)
+        assert bool(np.asarray(out)[0])
+        # and the opposite direction misses (t < 0 never blocks)
+        out2 = ray_any_hit_pallas(origins, -dirs, tri, tile_q=8, tile_f=16,
+                                  interpret=True)
+        assert not bool(np.asarray(out2)[0])
+
+    def test_segment_mode_t_bounds(self):
+        # t in [0, 1]: a segment stopping short of the box must not hit
+        v, f = box(2.0)
+        tri = v[f].astype(np.float32)
+        origins = np.array([[0.0, 0.0, -50.0]], np.float32)
+        dirs = np.array([[0.0, 0.0, 10.0]], np.float32)   # reaches z=-40
+        short = ray_any_hit_pallas(origins, dirs, tri, t_lo=0.0, t_hi=1.0,
+                                   tile_q=8, tile_f=16, interpret=True)
+        assert not bool(np.asarray(short)[0])
+        dirs_far = np.array([[0.0, 0.0, 100.0]], np.float32)  # reaches z=50
+        crossing = ray_any_hit_pallas(origins, dirs_far, tri, t_lo=0.0,
+                                      t_hi=1.0, tile_q=8, tile_f=16,
+                                      interpret=True)
+        assert bool(np.asarray(crossing)[0])
+
+    def test_nearest_alongnormal_matches_xla(self):
+        from mesh_tpu.query.pallas_ray import nearest_alongnormal_pallas
+        from mesh_tpu.query.ray import _nearest_alongnormal_xla
+
+        rng = np.random.RandomState(2)
+        v, f = icosphere(2)
+        v32 = v.astype(np.float32)
+        f32 = f.astype(np.int32)
+        pts = (rng.randn(120, 3) * 1.2).astype(np.float32)
+        # mix: radial normals (hit), random normals (hit/miss), plus a few
+        # guaranteed misses far away pointing outward
+        nrm = np.vstack([
+            pts[:60] / np.linalg.norm(pts[:60], axis=1, keepdims=True),
+            rng.randn(60, 3).astype(np.float32),
+        ]).astype(np.float32)
+        far = np.array([[50.0, 0, 0]], np.float32)
+        pts = np.vstack([pts, far])
+        nrm = np.vstack([nrm, np.array([[0.0, 1.0, 0.0]], np.float32)])
+        d_x, f_x, p_x = _nearest_alongnormal_xla(v32, f32, pts, nrm)
+        d_p, f_p, p_p = nearest_alongnormal_pallas(
+            v32, f32, pts, nrm, tile_q=32, tile_f=64, interpret=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(d_p), np.asarray(d_x), atol=1e-5
+        )
+        assert not np.isfinite(np.asarray(d_p)[-1])    # the planted miss
+        same = np.asarray(f_p) == np.asarray(f_x)
+        np.testing.assert_allclose(
+            np.asarray(p_p)[same], np.asarray(p_x)[same], atol=1e-5
+        )
+
+    def test_tri_tri_matches_xla(self):
+        from mesh_tpu.query.pallas_ray import tri_tri_any_hit_pallas
+        from mesh_tpu.query.ray import _intersections_mask_xla
+
+        v, f = icosphere(2)
+        # query mesh: the same sphere shifted so the shells interpenetrate
+        # on one side only -> a genuine mix of hits and misses
+        qv = (v + np.array([1.2, 0.0, 0.0])).astype(np.float32)
+        ref = np.asarray(
+            _intersections_mask_xla(v.astype(np.float32), f, qv, f)
+        )
+        out = np.asarray(
+            tri_tri_any_hit_pallas(
+                qv[f], v.astype(np.float32)[f], tile_q=32, tile_f=64,
+                interpret=True,
+            )
+        )
+        np.testing.assert_array_equal(out, ref)
+        assert ref.any() and not ref.all()
+
+    def test_self_intersection_count_matches_xla(self):
+        from mesh_tpu.query.pallas_ray import self_intersection_count_pallas
+        from mesh_tpu.query.ray import _self_intersection_count_xla
+
+        # clean sphere: zero; sphere + one pierced face: the XLA oracle
+        v, f = icosphere(2)
+        v32, f32 = v.astype(np.float32), f.astype(np.int32)
+        assert int(self_intersection_count_pallas(
+            v32, f32, tile_q=32, tile_f=64, interpret=True)) == 0
+        # graft a large triangle slicing through the sphere (no shared
+        # vertices with the shell -> every crossing counts)
+        n0 = len(v32)
+        v2 = np.vstack([v32, [[-2, -2, 0.1], [2, -2, 0.1], [0, 3, 0.1]]])
+        f2 = np.vstack([f32, [[n0, n0 + 1, n0 + 2]]]).astype(np.int32)
+        ref = int(_self_intersection_count_xla(v2, f2))
+        out = int(self_intersection_count_pallas(
+            v2, f2, tile_q=32, tile_f=64, interpret=True))
+        assert out == ref
+        assert ref > 0
+
+    def test_visibility_pallas_path_matches_xla(self):
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(1)
+        v, f = icosphere(2)
+        v32 = jnp.asarray(v, jnp.float32)
+        tri = v32[jnp.asarray(f)]
+        cams = jnp.asarray([[3.0, 0.0, 0.0], [0.0, -2.5, 1.0]], jnp.float32)
+        normals = jnp.asarray(
+            v / np.linalg.norm(v, axis=1, keepdims=True), jnp.float32
+        )
+        sensors = jnp.asarray(
+            np.tile(np.eye(3).reshape(-1), (2, 1)) * 2.0, jnp.float32
+        )
+        for sens in (None, sensors):
+            vis_x, ndc_x = _visibility_kernel(
+                v32, tri[:, 0], tri[:, 1], tri[:, 2], cams, normals, sens,
+                jnp.float32(1e-3), chunk=64,
+            )
+            vis_p, ndc_p = _visibility_kernel_pallas(
+                v32, tri, cams, normals, sens, jnp.float32(1e-3),
+                interpret=True,
+            )
+            np.testing.assert_array_equal(np.asarray(vis_p), np.asarray(vis_x))
+            np.testing.assert_allclose(
+                np.asarray(ndc_p), np.asarray(ndc_x), atol=1e-6
+            )
