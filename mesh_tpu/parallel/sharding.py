@@ -52,22 +52,43 @@ def _pad_rows(arr, multiple):
     return arr, pad
 
 
+def _mesh_on_tpu(mesh):
+    """Whether a jax.sharding.Mesh's devices are TPU cores — the per-shard
+    kernel choice keys on the mesh's platform, not the process default
+    (a CPU test mesh can exist on a TPU host)."""
+    return mesh.devices.flat[0].platform == "tpu"
+
+
+def _closest_local(v, f, pts, chunk, use_pallas):
+    """Per-shard closest-point body: the Pallas scan when the shards run
+    on TPU cores (pallas_call composes with shard_map), the XLA tiling
+    elsewhere (the virtual CPU test mesh)."""
+    if use_pallas:
+        from ..query.pallas_closest import closest_point_pallas
+
+        return closest_point_pallas(v, f, pts)
+    return closest_faces_and_points(v, f, pts, chunk=chunk)
+
+
 @lru_cache(maxsize=32)
 def _closest_shard_fn(mesh, axis, chunk):
     """Compiled sharded closest-point, cached per (mesh, axis, chunk) so
     repeated calls reuse the executable instead of retracing."""
+    use_pallas = _mesh_on_tpu(mesh)
 
     @partial(
         jax.shard_map,
         mesh=mesh,
         in_specs=(P(), P(), P(axis)),
-        out_specs=P(axis),
+        out_specs=(P(axis), P(axis)),
+        # pallas_call inside shard_map cannot express per-output varying
+        # axes for the vma check; keep the check on the XLA path
+        check_vma=not use_pallas,
     )
     def _run(v_rep, f_rep, pts_shard):
-        res = closest_faces_and_points(v_rep, f_rep, pts_shard, chunk=chunk)
-        return jnp.stack(
+        res = _closest_local(v_rep, f_rep, pts_shard, chunk, use_pallas)
+        packed = jnp.stack(
             [
-                res["face"].astype(jnp.float32),
                 res["part"].astype(jnp.float32),
                 res["sqdist"],
                 res["point"][:, 0],
@@ -76,6 +97,9 @@ def _closest_shard_fn(mesh, axis, chunk):
             ],
             axis=1,
         )
+        # face ids travel as int32: a float32 lane would corrupt ids past
+        # 2^24, exactly the huge-F regime the replicated-mesh path can see
+        return packed, res["face"].astype(jnp.int32)
 
     return jax.jit(_run)
 
@@ -91,20 +115,22 @@ def sharded_closest_faces_and_points(v, f, points, mesh, axis="dp", chunk=512):
     points = np.asarray(points, np.float32)
     points_padded, pad = _pad_rows(points, n_shards)
 
-    out = _closest_shard_fn(mesh, axis, chunk)(
+    out, face = _closest_shard_fn(mesh, axis, chunk)(
         jnp.asarray(v, jnp.float32), jnp.asarray(f, jnp.int32),
         jax.device_put(
             points_padded, NamedSharding(mesh, P(axis))
         ),
     )
     out = np.asarray(out)
+    face = np.asarray(face)
     if pad:
         out = out[:-pad]
+        face = face[:-pad]
     return {
-        "face": out[:, 0].astype(np.int32),
-        "part": out[:, 1].astype(np.int32),
-        "sqdist": out[:, 2],
-        "point": out[:, 3:6],
+        "face": face.astype(np.int32),
+        "part": out[:, 0].astype(np.int32),
+        "sqdist": out[:, 1],
+        "point": out[:, 2:5],
     }
 
 
@@ -117,6 +143,7 @@ def _closest_fsharded_fn(mesh, axis, chunk):
     is sharded" collective SURVEY.md section 5 calls for.  This is the
     shape that scales when the occluder mesh itself is too large for one
     device (queries are replicated, O(F) state is sharded)."""
+    use_pallas = _mesh_on_tpu(mesh)
 
     @partial(
         jax.shard_map,
@@ -128,7 +155,7 @@ def _closest_fsharded_fn(mesh, axis, chunk):
         check_vma=False,
     )
     def _run(v_rep, f_shard, pts_rep):
-        local = closest_faces_and_points(v_rep, f_shard, pts_rep, chunk=chunk)
+        local = _closest_local(v_rep, f_shard, pts_rep, chunk, use_pallas)
         shard_id = jax.lax.axis_index(axis)
         packed = jnp.stack(
             [
@@ -193,18 +220,22 @@ def sharded_closest_faces_sharded_topology(v, f, points, mesh, axis="dp",
 
 @lru_cache(maxsize=32)
 def _visibility_shard_fn(mesh, axis, chunk, min_dist):
-    from ..query.visibility import _visibility_kernel
+    from ..query.visibility import _visibility_local
+
+    use_pallas = _mesh_on_tpu(mesh)
 
     @partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(P(axis), P(axis), P(), P(), P(), P()),
+        in_specs=(P(axis), P(axis), P(), P()),
         out_specs=(P(None, axis), P(None, axis)),
+        # see _closest_shard_fn: pallas outputs carry no vma annotation
+        check_vma=not use_pallas,
     )
-    def _run(v_shard, n_shard, occ_a, occ_b, occ_c, cams_rep):
-        return _visibility_kernel(
-            v_shard, occ_a, occ_b, occ_c, cams_rep, n_shard, None,
-            jnp.float32(min_dist), chunk=chunk,
+    def _run(v_shard, n_shard, occ_tri, cams_rep):
+        return _visibility_local(
+            v_shard, occ_tri, cams_rep, n_shard, None,
+            jnp.float32(min_dist), chunk=chunk, use_pallas=use_pallas,
         )
 
     return jax.jit(_run)
@@ -237,7 +268,7 @@ def sharded_visibility(v, f, cams, n=None, mesh=None, axis="dp",
     vis, ndc = _visibility_shard_fn(mesh, axis, chunk, float(min_dist))(
         jax.device_put(v_padded, shard),
         jax.device_put(n_padded, shard),
-        jnp.asarray(occ[:, 0]), jnp.asarray(occ[:, 1]), jnp.asarray(occ[:, 2]),
+        jnp.asarray(occ),
         cams_j,
     )
     vis, ndc = np.asarray(vis), np.asarray(ndc, np.float64)

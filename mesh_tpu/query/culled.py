@@ -113,8 +113,27 @@ def closest_faces_and_points_auto(
     meshes take the culled path, and any query whose certificate is not tight
     (candidate set could not be proven optimal) is re-run through brute force,
     so the result is always exact.  Host-boundary function (returns numpy).
+
+    On TPU both branches run their Pallas kernels: the VMEM-tiled
+    brute-force scan, and the tile-sphere-culled kernel, which is exact by
+    construction (its bounds are conservative — no certificate/fallback
+    pass is needed, pallas_culled.py).
     """
     f = np.asarray(f)
+    if jax.devices()[0].platform == "tpu":
+        from .pallas_closest import closest_point_pallas
+        from .pallas_culled import closest_point_pallas_culled
+
+        kernel = (
+            closest_point_pallas
+            if f.shape[0] <= brute_force_max_faces
+            else closest_point_pallas_culled
+        )
+        res = kernel(
+            np.asarray(v, np.float32), f.astype(np.int32),
+            np.asarray(points, np.float32).reshape(-1, 3),
+        )
+        return {key: np.asarray(val) for key, val in res.items()}
     if f.shape[0] <= brute_force_max_faces:
         res = closest_faces_and_points(v, f, points)
         return {key: np.asarray(val) for key, val in res.items()}

@@ -97,6 +97,25 @@ def _visibility_kernel_pallas(verts, tri, cams, normals, sensors, min_dist,
     return reach, ndc
 
 
+def _visibility_local(verts, occ_tri, cams, normals, sensors, min_dist,
+                      chunk=1024, use_pallas=None):
+    """Single dispatch point for the (camera x vertex x triangle) core:
+    the Pallas any-hit kernel when running on TPU devices, the XLA tiling
+    otherwise.  ``use_pallas`` overrides the process-default check when
+    the caller targets a specific device set (the shard_map bodies in
+    parallel/sharding.py pass the mesh's platform)."""
+    if use_pallas is None:
+        use_pallas = jax.devices()[0].platform == "tpu"
+    if use_pallas:
+        return _visibility_kernel_pallas(
+            verts, occ_tri, cams, normals, sensors, min_dist
+        )
+    return _visibility_kernel(
+        verts, occ_tri[:, 0], occ_tri[:, 1], occ_tri[:, 2], cams, normals,
+        sensors, min_dist, chunk=chunk,
+    )
+
+
 def visibility_compute(
     v,
     f,
@@ -133,13 +152,7 @@ def visibility_compute(
         else jnp.zeros_like(v)
     )
     sens = None if sensors is None else jnp.atleast_2d(jnp.asarray(sensors, jnp.float32))
-    if jax.devices()[0].platform == "tpu":
-        vis, ndc = _visibility_kernel_pallas(
-            v, occ, cams, normals, sens, jnp.float32(min_dist)
-        )
-    else:
-        vis, ndc = _visibility_kernel(
-            v, occ[:, 0], occ[:, 1], occ[:, 2], cams, normals, sens,
-            jnp.float32(min_dist),
-        )
+    vis, ndc = _visibility_local(
+        v, occ, cams, normals, sens, jnp.float32(min_dist)
+    )
     return np.asarray(vis).astype(np.uint32), np.asarray(ndc, dtype=np.float64)

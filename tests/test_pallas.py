@@ -45,6 +45,34 @@ class TestPallasClosestPoint:
             np.asarray(out["point"]), [[0.3, 0.2, -1.0]], atol=1e-6
         )
 
+    def test_far_from_origin_conditioning(self):
+        """The centering prologue must keep the corner-a derived terms
+        (d3 = d1 - ab2 etc.) well-conditioned when the mesh sits far from
+        the origin — raw f32 coordinates at offset 1e3 would lose ~7
+        digits to cancellation without it."""
+        rng = np.random.RandomState(5)
+        v, f = icosphere(2)
+        offset = np.array([1e3, -2e3, 5e2])
+        v_far = (v + offset).astype(np.float32)
+        f = f.astype(np.int32)
+        q_far = ((rng.randn(100, 3) * 0.8) + offset).astype(np.float32)
+        out = closest_point_pallas(v_far, f, q_far, tile_q=32, tile_f=128,
+                                   interpret=True)
+        # genuine f64 oracle: without enable_x64 jnp would silently
+        # downcast and the oracle would share the f32 rounding under test
+        import jax
+
+        with jax.enable_x64(True):
+            ref = closest_faces_and_points(
+                (v + offset).astype(np.float64), f,
+                q_far.astype(np.float64),
+            )
+        np.testing.assert_allclose(
+            np.sqrt(np.asarray(out["sqdist"])),
+            np.sqrt(np.asarray(ref["sqdist"])),
+            atol=1e-4,
+        )
+
     def test_degenerate_faces_never_underreport(self):
         """Zero-area and collinear faces must fall through to their
         vertex/edge regions (zeroed reciprocals in _face_rows_fast), not

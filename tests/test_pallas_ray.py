@@ -120,6 +120,23 @@ class TestRayAnyHitPallas:
         np.testing.assert_array_equal(out, ref)
         assert ref.any() and not ref.all()
 
+    def test_tri_tri_random_soup_matches_xla(self):
+        from mesh_tpu.query.pallas_ray import tri_tri_any_hit_pallas
+        from mesh_tpu.query.ray import _intersections_mask_xla
+
+        rng = np.random.RandomState(7)
+        v = rng.randn(60, 3).astype(np.float32)
+        f = rng.randint(0, 60, size=(120, 3)).astype(np.int32)
+        qv = (rng.randn(40, 3) * 0.8).astype(np.float32)
+        qf = rng.randint(0, 40, size=(70, 3)).astype(np.int32)
+        ref = np.asarray(_intersections_mask_xla(v, f, qv, qf))
+        out = np.asarray(
+            tri_tri_any_hit_pallas(qv[qf], v[f], tile_q=16, tile_f=32,
+                                   interpret=True)
+        )
+        np.testing.assert_array_equal(out, ref)
+        assert ref.any() and not ref.all()
+
     def test_self_intersection_count_matches_xla(self):
         from mesh_tpu.query.pallas_ray import self_intersection_count_pallas
         from mesh_tpu.query.ray import _self_intersection_count_xla

@@ -161,6 +161,59 @@ class TestCompiledPallasParity:
         assert out == ref
         assert ref > 0    # a random triangle soup self-intersects a lot
 
+    def test_sharded_paths_run_pallas_per_shard(self):
+        """shard_map composes with the Pallas kernels on TPU: the sharded
+        closest-point and visibility entry points must agree with the
+        unsharded kernels on a 1-device mesh (the multi-device form is
+        covered by the virtual-CPU suite, which takes the XLA branch)."""
+        from mesh_tpu.parallel.sharding import (
+            make_device_mesh, sharded_closest_faces_and_points,
+            sharded_closest_faces_sharded_topology, sharded_visibility,
+        )
+        from mesh_tpu.query import closest_faces_and_points
+        from mesh_tpu.query.visibility import visibility_compute
+
+        v, f = _random_mesh(seed=14)
+        rng = np.random.RandomState(15)
+        pts = rng.randn(200, 3).astype(np.float32)
+        mesh = make_device_mesh(n_devices=1, axis_names=("dp",))
+        ref = closest_faces_and_points(v, f, pts)
+        out = sharded_closest_faces_and_points(v, f, pts, mesh)
+        np.testing.assert_allclose(
+            np.asarray(out["sqdist"]), np.asarray(ref["sqdist"]), atol=1e-5
+        )
+        out_f = sharded_closest_faces_sharded_topology(v, f, pts, mesh)
+        np.testing.assert_allclose(
+            np.asarray(out_f["sqdist"]), np.asarray(ref["sqdist"]), atol=1e-5
+        )
+        cams = np.array([[4.0, 0, 0]], np.float32)
+        nrm = rng.randn(len(v), 3).astype(np.float32)
+        vis_s, ndc_s = sharded_visibility(v, f, cams, n=nrm, mesh=mesh)
+        vis_r, ndc_r = visibility_compute(v, f, cams, n=nrm)
+        np.testing.assert_array_equal(vis_s, vis_r)
+        np.testing.assert_allclose(ndc_s, ndc_r, atol=1e-5)
+
+    def test_aabb_tree_facade_takes_pallas_branch_on_tpu(self):
+        """AabbTree.nearest routes through closest_faces_and_points_auto,
+        whose TPU branch runs the Pallas kernels; results must match the
+        XLA reference and keep the reference's (1, S) return shapes."""
+        from mesh_tpu import Mesh
+        from mesh_tpu.query import closest_faces_and_points
+
+        v, f = _random_mesh(seed=16)
+        m = Mesh(v=np.asarray(v, np.float64), f=f.astype(np.uint32))
+        tree = m.compute_aabb_tree()
+        rng = np.random.RandomState(17)
+        pts = rng.randn(150, 3)
+        f_idx, f_part, points = tree.nearest(pts, nearest_part=True)
+        assert f_idx.shape == (1, 150) and f_part.shape == (1, 150)
+        ref = closest_faces_and_points(
+            v, f, np.asarray(pts, np.float32)
+        )
+        d_t = np.linalg.norm(points - pts, axis=1)
+        d_r = np.linalg.norm(np.asarray(ref["point"]) - pts, axis=1)
+        np.testing.assert_allclose(d_t, d_r, atol=1e-5)
+
     def test_search_facade_takes_pallas_branch_on_tpu(self):
         """search.py AabbNormalsTree routes to the compiled Pallas kernel
         when the backend is TPU — exercise that exact branch."""
