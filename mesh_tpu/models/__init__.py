@@ -1,7 +1,9 @@
 from .body_model import (  # noqa: F401
+    MODEL_FAMILIES,
     BodyModel,
     lbs,
     load_body_model_npz,
-    synthetic_body_model,
     smpl_sized_sphere,
+    synthetic_body_model,
+    synthetic_family_model,
 )

@@ -137,11 +137,9 @@ def lbs(model, betas, pose, trans=None, precision=jax.lax.Precision.HIGHEST):
     return v_out, posed_joints
 
 
-def smpl_sized_sphere():
-    """A UV-sphere with *exactly* SMPL's vertex/face counts (6890 v, 13776 f):
-    84 latitude rings x 82 segments + 2 poles.  Used so benchmarks exercise
-    the precise shapes of BASELINE.md configs without shipping SMPL data."""
-    n_seg, n_ring = 82, 84
+def _uv_sphere(n_seg, n_ring):
+    """Unit UV-sphere: n_ring latitude rings x n_seg segments + 2 poles
+    -> (n_seg * n_ring + 2 vertices, 2 * n_seg * n_ring faces)."""
     theta = np.pi * (np.arange(1, n_ring + 1)) / (n_ring + 1)
     phi = 2 * np.pi * np.arange(n_seg) / n_seg
     rings = np.stack(
@@ -166,7 +164,14 @@ def smpl_sized_sphere():
         faces.append([0, 1 + s, 1 + s1])
         last = 1 + (n_ring - 1) * n_seg
         faces.append([len(v) - 1, last + s1, last + s])
-    f = np.array(faces, dtype=np.int32)
+    return v, np.array(faces, dtype=np.int32)
+
+
+def smpl_sized_sphere():
+    """A UV-sphere with *exactly* SMPL's vertex/face counts (6890 v, 13776 f):
+    84 latitude rings x 82 segments + 2 poles.  Used so benchmarks exercise
+    the precise shapes of BASELINE.md configs without shipping SMPL data."""
+    v, f = _uv_sphere(82, 84)
     assert v.shape == (6890, 3) and f.shape == (13776, 3)
     return v, f
 
@@ -215,6 +220,85 @@ def synthetic_body_model(seed=0, n_betas=10, n_joints=24, template=None,
         lbs_weights=jnp.asarray(lbs_weights, dtype),
         faces=jnp.asarray(f, jnp.int32),
         parents=tuple(parents),
+    )
+
+
+def _parametric_sphere(n_v_target):
+    """A UV-sphere with exactly ``n_v_target`` vertices, proportioned like
+    smpl_sized_sphere.  Used by the synthetic model-family constructors so
+    each family exercises its real vertex count without shipping licensed
+    template data.
+
+    Builds the near-square rings*segs+2 grid not exceeding the target
+    (n_seg closest to sqrt(target), so triangles stay well-proportioned
+    like smpl_sized_sphere's 82x84 rather than a sliver needle), then adds
+    the remainder — at most n_seg - 1 vertices — via centroid face splits
+    (1 face -> 3, projected back to the sphere): exact counts even when
+    n_v_target - 2 has no usable factorization (e.g. SMPL-X's 10475)."""
+    root = float(np.sqrt(max(n_v_target - 2, 1)))
+    best = None
+    for n_seg in range(3, 400):
+        n_ring = (n_v_target - 2) // n_seg
+        if n_ring >= 3:
+            if best is None or abs(n_seg - root) < abs(best[0] - root):
+                best = (n_seg, n_ring)
+    if best is None:
+        raise ValueError("n_v_target too small: %d" % n_v_target)
+    n_seg, n_ring = best
+    v, f = _uv_sphere(n_seg, n_ring)
+    faces = f.tolist()
+    v = list(v)
+    n_extra = n_v_target - len(v)
+    stride = max(1, len(faces) // max(n_extra, 1))
+    for k in range(n_extra):
+        fi = (k * stride) % len(faces)
+        a, b, c = faces[fi]
+        centroid = (np.asarray(v[a]) + v[b] + v[c]) / 3.0
+        centroid = centroid / np.linalg.norm(centroid)
+        new = len(v)
+        v.append(centroid)
+        faces[fi] = [a, b, new]
+        faces.append([b, c, new])
+        faces.append([c, a, new])
+    v = np.asarray(v)
+    assert len(v) == n_v_target
+    return v, np.array(faces, dtype=np.int32)
+
+
+#: (vertices, joints, betas) of the SMPL-family architectures this module's
+#: synthetic constructors reproduce; the real weight files load through
+#: load_body_model_npz with the same shapes
+MODEL_FAMILIES = {
+    "smpl": (6890, 24, 10),
+    "smplx": (10475, 55, 10),
+    "flame": (5023, 5, 100),
+    "mano": (778, 16, 10),
+}
+
+
+def synthetic_family_model(family, seed=0, dtype=jnp.float32):
+    """A synthetic model with the exact (V, J, B) architecture of a named
+    SMPL family member ("smpl", "smplx", "flame", "mano") — the model
+    families the reference package is the substrate for (reference
+    README.md:10-22).  Weights are synthesized (see synthetic_body_model);
+    load real .npz weights with load_body_model_npz for production use.
+    """
+    try:
+        n_v, n_joints, n_betas = MODEL_FAMILIES[family]
+    except KeyError:
+        raise ValueError(
+            "unknown family %r (have %s)" % (family, sorted(MODEL_FAMILIES))
+        ) from None
+    if family == "smpl":
+        template = None    # smpl_sized_sphere, exactly as before
+    else:
+        v, f = _parametric_sphere(n_v)
+        scale = {"smplx": [0.3, 0.2, 0.9], "flame": [0.09, 0.12, 0.1],
+                 "mano": [0.04, 0.09, 0.02]}[family]
+        template = (v * np.array(scale), f)
+    return synthetic_body_model(
+        seed=seed, n_betas=n_betas, n_joints=n_joints, template=template,
+        dtype=dtype,
     )
 
 

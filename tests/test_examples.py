@@ -53,3 +53,21 @@ def test_measure_body_example(tmp_path):
     assert "chest" in res.stdout and "waist" in res.stdout
     assert (tmp_path / "body.obj").exists()
     assert (tmp_path / "body_curves.obj").exists()
+
+
+def test_hand_body_contact_example(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    res = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(repo, "examples", "hand_body_contact.py"),
+            "--out", str(tmp_path),
+        ],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "PYTHONPATH": repo},
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "intersecting hand faces" in res.stdout
+    assert "contact vertices" in res.stdout
+    assert (tmp_path / "hand.ply").exists()
+    assert (tmp_path / "body.ply").exists()

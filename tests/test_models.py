@@ -105,3 +105,32 @@ class TestLBS:
         fn = jax.jit(lambda b, p: lbs(model, b, p)[0])
         out = fn(jnp.zeros(model.num_betas), jnp.zeros((model.num_joints, 3)))
         assert out.shape == (model.num_vertices, 3)
+
+
+class TestModelFamilies:
+    def test_family_architectures(self):
+        import jax
+        import jax.numpy as jnp
+
+        from mesh_tpu.models import MODEL_FAMILIES, lbs, synthetic_family_model
+
+        for family, (n_v, n_j, n_b) in MODEL_FAMILIES.items():
+            model = synthetic_family_model(family)
+            assert model.num_vertices == n_v, family
+            assert model.num_joints == n_j, family
+            assert model.num_betas == n_b, family
+            # one jitted forward at batch 2 runs and stays finite
+            verts, joints = jax.jit(lambda b, p, m=model: lbs(m, b, p))(
+                jnp.zeros((2, n_b)), jnp.zeros((2, n_j, 3))
+            )
+            assert verts.shape == (2, n_v, 3)
+            assert joints.shape == (2, n_j, 3)
+            assert bool(jnp.all(jnp.isfinite(verts)))
+
+    def test_unknown_family_raises(self):
+        import pytest
+
+        from mesh_tpu.models import synthetic_family_model
+
+        with pytest.raises(ValueError, match="unknown family"):
+            synthetic_family_model("ghost")

@@ -1,0 +1,69 @@
+"""End-to-end reference workflow: the module-composition path an
+SMPL/FLAME-era pipeline actually takes (load -> landmarks -> queries ->
+decimate -> subdivide -> serialize), asserting cross-module invariants
+rather than per-kernel numerics (those live in the per-module suites).
+
+Mirrors how the reference is used by its downstream pipelines
+(reference README.md:10-22): every step here is one the reference's own
+API performs, chained on one mesh object.
+"""
+
+import os
+
+import numpy as np
+
+from mesh_tpu import Mesh
+from mesh_tpu.topology.decimation import qslim_decimator
+from mesh_tpu.topology.subdivision import loop_subdivider
+
+from .fixtures import icosphere
+
+
+def test_full_pipeline_roundtrip(tmp_path):
+    v, f = icosphere(3)   # 642 v / 1280 f
+    m = Mesh(v=v, f=f.astype(np.uint32))
+
+    # landmarks snap to the surface and survive deformation via regressors
+    m.set_landmarks_from_raw({
+        "nose": [0.0, 0.0, 1.1],           # off-surface: snaps to the pole
+        "ear": [1.05, 0.0, 0.0],
+    })
+    lm0 = dict(m.landm_xyz)
+    assert abs(np.linalg.norm(lm0["nose"]) - 1.0) < 0.05
+    m.v = m.v * 2.0                         # uniform scale
+    lm1 = m.landm_xyz
+    np.testing.assert_allclose(lm1["nose"], np.asarray(lm0["nose"]) * 2.0,
+                               atol=1e-5)
+
+    # segmentation transfer through closest faces
+    m.segm = {"upper": np.nonzero(f[:, 0] >= 0)[0][: len(f) // 2].tolist(),
+              "lower": list(range(len(f) // 2, len(f)))}
+    verts_upper = m.verts_by_segm["upper"]
+    assert len(verts_upper) > 0
+
+    # queries against a noisy resample of its own surface
+    rng = np.random.RandomState(0)
+    scan = np.asarray(m.v)[rng.randint(0, len(v), 500)] + rng.randn(500, 3) * 0.01
+    faces, points = m.closest_faces_and_points(scan)
+    assert np.all(np.linalg.norm(points, axis=1) < 2.1)
+
+    # decimate to ~25%, map the full-res vertices down, subdivide back up
+    dec = qslim_decimator(m, factor=0.25)
+    low = dec(m)
+    assert low.f.shape[0] <= 0.3 * f.shape[0]
+    up = loop_subdivider(low)
+    high = up(low)
+    assert high.v.shape[0] > low.v.shape[0]
+    # the round trip stays near the unit sphere (scaled by 2)
+    r = np.linalg.norm(np.asarray(high.v), axis=1)
+    assert 1.5 < r.mean() < 2.1
+
+    # serialization round trip preserves landmarks through OBJ
+    path = os.path.join(tmp_path, "out.obj")
+    m.write_obj(path)
+    m2 = Mesh(filename=path)
+    assert m2.v.shape == m.v.shape and m2.f.shape == m.f.shape
+    ply = os.path.join(tmp_path, "out.ply")
+    m.write_ply(ply)
+    m3 = Mesh(filename=ply)
+    np.testing.assert_allclose(np.asarray(m3.v), np.asarray(m.v), atol=1e-6)
