@@ -302,6 +302,26 @@ def synthetic_family_model(family, seed=0, dtype=jnp.float32):
     )
 
 
+def save_body_model_npz(model, path):
+    """Write a BodyModel as a standard SMPL-family .npz (the key set
+    load_body_model_npz reads: v_template, shapedirs, posedirs,
+    J_regressor, weights, f, kintree_table) — lets synthetic or converted
+    models round-trip through the ecosystem's interchange format."""
+    parents = np.asarray(model.parents, np.int64)
+    kintree = np.stack([parents, np.arange(len(parents))])
+    kintree[0, 0] = 2 ** 32 - 1   # SMPL files mark the root this way
+    np.savez(
+        path,
+        v_template=np.asarray(model.v_template),
+        shapedirs=np.asarray(model.shapedirs),
+        posedirs=np.asarray(model.posedirs),
+        J_regressor=np.asarray(model.joint_regressor),
+        weights=np.asarray(model.lbs_weights),
+        f=np.asarray(model.faces),
+        kintree_table=kintree,
+    )
+
+
 def load_body_model_npz(path, dtype=jnp.float32):
     """Load a standard SMPL-family .npz (keys: v_template, shapedirs,
     posedirs, J_regressor, weights, f, kintree_table)."""
