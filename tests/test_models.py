@@ -140,11 +140,8 @@ def test_npz_roundtrip(tmp_path):
     """save_body_model_npz writes the interchange key set
     load_body_model_npz reads; a forward pass through the round-tripped
     model is bit-identical."""
-    import jax.numpy as jnp
-    import numpy as np
-
     from mesh_tpu.models import (
-        lbs, load_body_model_npz, save_body_model_npz, synthetic_family_model,
+        load_body_model_npz, save_body_model_npz, synthetic_family_model,
     )
 
     model = synthetic_family_model("mano", seed=3)

@@ -329,3 +329,49 @@ class TestPlyMultiPropertyFaceElement:
         self._check(read_ply(path))
         if native.available():
             self._check(native.load_ply_native(path))
+
+
+class TestLandmarkSniffing:
+    """set_landmark_indices_from_any file-format branches
+    (reference serialization.py:372-407)."""
+
+    def _mesh(self):
+        v, f = box(1.0)
+        return Mesh(v=v, f=f.astype(np.uint32))
+
+    def test_json_landmarks(self, tmp_path):
+        import json
+
+        m = self._mesh()
+        path = str(tmp_path / "lm.json")
+        with open(path, "w") as fh:
+            json.dump({"corner": [-0.5, -0.5, -0.5]}, fh)
+        m.set_landmark_indices_from_any(path)
+        assert "corner" in m.landm
+
+    def test_pkl_landmarks(self, tmp_path):
+        import pickle
+
+        m = self._mesh()
+        path = str(tmp_path / "lm.pkl")
+        with open(path, "wb") as fh:
+            pickle.dump({"top": [0.5, 0.5, 0.5]}, fh)
+        m.set_landmark_indices_from_any(path)
+        assert "top" in m.landm
+
+    def test_yaml_landmarks(self, tmp_path):
+        yaml = pytest.importorskip("yaml")
+        m = self._mesh()
+        path = str(tmp_path / "lm.yaml")
+        with open(path, "w") as fh:
+            yaml.safe_dump({"side": [0.5, -0.5, 0.5]}, fh)
+        m.set_landmark_indices_from_any(path)
+        assert "side" in m.landm
+
+    def test_unknown_format_raises(self, tmp_path):
+        m = self._mesh()
+        path = str(tmp_path / "lm.bin")
+        with open(path, "wb") as fh:
+            fh.write(b"\x00\x01\x02garbage")
+        with pytest.raises(SerializationError, match="unknown format"):
+            m.set_landmark_indices_from_any(path)
