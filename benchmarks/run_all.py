@@ -126,9 +126,7 @@ def config2():
 
     import jax
 
-    from mesh_tpu.query.visibility import (
-        _visibility_kernel, _visibility_kernel_pallas,
-    )
+    from mesh_tpu.query.visibility import _visibility_local
 
     vj = jnp.asarray(v, jnp.float32)
     fj = jnp.asarray(f, jnp.int32)
@@ -142,27 +140,18 @@ def config2():
         lambda: visibility_compute(np.asarray(v), f, cams, n=n), reps=5
     )
 
-    # device-resident path: the jitted kernel with device arrays, the way a
-    # TPU pipeline calls it (the Pallas any-hit kernel on accelerators,
-    # like visibility_compute's own dispatch)
+    # device-resident path the way a TPU pipeline calls it:
+    # _visibility_local is visibility_compute's own backend dispatch
+    # (Pallas any-hit kernel on TPU, XLA tiling elsewhere)
     occ = jax.device_put(vj[fj])
-    occ_a = jax.device_put(occ[:, 0])
-    occ_b = jax.device_put(occ[:, 1])
-    occ_c = jax.device_put(occ[:, 2])
     cams_j = jax.device_put(cams.astype(np.float32))
-    on_accel = jax.devices()[0].platform != "cpu"
 
     @jax.jit
     def work():
         tn = tri_normals(vj, fj)
-        if on_accel:
-            vis, ndc = _visibility_kernel_pallas(
-                vj, occ, cams_j, nj, None, np.float32(1e-3)
-            )
-        else:
-            vis, ndc = _visibility_kernel(
-                vj, occ_a, occ_b, occ_c, cams_j, nj, None, np.float32(1e-3)
-            )
+        vis, ndc = _visibility_local(
+            vj, occ, cams_j, nj, None, np.float32(1e-3)
+        )
         return tn, vis, ndc
 
     t = _time(work, reps=10)

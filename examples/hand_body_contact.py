@@ -23,6 +23,7 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from mesh_tpu import Mesh                                    # noqa: E402
+from mesh_tpu.geometry import tri_normals                    # noqa: E402
 from mesh_tpu.models import lbs, synthetic_family_model      # noqa: E402
 
 
@@ -65,8 +66,6 @@ def main():
     # surface point, signed by the closest face's outward normal
     f_idx, points = tree.nearest(hand.v)
     gap = np.linalg.norm(np.asarray(hand.v) - points, axis=1)
-    from mesh_tpu.geometry import tri_normals
-
     face_normals = np.asarray(tri_normals(body.v, body.f.astype(np.int32)))
     inside = (
         np.sum((np.asarray(hand.v) - points)
