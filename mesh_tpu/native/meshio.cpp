@@ -14,6 +14,7 @@
 //   f a b c d...         (fan triangulation; a, a/t, a/t/n, a//n forms)
 //   g <name>             #landmark <name>  mtllib <path>
 
+#include <algorithm>
 #include <cctype>
 #include <cstdint>
 #include <cstdio>
@@ -735,6 +736,94 @@ const char* ply_write(const char* path, int64_t n_v, const double* v,
     }
   }
 
+  FILE* fp = fopen(path, "wb");
+  if (!fp) {
+    g_write_error = std::string("could not open for writing: ") + path;
+    return g_write_error.c_str();
+  }
+  size_t written = fwrite(out.data(), 1, out.size(), fp);
+  int rc = fclose(fp);
+  if (written != out.size() || rc != 0) {
+    g_write_error = std::string("short write: ") + path;
+    return g_write_error.c_str();
+  }
+  return nullptr;
+}
+
+// OBJ writer — byte-identical to the text layout of the pure-Python writer
+// (serialization/obj.py:write_obj_data), which preserves the reference's
+// "%f" floats and face-line spacing quirks (reference serialization.py:
+// 134-196).  The header blob (comments + mtllib, O(bytes)) is pre-rendered
+// by the Python caller; the grouped/segmented face layout stays Python.
+//
+// v: n_v x 3 doubles; vn: n_vn x 3 or NULL; vt: n_vt x vt_cols (2|3) or
+// NULL; f/ft/fn: n_f x 3 int64 or NULL (ft and fn together select the
+// a/b/c form; fn alone the a//b form).  flip reverses each face's corner
+// order.  Returns NULL on success, an error message otherwise.
+const char* obj_write(const char* path, const char* header,
+                      int64_t n_v, const double* v,
+                      int64_t n_vn, const double* vn,
+                      int64_t n_vt, const double* vt, int vt_cols,
+                      int64_t n_f, const int64_t* f,
+                      const int64_t* ft, const int64_t* fn, int flip) {
+  std::string out;
+  out.reserve(static_cast<size_t>(n_v) * 40 +
+              static_cast<size_t>(n_f) * 40 + 512);
+  if (header) out += header;
+  // %f of any finite double is at most ~317 chars (DBL_MAX: 309 integer
+  // digits + '.' + 6 decimals), so 1024 covers the worst 3-double vertex
+  // line and every face line (9 int64s); the length check keeps a
+  // hypothetical overflow from silently gluing lines together
+  char buf[1024];
+  auto append = [&out, &buf](int len) {
+    out.append(buf, std::min(static_cast<size_t>(len), sizeof(buf) - 1));
+  };
+  for (int64_t i = 0; i < n_v; ++i)
+    append(snprintf(buf, sizeof(buf), "v %f %f %f\n", v[3 * i],
+                    v[3 * i + 1], v[3 * i + 2]));
+  for (int64_t i = 0; i < n_vn; ++i)
+    append(snprintf(buf, sizeof(buf), "vn %f %f %f\n", vn[3 * i],
+                    vn[3 * i + 1], vn[3 * i + 2]));
+  for (int64_t i = 0; i < n_vt; ++i) {
+    if (vt_cols == 3)
+      append(snprintf(buf, sizeof(buf), "vt %f %f %f\n", vt[3 * i],
+                      vt[3 * i + 1], vt[3 * i + 2]));
+    else
+      append(snprintf(buf, sizeof(buf), "vt %f %f\n", vt[2 * i],
+                      vt[2 * i + 1]));
+  }
+  int idx[3] = {0, 1, 2};
+  if (flip) {
+    idx[0] = 2;
+    idx[2] = 0;
+  }
+  for (int64_t i = 0; i < n_f; ++i) {
+    const int64_t* fv = f + 3 * i;
+    const long long a = fv[idx[0]] + 1;
+    const long long b = fv[idx[1]] + 1;
+    const long long c = fv[idx[2]] + 1;
+    if (ft) {
+      const int64_t* tv = ft + 3 * i;
+      const int64_t* nv = fn + 3 * i;
+      append(snprintf(buf, sizeof(buf),
+                      "f %lld/%lld/%lld %lld/%lld/%lld  %lld/%lld/%lld\n",
+                      a, static_cast<long long>(tv[idx[0]] + 1),
+                      static_cast<long long>(nv[idx[0]] + 1),
+                      b, static_cast<long long>(tv[idx[1]] + 1),
+                      static_cast<long long>(nv[idx[1]] + 1),
+                      c, static_cast<long long>(tv[idx[2]] + 1),
+                      static_cast<long long>(nv[idx[2]] + 1)));
+    } else if (fn) {
+      const int64_t* nv = fn + 3 * i;
+      append(snprintf(buf, sizeof(buf),
+                      "f %lld//%lld %lld//%lld  %lld//%lld\n",
+                      a, static_cast<long long>(nv[idx[0]] + 1),
+                      b, static_cast<long long>(nv[idx[1]] + 1),
+                      c, static_cast<long long>(nv[idx[2]] + 1)));
+    } else {
+      append(snprintf(buf, sizeof(buf), "f %lld %lld %lld\n", a, b, c));
+    }
+  }
   FILE* fp = fopen(path, "wb");
   if (!fp) {
     g_write_error = std::string("could not open for writing: ") + path;

@@ -65,6 +65,16 @@ def _build_and_load():
         ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int,
         ctypes.c_char_p,
     ]
+    lib.obj_write.restype = ctypes.c_char_p
+    lib.obj_write.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_int64, ctypes.c_void_p,          # v
+        ctypes.c_int64, ctypes.c_void_p,          # vn
+        ctypes.c_int64, ctypes.c_void_p, ctypes.c_int,  # vt, vt_cols
+        ctypes.c_int64, ctypes.c_void_p,          # f
+        ctypes.c_void_p, ctypes.c_void_p,         # ft, fn
+        ctypes.c_int,                             # flip
+    ]
     return lib
 
 
@@ -202,6 +212,74 @@ def write_ply_native(filename, v, f=None, vc=None, vn=None, ascii=False,
         filename.encode(), n_v, ptr(v), ptr(vn_arr), ptr(vc_arr),
         n_f, ptr(f_arr), mode,
         comment_blob.encode() if comment_blob is not None else None,
+    )
+    if err:
+        raise SerializationError(err.decode())
+
+
+def write_obj_native(filename, v, f=None, vn=None, vt=None, ft=None,
+                     fn=None, flip_faces=False, header=""):
+    """Write an OBJ through the native core; byte-identical to the layout
+    obj.write_obj_data emits for ungrouped faces.  ``header`` is the
+    pre-rendered comment/mtllib block (it precedes the vertex lines)."""
+    from ..errors import SerializationError
+
+    lib = _get_lib()
+    if lib is None:
+        raise RuntimeError("native meshio unavailable")
+
+    # the C side assumes fixed strides and equal face-array lengths;
+    # validate here so malformed inputs raise (as the Python writer would)
+    # instead of reading out of bounds behind the pointer
+    def coords(arr, name, cols=(3,)):
+        if arr is None:
+            return None
+        out = np.ascontiguousarray(np.asarray(arr, dtype=np.float64))
+        if out.ndim != 2 or out.shape[1] not in cols:
+            raise ValueError(
+                "%s must be (N, %s), got %s" % (name, cols, out.shape)
+            )
+        return out
+
+    v = coords(v, "v")
+    # vn only written alongside fn; vt only alongside ft (the Python
+    # writer's gating — callers pass them pre-gated)
+    vn_arr = coords(vn, "vn")
+    vt_arr = coords(vt, "vt", cols=(2, 3))
+    vt_cols = int(vt_arr.shape[1]) if vt_arr is not None else 2
+
+    def idx(arr, name):
+        if arr is None:
+            return None, 0
+        out = np.ascontiguousarray(np.asarray(arr, dtype=np.int64))
+        if out.ndim != 2 or out.shape[1] != 3:
+            raise ValueError("%s must be (F, 3), got %s" % (name, out.shape))
+        return out, out.shape[0]
+
+    f_arr, n_f = idx(f, "f")
+    ft_arr, n_ft = idx(ft, "ft")
+    fn_arr, n_fn = idx(fn, "fn")
+    if ft_arr is not None and fn_arr is None:
+        # the a/b/c face form interleaves texture AND normal indices; the
+        # Python writer has the same requirement (it would raise there,
+        # here it must not reach the C layer as a null deref)
+        raise ValueError("ft requires fn for the v/vt/vn face form")
+    for name, n in (("ft", n_ft), ("fn", n_fn)):
+        if n and n != n_f:
+            raise ValueError(
+                "%s has %d faces but f has %d" % (name, n, n_f)
+            )
+
+    def ptr(arr):
+        return arr.ctypes.data_as(ctypes.c_void_p) if arr is not None else None
+
+    err = lib.obj_write(
+        filename.encode(), header.encode(),
+        v.shape[0], ptr(v),
+        vn_arr.shape[0] if vn_arr is not None else 0, ptr(vn_arr),
+        vt_arr.shape[0] if vt_arr is not None else 0, ptr(vt_arr), vt_cols,
+        n_f, ptr(f_arr), ptr(ft_arr), ptr(fn_arr),
+        1 if flip_faces else 0,
     )
     if err:
         raise SerializationError(err.decode())

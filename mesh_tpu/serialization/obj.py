@@ -104,6 +104,34 @@ def write_obj_data(filename, v, f=None, vn=None, vt=None, ft=None, fn=None,
     dirname = os.path.dirname(filename)
     if dirname and not os.path.exists(dirname):
         os.makedirs(dirname)
+
+    # shared header block (comments + mtllib) — single source for both
+    # the native fast path and the Python fallback so their bytes can
+    # never diverge
+    header = []
+    if comments is not None:
+        for comment in [comments] if isinstance(comments, str) else comments:
+            for line in comment.split("\n"):
+                header.append("# %s\n" % line)
+    if mtl_name is not None:
+        header.append("mtllib %s\n" % mtl_name)
+    header = "".join(header)
+
+    # the native writer covers every layout except per-segment face groups
+    # (`segm and not group`); byte-identity with the Python path below is
+    # pinned by tests/test_native_io.py
+    if not (segm and not group):
+        from . import native
+
+        if native.available():
+            native.write_obj_native(
+                filename, v, f=f,
+                vn=vn if (fn is not None and vn is not None) else None,
+                vt=vt if (ft is not None and vt is not None) else None,
+                ft=ft, fn=fn, flip_faces=flip_faces, header=header,
+            )
+            return
+
     ff = -1 if flip_faces else 1
 
     def face_line(i):
@@ -122,14 +150,7 @@ def write_obj_data(filename, v, f=None, vn=None, vt=None, ft=None, fn=None,
         return "f %d %d %d\n" % tuple(vi)
 
     with open(filename, "w") as fp:
-        if comments is not None:
-            if isinstance(comments, str):
-                comments = [comments]
-            for comment in comments:
-                for line in comment.split("\n"):
-                    fp.write("# %s\n" % line)
-        if mtl_name is not None:
-            fp.write("mtllib %s\n" % mtl_name)
+        fp.write(header)
         for r in np.asarray(v):
             fp.write("v %f %f %f\n" % (r[0], r[1], r[2]))
         if fn is not None and vn is not None:

@@ -236,3 +236,92 @@ class TestNativePlyWriter:
         m2 = Mesh(filename=path)
         np.testing.assert_allclose(m2.v, m.v, atol=1e-6)
         np.testing.assert_array_equal(m2.f, m.f)
+
+
+@pytest.mark.skipif(not native.available(), reason="no native lib (no g++)")
+class TestNativeObjWriter:
+    """obj_write must be byte-identical to the pure-Python writer
+    (obj.py:write_obj_data's fallback body) in every ungrouped layout."""
+
+    def _compare(self, tmp_path, **kw):
+        import importlib
+
+        from mesh_tpu.serialization import obj as obj_mod
+        from mesh_tpu.serialization import native as native_mod
+
+        nat = str(tmp_path / "nat.obj")
+        ref = str(tmp_path / "ref.obj")
+        obj_mod.write_obj_data(nat, **kw)                 # dispatches native
+        avail = native_mod.available
+        try:
+            native_mod.available = lambda: False          # force Python path
+            obj_mod.write_obj_data(ref, **kw)
+        finally:
+            native_mod.available = avail
+        assert open(nat, "rb").read() == open(ref, "rb").read()
+
+    def _data(self):
+        rng = np.random.RandomState(0)
+        v = rng.randn(40, 3)
+        f = rng.randint(0, 40, (60, 3))
+        return v, f
+
+    def test_plain_faces(self, tmp_path):
+        v, f = self._data()
+        self._compare(tmp_path, v=v, f=f)
+
+    def test_flip_faces(self, tmp_path):
+        v, f = self._data()
+        self._compare(tmp_path, v=v, f=f, flip_faces=True)
+
+    def test_normals_form(self, tmp_path):
+        v, f = self._data()
+        vn = np.random.RandomState(1).randn(40, 3)
+        self._compare(tmp_path, v=v, f=f, vn=vn, fn=f)
+
+    def test_full_vt_form(self, tmp_path):
+        v, f = self._data()
+        rng = np.random.RandomState(2)
+        vt = rng.rand(40, 2)
+        self._compare(tmp_path, v=v, f=f, vn=v, fn=f, vt=vt, ft=f)
+
+    def test_vt3_comments_mtl(self, tmp_path):
+        v, f = self._data()
+        rng = np.random.RandomState(3)
+        vt = rng.rand(40, 3)
+        self._compare(
+            tmp_path, v=v, f=f, vn=v, fn=f, vt=vt, ft=f,
+            comments=["line one\nline two", "three"], mtl_name="m.mtl",
+        )
+
+    def test_segm_grouped_stays_python_and_matches(self, tmp_path):
+        # segm without group is the one layout the native writer does not
+        # cover; both invocations must produce the same (Python) bytes
+        v, f = self._data()
+        segm = {"a": [0, 2, 4], "b": [1, 3]}
+        self._compare(tmp_path, v=v, f=f, segm=segm)
+
+    def test_ft_without_fn_raises(self, tmp_path):
+        from mesh_tpu.serialization import native as native_mod
+
+        v, f = self._data()
+        with pytest.raises(ValueError, match="ft requires fn"):
+            native_mod.write_obj_native(str(tmp_path / "x.obj"), v, f=f, ft=f)
+
+    def test_huge_coordinates_byte_identical(self, tmp_path):
+        # %f of large doubles renders hundreds of chars; the native line
+        # buffer must not truncate where the Python writer would not
+        v = np.array([[1e60, -1e300, 0.5], [1.0, 2.0, 3.0]])
+        f = np.array([[0, 1, 0]])
+        self._compare(tmp_path, v=v, f=f)
+
+    def test_bad_shapes_raise(self, tmp_path):
+        from mesh_tpu.serialization import native as native_mod
+
+        v, f = self._data()
+        with pytest.raises(ValueError, match="must be"):
+            native_mod.write_obj_native(str(tmp_path / "x.obj"), v[:, :2], f=f)
+        with pytest.raises(ValueError, match="ft has"):
+            native_mod.write_obj_native(
+                str(tmp_path / "y.obj"), v, f=f, ft=f[:5], fn=f
+            )
