@@ -84,15 +84,24 @@ def closest_faces_and_points(v, f, points, chunk=512):
     return {"face": face, "part": part, "point": point, "sqdist": sqdist}
 
 
-@partial(jax.jit, static_argnames=("chunk",))
 def closest_vertices_with_distance(v, points, chunk=2048):
     """Nearest mesh vertex per query -> (index [Q] int32, distance [Q]).
 
     Replaces reference ClosestPointTree (search.py:52-65) / the
     degenerate-triangle CGALClosestPointTree (search.py:68-86) with a tiled
     brute-force pairwise argmin — one fused XLA computation instead of a
-    Python loop over scipy KDTree queries.
+    Python loop over scipy KDTree queries.  On TPU the scan runs in the
+    Pallas argmin kernel (pallas_closest.nearest_vertices_pallas).
     """
+    if jax.devices()[0].platform == "tpu":
+        from .pallas_closest import nearest_vertices_pallas
+
+        return nearest_vertices_pallas(v, points)
+    return _closest_vertices_xla(v, points, chunk=chunk)
+
+
+@partial(jax.jit, static_argnames=("chunk",))
+def _closest_vertices_xla(v, points, chunk=2048):
     v = jnp.asarray(v)
     points = jnp.asarray(points, dtype=v.dtype)
     center = jnp.mean(v, axis=0)

@@ -214,6 +214,59 @@ def _pad_rows(x, multiple, fill):
     return x
 
 
+def _vertex_sqdist_tile(px, py, pz, vx, vy, vz):
+    """Point-to-vertex squared distance on a (TQ, TV) tile — the cost of
+    the nearest-vertex scan (reference ClosestPointTree, search.py:52-65)."""
+    dx, dy, dz = px - vx, py - vy, pz - vz
+    return dx * dx + dy * dy + dz * dz
+
+
+_vertex_kernel = make_argmin_kernel(_vertex_sqdist_tile)
+
+
+@partial(jax.jit, static_argnames=("tile_q", "tile_v", "interpret"))
+def nearest_vertices_pallas(v, points, tile_q=256, tile_v=2048,
+                            interpret=False):
+    """Pallas path of query.closest_vertices_with_distance: nearest mesh
+    vertex per query -> (index [Q] int32, distance [Q]).  Same VMEM
+    argmin scaffold as the closest-point scan with the trivial
+    point-point cost; padded vertices sit at _BIG and can never win."""
+    v = jnp.asarray(v, jnp.float32)
+    points = jnp.asarray(points, jnp.float32)
+    center = jnp.mean(v, axis=0)
+    vc_ = v - center
+    pts = points - center
+    n_q = pts.shape[0]
+
+    p_cols = [_pad_rows(pts[:, k:k + 1], tile_q, 0.0) for k in range(3)]
+    v_rows = [
+        _pad_cols(vc_[:, k][None, :], tile_v, _BIG) for k in range(3)
+    ]
+    q_pad = p_cols[0].shape[0]
+    v_pad = v_rows[0].shape[1]
+    grid = (q_pad // tile_q, v_pad // tile_v)
+
+    out_i = pl.pallas_call(
+        _vertex_kernel,
+        grid=grid,
+        in_specs=[
+            *[pl.BlockSpec((tile_q, 1), lambda i, j: (i, 0)) for _ in range(3)],
+            *[pl.BlockSpec((1, tile_v), lambda i, j: (0, j)) for _ in range(3)],
+        ],
+        out_specs=pl.BlockSpec((tile_q, 1), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((q_pad, 1), jnp.int32),
+        scratch_shapes=[
+            pltpu.VMEM((tile_q, 1), jnp.float32),
+            pltpu.VMEM((tile_q, 1), jnp.int32),
+        ],
+        interpret=interpret,
+    )(*p_cols, *v_rows)
+
+    best = out_i[:n_q, 0]
+    dist = jnp.linalg.norm(pts - vc_[best], axis=-1)
+    return best, dist
+
+
 @partial(jax.jit, static_argnames=("tile_q", "tile_f", "interpret"))
 def closest_point_pallas(v, f, points, tile_q=256, tile_f=2048, interpret=False):
     """Pallas-accelerated closest_faces_and_points.

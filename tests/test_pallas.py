@@ -45,6 +45,23 @@ class TestPallasClosestPoint:
             np.asarray(out["point"]), [[0.3, 0.2, -1.0]], atol=1e-6
         )
 
+    def test_nearest_vertices_matches_xla(self):
+        from mesh_tpu.query.closest_point import _closest_vertices_xla
+        from mesh_tpu.query.pallas_closest import nearest_vertices_pallas
+
+        rng = np.random.RandomState(6)
+        v, _ = icosphere(2)
+        v = v.astype(np.float32)
+        q = (rng.randn(300, 3) * 1.3).astype(np.float32)
+        i_p, d_p = nearest_vertices_pallas(v, q, tile_q=32, tile_v=64,
+                                           interpret=True)
+        i_x, d_x = _closest_vertices_xla(v, q)
+        np.testing.assert_allclose(np.asarray(d_p), np.asarray(d_x),
+                                   atol=1e-5)
+        # index ties only at exactly equidistant vertices
+        same = np.asarray(i_p) == np.asarray(i_x)
+        assert same.mean() > 0.99
+
     def test_vmapped_batch_matches_per_mesh(self):
         """The bench composes the kernel under vmap (one launch for all B
         meshes); the lifted grid must agree with per-mesh calls."""

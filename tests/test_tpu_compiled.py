@@ -121,6 +121,18 @@ class TestCompiledPallasParity:
             np.asarray(ndc_p), np.asarray(ndc_x), atol=1e-6
         )
 
+    def test_nearest_vertices_compiled_matches_xla(self):
+        from mesh_tpu.query.closest_point import _closest_vertices_xla
+        from mesh_tpu.query.pallas_closest import nearest_vertices_pallas
+
+        v, _ = _random_mesh(seed=18)
+        rng = np.random.RandomState(19)
+        q = rng.randn(400, 3).astype(np.float32)
+        i_p, d_p = nearest_vertices_pallas(v, q)          # compiled
+        i_x, d_x = _closest_vertices_xla(v, q)
+        np.testing.assert_allclose(np.asarray(d_p), np.asarray(d_x),
+                                   atol=1e-5)
+
     def test_nearest_alongnormal_compiled_matches_xla(self):
         from mesh_tpu.query.pallas_ray import nearest_alongnormal_pallas
         from mesh_tpu.query.ray import _nearest_alongnormal_xla
