@@ -24,6 +24,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..geometry.vert_normals import vert_normals
 from ..query.closest_point import closest_faces_and_points
+from ..utils.dispatch import mesh_on_tpu
 
 
 def make_device_mesh(n_devices=None, axis_names=("dp",), shape=None):
@@ -52,13 +53,6 @@ def _pad_rows(arr, multiple):
     return arr, pad
 
 
-def _mesh_on_tpu(mesh):
-    """Whether a jax.sharding.Mesh's devices are TPU cores — the per-shard
-    kernel choice keys on the mesh's platform, not the process default
-    (a CPU test mesh can exist on a TPU host)."""
-    return mesh.devices.flat[0].platform == "tpu"
-
-
 def _closest_local(v, f, pts, chunk, use_pallas):
     """Per-shard closest-point body: the Pallas scan when the shards run
     on TPU cores (pallas_call composes with shard_map), the XLA tiling
@@ -74,7 +68,7 @@ def _closest_local(v, f, pts, chunk, use_pallas):
 def _closest_shard_fn(mesh, axis, chunk):
     """Compiled sharded closest-point, cached per (mesh, axis, chunk) so
     repeated calls reuse the executable instead of retracing."""
-    use_pallas = _mesh_on_tpu(mesh)
+    use_pallas = mesh_on_tpu(mesh)
 
     @partial(
         jax.shard_map,
@@ -143,7 +137,7 @@ def _closest_fsharded_fn(mesh, axis, chunk):
     is sharded" collective SURVEY.md section 5 calls for.  This is the
     shape that scales when the occluder mesh itself is too large for one
     device (queries are replicated, O(F) state is sharded)."""
-    use_pallas = _mesh_on_tpu(mesh)
+    use_pallas = mesh_on_tpu(mesh)
 
     @partial(
         jax.shard_map,
@@ -222,7 +216,7 @@ def sharded_closest_faces_sharded_topology(v, f, points, mesh, axis="dp",
 def _visibility_shard_fn(mesh, axis, chunk, min_dist):
     from ..query.visibility import _visibility_local
 
-    use_pallas = _mesh_on_tpu(mesh)
+    use_pallas = mesh_on_tpu(mesh)
 
     @partial(
         jax.shard_map,

@@ -38,6 +38,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import numpy as np
+from ..utils.dispatch import pallas_default
 
 from .point_triangle import closest_point_on_triangle
 
@@ -170,7 +171,7 @@ def closest_point_anchored_auto(v, f, points, tables=None, k=128, chunk=8192):
     loose = np.nonzero(~tight)[0]
     if loose.size:
         loose_pts = np.asarray(points)[loose]
-        if jax.devices()[0].platform == "tpu":
+        if pallas_default():
             from .pallas_closest import closest_point_pallas
 
             fix = closest_point_pallas(v, f, loose_pts)

@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from .point_triangle import closest_point_barycentric, closest_point_on_triangle
+from ..utils.dispatch import pallas_default
 
 
 def _pad_to_multiple(x, multiple, axis):
@@ -93,7 +94,7 @@ def closest_vertices_with_distance(v, points, chunk=2048):
     Python loop over scipy KDTree queries.  On TPU the scan runs in the
     Pallas argmin kernel (pallas_closest.nearest_vertices_pallas).
     """
-    if jax.devices()[0].platform == "tpu":
+    if pallas_default():
         from .pallas_closest import nearest_vertices_pallas
 
         return nearest_vertices_pallas(v, points)

@@ -32,6 +32,7 @@ import numpy as np
 
 from .closest_point import _pad_to_multiple, closest_faces_and_points
 from .point_triangle import closest_point_on_triangle
+from ..utils.dispatch import pallas_default
 
 
 def triangle_bounds(v, f):
@@ -120,7 +121,7 @@ def closest_faces_and_points_auto(
     pass is needed, pallas_culled.py).
     """
     f = np.asarray(f)
-    if jax.devices()[0].platform == "tpu":
+    if pallas_default():
         from .pallas_closest import closest_point_pallas
         from .pallas_culled import closest_point_pallas_culled
 

@@ -24,6 +24,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+from ..utils.dispatch import pallas_default
 
 _EPS = 1e-9
 # Barycentric inclusion tolerance for ray hits.  Must be much wider than f32
@@ -84,7 +85,7 @@ def nearest_alongnormal(v, f, points, normals, chunk=512):
     the Pallas min-hit kernel (pallas_ray.py); the XLA tiling below is the
     CPU/interpret path.
     """
-    if jax.devices()[0].platform == "tpu":
+    if pallas_default():
         from .pallas_ray import nearest_alongnormal_pallas
 
         return nearest_alongnormal_pallas(v, f, points, normals)
@@ -165,7 +166,7 @@ def intersections_mask(v, f, q_v, q_f, chunk=128):
     triangle kernel (pallas_ray.py); the XLA tiling below is the
     CPU/interpret path.
     """
-    if jax.devices()[0].platform == "tpu":
+    if pallas_default():
         return _intersections_mask_pallas(v, f, q_v, q_f)
     return _intersections_mask_xla(v, f, q_v, q_f, chunk=chunk)
 
@@ -207,7 +208,7 @@ def self_intersection_count(v, f, chunk=128):
     (Do_intersect_noself_traits, AABB_n_tree.h:95-117).  On accelerators the
     O(F^2) pair grid runs in the Pallas kernel (pallas_ray.py).
     """
-    if jax.devices()[0].platform == "tpu":
+    if pallas_default():
         from .pallas_ray import self_intersection_count_pallas
 
         return self_intersection_count_pallas(v, f)

@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from .ray import ray_triangle_hits
+from ..utils.dispatch import pallas_default
 
 
 def _sensor_mask(vts, dirs, cam, sensor):
@@ -105,7 +106,7 @@ def _visibility_local(verts, occ_tri, cams, normals, sensors, min_dist,
     the caller targets a specific device set (the shard_map bodies in
     parallel/sharding.py pass the mesh's platform)."""
     if use_pallas is None:
-        use_pallas = jax.devices()[0].platform == "tpu"
+        use_pallas = pallas_default()
     if use_pallas:
         return _visibility_kernel_pallas(
             verts, occ_tri, cams, normals, sensors, min_dist

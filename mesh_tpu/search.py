@@ -11,6 +11,7 @@ SMPL-scale, SURVEY.md section 7.1).
 import numpy as np
 
 from . import query
+from .utils.dispatch import pallas_default
 
 __all__ = ["AabbTree", "AabbNormalsTree", "ClosestPointTree", "CGALClosestPointTree"]
 
@@ -122,11 +123,9 @@ class AabbNormalsTree(object):
         self.eps = eps
 
     def nearest(self, v_samples, n_samples):
-        import jax
-
         pts = np.asarray(v_samples, np.float32).reshape(-1, 3)
         nrm = np.asarray(n_samples, np.float32).reshape(-1, 3)
-        if jax.devices()[0].platform == "tpu":
+        if pallas_default():
             from .query.pallas_normal_weighted import (
                 nearest_normal_weighted_pallas,
             )

@@ -1,0 +1,39 @@
+"""Central Pallas-vs-XLA dispatch policy.
+
+Every query kernel has two implementations: a Pallas TPU kernel (the fast
+path) and a pure-XLA fallback that runs anywhere.  By default the Pallas
+path is used whenever the backend is TPU, but ``MESH_TPU_FORCE_XLA=1``
+forces the XLA path even on TPU.  This is the escape hatch for the case
+where a kernel compiles in interpret mode / on CPU but misbehaves only
+when Mosaic-compiled on the real chip: users can disable the kernels
+without downgrading or patching (advisor round-2 finding).
+
+The env var is read per call (not cached) so tests can toggle it.
+"""
+
+import os
+
+import jax
+
+__all__ = ["force_xla", "pallas_default", "mesh_on_tpu"]
+
+
+def force_xla():
+    """True when MESH_TPU_FORCE_XLA requests the XLA paths everywhere."""
+    value = os.environ.get("MESH_TPU_FORCE_XLA", "").strip().lower()
+    return value not in ("", "0", "false", "no", "off")
+
+
+def pallas_default():
+    """Whether Pallas kernels should be the default for this process:
+    the default jax backend is TPU and the escape hatch is not set."""
+    if force_xla():
+        return False
+    return jax.devices()[0].platform == "tpu"
+
+
+def mesh_on_tpu(mesh):
+    """Same policy for an explicit device mesh (sharded paths)."""
+    if force_xla():
+        return False
+    return mesh.devices.flat[0].platform == "tpu"

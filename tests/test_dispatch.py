@@ -1,0 +1,65 @@
+"""MESH_TPU_FORCE_XLA escape hatch (utils/dispatch.py).
+
+If a Pallas kernel ever misbehaves only when Mosaic-compiled on a real
+chip, users must be able to force the XLA fallback paths without patching
+the library.  The policy helpers are the single source of truth for every
+kernel dispatch site, so testing them (with the platform faked to "tpu")
+covers the routing everywhere.
+"""
+
+import types
+
+import pytest
+
+from mesh_tpu.utils import dispatch
+
+
+class _FakeDev:
+    platform = "tpu"
+
+
+def _fake_tpu(monkeypatch):
+    monkeypatch.setattr(dispatch.jax, "devices", lambda: [_FakeDev()])
+
+
+@pytest.mark.parametrize(
+    "value,expected",
+    [(None, False), ("", False), ("0", False), ("1", True),
+     (" 1 ", True), ("yes", True)],
+)
+def test_force_xla_parsing(monkeypatch, value, expected):
+    if value is None:
+        monkeypatch.delenv("MESH_TPU_FORCE_XLA", raising=False)
+    else:
+        monkeypatch.setenv("MESH_TPU_FORCE_XLA", value)
+    assert dispatch.force_xla() is expected
+
+
+def test_pallas_default_on_tpu(monkeypatch):
+    _fake_tpu(monkeypatch)
+    monkeypatch.delenv("MESH_TPU_FORCE_XLA", raising=False)
+    assert dispatch.pallas_default() is True
+
+
+def test_escape_hatch_overrides_tpu_platform(monkeypatch):
+    _fake_tpu(monkeypatch)
+    monkeypatch.setenv("MESH_TPU_FORCE_XLA", "1")
+    assert dispatch.pallas_default() is False
+
+
+def test_mesh_on_tpu_honors_escape_hatch(monkeypatch):
+    mesh = types.SimpleNamespace(
+        devices=types.SimpleNamespace(flat=[_FakeDev()])
+    )
+    monkeypatch.delenv("MESH_TPU_FORCE_XLA", raising=False)
+    assert dispatch.mesh_on_tpu(mesh) is True
+    monkeypatch.setenv("MESH_TPU_FORCE_XLA", "1")
+    assert dispatch.mesh_on_tpu(mesh) is False
+
+
+def test_env_read_per_call(monkeypatch):
+    # the hatch must be toggleable at runtime, not cached at import
+    monkeypatch.setenv("MESH_TPU_FORCE_XLA", "1")
+    assert dispatch.force_xla() is True
+    monkeypatch.setenv("MESH_TPU_FORCE_XLA", "0")
+    assert dispatch.force_xla() is False
