@@ -61,6 +61,16 @@ def _sqdist_tile_fast(px, py, pz,
     disagreements were exactly equidistant neighbors, the rest differed by
     < 6e-8).  The winning face's exact point/part are recomputed in the
     epilogue either way.
+
+    Accuracy caveat: the derived corner terms cancel catastrophically for
+    queries near corner b/c of faces with LONG edges — bp2 = ap2 - 2 d1 +
+    ab2 has absolute error ~ulp(ap2), not ~ulp(bp2), so the error grows
+    with edge length (worse for elongated/sliver meshes than the direct
+    |p-b|^2 form).  Query centering bounds the magnitudes and only argmin
+    tie-flips between near-equidistant faces are affected — the epilogue's
+    exact recompute fixes the reported distance/point regardless.  If
+    tie-flips ever matter, computing bp2/cp2 directly from b/c coordinate
+    planes costs two extra plane loads per face tile.
     """
     apx, apy, apz = px - ax, py - ay, pz - az
     d1 = abx * apx + aby * apy + abz * apz

@@ -69,9 +69,14 @@ def _mt_terms(o, d, a, e1, e2):
     return ad, sd, un, vn, tn
 
 
-def _mt_hit(o, d, a, e1, e2, eps, beps, t_lo, t_hi):
-    """Boolean hit tile; ``t_lo``/``t_hi`` are python floats or None
-    (unbounded).  Matches ray.ray_triangle_hits(...) & the t bounds."""
+def _mt_line_hit(o, d, a, e1, e2, eps=_EPS, beps=_BARY_EPS):
+    """Division-free line-vs-triangle acceptance (t unbounded in sign).
+
+    Returns (hit, ad, tn).  This is THE acceptance predicate for the
+    alongnormal kernel: the cost tile and the nearest_alongnormal_pallas
+    epilogue both call it, so a winner accepted in-kernel can never
+    recompute as a miss (they would otherwise have to stay bitwise
+    identical by hand — advisor round-2 finding)."""
     ad, _, un, vn, tn = _mt_terms(o, d, a, e1, e2)
     tol = beps * ad
     hit = (
@@ -80,6 +85,13 @@ def _mt_hit(o, d, a, e1, e2, eps, beps, t_lo, t_hi):
         & (vn >= -tol)
         & (un + vn <= ad + tol)
     )
+    return hit, ad, tn
+
+
+def _mt_hit(o, d, a, e1, e2, eps, beps, t_lo, t_hi):
+    """Boolean hit tile; ``t_lo``/``t_hi`` are python floats or None
+    (unbounded).  Matches ray.ray_triangle_hits(...) & the t bounds."""
+    hit, ad, tn = _mt_line_hit(o, d, a, e1, e2, eps, beps)
     if t_lo is not None:
         hit = hit & (tn >= t_lo * ad)
     if t_hi is not None:
@@ -179,14 +191,7 @@ def _alongnormal_cost_tile(*planes):
     a = planes[6:9]
     e1 = planes[9:12]
     e2 = planes[12:15]
-    ad, _, un, vn, tn = _mt_terms(o, d, a, e1, e2)
-    tol = _BARY_EPS * ad
-    hit = (
-        (ad >= _EPS)
-        & (un >= -tol)
-        & (vn >= -tol)
-        & (un + vn <= ad + tol)
-    )
+    hit, ad, tn = _mt_line_hit(o, d, a, e1, e2)
     t_abs = jnp.abs(tn) / jnp.where(ad == 0, 1.0, ad)
     return jnp.where(hit, t_abs, _BIG)
 
@@ -200,7 +205,7 @@ def nearest_alongnormal_pallas(v, f, points, normals, tile_q=256,
     """Pallas path of ray.nearest_alongnormal: (distance [Q], face [Q]
     int32, point [Q, 3]); distance is |t| * |n| with +inf when no triangle
     is hit in either direction."""
-    from .ray import NO_HIT, ray_triangle_hits
+    from .ray import NO_HIT
 
     v = jnp.asarray(v, jnp.float32)
     points = jnp.asarray(points, jnp.float32)
@@ -228,11 +233,23 @@ def nearest_alongnormal_pallas(v, f, points, normals, tile_q=256,
     )(*qcols, *frows)
 
     best = out_i[:n_q, 0]
-    # exact recompute on the winning face (divided form, same as the XLA
-    # path); a no-hit winner (arbitrary index) recomputes as miss -> +inf
-    t, hit = ray_triangle_hits(
-        points, normals, tri[best, 0], tri[best, 1], tri[best, 2]
+    # recompute t on the winning face with the SAME division-free
+    # acceptance as the kernel (not ray_triangle_hits's divided form, whose
+    # tolerances differ by ~1 ulp at borderline pairs: a winner accepted
+    # in-kernel must never recompute as a miss, or a genuinely-hit query
+    # would return +inf); a no-hit winner (arbitrary index, cost _BIG)
+    # still fails the acceptance here -> +inf
+    wa = tri[best, 0]
+    we1 = tri[best, 1] - wa
+    we2 = tri[best, 2] - wa
+    hit, ad, tn = _mt_line_hit(
+        tuple(points[:, k] for k in range(3)),
+        tuple(normals[:, k] for k in range(3)),
+        tuple(wa[:, k] for k in range(3)),
+        tuple(we1[:, k] for k in range(3)),
+        tuple(we2[:, k] for k in range(3)),
     )
+    t = tn / jnp.where(ad == 0, 1.0, ad)
     dist = jnp.where(hit, jnp.abs(t) * jnp.linalg.norm(normals, axis=-1),
                      NO_HIT)
     point = jnp.where(

@@ -100,6 +100,37 @@ class TestRayAnyHitPallas:
             np.asarray(p_p)[same], np.asarray(p_x)[same], atol=1e-5
         )
 
+    def test_nearest_alongnormal_borderline_edge_hit_is_finite(self):
+        # The winning hit lies exactly on a triangle edge (v == 0): the
+        # kernel's division-free acceptance and a divided-form recompute
+        # can disagree by ~1 ulp there.  Since the epilogue re-tests the
+        # winner with the kernel's own acceptance, an in-kernel hit must
+        # never come back as +inf (advisor round-2 finding, pallas_ray
+        # recompute-miss).
+        from mesh_tpu.query.pallas_ray import nearest_alongnormal_pallas
+
+        v = np.array(
+            [[0, 0, 0], [1, 0, 0], [0, 1, 0], [1, 1, 0]], np.float32
+        )
+        f = np.array([[0, 1, 2], [1, 3, 2]], np.int32)
+        # queries exactly over the shared edge x+y=1 and over edge y=0
+        pts = np.array(
+            [[0.5, 0.5, -1.0], [0.3, 0.0, 2.0], [0.0, 0.0, -1.0]],
+            np.float32,
+        )
+        nrm = np.array(
+            [[0, 0, 1], [0, 0, -1], [0, 0, 1]], np.float32
+        )
+        d, face, p = nearest_alongnormal_pallas(
+            v, f, pts, nrm, tile_q=8, tile_f=8, interpret=True
+        )
+        d = np.asarray(d)
+        assert np.all(np.isfinite(d)), d
+        np.testing.assert_allclose(d, [1.0, 2.0, 1.0], atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(p)[:, 2], [0.0, 0.0, 0.0], atol=1e-6
+        )
+
     def test_tri_tri_matches_xla(self):
         from mesh_tpu.query.pallas_ray import tri_tri_any_hit_pallas
         from mesh_tpu.query.ray import _intersections_mask_xla
