@@ -359,8 +359,9 @@ def _self_intersect_kernel(eps, *refs):
 @partial(jax.jit, static_argnames=("tile_q", "tile_f", "interpret"))
 def self_intersection_count_pallas(v, f, tile_q=256, tile_f=512,
                                    interpret=False):
-    """Pallas path of query.self_intersection_count: the number of ordered
-    intersecting face pairs, excluding vertex-sharing pairs."""
+    """Pallas path of query.self_intersection_count: the number of faces
+    intersecting at least one other non-vertex-sharing face (the kernel
+    accumulates per-face partner counts; involvement is counted here)."""
     v = jnp.asarray(v, jnp.float32)
     f = jnp.asarray(f, jnp.int32)
     tri = v[f]
@@ -391,7 +392,7 @@ def self_intersection_count_pallas(v, f, tile_q=256, tile_f=512,
         scratch_shapes=[pltpu.VMEM((tile_q, 1), jnp.int32)],
         interpret=interpret,
     )(*qcols, qi, *frows, mi)
-    return jnp.sum(out_c[:n_f, 0])
+    return jnp.sum(out_c[:n_f, 0] > 0)
 
 
 @partial(jax.jit, static_argnames=("tile_q", "tile_f", "interpret"))

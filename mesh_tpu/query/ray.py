@@ -11,8 +11,9 @@ TPU-native replacement for the reference's CGAL intersection machinery:
   NB the reference implementation has a real data race here
   (SURVEY.md section 5) — the functional formulation removes it.
 - `self_intersection_count` (aabb_normals.cpp:192-207 /
-  AABB_n_tree.h:95-117): number of ordered triangle pairs that intersect,
-  excluding pairs sharing a vertex index.
+  AABB_n_tree.h:95-117): number of faces involved in at least one
+  intersection with a face they share no vertex index with (the reference
+  counts per-face involvement, not pairs).
 
 Triangle-triangle overlap uses the segment-vs-triangle formulation (each edge
 of one triangle tested against the face of the other, both ways), which is
@@ -200,13 +201,18 @@ def _intersections_mask_xla(v, f, q_v, q_f, chunk=128):
 
 
 def self_intersection_count(v, f, chunk=128):
-    """Count of ordered intersecting face pairs, excluding vertex-sharing pairs.
+    """Number of faces that intersect at least one other face of the mesh,
+    excluding vertex-sharing pairs.
 
     Parity with aabb_normals.aabbtree_n_selfintersects (aabb_normals.cpp:
-    192-207): the CGAL traversal counts each unordered intersecting pair twice
-    (tree vs own triangles), and pairs sharing any vertex index are excluded
-    (Do_intersect_noself_traits, AABB_n_tree.h:95-117).  On accelerators the
-    O(F^2) pair grid runs in the Pallas kernel (pallas_ray.py).
+    193-207): the loop there asks, PER TRIANGLE, whether the tree intersects
+    it anywhere (`if (tree.do_intersect(*it)) ++n`), so each involved face
+    counts once no matter how many partners it has — e.g. the reference's
+    bent-cylinder fixture counts 2*8 involved faces even though the cap and
+    wall fans cross in more than 8 pairs (tests/test_aabb_n_tree.py:85-89).
+    Pairs sharing any vertex index are excluded (Do_intersect_noself_traits,
+    AABB_n_tree.h:95-117).  On accelerators the O(F^2) pair grid runs in the
+    Pallas kernel (pallas_ray.py).
     """
     if pallas_default():
         from .pallas_ray import self_intersection_count_pallas
@@ -233,7 +239,8 @@ def _self_intersection_count_xla(v, f, chunk=128):
         )  # [chunk, F]
         not_self = qi[:, None] != jnp.arange(n_f)[None]
         valid = (qi >= 0)[:, None]
-        return jnp.sum(inter & ~shares & not_self & valid, dtype=jnp.int32)
+        involved = jnp.any(inter & ~shares & not_self & valid, axis=1)
+        return jnp.sum(involved, dtype=jnp.int32)
 
     counts = jax.lax.map(
         one_tile,

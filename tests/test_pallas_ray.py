@@ -178,7 +178,8 @@ class TestRayAnyHitPallas:
         assert int(self_intersection_count_pallas(
             v32, f32, tile_q=32, tile_f=64, interpret=True)) == 0
         # graft a large triangle slicing through the sphere (no shared
-        # vertices with the shell -> every crossing counts)
+        # vertices with the shell -> the slab and every face it crosses
+        # count as involved)
         n0 = len(v32)
         v2 = np.vstack([v32, [[-2, -2, 0.1], [2, -2, 0.1], [0, 3, 0.1]]])
         f2 = np.vstack([f32, [[n0, n0 + 1, n0 + 2]]]).astype(np.int32)

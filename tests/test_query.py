@@ -202,8 +202,9 @@ class TestIntersections:
             [0.2, 0.2, -0.5], [0.4, 0.2, 0.5], [0.2, 0.4, 0.5],
         ], np.float32)
         f2 = np.array([[0, 1, 2], [3, 4, 5]], np.int32)
-        # ordered pairs -> count of 2 (reference counts both directions,
-        # tests/test_aabb_n_tree.py:78-89 asserts 2 * n_pairs)
+        # both faces are involved in an intersection -> count of 2 (the
+        # reference counts involved FACES, not pairs: aabb_normals.cpp:203-205
+        # asks per triangle whether the tree intersects it anywhere)
         assert int(self_intersection_count(v2, f2)) == 2
 
     def test_shared_vertex_pairs_excluded(self):

@@ -1,0 +1,168 @@
+"""Parity tests on the reference's own aabb_normals unittest fixtures.
+
+The vertex/face literals below are DATA extracted from the reference's
+unittest geometry (reference data/unittest/{test_doublebox, cylinder,
+cylinder_trans, self_intersecting_cyl}.obj — tiny Blender-exported
+meshes), embedded here the same way test_reference_goldens.py embeds the
+reference's golden output values.  The assertions mirror reference
+tests/test_aabb_n_tree.py:29-89 exactly, so a pass is direct semantic
+parity with the CGAL aabb_normals extension (aabb_normals.cpp:192-207,
+AABB_n_tree.h:95-117):
+
+- nearest with eps=0 is the classic euclidean NN; with eps>0 the blended
+  ``|p-q| + eps*(1 - n.n_tri)`` metric changes the winners;
+- the translated-cylinder coverage counts (<= 4 unique winners without
+  normals, >= F-4 with);
+- aabbtree_n_selfintersects counts the FACES involved in at least one
+  non-vertex-sharing intersection (aabb_normals.cpp:203-205 asks per
+  triangle whether the tree intersects it anywhere — NOT a pair count;
+  the bent cylinder has 20 unordered intersecting pairs but only 2*8
+  involved faces): 0 for the shared-face double box, exactly 2*8 for
+  the bent (self-intersecting) cylinder.
+"""
+
+import numpy as np
+
+from mesh_tpu.geometry.compat import NormalizeRows, TriToScaledNormal
+from mesh_tpu.query import self_intersection_count
+from mesh_tpu.search import AabbNormalsTree
+
+
+class _M:
+    def __init__(self, v, f):
+        self.v = np.asarray(v, np.float64)
+        self.f = np.asarray(f, np.int32)
+
+
+# reference data/unittest/test_doublebox.obj: two unit boxes stacked in z,
+# sharing the 4 verts of the z=0.5 plane (the shared face is not meshed)
+DOUBLEBOX_V = np.array([
+    [0.5, 0.5, 0.5], [-0.5, 0.5, 0.5], [0.5, -0.5, 0.5], [-0.5, -0.5, 0.5],
+    [0.5, 0.5, -0.5], [-0.5, 0.5, -0.5], [0.5, -0.5, -0.5],
+    [-0.5, -0.5, -0.5],
+    [0.5, 0.5, 1.5], [-0.5, 0.5, 1.5], [0.5, -0.5, 1.5], [-0.5, -0.5, 1.5],
+])
+DOUBLEBOX_F = np.array([
+    [0, 2, 4], [6, 4, 2], [0, 4, 1], [5, 1, 4], [7, 5, 6], [4, 6, 5],
+    [7, 6, 3], [2, 3, 6], [7, 3, 5], [1, 5, 3], [8, 9, 10], [11, 10, 9],
+    [8, 10, 0], [2, 0, 10], [8, 0, 9], [1, 9, 0], [3, 2, 11], [10, 11, 2],
+    [3, 11, 1], [9, 1, 11],
+])
+
+# reference data/unittest/cylinder.obj: open 8-segment cylinder, axis y
+CYL_V = np.array([
+    [0.0, -1.0, -1.0], [0.0, -1.0, 1.0], [-0.382683, -1.0, 0.923880],
+    [-0.707107, -1.0, 0.707107], [-0.923880, -1.0, 0.382684],
+    [-1.0, -1.0, -0.0], [-0.923879, -1.0, -0.382684],
+    [-0.707107, -1.0, -0.707107], [-0.382683, -1.0, -0.923880],
+    [1e-06, 1.0, -1.0], [-2e-06, 1.0, 1.0], [-0.382685, 1.0, 0.923879],
+    [-0.707108, 1.0, 0.707105], [-0.923880, 1.0, 0.382681],
+    [-1.0, 1.0, -3e-06], [-0.923878, 1.0, -0.382686],
+    [-0.707105, 1.0, -0.707109], [-0.382681, 1.0, -0.923881],
+])
+CYL_F = np.array([
+    [9, 0, 17], [0, 8, 17], [7, 16, 8], [16, 17, 8], [6, 15, 7],
+    [15, 16, 7], [5, 14, 6], [14, 15, 6], [4, 13, 5], [13, 14, 5],
+    [3, 12, 4], [12, 13, 4], [2, 11, 3], [11, 12, 3], [1, 10, 2],
+    [10, 11, 2],
+])
+
+# reference data/unittest/cylinder_trans.obj: the same half-cylinder shell
+# translated so it faces the original across a gap
+CYL_TRANS_V = np.array([
+    [1.057678, -1.0, -1.0], [1.057678, -1.0, 1.0],
+    [0.674994, -1.0, 0.923880], [0.350571, -1.0, 0.707107],
+    [0.133798, -1.0, 0.382684], [0.057678, -1.0, -0.0],
+    [0.133798, -1.0, -0.382684], [0.350571, -1.0, -0.707107],
+    [0.674995, -1.0, -0.923880], [1.057678, 1.0, -1.0],
+    [1.057676, 1.0, 1.0], [0.674992, 1.0, 0.923879],
+    [0.350569, 1.0, 0.707105], [0.133797, 1.0, 0.382681],
+    [0.057678, 1.0, -3e-06], [0.133799, 1.0, -0.382686],
+    [0.350573, 1.0, -0.707109], [0.674997, 1.0, -0.923881],
+])
+CYL_TRANS_F = CYL_F.copy()
+
+# reference data/unittest/self_intersecting_cyl.obj: an 8-segment cylinder
+# whose bottom cap apex (vertex 17) is pushed below the rim, bending the
+# cap fan through the side wall: 8 genuine crossings
+SELF_INT_CYL_V = np.array([
+    [0.0, -0.5, -1.0], [0.707107, -0.5, -0.707107], [1.0, -0.5, 0.0],
+    [0.707107, -0.5, 0.707107], [-0.0, -0.5, 1.0],
+    [-0.707107, -0.5, 0.707107], [-1.0, -0.5, -0.0],
+    [-0.707107, -0.5, -0.707107], [-0.0, 0.5, -1.0],
+    [0.707106, 0.5, -0.707107], [1.0, 0.5, -1e-06],
+    [0.707107, 0.5, 0.707107], [-0.0, 0.5, 1.0],
+    [-0.707107, 0.5, 0.707107], [-1.0, 0.5, -1e-06],
+    [-0.707106, 0.5, -0.707107], [0.0, -0.5, 0.0], [0.0, -0.835754, 0.0],
+])
+SELF_INT_CYL_F = np.array([
+    [16, 0, 1], [17, 9, 8], [16, 1, 2], [17, 10, 9], [16, 2, 3],
+    [17, 11, 10], [16, 3, 4], [17, 12, 11], [16, 4, 5], [17, 13, 12],
+    [16, 5, 6], [17, 14, 13], [16, 6, 7], [17, 15, 14], [7, 0, 16],
+    [17, 8, 15], [0, 8, 9], [1, 9, 10], [2, 10, 11], [3, 11, 12],
+    [4, 12, 13], [5, 13, 14], [6, 14, 15], [8, 0, 7],
+])
+
+
+class TestAabbNormalsFixtureParity:
+    """reference tests/test_aabb_n_tree.py on the same geometry."""
+
+    def test_dist_classic(self):
+        # eps=0 is the classic euclidean NN (test_aabb_n_tree.py:29-39)
+        tree = AabbNormalsTree(_M(DOUBLEBOX_V, DOUBLEBOX_F), eps=0.0)
+        query_v = np.array([[0.5, 0.1, 0.25], [0.5, 0.1, 0.25]])
+        query_n = np.array([[0.0, 1.0, 0.0], [1.0, 0.0, 0.0]])
+        closest_tri, closest_p = tree.nearest(query_v, query_n)
+        assert (closest_tri == np.array([[0], [0]])).all()
+        np.testing.assert_allclose(closest_p, query_v, atol=1e-6)
+
+    def test_dist_normals(self):
+        # eps=0.5 pulls query 1 (normal +y) to the top face
+        # (test_aabb_n_tree.py:41-52)
+        tree = AabbNormalsTree(_M(DOUBLEBOX_V, DOUBLEBOX_F), eps=0.5)
+        query_v = np.array([[0.5, 0.1, 0.25], [0.5, 0.1, 0.25]])
+        query_n = np.array([[0.0, 1.0, 0.0], [1.0, 0.0, 0.0]])
+        closest_tri, closest_p = tree.nearest(query_v, query_n)
+        assert (closest_tri == np.array([[2], [0]])).all()
+        np.testing.assert_allclose(
+            closest_p, np.array([[0.5, 0.5, 0.25], [0.5, 0.1, 0.25]]),
+            atol=1e-6,
+        )
+
+    def test_cylinders_coverage(self):
+        # facing half-cylinders (test_aabb_n_tree.py:54-76): without the
+        # normal term every winner is at the two extremes (<= 4 unique
+        # faces); with eps=10 nearly every face is someone's winner
+        query_v = CYL_TRANS_V
+        tri_n = NormalizeRows(TriToScaledNormal(CYL_TRANS_V, CYL_TRANS_F))
+        query_n = np.zeros(CYL_TRANS_V.shape)
+        for i_f in range(CYL_TRANS_F.shape[0]):
+            query_n[CYL_TRANS_F[i_f, :], :] += tri_n[i_f, :]
+        query_n = NormalizeRows(query_n)
+
+        cyl = _M(CYL_V, CYL_F)
+        closest_tri, _ = AabbNormalsTree(cyl, eps=0).nearest(query_v, query_n)
+        assert np.unique(closest_tri).shape[0] <= 4
+
+        closest_tri_n, _ = AabbNormalsTree(cyl, eps=10).nearest(
+            query_v, query_n
+        )
+        assert np.unique(closest_tri_n).shape[0] >= CYL_F.shape[0] - 4
+
+    def test_selfintersects_doublebox_is_zero(self):
+        # every touching face pair of the two boxes shares a vertex, and
+        # vertex-sharing pairs are excluded (test_aabb_n_tree.py:78-83)
+        count = int(self_intersection_count(
+            DOUBLEBOX_V.astype(np.float32), DOUBLEBOX_F.astype(np.int32)
+        ))
+        assert count == 0
+
+    def test_selfintersects_bent_cylinder_is_2x8(self):
+        # the bent lower fan crosses the cap fan: 8 faces on each side are
+        # involved (in 20 unordered pairs — involvement, not pairs, is
+        # what's counted; test_aabb_n_tree.py:85-89)
+        count = int(self_intersection_count(
+            SELF_INT_CYL_V.astype(np.float32),
+            SELF_INT_CYL_F.astype(np.int32),
+        ))
+        assert count == 2 * 8
