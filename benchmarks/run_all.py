@@ -89,12 +89,29 @@ def config1():
         np.add.at(vn, f[:, k], fn_np)
     vn /= np.maximum(np.linalg.norm(vn, axis=1, keepdims=True), 1e-30)
     t_cpu = time.perf_counter() - t0
+    # batched facade: B same-topology meshes through the reference-shaped
+    # numpy-in/numpy-out API in ONE dispatch (mesh_tpu.batch) — the entry
+    # point that lets facade callers amortize the tunnel round trip
+    # (VERDICT r2 #4: target within ~4x of the sustained device rate)
+    from mesh_tpu.batch import batched_vertex_normals
+
+    batch_b = 64
+    rng = np.random.RandomState(0)
+    v_stack = (
+        v[None] + 0.01 * rng.randn(batch_b, *v.shape)
+    ).astype(np.float32)
+    f_np = np.asarray(f, np.int32)
+    t_batched = _time(
+        lambda: batched_vertex_normals((v_stack, f_np)), reps=5
+    ) / batch_b
+
     # metric renamed from config1_single_smpl_normals (which measured
     # per-call dispatch until r01): the headline is the sustained
     # device-resident rate, the dispatch-bound rate rides alongside
     return {"metric": "config1_sustained_normals", "value": round(1.0 / t, 1),
             "unit": "meshes/sec", "vs_baseline": round(t_cpu / t, 2),
-            "single_dispatch_meshes_per_sec": round(1.0 / t_dispatch, 1)}
+            "single_dispatch_meshes_per_sec": round(1.0 / t_dispatch, 1),
+            "facade_batched_meshes_per_sec": round(1.0 / t_batched, 1)}
 
 
 def config2():

@@ -12,6 +12,11 @@ import os
 
 from .core import MeshArrays  # noqa: F401
 from .mesh import Mesh  # noqa: F401
+from .batch import (  # noqa: F401
+    batched_closest_faces_and_points,
+    batched_vertex_normals,
+    fused_normals_and_closest_points,
+)
 
 __version__ = "0.2.0"          # keep in step with pyproject.toml
 

@@ -1,0 +1,174 @@
+"""Batched facade entry points: one device dispatch for many meshes.
+
+The reference API is strictly one-mesh-per-call (mesh.py:208-222 computes
+normals for `self`; search.py:19-49 queries one tree), which on a tunneled
+TPU pays the full host->device dispatch latency per mesh (~25 ms here —
+BASELINE row 1's facade-vs-device gap).  These functions accept a LIST of
+same-topology meshes (or a stacked vertex array) and run the whole batch
+in one jitted dispatch, so reference-style callers with many meshes in
+flight — the SMPL-fitting loops the reference serves — amortize the
+round trip across the batch instead of paying it per mesh.
+
+`fused_normals_and_closest_points` additionally fuses the two hottest
+facade calls (estimate_vertex_normals + closest_faces_and_points,
+reference mesh.py:208-216 / search.py:29-37) into a single computation:
+one dispatch, one sync, both results.
+"""
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .geometry.vert_normals import vert_normals
+from .query.closest_point import closest_faces_and_points
+from .utils.dispatch import pallas_default
+
+__all__ = [
+    "stack_mesh_batch",
+    "batched_vertex_normals",
+    "batched_closest_faces_and_points",
+    "fused_normals_and_closest_points",
+]
+
+
+def stack_mesh_batch(meshes):
+    """(v [B, V, 3] f32, f [F, 3] int32) from same-topology meshes.
+
+    Accepts a list of Mesh facade objects / duck-typed (v, f) holders, or
+    a ready [B, V, 3] array plus shared faces via ``(v_stack, f)``.
+    """
+    if (
+        isinstance(meshes, tuple) and len(meshes) == 2
+        and not hasattr(meshes[0], "v")     # a 2-tuple of meshes is a batch
+    ):
+        v = np.asarray(meshes[0], np.float32)
+        f = np.asarray(meshes[1], np.int32)
+        if v.ndim != 3:
+            raise ValueError("v_stack must be [B, V, 3], got %r" % (v.shape,))
+        return v, f
+    if not len(meshes):
+        raise ValueError("empty mesh batch")
+    f0_raw = meshes[0].f
+    f0 = np.asarray(f0_raw, np.int64)
+    for m in meshes[1:]:
+        # identity short-circuit: fitting loops share one face array across
+        # the batch, making the steady-state check free
+        if m.f is f0_raw:
+            continue
+        if not np.array_equal(np.asarray(m.f, np.int64), f0):
+            raise ValueError(
+                "batched facade calls need identical topology on every mesh"
+            )
+    v = np.stack([np.asarray(m.v, np.float32) for m in meshes])
+    return v, f0.astype(np.int32)
+
+
+def _per_mesh_closest(v, f, pts, use_pallas, chunk):
+    if use_pallas:
+        from .query.pallas_closest import closest_point_pallas
+
+        return closest_point_pallas(v, f, pts)
+    return closest_faces_and_points(v, f, pts, chunk=chunk)
+
+
+@partial(jax.jit, static_argnames=("use_pallas", "chunk", "with_normals"))
+def _batch_step(vs, fj, pts, use_pallas, chunk, with_normals):
+    normals = vert_normals(vs, fj) if with_normals else None
+
+    def body(v, q):
+        return _per_mesh_closest(v, fj, q, use_pallas, chunk)
+
+    if pts is None:
+        res = None
+    elif use_pallas:
+        # vmap lifts the Pallas grid to a batch dimension: one kernel
+        # launch for all B meshes (same shape as bench.py's fused step)
+        res = jax.vmap(body)(vs, pts)
+    else:
+        # sequential map keeps the CPU path's [chunk, F] working set bounded
+        res = jax.lax.map(lambda args: body(*args), (vs, pts))
+    return normals, res
+
+
+def batched_vertex_normals(meshes):
+    """Area-weighted vertex normals for every mesh in ONE dispatch.
+
+    Batched counterpart of Mesh.estimate_vertex_normals (reference
+    mesh.py:208-216).  Returns [B, V, 3] float64.
+    """
+    v, f = stack_mesh_batch(meshes)
+    normals, _ = _batch_step(
+        jnp.asarray(v), jnp.asarray(f), None, False, 2048, True
+    )
+    return np.asarray(normals, np.float64)
+
+
+def _broadcast_points(points, batch):
+    pts = np.asarray(points, np.float32)
+    if pts.ndim == 2:
+        pts = np.broadcast_to(pts, (batch,) + pts.shape)
+    if pts.ndim != 3 or pts.shape[0] != batch:
+        raise ValueError(
+            "points must be [Q, 3] or [B, Q, 3] with B=%d, got %r"
+            % (batch, np.asarray(points).shape)
+        )
+    return pts
+
+
+def batched_closest_faces_and_points(meshes, points, chunk=2048):
+    """AabbTree.nearest for every (mesh, query set) pair in ONE dispatch.
+
+    :param points: [Q, 3] (same queries against every mesh) or [B, Q, 3].
+    :returns: (faces [B, 1, Q] uint32, points [B, Q, 3] f64) — each batch
+        row matches the reference's AabbTree.nearest convention
+        (search.py:29-37 row-vector index shape).
+    """
+    v, f = stack_mesh_batch(meshes)
+    pts = _broadcast_points(points, v.shape[0])
+    _, res = _batch_step(
+        jnp.asarray(v), jnp.asarray(f), jnp.asarray(pts),
+        pallas_default(), chunk, False,
+    )
+    faces = np.asarray(res["face"]).astype(np.uint32)[:, None, :]
+    return faces, np.asarray(res["point"], np.float64)
+
+
+def fused_normals_and_closest_points(meshes, points, chunk=2048):
+    """Vertex normals AND closest-point queries, one dispatch for the batch.
+
+    The fused form of the facade pair estimate_vertex_normals +
+    closest_faces_and_points: callers needing both (e.g. normal-guided
+    correspondence in registration loops) pay one round trip instead of
+    2B.  Accepts a single Mesh, a list, or a (v_stack, f) tuple; a single
+    Mesh returns unbatched arrays.
+
+    :returns: (normals [B, V, 3] f64, faces [B, 1, Q] uint32,
+        points [B, Q, 3] f64); no leading B for a single Mesh input.
+    """
+    single = hasattr(meshes, "v") and hasattr(meshes, "f")
+    if single:
+        # route through the mesh's crc-validated device cache (mesh.py:78)
+        # so repeated fused calls on an unchanged mesh skip the re-upload,
+        # like the unfused facade calls they replace
+        if hasattr(meshes, "device_arrays"):
+            vj, fj = meshes.device_arrays()
+        else:
+            vj = jnp.asarray(np.asarray(meshes.v, np.float32))
+            fj = jnp.asarray(np.asarray(meshes.f, np.int64).astype(np.int32))
+        vs, fs, batch = vj[None], fj, 1
+    else:
+        v, f = stack_mesh_batch(meshes)
+        vs, fs, batch = jnp.asarray(v), jnp.asarray(f), v.shape[0]
+    pts = _broadcast_points(points, batch)
+    normals, res = _batch_step(
+        vs, fs, jnp.asarray(pts), pallas_default(), chunk, True,
+    )
+    normals = np.asarray(normals, np.float64)
+    faces = np.asarray(res["face"]).astype(np.uint32)[:, None, :]
+    points_out = np.asarray(res["point"], np.float64)
+    if single:
+        return normals[0], faces[0], points_out[0]
+    return normals, faces, points_out

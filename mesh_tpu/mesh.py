@@ -542,6 +542,17 @@ class Mesh(object):
     def closest_faces_and_points(self, vertices):
         return self.compute_aabb_tree().nearest(vertices)
 
+    def normals_and_closest_points(self, vertices):
+        """estimate_vertex_normals + closest_faces_and_points fused into ONE
+        device dispatch (normals [V, 3] f64, faces [1, Q] uint32, points
+        [Q, 3] f64).  Callers needing both per frame (registration /
+        correspondence loops built on the reference pair mesh.py:208-216 +
+        search.py:29-37) pay one host->device round trip instead of two.
+        For many meshes at once see mesh_tpu.batch."""
+        from .batch import fused_normals_and_closest_points
+
+        return fused_normals_and_closest_points(self, vertices)
+
     # ------------------------------------------------------------------
     # Serialization (delegates, reference mesh.py:460-492)
 

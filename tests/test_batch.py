@@ -1,0 +1,113 @@
+"""Batched facade dispatch (mesh_tpu/batch.py): one device dispatch for a
+list of same-topology meshes must agree with the per-mesh facade calls
+(BASELINE row 1's facade-vs-device gap is latency, not math)."""
+
+import numpy as np
+import pytest
+
+from mesh_tpu import (
+    Mesh,
+    batched_closest_faces_and_points,
+    batched_vertex_normals,
+    fused_normals_and_closest_points,
+)
+from .fixtures import icosphere
+
+
+def _mesh_batch(n=3):
+    v, f = icosphere(2)
+    rng = np.random.RandomState(0)
+    out = []
+    for k in range(n):
+        scale = 1.0 + 0.2 * k
+        jitter = 0.01 * rng.randn(*v.shape)
+        out.append(Mesh(v=v * scale + jitter, f=f))
+    return out
+
+
+class TestBatchedNormals:
+    def test_matches_per_mesh_facade(self):
+        meshes = _mesh_batch()
+        batched = batched_vertex_normals(meshes)
+        assert batched.shape == (3,) + meshes[0].v.shape
+        for k, m in enumerate(meshes):
+            np.testing.assert_allclose(
+                batched[k], m.estimate_vertex_normals(), atol=1e-6
+            )
+
+    def test_accepts_stacked_tuple(self):
+        meshes = _mesh_batch(2)
+        v = np.stack([m.v for m in meshes]).astype(np.float32)
+        f = np.asarray(meshes[0].f, np.int32)
+        np.testing.assert_allclose(
+            batched_vertex_normals((v, f)),
+            batched_vertex_normals(meshes),
+            atol=1e-6,
+        )
+
+    def test_tuple_of_meshes_is_a_batch(self):
+        # a 2-tuple of Mesh objects must behave like the 2-element list,
+        # not be misparsed as a (v_stack, f) pair
+        m1, m2 = _mesh_batch(2)
+        np.testing.assert_allclose(
+            batched_vertex_normals((m1, m2)),
+            batched_vertex_normals([m1, m2]),
+            atol=1e-6,
+        )
+
+    def test_topology_mismatch_raises(self):
+        meshes = _mesh_batch(2)
+        bad = Mesh(v=meshes[1].v, f=np.asarray(meshes[1].f)[::-1])
+        with pytest.raises(ValueError, match="identical topology"):
+            batched_vertex_normals([meshes[0], bad])
+
+
+class TestBatchedClosest:
+    def test_matches_per_mesh_facade(self):
+        meshes = _mesh_batch()
+        rng = np.random.RandomState(1)
+        pts = rng.randn(4, 40, 3).astype(np.float32)[:3]
+        faces, points = batched_closest_faces_and_points(meshes, pts)
+        assert faces.shape == (3, 1, 40) and faces.dtype == np.uint32
+        assert points.shape == (3, 40, 3)
+        for k, m in enumerate(meshes):
+            f_ref, p_ref = m.closest_faces_and_points(pts[k])
+            np.testing.assert_array_equal(faces[k], f_ref)
+            np.testing.assert_allclose(points[k], p_ref, atol=1e-6)
+
+    def test_shared_queries_broadcast(self):
+        meshes = _mesh_batch(2)
+        pts = np.random.RandomState(2).randn(25, 3).astype(np.float32)
+        faces, points = batched_closest_faces_and_points(meshes, pts)
+        f0, p0 = meshes[0].closest_faces_and_points(pts)
+        f1, p1 = meshes[1].closest_faces_and_points(pts)
+        np.testing.assert_array_equal(faces[0], f0)
+        np.testing.assert_array_equal(faces[1], f1)
+        np.testing.assert_allclose(points[1], p1, atol=1e-6)
+
+
+class TestFused:
+    def test_batch_matches_unfused(self):
+        meshes = _mesh_batch()
+        pts = np.random.RandomState(3).randn(3, 30, 3).astype(np.float32)
+        normals, faces, points = fused_normals_and_closest_points(meshes, pts)
+        np.testing.assert_allclose(
+            normals, batched_vertex_normals(meshes), atol=1e-6
+        )
+        f_ref, p_ref = batched_closest_faces_and_points(meshes, pts)
+        np.testing.assert_array_equal(faces, f_ref)
+        np.testing.assert_allclose(points, p_ref, atol=1e-6)
+
+    def test_single_mesh_unbatched_shapes(self):
+        m = _mesh_batch(1)[0]
+        pts = np.random.RandomState(4).randn(20, 3).astype(np.float32)
+        normals, faces, points = m.normals_and_closest_points(pts)
+        assert normals.shape == m.v.shape
+        assert faces.shape == (1, 20)
+        assert points.shape == (20, 3)
+        np.testing.assert_allclose(
+            normals, m.estimate_vertex_normals(), atol=1e-6
+        )
+        f_ref, p_ref = m.closest_faces_and_points(pts)
+        np.testing.assert_array_equal(faces, f_ref)
+        np.testing.assert_allclose(points, p_ref, atol=1e-6)
