@@ -3,6 +3,7 @@ from .body_model import (  # noqa: F401
     BodyModel,
     lbs,
     load_body_model_npz,
+    mano_pose_from_pca,
     save_body_model_npz,
     smpl_sized_sphere,
     synthetic_body_model,

@@ -38,6 +38,11 @@ class BodyModel:
     lbs_weights: jax.Array         # (V, J)
     faces: jax.Array               # (F, 3) int32
     parents: Tuple[int, ...]       # static kinematic tree, parents[0] == -1
+    # MANO/SMPL-H pose-PCA basis when the file ships one (None otherwise):
+    # components are stored full-rank (45, 45); users select the first n
+    # at pose construction time (mano_pose_from_pca)
+    hands_components: Optional[jax.Array] = None   # (45, 45)
+    hands_mean: Optional[jax.Array] = None         # (45,)
 
     @property
     def num_vertices(self):
@@ -55,7 +60,7 @@ class BodyModel:
 jax.tree_util.register_dataclass(
     BodyModel,
     data_fields=["v_template", "shapedirs", "posedirs", "joint_regressor",
-                 "lbs_weights", "faces"],
+                 "lbs_weights", "faces", "hands_components", "hands_mean"],
     meta_fields=["parents"],
 )
 
@@ -310,6 +315,11 @@ def save_body_model_npz(model, path):
     parents = np.asarray(model.parents, np.int64)
     kintree = np.stack([parents, np.arange(len(parents))])
     kintree[0, 0] = 2 ** 32 - 1   # SMPL files mark the root this way
+    extras = {}
+    if model.hands_components is not None:
+        extras["hands_components"] = np.asarray(model.hands_components)
+    if model.hands_mean is not None:
+        extras["hands_mean"] = np.asarray(model.hands_mean)
     np.savez(
         path,
         v_template=np.asarray(model.v_template),
@@ -319,25 +329,130 @@ def save_body_model_npz(model, path):
         weights=np.asarray(model.lbs_weights),
         f=np.asarray(model.faces),
         kintree_table=kintree,
+        **extras,
+    )
+
+
+def _densify(name, value):
+    """A plain numeric ndarray from whatever a released SMPL-family file
+    stored under ``name``.
+
+    Real SMPL/SMPL-X/FLAME/MANO distributions (pickled chumpy models
+    converted to .npz with varying care) wrap arrays three ways: 0-d
+    object arrays holding a scipy.sparse matrix (J_regressor in the
+    original SMPL pkl is scipy CSC), chumpy ``Ch`` objects (read through
+    their ``.r`` dense view — note np.load still needs the pickled
+    object's module importable to UNPICKLE it; the duck-typing only
+    avoids depending on chumpy's API), and f64 payloads.  dtype
+    conversion happens at the caller.
+    """
+    a = np.asarray(value)
+    if a.dtype != object:
+        return a
+    obj = a.item() if a.ndim == 0 else value
+    if hasattr(obj, "toarray"):            # scipy.sparse.*_matrix
+        return np.asarray(obj.toarray())
+    if hasattr(obj, "r"):                  # chumpy.Ch duck type
+        return np.asarray(obj.r)
+    try:
+        # object array of equal-length rows (seen in hand-rolled exports)
+        return np.asarray([np.asarray(row, np.float64) for row in obj])
+    except (TypeError, ValueError):
+        raise TypeError(
+            "key %r holds %r, which is not convertible to a dense array"
+            % (name, type(obj).__name__)
+        ) from None
+
+
+# keys as written by the official distributions, plus aliases seen in
+# common conversions of the family files
+_KEY_ALIASES = {
+    "v_template": ("v_template",),
+    "shapedirs": ("shapedirs",),
+    "posedirs": ("posedirs",),
+    "J_regressor": ("J_regressor",),
+    "weights": ("weights", "lbs_weights"),
+    "f": ("f", "faces"),
+    "kintree_table": ("kintree_table",),
+}
+
+
+def _fetch(data, canonical):
+    for key in _KEY_ALIASES[canonical]:
+        if key in data:
+            return _densify(key, data[key])
+    raise KeyError(
+        "SMPL-family file is missing %r (accepted aliases: %s; file keys: "
+        "%s)" % (canonical, list(_KEY_ALIASES[canonical]),
+                 sorted(getattr(data, "files", data.keys())))
     )
 
 
 def load_body_model_npz(path, dtype=jnp.float32):
-    """Load a standard SMPL-family .npz (keys: v_template, shapedirs,
-    posedirs, J_regressor, weights, f, kintree_table)."""
+    """Load a SMPL-family .npz (canonical keys: v_template, shapedirs,
+    posedirs, J_regressor, weights, f, kintree_table).
+
+    Tolerates the layout quirks of real released files: scipy-sparse
+    J_regressor, chumpy object arrays (densified via ``.r`` — the pickled
+    module must still be importable for np.load to unpickle them), f64
+    payloads (cast to ``dtype``), uint32 root sentinel in kintree_table,
+    ``faces``/``lbs_weights`` key aliases, and extra keys (MANO's
+    ``hands_components``/``hands_mean`` pose-PCA basis is kept on the
+    model; anything else — including SMPL-H's per-hand
+    ``hands_components{l,r}`` — is ignored).  doc/models.md lists the
+    family files known to load.
+    """
     data = np.load(path, allow_pickle=True)
-    kintree = np.asarray(data["kintree_table"])
+    kintree = _fetch(data, "kintree_table")
     parents = kintree[0].astype(np.int64)
     parents[0] = -1
-    posedirs = np.asarray(data["posedirs"])
+    posedirs = _fetch(data, "posedirs")
     if posedirs.ndim == 3:
         posedirs = posedirs.reshape(posedirs.shape[0], 3, -1)
+    shapedirs = _fetch(data, "shapedirs")
+    if shapedirs.ndim == 2:                # some exports flatten (V*3, B)
+        shapedirs = shapedirs.reshape(-1, 3, shapedirs.shape[-1])
+    pca = {}
+    if "hands_components" in data:
+        pca["hands_components"] = jnp.asarray(
+            _densify("hands_components", data["hands_components"]), dtype
+        )
+        if "hands_mean" in data:
+            pca["hands_mean"] = jnp.asarray(
+                _densify("hands_mean", data["hands_mean"]), dtype
+            )
     return BodyModel(
-        v_template=jnp.asarray(data["v_template"], dtype),
-        shapedirs=jnp.asarray(np.asarray(data["shapedirs"]), dtype),
+        v_template=jnp.asarray(_fetch(data, "v_template"), dtype),
+        shapedirs=jnp.asarray(shapedirs, dtype),
         posedirs=jnp.asarray(posedirs, dtype),
-        joint_regressor=jnp.asarray(np.asarray(data["J_regressor"]), dtype),
-        lbs_weights=jnp.asarray(np.asarray(data["weights"]), dtype),
-        faces=jnp.asarray(np.asarray(data["f"]), jnp.int32),
+        joint_regressor=jnp.asarray(_fetch(data, "J_regressor"), dtype),
+        lbs_weights=jnp.asarray(_fetch(data, "weights"), dtype),
+        faces=jnp.asarray(
+            _fetch(data, "f").astype(np.int64), jnp.int32
+        ),
         parents=tuple(int(p) for p in parents),
+        **pca,
     )
+
+
+def mano_pose_from_pca(model, coeffs, flat_hand_mean=False):
+    """(..., n) MANO pose-PCA coefficients -> (..., J, 3) axis-angle pose.
+
+    The released MANO/SMPL-H files parameterize the 45-dim hand pose by a
+    full-rank PCA basis (``hands_components`` (45, 45), ``hands_mean``
+    (45,)); callers use the first ``n <= 45`` components ("reduced
+    components" — the official mano package's ``ncomps``).  The global
+    rotation (joint 0) is returned as zeros; set it on the result.
+    """
+    if model.hands_components is None:
+        raise ValueError("model has no pose-PCA basis (hands_components)")
+    coeffs = jnp.asarray(coeffs, model.hands_components.dtype)
+    n = coeffs.shape[-1]
+    flat = jnp.einsum(
+        "...n,nk->...k", coeffs, model.hands_components[:n]
+    )
+    if not flat_hand_mean and model.hands_mean is not None:
+        flat = flat + model.hands_mean
+    flat = flat.reshape(coeffs.shape[:-1] + (-1, 3))
+    root = jnp.zeros(flat.shape[:-2] + (1, 3), flat.dtype)
+    return jnp.concatenate([root, flat], axis=-2)

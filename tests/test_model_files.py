@@ -1,0 +1,192 @@
+"""Released-file layout robustness for load_body_model_npz.
+
+Real SMPL-family distributions are pickled chumpy models converted to
+.npz with varying care; each test builds a synthetic file mimicking one
+documented quirk (scipy-sparse J_regressor, chumpy object arrays, f64
+payloads, key aliases, flattened shapedirs, MANO pose-PCA components)
+and asserts the loaded model matches the clean round-trip bit-for-bit
+where exact, or to f32 where a cast is involved."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from mesh_tpu.models import (
+    BodyModel,
+    lbs,
+    load_body_model_npz,
+    mano_pose_from_pca,
+    save_body_model_npz,
+    synthetic_family_model,
+)
+
+
+class FakeCh:
+    """Duck-typed chumpy.Ch: loader must use .r without importing chumpy."""
+
+    def __init__(self, arr):
+        self.r = np.asarray(arr)
+
+
+@pytest.fixture(scope="module")
+def clean(tmp_path_factory):
+    model = synthetic_family_model("mano", seed=3)
+    path = tmp_path_factory.mktemp("npz") / "clean.npz"
+    save_body_model_npz(model, path)
+    return model, dict(np.load(path, allow_pickle=True)), tmp_path_factory
+
+
+def _roundtrip(clean, tmp_name, **overrides):
+    model, raw, factory = clean
+    data = dict(raw)
+    data.update(overrides)
+    path = factory.mktemp("npz") / (tmp_name + ".npz")
+    np.savez(path, **data)
+    return model, load_body_model_npz(path)
+
+
+def _assert_same_weights(a, b, atol=0.0):
+    for field in ("v_template", "shapedirs", "posedirs", "joint_regressor",
+                  "lbs_weights"):
+        np.testing.assert_allclose(
+            np.asarray(getattr(a, field)), np.asarray(getattr(b, field)),
+            atol=atol,
+        )
+    np.testing.assert_array_equal(np.asarray(a.faces), np.asarray(b.faces))
+    assert a.parents == b.parents
+
+
+def test_sparse_j_regressor(clean):
+    scipy_sparse = pytest.importorskip("scipy.sparse")
+    model, raw, _ = clean
+    sparse = scipy_sparse.csc_matrix(np.asarray(model.joint_regressor))
+    ref, loaded = _roundtrip(clean, "sparse", J_regressor=sparse)
+    _assert_same_weights(ref, loaded)
+
+
+def test_chumpy_object_arrays(clean):
+    model, raw, _ = clean
+    wrapped = {
+        k: np.array(FakeCh(raw[k]), dtype=object)
+        for k in ("v_template", "shapedirs", "posedirs", "weights")
+    }
+    ref, loaded = _roundtrip(clean, "chumpy", **wrapped)
+    _assert_same_weights(ref, loaded)
+
+
+def test_f64_payload_casts_to_f32(clean):
+    model, raw, _ = clean
+    f64 = {k: np.asarray(raw[k], np.float64)
+           for k in ("v_template", "shapedirs", "posedirs", "J_regressor",
+                     "weights")}
+    ref, loaded = _roundtrip(clean, "f64", **f64)
+    assert loaded.v_template.dtype == jnp.float32
+    _assert_same_weights(ref, loaded, atol=1e-7)
+
+
+def test_key_aliases_faces_and_lbs_weights(clean):
+    model, raw, factory = clean
+    data = dict(raw)
+    data["faces"] = data.pop("f")
+    data["lbs_weights"] = data.pop("weights")
+    path = factory.mktemp("npz") / "alias.npz"
+    np.savez(path, **data)
+    loaded = load_body_model_npz(path)
+    _assert_same_weights(model, loaded)
+
+
+def test_flattened_shapedirs(clean):
+    model, raw, _ = clean
+    flat = np.asarray(raw["shapedirs"])
+    flat = flat.reshape(-1, flat.shape[-1])      # (V*3, B) export quirk
+    ref, loaded = _roundtrip(clean, "flatshape", shapedirs=flat)
+    _assert_same_weights(ref, loaded)
+
+
+def test_uint32_root_sentinel(clean):
+    # save_body_model_npz writes the official 2**32-1 root marker; the
+    # loader must map it back to parents[0] == -1
+    model, raw, _ = clean
+    assert raw["kintree_table"][0, 0] == 2 ** 32 - 1
+    ref, loaded = _roundtrip(clean, "sentinel")
+    assert loaded.parents[0] == -1
+
+
+def test_missing_key_reports_aliases(clean):
+    model, raw, factory = clean
+    data = dict(raw)
+    del data["J_regressor"]
+    path = factory.mktemp("npz") / "missing.npz"
+    np.savez(path, **data)
+    with pytest.raises(KeyError, match="J_regressor.*file keys"):
+        load_body_model_npz(path)
+
+
+def test_extra_keys_ignored(clean):
+    ref, loaded = _roundtrip(
+        clean, "extras", J_shaped=np.zeros(3), bs_style=np.array(b"lbs")
+    )
+    _assert_same_weights(ref, loaded)
+
+
+class TestManoPosePCA:
+    def _mano_file(self, clean, ncomp_stored=45):
+        model, raw, factory = clean
+        rng = np.random.RandomState(0)
+        n_pose = np.asarray(raw["posedirs"]).reshape(
+            raw["posedirs"].shape[0], 3, -1
+        ).shape[-1] // 9 * 3   # (J-1)*3 axis-angle dims
+        comps = rng.randn(ncomp_stored, n_pose)
+        mean = 0.1 * rng.randn(n_pose)
+        path = factory.mktemp("npz") / "mano.npz"
+        np.savez(path, **dict(raw), hands_components=comps, hands_mean=mean)
+        return load_body_model_npz(path), comps, mean
+
+    def test_pca_basis_kept_on_model(self, clean):
+        loaded, comps, mean = self._mano_file(clean)
+        np.testing.assert_allclose(
+            np.asarray(loaded.hands_components), comps, atol=1e-6
+        )
+        np.testing.assert_allclose(np.asarray(loaded.hands_mean), mean,
+                                   atol=1e-6)
+
+    def test_reduced_components_pose(self, clean):
+        # the official mano package's ncomps: callers pass n <= 45 coeffs
+        loaded, comps, mean = self._mano_file(clean)
+        coeffs = np.array([0.5, -1.0, 0.25], np.float32)
+        pose = np.asarray(mano_pose_from_pca(loaded, coeffs))
+        assert pose.shape == (loaded.num_joints, 3)
+        np.testing.assert_allclose(pose[0], 0.0)
+        expect = (coeffs @ comps[:3] + mean).reshape(-1, 3)
+        np.testing.assert_allclose(pose[1:], expect, atol=1e-5)
+        # and the pose drives the forward pass
+        verts, joints = lbs(
+            loaded, np.zeros(loaded.num_betas, np.float32), pose
+        )
+        assert np.isfinite(np.asarray(verts)).all()
+
+    def test_flat_hand_mean(self, clean):
+        loaded, comps, mean = self._mano_file(clean)
+        coeffs = np.ones(2, np.float32)
+        with_mean = np.asarray(mano_pose_from_pca(loaded, coeffs))
+        flat = np.asarray(mano_pose_from_pca(loaded, coeffs,
+                                             flat_hand_mean=True))
+        np.testing.assert_allclose(
+            (with_mean - flat)[1:].reshape(-1), mean, atol=1e-5
+        )
+
+    def test_pca_basis_roundtrips_through_save(self, clean, tmp_path):
+        loaded, comps, mean = self._mano_file(clean)
+        save_body_model_npz(loaded, tmp_path / "rt.npz")
+        again = load_body_model_npz(tmp_path / "rt.npz")
+        np.testing.assert_allclose(
+            np.asarray(again.hands_components), comps, atol=1e-6
+        )
+        np.testing.assert_allclose(np.asarray(again.hands_mean), mean,
+                                   atol=1e-6)
+
+    def test_no_basis_raises(self, clean):
+        model, _, _ = clean
+        with pytest.raises(ValueError, match="hands_components"):
+            mano_pose_from_pca(model, np.zeros(3))
