@@ -4,6 +4,7 @@ from .closest_point import (  # noqa: F401
     closest_vertices,
     closest_vertices_with_distance,
 )
+from .autotune import calibrate_crossover, crossover_faces  # noqa: F401
 from .culled import (  # noqa: F401
     closest_faces_and_points_auto,
     closest_faces_and_points_culled,

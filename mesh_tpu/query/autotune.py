@@ -1,0 +1,175 @@
+"""Measured brute-vs-culled crossover for the auto closest-point strategy.
+
+The reference's CGAL tree is O(log F) for any mesh size
+(spatialsearchmodule.cpp:105-127); this framework instead has two exact
+strategies with different scaling — the O(Q*F) brute-force scan and the
+tile-sphere-culled kernel whose exact work is O(Q*k) after an O(Q*F)
+cheap-bound pass.  Which one wins at a given F is a property of the
+backend (VPU throughput vs the cull's overhead), so the switch point
+used by ``closest_faces_and_points_auto`` is MEASURED, not guessed:
+
+- ``calibrate_crossover()`` times both strategies over a geometric
+  ladder of synthetic face counts on the live backend and returns the
+  smallest F where the culled path wins; the result is cached in-process
+  and persisted under $MESH_TPU_CACHE keyed by device kind, so one
+  calibration serves all later processes on the same hardware.
+- ``crossover_faces()`` is what auto consults: the
+  $MESH_TPU_BRUTE_MAX_FACES env override, else the cached measurement,
+  else a conservative default (32768 — safely inside the brute-force
+  comfort zone on every backend measured so far).
+"""
+
+import json
+import logging
+import os
+import time
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+DEFAULT_CROSSOVER = 32768
+
+# in-process resolution cache (covers the cache-file miss too, so hot query
+# loops don't pay a filesystem probe per call; a calibration persisted by
+# ANOTHER process mid-run is picked up on the next interpreter start)
+_measured = None
+
+
+def _cache_path():
+    from .. import mesh_package_cache_folder
+
+    import jax
+
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", dev.platform).replace(" ", "_")
+    return os.path.join(
+        mesh_package_cache_folder, "crossover_%s_%s.json" % (dev.platform, kind)
+    )
+
+
+def crossover_faces():
+    """The face count up to which auto uses brute force (env override >
+    cached measurement > default); above it the culled strategy runs."""
+    env = os.environ.get("MESH_TPU_BRUTE_MAX_FACES")
+    if env:
+        return int(env)
+    global _measured
+    if _measured is not None:
+        return _measured
+    try:
+        with open(_cache_path()) as fh:
+            value = int(json.load(fh)["crossover_faces"])
+        if value <= 0:
+            raise ValueError(value)
+        log.info("using measured brute/culled crossover %d from %s "
+                 "(delete the file or re-run calibrate_crossover() to "
+                 "re-measure)", value, _cache_path())
+        _measured = value
+    except (OSError, ValueError, KeyError, TypeError):
+        _measured = DEFAULT_CROSSOVER
+    return _measured
+
+
+def _sphere_mesh(n_faces, seed=0):
+    """Synthetic parametric sphere with ~n_faces triangles (queried-mesh
+    stand-in for calibration; the crossover depends on F, not geometry)."""
+    n_ring = max(3, int(np.sqrt(n_faces / 2)))
+    n_seg = max(3, n_faces // (2 * n_ring))
+    theta = np.pi * np.arange(1, n_ring + 1) / (n_ring + 1)
+    phi = 2 * np.pi * np.arange(n_seg) / n_seg
+    v = np.stack([
+        np.outer(np.sin(theta), np.cos(phi)),
+        np.outer(np.sin(theta), np.sin(phi)),
+        np.outer(np.cos(theta), np.ones(n_seg)),
+    ], axis=-1).reshape(-1, 3)
+    faces = []
+    for r in range(n_ring - 1):
+        b0, b1 = r * n_seg, (r + 1) * n_seg
+        for s in range(n_seg):
+            s1 = (s + 1) % n_seg
+            faces.append([b0 + s, b1 + s, b1 + s1])
+            faces.append([b0 + s, b1 + s1, b0 + s1])
+    return v.astype(np.float32), np.asarray(faces, np.int32)
+
+
+def _time_best(fn, reps):
+    from ..utils.profiling import time_fn
+
+    return time_fn(fn, reps=reps)
+
+
+def calibrate_crossover(ladder=(8192, 16384, 32768, 65536, 131072),
+                        n_queries=1024, reps=3, save=True):
+    """Measure the brute-vs-culled switch point on the live backend.
+
+    Returns the smallest ladder F where the culled strategy beats brute
+    force (and every larger ladder point agrees), or the point past the
+    whole ladder when brute force always won.  Persists to the cache dir
+    unless ``save=False``.
+    """
+    from .closest_point import closest_faces_and_points
+    from ..utils.dispatch import pallas_default
+
+    use_pallas = pallas_default()
+    if use_pallas:
+        from .pallas_closest import closest_point_pallas
+        from .pallas_culled import closest_point_pallas_culled
+
+        brute, culled = closest_point_pallas, closest_point_pallas_culled
+    else:
+        from .culled import closest_faces_and_points_culled
+
+        brute = closest_faces_and_points
+        culled = closest_faces_and_points_culled
+
+    rng = np.random.RandomState(0)
+    pts = rng.randn(n_queries, 3).astype(np.float32)
+    wins = []
+    for n_f in ladder:
+        v, f = _sphere_mesh(n_f)
+        t_brute = _time_best(lambda: brute(v, f, pts), reps)
+        t_culled = _time_best(lambda: culled(v, f, pts), reps)
+        wins.append((f.shape[0], t_brute, t_culled))
+    # transient-degradation guard: this machine's tunneled backend has
+    # shown temporary ~25x slowdowns; a calibration taken then would
+    # poison every later process.  Re-measure one ladder point — if it
+    # disagrees with itself by >2x the numbers are not trustworthy.
+    check_f, check_t, _ = wins[len(wins) // 2]
+    v, f = _sphere_mesh(check_f)
+    recheck = _time_best(lambda: brute(v, f, pts), reps)
+    stable = max(check_t, recheck) <= 2.0 * min(check_t, recheck)
+    # auto uses the value as brute_force_max_faces (brute iff F <= value),
+    # so return the LARGEST brute-winning F, one below the first ladder
+    # point where culled takes over for good
+    crossover = None
+    for i, (n_f, t_b, t_c) in enumerate(wins):
+        if t_c < t_b and all(tc < tb for _, tb, tc in wins[i:]):
+            crossover = wins[i - 1][0] if i > 0 else max(1, n_f - 1)
+            break
+    if crossover is None:
+        crossover = 2 * wins[-1][0]   # brute won everywhere measured
+    global _measured
+    _measured = crossover
+    if not stable:
+        log.warning(
+            "calibrate_crossover: backend timings unstable (%.3fs vs %.3fs "
+            "at F=%d) — not persisting; using %d for this process only",
+            check_t, recheck, check_f, crossover,
+        )
+        save = False
+    if save:
+        try:
+            with open(_cache_path(), "w") as fh:
+                json.dump({
+                    "crossover_faces": crossover,
+                    "ladder": [
+                        {"faces": n, "t_brute": tb, "t_culled": tc}
+                        for n, tb, tc in wins
+                    ],
+                    "n_queries": n_queries,
+                    "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                }, fh, indent=1)
+        except OSError:
+            pass
+    return crossover

@@ -106,7 +106,7 @@ def closest_faces_and_points_culled(v, f, points, k=64, chunk=256):
 
 
 def closest_faces_and_points_auto(
-    v, f, points, brute_force_max_faces=32768, k=64, chunk=256
+    v, f, points, brute_force_max_faces=None, k=64, chunk=256
 ):
     """Exact closest point with automatic strategy choice.
 
@@ -115,11 +115,20 @@ def closest_faces_and_points_auto(
     (candidate set could not be proven optimal) is re-run through brute force,
     so the result is always exact.  Host-boundary function (returns numpy).
 
+    The switch point defaults to the MEASURED brute-vs-culled crossover for
+    this backend (query/autotune.py: $MESH_TPU_BRUTE_MAX_FACES override,
+    else a cached `calibrate_crossover()` run, else 32768); pass
+    ``brute_force_max_faces`` to pin it explicitly.
+
     On TPU both branches run their Pallas kernels: the VMEM-tiled
     brute-force scan, and the tile-sphere-culled kernel, which is exact by
     construction (its bounds are conservative — no certificate/fallback
     pass is needed, pallas_culled.py).
     """
+    if brute_force_max_faces is None:
+        from .autotune import crossover_faces
+
+        brute_force_max_faces = crossover_faces()
     f = np.asarray(f)
     if pallas_default():
         from .pallas_closest import closest_point_pallas

@@ -1,0 +1,127 @@
+"""Measured brute-vs-culled crossover (query/autotune.py) and its wiring
+into closest_faces_and_points_auto."""
+
+import json
+
+import numpy as np
+import pytest
+
+import mesh_tpu
+from mesh_tpu.query import autotune
+from mesh_tpu.query.culled import closest_faces_and_points_auto
+from mesh_tpu.query.closest_point import closest_faces_and_points
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state(monkeypatch, tmp_path):
+    monkeypatch.setattr(autotune, "_measured", None)
+    monkeypatch.setattr(mesh_tpu, "mesh_package_cache_folder", str(tmp_path))
+    monkeypatch.delenv("MESH_TPU_BRUTE_MAX_FACES", raising=False)
+    yield
+
+
+def test_sphere_mesh_face_count():
+    v, f = autotune._sphere_mesh(10_000)
+    assert abs(f.shape[0] - 10_000) / 10_000 < 0.2
+    assert f.min() >= 0 and f.max() < v.shape[0]
+
+
+def test_default_without_measurement():
+    assert autotune.crossover_faces() == autotune.DEFAULT_CROSSOVER
+
+
+def test_env_override_wins(monkeypatch):
+    monkeypatch.setenv("MESH_TPU_BRUTE_MAX_FACES", "1234")
+    assert autotune.crossover_faces() == 1234
+
+
+def _deterministic_times(sequence):
+    """Patchable _time_best returning canned values in call order."""
+    it = iter(sequence)
+
+    def fake(fn, reps):
+        return next(it)
+
+    return fake
+
+
+def test_calibrate_persists_and_reloads(monkeypatch):
+    # brute 1.0 always; culled loses at ladder[0], wins at ladder[1];
+    # stability recheck agrees -> persist.  Crossover = the largest
+    # brute-winning F (ladder[0]'s actual face count).
+    monkeypatch.setattr(
+        autotune, "_time_best",
+        _deterministic_times([1.0, 2.0, 1.0, 0.5, 1.0]),
+    )
+    measured = autotune.calibrate_crossover(
+        ladder=(512, 1024), n_queries=64, reps=1
+    )
+    _, f0 = autotune._sphere_mesh(512)
+    assert measured == f0.shape[0]
+    with open(autotune._cache_path()) as fh:
+        blob = json.load(fh)
+    assert blob["crossover_faces"] == measured
+    assert len(blob["ladder"]) == 2
+    # a fresh process (simulated by clearing the in-process cache) reads
+    # the persisted measurement back
+    monkeypatch.setattr(autotune, "_measured", None)
+    assert autotune.crossover_faces() == measured
+
+
+def test_unstable_backend_not_persisted(monkeypatch):
+    # the stability recheck disagrees by >2x -> value used in-process but
+    # never written (transient axon-tunnel degradation guard)
+    monkeypatch.setattr(
+        autotune, "_time_best",
+        _deterministic_times([1.0, 2.0, 1.0, 0.5, 10.0]),
+    )
+    measured = autotune.calibrate_crossover(
+        ladder=(512, 1024), n_queries=64, reps=1
+    )
+    assert measured > 0
+    import os
+    assert not os.path.exists(autotune._cache_path())
+
+
+def test_poisoned_cache_falls_back_to_default(monkeypatch):
+    import os
+    os.makedirs(os.path.dirname(autotune._cache_path()), exist_ok=True)
+    with open(autotune._cache_path(), "w") as fh:
+        fh.write('{"crossover_faces": null}')
+    assert autotune.crossover_faces() == autotune.DEFAULT_CROSSOVER
+
+
+def test_auto_uses_measured_crossover(monkeypatch):
+    # force a tiny crossover: auto must take the culled path yet stay exact
+    monkeypatch.setenv("MESH_TPU_BRUTE_MAX_FACES", "16")
+    from .fixtures import icosphere
+
+    v, f = icosphere(3)
+    assert f.shape[0] > 16
+    pts = np.random.RandomState(0).randn(50, 3).astype(np.float32)
+    auto = closest_faces_and_points_auto(
+        v.astype(np.float32), f.astype(np.int32), pts
+    )
+    ref = closest_faces_and_points(
+        v.astype(np.float32), f.astype(np.int32), pts
+    )
+    np.testing.assert_allclose(
+        auto["sqdist"], np.asarray(ref["sqdist"]), atol=1e-6
+    )
+
+
+def test_brute_always_wins_returns_past_ladder(monkeypatch):
+    # if the culled path never wins on the measured ladder, the crossover
+    # lands past the ladder (brute keeps being chosen at measured sizes)
+    calls = {"n": 0}
+
+    def fake_time(fn, reps):
+        calls["n"] += 1
+        # calibrate times brute then culled per ladder point
+        return 0.5 if calls["n"] % 2 == 1 else 1.0
+
+    monkeypatch.setattr(autotune, "_time_best", fake_time)
+    measured = autotune.calibrate_crossover(
+        ladder=(512, 1024), n_queries=16, reps=1, save=False
+    )
+    assert measured > 1024
