@@ -13,10 +13,13 @@ itself publishes no numbers (BASELINE.md).
 """
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
+
+_REPO = os.path.dirname(os.path.abspath(__file__))
 
 BATCH = 256
 QUERIES_PER_MESH = 1024
@@ -263,6 +266,19 @@ def main():
     qps = total_queries / elapsed
     cpu_total = cpu_baseline(model, betas, pose, queries)
     vs_baseline = cpu_total / elapsed
+    # device-absolute roofline figures: clock-drift-independent kernel
+    # quality record alongside the CPU ratio (benchmarks/roofline.py)
+    import jax
+
+    sys.path.insert(0, os.path.join(_REPO, "benchmarks"))
+    from roofline import accounting
+
+    n_faces = int(np.asarray(model.faces).shape[0])
+    absolute = accounting(
+        "closest_point", elapsed, n_pairs=total_queries * n_faces,
+        n_queries=total_queries, n_faces=n_faces, face_planes=19,
+        platform=jax.devices()[0].platform,
+    )
     print(
         json.dumps(
             {
@@ -270,6 +286,7 @@ def main():
                 "value": round(qps, 1),
                 "unit": "queries/sec",
                 "vs_baseline": round(vs_baseline, 2),
+                "device_absolute": absolute,
             }
         )
     )

@@ -1,0 +1,82 @@
+"""Device-absolute kernel accounting: pair rates, FLOP/s, HBM traffic and
+% of chip peak (VERDICT r2 #2).
+
+The CPU ratios in BASELINE.md ride on a shared VM whose clock drifts ~2x
+between reruns; these absolute figures make kernel quality comparable
+across rounds without trusting that clock.  Per measured kernel we
+report:
+
+- pair_tests/sec (the natural unit of every query kernel),
+- achieved FLOP/s from an ANALYTIC per-pair flop count (hand-counted
+  from the tile math, +-20% — good enough to place a kernel on the
+  roofline; they are NOT hardware counters),
+- modeled HBM bytes/s (face planes re-streamed per query tile + query
+  I/O; VMEM-resident accumulators add nothing),
+- % of v5e peak for whichever unit bounds the kernel, and the bound
+  itself from the roofline ridge: intensity = flops/bytes vs
+  peak_flops/peak_bw.
+
+Peaks (per v5e chip, public figures; the VPU number is an estimate from
+the "How to Scale Your Model" architecture description — 8x128 lanes x 4
+ALUs x ~0.94 GHz):
+"""
+
+V5E_PEAK_FLOPS_VPU_F32 = 3.9e12     # elementwise f32 (no MXU)
+V5E_PEAK_FLOPS_MXU_BF16 = 1.97e14
+V5E_PEAK_HBM_BYTES = 8.19e11        # 819 GB/s
+
+# analytic flops per pair test, hand-counted from each kernel's tile math
+FLOPS_PER_PAIR = {
+    # pallas_closest corner-a Ericson tile (pallas_closest.py:_cost_tile):
+    # ap + 4 dots + derived corner terms + va/vb/vc + region selects
+    "closest_point": 70,
+    # division-free Moller-Trumbore any-hit (pallas_ray.py:_mt_hit):
+    # 2 crosses + 4 dots + sign/tolerance compares
+    "ray_any_hit": 50,
+    # + |t| ordering division (pallas_ray.py:_alongnormal_cost_tile)
+    "alongnormal": 55,
+    # 6 edge-vs-face segment tests per triangle pair
+    # (pallas_ray.py:_tri_tri_kernel)
+    "tri_tri": 330,
+    # nearest-vertex argmin: diff + sqnorm + running min
+    "nearest_vertex": 10,
+}
+
+
+def accounting(kind, t_seconds, n_pairs, n_queries, n_faces,
+               face_planes=9, query_io_bytes=0, platform="tpu"):
+    """Roofline figures for one measured kernel invocation.
+
+    :param kind: key into FLOPS_PER_PAIR
+    :param t_seconds: measured seconds per invocation
+    :param n_pairs: pair tests per invocation (usually Q*F or Q*F*B)
+    :param n_queries: queries per invocation (I/O modeling)
+    :param n_faces: faces streamed per query tile (HBM modeling)
+    :param face_planes: f32 planes fetched per face per query tile
+    :param query_io_bytes: extra per-invocation query-side I/O bytes
+    :param platform: % of peak only reported for "tpu"
+    """
+    flops = FLOPS_PER_PAIR[kind] * n_pairs
+    # each query tile streams every face plane once; 256 = the kernels'
+    # default query tile
+    n_qtiles = max(1, -(-n_queries // 256))
+    hbm = n_qtiles * n_faces * face_planes * 4 + query_io_bytes
+    out = {
+        "kind": kind,
+        "pair_tests_per_sec": round(n_pairs / t_seconds, 1),
+        "achieved_flops_per_sec": round(flops / t_seconds, 1),
+        "modeled_hbm_bytes_per_sec": round(hbm / t_seconds, 1),
+    }
+    if platform == "tpu":
+        intensity = flops / max(hbm, 1)
+        ridge = V5E_PEAK_FLOPS_VPU_F32 / V5E_PEAK_HBM_BYTES
+        bound = "vpu" if intensity >= ridge else "hbm"
+        out["arithmetic_intensity_flops_per_byte"] = round(intensity, 2)
+        out["bound"] = bound
+        out["pct_vpu_f32_peak"] = round(
+            100.0 * flops / t_seconds / V5E_PEAK_FLOPS_VPU_F32, 1
+        )
+        out["pct_hbm_peak"] = round(
+            100.0 * hbm / t_seconds / V5E_PEAK_HBM_BYTES, 1
+        )
+    return out

@@ -21,6 +21,13 @@ sys.path.insert(0, __file__.rsplit("/", 2)[0])
 # before execution completes there, so timings synchronize by reading
 # values back (see mesh_tpu/utils/profiling.py)
 from mesh_tpu.utils.profiling import time_fn as _time  # noqa: E402
+from roofline import accounting as _roofline  # noqa: E402
+
+
+def _platform():
+    import jax
+
+    return jax.devices()[0].platform
 
 
 
@@ -47,6 +54,59 @@ def _chunked_moller_trumbore(origins, dirs, tri, t_max=None, chunk=500):
         if t_max is not None:
             hit &= tt <= t_max
         hit.any(axis=1)
+
+
+def _cpu_exact_on_candidates(points, tri_cand):
+    """Min squared point-triangle distance over per-query candidate sets,
+    single-core vectorized numpy (7-candidate Ericson form: the three
+    corners, the three clamped edge projections, and the clamped interior
+    projection).  Shared CPU-baseline kernel of configs 5 and 6 so their
+    tree-seeded baselines stay identical.
+
+    :param points: [n, 3] f64 queries
+    :param tri_cand: [n, K, 3, 3] f64 candidate triangles per query
+    :returns: [n] min squared distances
+    """
+    a_, b_, c_ = tri_cand[:, :, 0], tri_cand[:, :, 1], tri_cand[:, :, 2]
+    p = points[:, None, :]
+    ab, ac, ap = b_ - a_, c_ - a_, p - a_
+    d1 = np.einsum("nkj,nkj->nk", ab, ap)
+    d2 = np.einsum("nkj,nkj->nk", ac, ap)
+    bp = p - b_
+    d3 = np.einsum("nkj,nkj->nk", ab, bp)
+    d4 = np.einsum("nkj,nkj->nk", ac, bp)
+    cp = p - c_
+    d5 = np.einsum("nkj,nkj->nk", ab, cp)
+    d6 = np.einsum("nkj,nkj->nk", ac, cp)
+    va = d3 * d6 - d5 * d4
+    vb = d5 * d2 - d1 * d6
+    vc = d1 * d4 - d3 * d2
+    denom = np.where(va + vb + vc == 0, 1.0, va + vb + vc)
+    w1 = vb / denom
+    w2 = vc / denom
+    # the interior projection is only a valid candidate when it falls
+    # inside the triangle — clamping the barycentrics independently can
+    # produce a point OUTSIDE the face whose distance underestimates the
+    # true one; substitute corner a (already a candidate) when invalid
+    inside = (w1 >= 0) & (w2 >= 0) & (w1 + w2 <= 1)
+    w1 = np.where(inside, w1, 0.0)
+    w2 = np.where(inside, w2, 0.0)
+    # region clamps (vectorized Ericson)
+    t_ab = np.clip(d1 / np.where(d1 - d3 == 0, 1.0, d1 - d3), 0, 1)
+    t_ac = np.clip(d2 / np.where(d2 - d6 == 0, 1.0, d2 - d6), 0, 1)
+    t_bc = np.clip(
+        (d4 - d3) / np.where((d4 - d3) + (d5 - d6) == 0, 1.0,
+                             (d4 - d3) + (d5 - d6)), 0, 1)
+    cands = np.stack([
+        a_, b_, c_,
+        a_ + t_ab[..., None] * ab,
+        a_ + t_ac[..., None] * ac,
+        b_ + t_bc[..., None] * (c_ - b_),
+        a_ + w1[..., None] * ab + w2[..., None] * ac,
+    ], axis=2)                                          # [n, K, 7, 3]
+    diff = p[:, :, None, :] - cands
+    dall = np.einsum("nkrj,nkrj->nkr", diff, diff)
+    return dall.min(axis=(1, 2))
 
 
 def config1():
@@ -190,7 +250,11 @@ def config2():
     return {"metric": "config2_flame_trinormals_visibility",
             "value": round(1.0 / t, 2), "unit": "passes/sec",
             "vs_baseline": round(t_cpu / t, 2), "conn_build_s": round(t_conn, 3),
-            "facade_passes_per_sec": round(1.0 / t_facade, 2)}
+            "facade_passes_per_sec": round(1.0 / t_facade, 2),
+            "device_absolute": _roofline(
+                "ray_any_hit", t, n_pairs=len(cams) * len(v) * len(f),
+                n_queries=len(cams) * len(v), n_faces=len(f),
+                face_planes=9, platform=_platform())}
 
 
 def config3():
@@ -199,9 +263,14 @@ def config3():
 
     elapsed, total_queries, out, model, betas, pose, queries = bench.tpu_workload()
     cpu_total = bench.cpu_baseline(model, betas, pose, queries)
+    n_faces = int(np.asarray(model.faces).shape[0])
     return {"metric": "config3_batch256_normals_closest_point",
             "value": round(total_queries / elapsed, 1), "unit": "queries/sec",
-            "vs_baseline": round(cpu_total / elapsed, 2)}
+            "vs_baseline": round(cpu_total / elapsed, 2),
+            "device_absolute": _roofline(
+                "closest_point", elapsed, n_pairs=total_queries * n_faces,
+                n_queries=total_queries, n_faces=n_faces,
+                face_planes=19, platform=_platform())}
 
 
 def config4():
@@ -241,7 +310,11 @@ def config4():
     t_cpu = time.perf_counter() - t0
     return {"metric": "config4_hand_body_intersection",
             "value": round(1.0 / t, 2), "unit": "tests/sec",
-            "vs_baseline": round(t_cpu / t, 2), "intersecting_faces": n_hit}
+            "vs_baseline": round(t_cpu / t, 2), "intersecting_faces": n_hit,
+            "device_absolute": _roofline(
+                "tri_tri", t, n_pairs=len(hf) * len(bf),
+                n_queries=len(hf), n_faces=len(bf),
+                face_planes=9, platform=_platform())}
 
 
 def config5():
@@ -305,45 +378,18 @@ def config5():
     t0 = time.perf_counter()
     _, seed = tree.query(scan[:n_sub])
     cand = ring[seed]                                   # [n, K]
-    tri = v[f[cand]]                                    # [n, K, 3, 3]
-    a_, b_, c_ = tri[:, :, 0], tri[:, :, 1], tri[:, :, 2]
-    p = scan[:n_sub, None, :].astype(np.float64)
-    ab, ac, ap = b_ - a_, c_ - a_, p - a_
-    d1 = np.einsum("nkj,nkj->nk", ab, ap)
-    d2 = np.einsum("nkj,nkj->nk", ac, ap)
-    bp = p - b_
-    d3 = np.einsum("nkj,nkj->nk", ab, bp)
-    d4 = np.einsum("nkj,nkj->nk", ac, bp)
-    cp = p - c_
-    d5 = np.einsum("nkj,nkj->nk", ab, cp)
-    d6 = np.einsum("nkj,nkj->nk", ac, cp)
-    va = d3 * d6 - d5 * d4
-    vb = d5 * d2 - d1 * d6
-    vc = d1 * d4 - d3 * d2
-    denom = np.where(va + vb + vc == 0, 1.0, va + vb + vc)
-    w1 = np.clip(vb / denom, 0, 1)
-    w2 = np.clip(vc / denom, 0, 1)
-    # region clamps (vectorized Ericson)
-    t_ab = np.clip(d1 / np.where(d1 - d3 == 0, 1.0, d1 - d3), 0, 1)
-    t_ac = np.clip(d2 / np.where(d2 - d6 == 0, 1.0, d2 - d6), 0, 1)
-    t_bc = np.clip(
-        (d4 - d3) / np.where((d4 - d3) + (d5 - d6) == 0, 1.0,
-                             (d4 - d3) + (d5 - d6)), 0, 1)
-    cands = np.stack([
-        a_, b_, c_,
-        a_ + t_ab[..., None] * ab,
-        a_ + t_ac[..., None] * ac,
-        b_ + t_bc[..., None] * (c_ - b_),
-        a_ + w1[..., None] * ab + w2[..., None] * ac,
-    ], axis=2)                                          # [n, K, 7, 3]
-    diff = p[:, :, None, :] - cands
-    dall = np.einsum("nkrj,nkrj->nkr", diff, diff)
-    best = dall.min(axis=(1, 2))
+    best = _cpu_exact_on_candidates(
+        scan[:n_sub].astype(np.float64), v[f[cand]]
+    )
     t_cpu = (time.perf_counter() - t0) * (100_000 / n_sub)
     del best
     return {"metric": "config5_scan100k_closest_faces",
             "value": round(100_000 / t, 1), "unit": "queries/sec",
-            "vs_baseline": round(t_cpu / t, 2)}
+            "vs_baseline": round(t_cpu / t, 2),
+            "device_absolute": _roofline(
+                "closest_point", t, n_pairs=100_000 * len(f),
+                n_queries=100_000, n_faces=len(f),
+                face_planes=19, platform=_platform())}
 
 
 def config6():
@@ -401,7 +447,12 @@ def config6():
     t_auto_dense = _time(
         lambda: closest_faces_and_points_auto(v, f, dense), reps=reps
     )
-    auto_picked = "culled" if f.shape[0] > crossover else "brute"
+    # label the timing with the strategy auto ACTUALLY used — its threshold
+    # resolves through crossover_faces(), where an env override outranks
+    # the calibration just performed
+    from mesh_tpu.query import crossover_faces
+
+    auto_picked = "culled" if f.shape[0] > crossover_faces() else "brute"
 
     # exactness: all strategies agree on the sparse set (auto is exact by
     # construction; brute is the oracle)
@@ -425,23 +476,7 @@ def config6():
     n_sub = min(20_000, n_dense)
     t0 = time.perf_counter()
     _, cand = tree.query(dense[:n_sub].astype(np.float64), k=32)
-    tcand = tri[cand]                                   # [n, K, 3, 3]
-    a_, b_, c_ = tcand[:, :, 0], tcand[:, :, 1], tcand[:, :, 2]
-    p = dense[:n_sub, None, :].astype(np.float64)
-    ab, ac, ap = b_ - a_, c_ - a_, p - a_
-    d1 = np.einsum("nkj,nkj->nk", ab, ap)
-    d2 = np.einsum("nkj,nkj->nk", ac, ap)
-    va_ = np.einsum("nkj,nkj->nk", ab, ab)
-    vb_ = np.einsum("nkj,nkj->nk", ac, ac)
-    vab = np.einsum("nkj,nkj->nk", ab, ac)
-    denom = np.where(va_ * vb_ - vab ** 2 == 0, 1.0, va_ * vb_ - vab ** 2)
-    w1 = np.clip((vb_ * d1 - vab * d2) / denom, 0, 1)
-    w2 = np.clip((va_ * d2 - vab * d1) / denom, 0, 1)
-    scale = np.where(w1 + w2 > 1, 1.0 / np.where(w1 + w2 == 0, 1.0, w1 + w2),
-                     1.0)
-    cp = a_ + (w1 * scale)[..., None] * ab + (w2 * scale)[..., None] * ac
-    diff = p - cp
-    np.einsum("nkj,nkj->nk", diff, diff).min(axis=1)
+    _cpu_exact_on_candidates(dense[:n_sub].astype(np.float64), tri[cand])
     t_cpu = (time.perf_counter() - t0) * (n_dense / n_sub)
 
     return {"metric": "config6_largef_closest_point",
@@ -454,7 +489,12 @@ def config6():
             "sparse_culled_s": round(t_culled_sparse, 4),
             "dense_brute_s": round(t_brute_dense, 4),
             "dense_culled_s": round(t_culled_dense, 4),
-            "culled_speedup_dense": round(t_brute_dense / t_culled_dense, 2)}
+            "culled_speedup_dense": round(t_brute_dense / t_culled_dense, 2),
+            "device_absolute_brute": _roofline(
+                "closest_point", t_brute_dense,
+                n_pairs=n_dense * int(f.shape[0]), n_queries=n_dense,
+                n_faces=int(f.shape[0]), face_planes=19,
+                platform=_platform())}
 
 
 def main():

@@ -53,7 +53,13 @@ def crossover_faces():
     cached measurement > default); above it the culled strategy runs."""
     env = os.environ.get("MESH_TPU_BRUTE_MAX_FACES")
     if env:
-        return int(env)
+        try:
+            return int(env)
+        except ValueError:
+            log.warning(
+                "ignoring malformed MESH_TPU_BRUTE_MAX_FACES=%r "
+                "(want an integer face count)", env,
+            )
     global _measured
     if _measured is not None:
         return _measured

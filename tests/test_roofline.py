@@ -1,0 +1,58 @@
+"""Device-absolute accounting (benchmarks/roofline.py)."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(__file__), "..", "benchmarks")
+)
+from roofline import (  # noqa: E402
+    FLOPS_PER_PAIR,
+    V5E_PEAK_FLOPS_VPU_F32,
+    V5E_PEAK_HBM_BYTES,
+    accounting,
+)
+
+
+def test_tpu_block_has_peaks_and_bound():
+    out = accounting("closest_point", t_seconds=0.1,
+                     n_pairs=262144 * 13776, n_queries=262144,
+                     n_faces=13776, face_planes=19, platform="tpu")
+    assert out["bound"] in ("vpu", "hbm")
+    assert 0 < out["pct_vpu_f32_peak"]
+    assert 0 < out["pct_hbm_peak"]
+    # high-intensity streaming kernel must classify as compute-bound
+    assert out["arithmetic_intensity_flops_per_byte"] > (
+        V5E_PEAK_FLOPS_VPU_F32 / V5E_PEAK_HBM_BYTES
+    )
+    assert out["bound"] == "vpu"
+
+
+def test_cpu_block_omits_peaks():
+    out = accounting("ray_any_hit", t_seconds=1.0, n_pairs=1000,
+                     n_queries=10, n_faces=100, platform="cpu")
+    assert "pct_vpu_f32_peak" not in out
+    assert out["pair_tests_per_sec"] == 1000.0
+
+
+def test_low_intensity_classifies_hbm_bound():
+    # one query against many faces: each 256-query tile streams all the
+    # face planes for very few pair tests -> memory-bound
+    out = accounting("nearest_vertex", t_seconds=0.1, n_pairs=1_000_000,
+                     n_queries=1, n_faces=1_000_000, face_planes=19,
+                     platform="tpu")
+    assert out["bound"] == "hbm"
+
+
+def test_flop_table_covers_all_kernel_kinds():
+    assert set(FLOPS_PER_PAIR) == {
+        "closest_point", "ray_any_hit", "alongnormal", "tri_tri",
+        "nearest_vertex",
+    }
+
+
+def test_unknown_kind_raises():
+    with pytest.raises(KeyError):
+        accounting("nope", 1.0, 1, 1, 1)
