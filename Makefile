@@ -42,7 +42,11 @@ documentation:
 	@python tools/gen_parity_map.py > PARITY.md
 	@echo "wrote PARITY.md"
 
-clean:
-	@rm -rf build dist *.egg-info
+docs:
+	@echo "----- [ ${package_name} ] Building HTML documentation"
+	@PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python tools/build_docs.py
 
-.PHONY: all import_tests unit_tests tpu_tests tests bench sdist wheel documentation clean
+clean:
+	@rm -rf build dist *.egg-info doc/_build
+
+.PHONY: all import_tests unit_tests tpu_tests tests bench sdist wheel documentation docs clean
