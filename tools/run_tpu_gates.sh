@@ -1,0 +1,25 @@
+#!/bin/bash
+# On-chip revalidation gates, run STRICTLY one at a time (overlapping TPU
+# processes are what wedged the axon tunnel on 2026-07-30).  Run this as
+# soon as `python -c "from bench import backend_responsive; ..."` reports
+# the tunnel responsive:
+#
+#   bash tools/run_tpu_gates.sh
+#
+# Order matters: the compiled-kernel tests validate every Pallas kernel
+# added since the last good window BEFORE the benchmarks quote numbers
+# from them.  Each step gets its own process; a failure stops the chain
+# (fix, then rerun from the top — the suite is cheap compared to a wedge).
+set -e
+cd "$(dirname "$0")/.."
+
+echo "=== gate 1/3: compiled-kernel tests on the real chip ==="
+MESH_TPU_TEST_TPU=1 python -m pytest tests/test_tpu_compiled.py -m tpu -q
+
+echo "=== gate 2/3: north-star bench ==="
+python bench.py
+
+echo "=== gate 3/3: full benchmark suite (writes BASELINE rows) ==="
+python benchmarks/run_all.py
+
+echo "=== all gates passed; update BASELINE.md with the new rows ==="
