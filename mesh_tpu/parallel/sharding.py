@@ -53,6 +53,27 @@ def _pad_rows(arr, multiple):
     return arr, pad
 
 
+def _pack_local_winner(local, axis, shard_faces):
+    """(packed [Q, 5], global face ids [Q] int32) from a per-shard
+    closest-point result — the shared preamble of both face-sharded merge
+    kernels.  Lane layout (consumed positionally by the host unpackers):
+    sqdist, part, point xyz.  Face ids travel as int32 in their own array:
+    a float32 lane would corrupt ids past 2^24, exactly the huge-F regime
+    the face-sharded paths exist for."""
+    packed = jnp.stack(
+        [
+            local["sqdist"],
+            local["part"].astype(jnp.float32),
+            local["point"][:, 0],
+            local["point"][:, 1],
+            local["point"][:, 2],
+        ],
+        axis=1,
+    )
+    shard_id = jax.lax.axis_index(axis)
+    return packed, local["face"] + shard_id * shard_faces
+
+
 def _closest_local(v, f, pts, chunk, use_pallas):
     """Per-shard closest-point body: the Pallas scan when the shards run
     on TPU cores (pallas_call composes with shard_map), the XLA tiling
@@ -150,20 +171,9 @@ def _closest_fsharded_fn(mesh, axis, chunk):
     )
     def _run(v_rep, f_shard, pts_rep):
         local = _closest_local(v_rep, f_shard, pts_rep, chunk, use_pallas)
-        shard_id = jax.lax.axis_index(axis)
-        packed = jnp.stack(
-            [
-                local["sqdist"],
-                local["part"].astype(jnp.float32),
-                local["point"][:, 0],
-                local["point"][:, 1],
-                local["point"][:, 2],
-            ],
-            axis=1,
-        )                                           # [Q, 5] per device
-        # face ids travel as int32 (a float32 lane would corrupt ids past
-        # 2^24 — exactly the huge-F regime this function is for)
-        faces_global = local["face"] + shard_id * f_shard.shape[0]
+        packed, faces_global = _pack_local_winner(
+            local, axis, f_shard.shape[0]
+        )
         everyone = jax.lax.all_gather(packed, axis)       # [n_shards, Q, 5]
         all_faces = jax.lax.all_gather(faces_global, axis)  # [n_shards, Q]
         winner = jnp.argmin(everyone[:, :, 0], axis=0)    # [Q]
@@ -176,24 +186,84 @@ def _closest_fsharded_fn(mesh, axis, chunk):
     return jax.jit(_run)
 
 
+@lru_cache(maxsize=32)
+def _closest_fsharded_ring_fn(mesh, axis, chunk):
+    """Ring-merge variant of _closest_fsharded_fn: the per-device winner
+    circulates around the ICI ring via `lax.ppermute`, each device folding
+    the incoming candidate into its accumulator by lexicographic
+    (sqdist, global face id) min.  After n-1 nearest-neighbor hops every
+    accumulator holds the global winner.
+
+    Same contract and same tie-breaking as the all-gather path (both
+    resolve exact-distance ties to the lowest global face id), but peak
+    live memory per device is O(Q) instead of the all-gather's
+    O(n_shards * Q) — the shape that matters when Q is scan-sized and the
+    mesh spans many devices.  Traffic is the same n-1 neighbor hops XLA's
+    ring all-gather would issue, so latency is equivalent on ICI.
+    """
+    use_pallas = mesh_on_tpu(mesh)
+    n_shards = mesh.shape[axis]
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(axis), P()),
+        out_specs=(P(), P()),
+        # every device converges to the identical global winner, which the
+        # static varying-axes analysis cannot prove
+        check_vma=False,
+    )
+    def _run(v_rep, f_shard, pts_rep):
+        local = _closest_local(v_rep, f_shard, pts_rep, chunk, use_pallas)
+        acc = _pack_local_winner(local, axis, f_shard.shape[0])
+        perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+
+        def hop(_, acc):
+            acc_p, acc_f = acc
+            # one pytree ppermute per hop: both arrays travel in a single
+            # collective, and the rolled loop keeps HLO size constant in
+            # the mesh size
+            in_p, in_f = jax.lax.ppermute((acc_p, acc_f), axis, perm)
+            better = (in_p[:, 0] < acc_p[:, 0]) | (
+                (in_p[:, 0] == acc_p[:, 0]) & (in_f < acc_f)
+            )
+            return (
+                jnp.where(better[:, None], in_p, acc_p),
+                jnp.where(better, in_f, acc_f),
+            )
+
+        return jax.lax.fori_loop(0, n_shards - 1, hop, acc)
+
+    return jax.jit(_run)
+
+
 def sharded_closest_faces_sharded_topology(v, f, points, mesh, axis="dp",
-                                           chunk=512):
+                                           chunk=512, merge="gather"):
     """Closest-point query with the face axis sharded over the ICI mesh.
 
     The dual of `sharded_closest_faces_and_points`: query points are
     replicated, the triangle soup is split across devices, and the global
-    winner per query is found by an all-gather + argmin collective.  Use
+    winner per query is found by a cross-device merge collective.  Use
     this when F is the large axis (e.g. querying a sparse landmark set
     against a 1M-face scan on a v5e-8).  Returns the same dict as
     closest_faces_and_points.
+
+    :param merge: ``"gather"`` (all_gather + argmin, the default) or
+        ``"ring"`` (ppermute ring min-merge — same winners incl. ties,
+        O(Q) instead of O(n_shards * Q) peak memory per device; prefer it
+        for scan-sized Q on large meshes).
     """
+    if merge not in ("gather", "ring"):
+        raise ValueError("merge must be 'gather' or 'ring', got %r" % (merge,))
     n_shards = mesh.shape[axis]
     n_faces = np.asarray(f).shape[0]
     # pad with copies of the last face: harmless duplicates that can
     # only tie, never beat, the true winner (strict < keeps lowest id)
     f_np, _ = _pad_rows(np.asarray(f, np.int64), n_shards)
 
-    out, face = _closest_fsharded_fn(mesh, axis, chunk)(
+    fn = (_closest_fsharded_ring_fn if merge == "ring"
+          else _closest_fsharded_fn)
+    out, face = fn(mesh, axis, chunk)(
         jnp.asarray(v, jnp.float32),
         jax.device_put(
             jnp.asarray(f_np, jnp.int32), NamedSharding(mesh, P(axis))

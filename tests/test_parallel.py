@@ -69,6 +69,44 @@ class TestShardedQueries:
         agree = sharded["face"] == np.asarray(single["face"])
         assert agree.mean() > 0.99
 
+    def test_face_sharded_ring_merge_matches_gather(self):
+        """The ppermute ring min-merge must produce BIT-IDENTICAL winners
+        to the all-gather + argmin path, including exact-distance ties
+        (both resolve to the lowest global face id)."""
+        rng = np.random.RandomState(5)
+        v, f = icosphere(2)
+        # force cross-shard exact ties: duplicate the whole face list, so
+        # every query's best face exists in two different shards
+        f2 = np.concatenate([f, f]).astype(np.int32)
+        points = rng.randn(300, 3).astype(np.float32)
+        mesh = make_device_mesh(8, ("dp",))
+        gather = sharded_closest_faces_sharded_topology(
+            v.astype(np.float32), f2, points, mesh, chunk=64, merge="gather"
+        )
+        ring = sharded_closest_faces_sharded_topology(
+            v.astype(np.float32), f2, points, mesh, chunk=64, merge="ring"
+        )
+        np.testing.assert_array_equal(ring["face"], gather["face"])
+        np.testing.assert_array_equal(ring["part"], gather["part"])
+        np.testing.assert_allclose(ring["sqdist"], gather["sqdist"], rtol=0)
+        np.testing.assert_allclose(ring["point"], gather["point"], rtol=0)
+        # and both agree with the single-device oracle
+        single = closest_faces_and_points(
+            v.astype(np.float32), f2, points, chunk=64
+        )
+        np.testing.assert_allclose(
+            ring["sqdist"], np.asarray(single["sqdist"]), atol=1e-6
+        )
+
+    def test_face_sharded_merge_rejects_unknown(self):
+        v, f = icosphere(1)
+        mesh = make_device_mesh(8, ("dp",))
+        with pytest.raises(ValueError, match="gather.*ring"):
+            sharded_closest_faces_sharded_topology(
+                v.astype(np.float32), f.astype(np.int32),
+                np.zeros((4, 3), np.float32), mesh, merge="tree",
+            )
+
     def test_face_sharded_non_divisible_face_count(self):
         # icosphere(1) has 80 faces; 80 % 8 == 0, so drop a few to force the
         # duplicate-face padding path
