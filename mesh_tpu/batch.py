@@ -101,7 +101,7 @@ def batched_vertex_normals(meshes):
     """
     v, f = stack_mesh_batch(meshes)
     normals, _ = _batch_step(
-        jnp.asarray(v), jnp.asarray(f), None, False, 2048, True
+        jnp.asarray(v), jnp.asarray(f), None, False, 512, True
     )
     return np.asarray(normals, np.float64)
 
@@ -118,7 +118,7 @@ def _broadcast_points(points, batch):
     return pts
 
 
-def batched_closest_faces_and_points(meshes, points, chunk=2048):
+def batched_closest_faces_and_points(meshes, points, chunk=512):
     """AabbTree.nearest for every (mesh, query set) pair in ONE dispatch.
 
     :param points: [Q, 3] (same queries against every mesh) or [B, Q, 3].
@@ -136,7 +136,7 @@ def batched_closest_faces_and_points(meshes, points, chunk=2048):
     return faces, np.asarray(res["point"], np.float64)
 
 
-def fused_normals_and_closest_points(meshes, points, chunk=2048):
+def fused_normals_and_closest_points(meshes, points, chunk=512):
     """Vertex normals AND closest-point queries, one dispatch for the batch.
 
     The fused form of the facade pair estimate_vertex_normals +

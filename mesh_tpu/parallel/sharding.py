@@ -224,8 +224,17 @@ def _closest_fsharded_ring_fn(mesh, axis, chunk):
             # collective, and the rolled loop keeps HLO size constant in
             # the mesh size
             in_p, in_f = jax.lax.ppermute((acc_p, acc_f), axis, perm)
-            better = (in_p[:, 0] < acc_p[:, 0]) | (
-                (in_p[:, 0] == acc_p[:, 0]) & (in_f < acc_f)
+            # NaN maps to -inf so a NaN local winner (degenerate/NaN
+            # geometry in one shard) propagates to EVERY device, exactly
+            # like the gather path's argmin (numpy argmin picks the first
+            # NaN); plain < would strand the NaN on its own shard and
+            # break the replicated-output contract
+            in_key = jnp.where(jnp.isnan(in_p[:, 0]), -jnp.inf, in_p[:, 0])
+            acc_key = jnp.where(
+                jnp.isnan(acc_p[:, 0]), -jnp.inf, acc_p[:, 0]
+            )
+            better = (in_key < acc_key) | (
+                (in_key == acc_key) & (in_f < acc_f)
             )
             return (
                 jnp.where(better[:, None], in_p, acc_p),

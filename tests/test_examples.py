@@ -4,69 +4,59 @@ import os
 import subprocess
 import sys
 
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-def test_register_scan_example(tmp_path):
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+def _run_example(name, *args, timeout=300):
+    """Run examples/<name> in a subprocess with the repo PREPENDED to
+    PYTHONPATH — clobbering it would drop /root/.axon_site (the axon PJRT
+    plugin) and break backend init on the TPU host."""
+    pythonpath = os.pathsep.join(
+        p for p in (_REPO, os.environ.get("PYTHONPATH", "")) if p
+    )
     res = subprocess.run(
-        [
-            sys.executable,
-            os.path.join(repo, "examples", "register_scan.py"),
-            "--steps", "20", "--out", str(tmp_path),
-        ],
-        capture_output=True, text=True, timeout=300,
-        env={**os.environ, "PYTHONPATH": repo},
+        [sys.executable, os.path.join(_REPO, "examples", name), *args],
+        capture_output=True, text=True, timeout=timeout,
+        env={**os.environ, "PYTHONPATH": pythonpath},
     )
     assert res.returncode == 0, res.stderr[-2000:]
+    return res
+
+
+def test_register_scan_example(tmp_path):
+    res = _run_example(
+        "register_scan.py", "--steps", "20", "--out", str(tmp_path)
+    )
     assert "surface error" in res.stdout
     assert (tmp_path / "fitted.ply").exists()
     assert (tmp_path / "scan.ply").exists()
 
 
+def test_batch_pipeline_example():
+    res = _run_example("batch_pipeline.py", "--batch", "3", "--queries", "64")
+    assert "results identical" in res.stdout
+    assert "amortization" in res.stdout
+
+
 def test_fit_multichip_example(tmp_path):
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    res = subprocess.run(
-        [
-            sys.executable,
-            os.path.join(repo, "examples", "fit_multichip.py"),
-            "--steps", "8", "--ckpt", str(tmp_path / "ckpt"),
-        ],
-        capture_output=True, text=True, timeout=600,
-        env={**os.environ, "PYTHONPATH": repo},
+    res = _run_example(
+        "fit_multichip.py", "--steps", "8", "--ckpt", str(tmp_path / "ckpt"),
+        timeout=600,
     )
-    assert res.returncode == 0, res.stderr[-2000:]
     assert "checkpoint resume bit-identical: ok" in res.stdout
 
 
 def test_measure_body_example(tmp_path):
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    out = str(tmp_path / "body")
-    res = subprocess.run(
-        [
-            sys.executable,
-            os.path.join(repo, "examples", "measure_body.py"),
-            "--batch", "2", "--out", out,
-        ],
-        capture_output=True, text=True, timeout=300,
-        env={**os.environ, "PYTHONPATH": repo},
+    res = _run_example(
+        "measure_body.py", "--batch", "2", "--out", str(tmp_path / "body")
     )
-    assert res.returncode == 0, res.stderr[-2000:]
     assert "chest" in res.stdout and "waist" in res.stdout
     assert (tmp_path / "body.obj").exists()
     assert (tmp_path / "body_curves.obj").exists()
 
 
 def test_hand_body_contact_example(tmp_path):
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    res = subprocess.run(
-        [
-            sys.executable,
-            os.path.join(repo, "examples", "hand_body_contact.py"),
-            "--out", str(tmp_path),
-        ],
-        capture_output=True, text=True, timeout=300,
-        env={**os.environ, "PYTHONPATH": repo},
-    )
-    assert res.returncode == 0, res.stderr[-2000:]
+    res = _run_example("hand_body_contact.py", "--out", str(tmp_path))
     assert "intersecting hand faces" in res.stdout
     assert "contact vertices" in res.stdout
     assert (tmp_path / "hand.ply").exists()

@@ -98,6 +98,32 @@ class TestShardedQueries:
             ring["sqdist"], np.asarray(single["sqdist"]), atol=1e-6
         )
 
+    def test_face_sharded_ring_nan_propagates_like_gather(self):
+        # a NaN vertex in ONE shard's face block must poison the merged
+        # result identically in both merges (numpy argmin picks the first
+        # NaN; the ring maps NaN to -inf for the same effect) — otherwise
+        # the ring would leave devices holding different accumulators
+        rng = np.random.RandomState(6)
+        v, f = icosphere(2)
+        v = v.astype(np.float32)
+        v_nan = v.copy()
+        # poison a vertex used by faces landing in a middle shard
+        target_face = f[200]
+        v_nan[target_face[0]] = np.nan
+        points = rng.randn(40, 3).astype(np.float32)
+        mesh = make_device_mesh(8, ("dp",))
+        gather = sharded_closest_faces_sharded_topology(
+            v_nan, f.astype(np.int32), points, mesh, chunk=64,
+            merge="gather",
+        )
+        ring = sharded_closest_faces_sharded_topology(
+            v_nan, f.astype(np.int32), points, mesh, chunk=64, merge="ring"
+        )
+        np.testing.assert_array_equal(
+            np.isnan(ring["sqdist"]), np.isnan(gather["sqdist"])
+        )
+        np.testing.assert_array_equal(ring["face"], gather["face"])
+
     def test_face_sharded_merge_rejects_unknown(self):
         v, f = icosphere(1)
         mesh = make_device_mesh(8, ("dp",))
