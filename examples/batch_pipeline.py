@@ -47,11 +47,14 @@ def main():
     ]
     queries = rng.randn(args.queries, 3).astype(np.float32)
 
-    # warm the jit caches so the timings compare steady-state dispatch,
-    # not first-call compilation
-    meshes[0].estimate_vertex_normals()
-    meshes[0].closest_faces_and_points(queries)
-    meshes[0].normals_and_closest_points(queries)
+    # warm the jit caches AND every mesh's device-array cache so the
+    # timings compare steady-state dispatch only — not first-call
+    # compilation, and not host->device uploads charged to whichever
+    # path happens to run first
+    for m in meshes:
+        m.estimate_vertex_normals()
+        m.closest_faces_and_points(queries)
+        m.normals_and_closest_points(queries)
     fused_normals_and_closest_points(meshes, queries)
 
     # 1. classic per-mesh facade loop (2 dispatches per mesh)
