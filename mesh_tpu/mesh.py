@@ -518,10 +518,10 @@ class Mesh(object):
     # ------------------------------------------------------------------
     # Search (delegates; reference mesh.py:439-455 via search.py trees)
 
-    def compute_aabb_tree(self):
+    def compute_aabb_tree(self, strategy="auto"):
         from .search import AabbTree
 
-        return AabbTree(self)
+        return AabbTree(self, strategy=strategy)
 
     def compute_aabb_normals_tree(self):
         from .search import AabbNormalsTree

@@ -34,10 +34,25 @@ def _mesh_vf(m):
 
 class AabbTree(object):
     """Closest-point / ray / intersection queries against a mesh
-    (reference search.py:19-49)."""
+    (reference search.py:19-49).
 
-    def __init__(self, m):
+    ``strategy="anchored"`` opts into the reference's build-once/query-many
+    shape: the first ``nearest`` call builds per-vertex candidate tables
+    (query/anchored.py — the analog of the reference's cached CGAL tree,
+    search.py:21-24), and every later call does O(K) exact work per query
+    instead of O(F), with non-tight queries re-run exactly.  The default
+    ``"auto"`` keeps the stateless per-call strategy choice (brute force
+    vs culled at the measured crossover).
+    """
+
+    def __init__(self, m, strategy="auto"):
+        if strategy not in ("auto", "anchored"):
+            raise ValueError(
+                "strategy must be 'auto' or 'anchored', got %r" % (strategy,)
+            )
         self.v, self.f = _mesh_vf(m)
+        self._strategy = strategy
+        self._tables = None
 
     def nearest(self, v_samples, nearest_part=False):
         """nearest_part tells you whether the closest point in triangle abc
@@ -45,9 +60,17 @@ class AabbTree(object):
         (a:4, b:5, c:6).
 
         Strategy is automatic: exact brute force at SMPL scale, top-k culled
-        with exact fallback beyond (query/culled.py)."""
+        with exact fallback beyond (query/culled.py); see the class
+        docstring for the amortized ``"anchored"`` mode."""
         pts = np.asarray(v_samples, dtype=np.float32).reshape(-1, 3)
-        res = query.closest_faces_and_points_auto(self.v, self.f, pts)
+        if self._strategy == "anchored":
+            if self._tables is None:
+                self._tables = query.build_anchor_tables(self.v, self.f)
+            res = query.closest_point_anchored_auto(
+                self.v, self.f, pts, tables=self._tables
+            )
+        else:
+            res = query.closest_faces_and_points_auto(self.v, self.f, pts)
         f_idxs = np.asarray(res["face"]).astype(np.uint32).reshape(1, -1)
         f_part = np.asarray(res["part"]).astype(np.uint32).reshape(1, -1)
         v_out = np.asarray(res["point"], dtype=np.float64)

@@ -7,6 +7,7 @@ already equal the brute-force distance without any fallback.
 """
 
 import numpy as np
+import pytest
 
 from mesh_tpu.query import closest_faces_and_points
 from mesh_tpu.query.anchored import (
@@ -135,3 +136,37 @@ class TestAnchoredQueries:
         b = closest_point_anchored_auto(v, f, scan, k=64)
         np.testing.assert_array_equal(a["face"], b["face"])
         np.testing.assert_allclose(a["sqdist"], b["sqdist"], atol=0)
+
+
+class TestAabbTreeAnchoredStrategy:
+    def test_anchored_tree_matches_auto_and_caches_tables(self):
+        # AabbTree(strategy="anchored") is the reference's build-once/
+        # query-many shape: first nearest() builds the tables, later calls
+        # reuse them, and results stay exact
+        from mesh_tpu import Mesh
+
+        rng = np.random.RandomState(11)
+        v, f = icosphere(3)
+        m = Mesh(v=v, f=f)
+        tree = m.compute_aabb_tree(strategy="anchored")
+        assert tree._tables is None
+        pts = rng.randn(120, 3)
+        f_a, p_a = tree.nearest(pts)
+        assert tree._tables is not None
+        tables_after_first = tree._tables
+        f_b, p_b = tree.nearest(pts)
+        assert tree._tables is tables_after_first     # reused, not rebuilt
+        np.testing.assert_array_equal(f_a, f_b)
+        ref_tree = m.compute_aabb_tree()
+        f_r, p_r = ref_tree.nearest(pts)
+        d_a = np.linalg.norm(p_a - pts, axis=1)
+        d_r = np.linalg.norm(p_r - pts, axis=1)
+        np.testing.assert_allclose(d_a, d_r, atol=1e-5)
+        assert f_a.shape == (1, 120)                  # reference shape kept
+
+    def test_unknown_strategy_raises(self):
+        from mesh_tpu import Mesh
+
+        v, f = icosphere(1)
+        with pytest.raises(ValueError, match="auto.*anchored"):
+            Mesh(v=v, f=f).compute_aabb_tree(strategy="bvh")

@@ -54,6 +54,28 @@ class TestVisibility:
         vis, _ = visibility_compute(v, f, cam, n=n, extra_v=wall_v, extra_f=wall_f)
         assert not vis.any()
 
+    def test_min_dist_skips_near_occluders(self):
+        # reference tests/test_visibility.py:49-53: an occluder nearer to
+        # the vertex than min_dist does not block (the ray starts at
+        # vert + min_dist * dir, past it)
+        v, f, n = self._box()
+        wall_v = np.array(
+            [[-10, -10, 2.5], [10, -10, 2.5], [10, 10, 2.5], [-10, 10, 2.5]]
+        )
+        wall_f = np.array([[0, 1, 2], [0, 2, 3]])
+        cam = np.array([[0.0, 0.0, 5.0]])
+        # wall is 1.5 in front of the +z face: with min_dist=2.0 the rays
+        # start beyond it, so the +z face is visible again
+        vis, _ = visibility_compute(
+            v, f, cam, n=n, extra_v=wall_v, extra_f=wall_f, min_dist=2.0
+        )
+        np.testing.assert_array_equal(vis[0].astype(bool), v[:, 2] > 0)
+        # sanity: with the default epsilon the same wall blocks everything
+        vis0, _ = visibility_compute(
+            v, f, cam, n=n, extra_v=wall_v, extra_f=wall_f
+        )
+        assert not vis0.any()
+
     def test_n_dot_cam(self):
         v, f, n = self._box()
         cam = np.array([[0.0, 0.0, 100.0]])
