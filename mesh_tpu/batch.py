@@ -74,8 +74,9 @@ def _per_mesh_closest(v, f, pts, use_pallas, chunk):
     return closest_faces_and_points(v, f, pts, chunk=chunk)
 
 
-@partial(jax.jit, static_argnames=("use_pallas", "chunk", "with_normals"))
-def _batch_step(vs, fj, pts, use_pallas, chunk, with_normals):
+@partial(jax.jit, static_argnames=("use_pallas", "use_culled", "chunk",
+                                   "with_normals"))
+def _batch_step(vs, fj, pts, use_pallas, use_culled, chunk, with_normals):
     normals = vert_normals(vs, fj) if with_normals else None
 
     def body(v, q):
@@ -83,6 +84,12 @@ def _batch_step(vs, fj, pts, use_pallas, chunk, with_normals):
 
     if pts is None:
         res = None
+    elif use_culled:
+        # past the measured crossover the tile-sphere-culled kernel wins,
+        # and it takes the [B, V, 3] batch natively — no vmap lift needed
+        from .query.pallas_culled import closest_point_pallas_culled
+
+        res = closest_point_pallas_culled(vs, fj, pts)
     elif use_pallas:
         # vmap lifts the Pallas grid to a batch dimension: one kernel
         # launch for all B meshes (same shape as bench.py's fused step)
@@ -93,6 +100,24 @@ def _batch_step(vs, fj, pts, use_pallas, chunk, with_normals):
     return normals, res
 
 
+def _strategy(f):
+    """(use_pallas, use_culled) for a face array — the batch analog of
+    closest_faces_and_points_auto's measured-crossover switch on the
+    Pallas path (off-TPU the batched path is always the tiled brute
+    scan; only the single-mesh auto has an XLA culled variant).
+
+    ``f.shape[0]`` is static metadata on numpy AND jax arrays — never
+    np.asarray the faces here, which would sync a device array to the
+    host on every batched call.
+    """
+    use_pallas = pallas_default()
+    if not use_pallas:
+        return False, False
+    from .query.autotune import crossover_faces
+
+    return True, int(f.shape[0]) > crossover_faces()
+
+
 def batched_vertex_normals(meshes):
     """Area-weighted vertex normals for every mesh in ONE dispatch.
 
@@ -101,7 +126,7 @@ def batched_vertex_normals(meshes):
     """
     v, f = stack_mesh_batch(meshes)
     normals, _ = _batch_step(
-        jnp.asarray(v), jnp.asarray(f), None, False, 512, True
+        jnp.asarray(v), jnp.asarray(f), None, False, False, 512, True
     )
     return np.asarray(normals, np.float64)
 
@@ -128,9 +153,10 @@ def batched_closest_faces_and_points(meshes, points, chunk=512):
     """
     v, f = stack_mesh_batch(meshes)
     pts = _broadcast_points(points, v.shape[0])
+    use_pallas, use_culled = _strategy(f)
     _, res = _batch_step(
         jnp.asarray(v), jnp.asarray(f), jnp.asarray(pts),
-        pallas_default(), chunk, False,
+        use_pallas, use_culled, chunk, False,
     )
     faces = np.asarray(res["face"]).astype(np.uint32)[:, None, :]
     return faces, np.asarray(res["point"], np.float64)
@@ -163,8 +189,9 @@ def fused_normals_and_closest_points(meshes, points, chunk=512):
         v, f = stack_mesh_batch(meshes)
         vs, fs, batch = jnp.asarray(v), jnp.asarray(f), v.shape[0]
     pts = _broadcast_points(points, batch)
+    use_pallas, use_culled = _strategy(fs)
     normals, res = _batch_step(
-        vs, fs, jnp.asarray(pts), pallas_default(), chunk, True,
+        vs, fs, jnp.asarray(pts), use_pallas, use_culled, chunk, True,
     )
     normals = np.asarray(normals, np.float64)
     faces = np.asarray(res["face"]).astype(np.uint32)[:, None, :]

@@ -86,6 +86,26 @@ class TestBatchedClosest:
         np.testing.assert_allclose(points[1], p1, atol=1e-6)
 
 
+class TestStrategy:
+    def test_cpu_never_culled(self):
+        from mesh_tpu.batch import _strategy
+
+        use_pallas, use_culled = _strategy(np.zeros((10 ** 6, 3), np.int32))
+        assert use_pallas is False and use_culled is False
+
+    def test_tpu_crossover_routing(self, monkeypatch):
+        from mesh_tpu import batch
+        from mesh_tpu.utils import dispatch
+
+        class _FakeDev:
+            platform = "tpu"
+
+        monkeypatch.setattr(dispatch.jax, "devices", lambda: [_FakeDev()])
+        monkeypatch.setenv("MESH_TPU_BRUTE_MAX_FACES", "1000")
+        assert batch._strategy(np.zeros((999, 3), np.int32)) == (True, False)
+        assert batch._strategy(np.zeros((1001, 3), np.int32)) == (True, True)
+
+
 class TestFused:
     def test_batch_matches_unfused(self):
         meshes = _mesh_batch()

@@ -375,3 +375,26 @@ class TestCompiledPallasParity:
         d = np.asarray(dist)
         assert np.all(np.isfinite(d)), d
         np.testing.assert_allclose(d, [1.0, 2.0, 1.0], atol=1e-5)
+
+    def test_batched_facade_culled_routing_compiled(self, monkeypatch):
+        """Above the crossover the batched facade runs the natively
+        batched culled kernel; results must match the vmapped brute
+        kernel compiled."""
+        from mesh_tpu.batch import batched_closest_faces_and_points
+        from mesh_tpu.query.autotune import _sphere_mesh
+
+        v, f = _sphere_mesh(40_000)
+        rng = np.random.RandomState(27)
+        v_stack = np.stack([v, v * 1.1])
+        pts = rng.randn(2, 256, 3).astype(np.float32)
+        monkeypatch.setenv("MESH_TPU_BRUTE_MAX_FACES", "1000")  # force culled
+        faces_c, points_c = batched_closest_faces_and_points(
+            (v_stack, f), pts
+        )
+        monkeypatch.setenv("MESH_TPU_BRUTE_MAX_FACES", "10000000")  # brute
+        faces_b, points_b = batched_closest_faces_and_points(
+            (v_stack, f), pts
+        )
+        d_c = np.linalg.norm(points_c - pts, axis=-1)
+        d_b = np.linalg.norm(points_b - pts, axis=-1)
+        np.testing.assert_allclose(d_c, d_b, atol=1e-4)
