@@ -497,8 +497,39 @@ def config6():
                 platform=_platform())}
 
 
-def main():
+ALL_CONFIGS = (config1, config2, config3, config4, config5, config6)
+
+
+def main(argv=None):
+    """`python benchmarks/run_all.py [--configs 1,4,6] [--trace DIR]`
+
+    --configs reruns a subset (the on-chip gates shouldn't pay for five
+    healthy configs to re-measure one fix); --trace captures a
+    jax.profiler trace per config under DIR (view with tensorboard or
+    xprof) for kernel-level analysis on the chip.
+    """
+    import argparse
+
     from bench import backend_responsive
+
+    parser = argparse.ArgumentParser(description=main.__doc__)
+    parser.add_argument("--configs", default=None,
+                        help="comma-separated config numbers, e.g. 1,4,6")
+    parser.add_argument("--trace", default=None, metavar="DIR",
+                        help="write a jax.profiler trace per config")
+    args = parser.parse_args(argv)
+
+    configs = ALL_CONFIGS
+    if args.configs:
+        try:
+            wanted = {int(x) for x in args.configs.split(",")}
+        except ValueError:
+            parser.error("--configs wants comma-separated integers, got %r"
+                         % args.configs)
+        unknown = wanted - set(range(1, len(ALL_CONFIGS) + 1))
+        if unknown:
+            parser.error("unknown config numbers: %s" % sorted(unknown))
+        configs = [c for i, c in enumerate(ALL_CONFIGS, 1) if i in wanted]
 
     ok, reason = backend_responsive()
     if not ok:
@@ -507,10 +538,18 @@ def main():
         print(json.dumps({"suite": "baseline_configs", "results": [],
                           "error": "jax backend probe failed: %s" % reason}))
         sys.exit(1)
+    import contextlib
+
+    if args.trace:
+        from mesh_tpu.utils.profiling import trace
+
     results = []
-    for cfg in (config1, config2, config3, config4, config5, config6):
+    for cfg in configs:
+        ctx = (trace("%s/%s" % (args.trace, cfg.__name__))
+               if args.trace else contextlib.nullcontext())
         try:
-            res = cfg()
+            with ctx:
+                res = cfg()
         except Exception as e:  # keep the suite running
             res = {"metric": cfg.__name__, "error": str(e)[:200]}
         results.append(res)

@@ -20,6 +20,8 @@ echo "=== gate 2/3: north-star bench ==="
 python bench.py
 
 echo "=== gate 3/3: full benchmark suite (writes BASELINE rows) ==="
+# retry a single fixed config with `--configs N`; add `--trace DIR` for a
+# per-config jax.profiler capture
 python benchmarks/run_all.py
 
 echo "=== all gates passed; update BASELINE.md with the new rows ==="
