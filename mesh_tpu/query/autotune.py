@@ -89,14 +89,21 @@ def _sphere_mesh(n_faces, seed=0):
         np.outer(np.sin(theta), np.sin(phi)),
         np.outer(np.cos(theta), np.ones(n_seg)),
     ], axis=-1).reshape(-1, 3)
-    faces = []
-    for r in range(n_ring - 1):
-        b0, b1 = r * n_seg, (r + 1) * n_seg
-        for s in range(n_seg):
-            s1 = (s + 1) % n_seg
-            faces.append([b0 + s, b1 + s, b1 + s1])
-            faces.append([b0 + s, b1 + s1, b0 + s1])
-    return v.astype(np.float32), np.asarray(faces, np.int32)
+    # vectorized quad split, same face order as the equivalent
+    # (ring, segment) double loop — config 6 builds ~1M faces per run
+    r = np.arange(n_ring - 1)[:, None]
+    s = np.arange(n_seg)[None, :]
+    s1 = (s + 1) % n_seg
+    b0s, b1s, b1s1, b0s1 = (
+        r * n_seg + s, (r + 1) * n_seg + s,
+        (r + 1) * n_seg + s1, r * n_seg + s1,
+    )
+    faces = np.stack(
+        [np.stack([b0s, b1s, b1s1], axis=-1),
+         np.stack([b0s, b1s1, b0s1], axis=-1)],
+        axis=2,
+    ).reshape(-1, 3)
+    return v.astype(np.float32), faces.astype(np.int32)
 
 
 def _time_best(fn, reps):
