@@ -178,3 +178,76 @@ class TestProcessing:
         u = m.uniquified_mesh()
         assert u.v.shape == (36, 3)
         np.testing.assert_array_equal(u.f, np.arange(36).reshape(-1, 3))
+
+    @staticmethod
+    def _tri_set(verts, faces):
+        """Triangles as an order-independent set of corner-point tuples."""
+        verts = np.asarray(verts)
+        return {
+            tuple(sorted(map(tuple, verts[np.asarray(face, np.int64)])))
+            for face in faces
+        }
+
+    def test_remove_faces_drops_unreferenced_vertices(self):
+        # reference processing.py:67-95: faces go, orphaned vertices go,
+        # surviving face indices remap densely, fc rows follow the faces
+        v, f = box()
+        m = Mesh(v=v, f=f)
+        m.set_face_colors(np.tile([1.0, 0.0, 0.0], (len(f), 1)))
+        # keep only the two z=-0.5 faces: vertices 4-7 become orphans,
+        # so the dense remap genuinely renumbers
+        drop = list(range(2, len(f)))
+        before = self._tri_set(v, f[:2])
+        m.remove_faces(drop)
+        assert self._tri_set(m.v, m.f) == before   # surviving geometry
+        assert m.f.shape[0] == 2
+        assert m.fc.shape[0] == 2
+        assert len(m.v) == 4                       # orphans dropped
+        assert m.f.max() == len(m.v) - 1           # dense remap
+        assert len(np.unique(m.f)) == len(m.v)
+
+    def test_reorder_vertices_preserves_geometry(self):
+        # new_ordering[i] = j means vertex i becomes the j-th vertex
+        # (reference processing.py:171-186); triangles must be unchanged
+        # as point sets
+        rng = np.random.RandomState(0)
+        v, f = box()
+        m = Mesh(v=v, f=f)
+        order = rng.permutation(len(v))
+        tris_before = self._tri_set(v, f)
+        m.reorder_vertices(order)
+        np.testing.assert_allclose(np.asarray(m.v)[order], v)
+        assert self._tri_set(m.v, m.f) == tris_before
+
+    def test_rotate_scale_translate(self):
+        v, f = box()
+        m = Mesh(v=v, f=f)
+        # axis-angle pi/2 about z, as the reference feeds cv2.Rodrigues
+        m.rotate_vertices(np.array([0.0, 0.0, np.pi / 2]))
+        np.testing.assert_allclose(
+            np.asarray(m.v), np.stack([-v[:, 1], v[:, 0], v[:, 2]], axis=1),
+            atol=1e-7,
+        )
+        m2 = Mesh(v=v, f=f)
+        R = np.array([[0, -1, 0], [1, 0, 0], [0, 0, 1]], np.float64)
+        m2.rotate_vertices(R)                  # matrix input, same result
+        np.testing.assert_allclose(np.asarray(m.v), np.asarray(m2.v),
+                                   atol=1e-7)
+        m2.scale_vertices(2.0).translate_vertices([1.0, 0.0, 0.0])
+        np.testing.assert_allclose(
+            np.asarray(m2.v),
+            2.0 * np.stack([-v[:, 1], v[:, 0], v[:, 2]], axis=1)
+            + [1.0, 0.0, 0.0],
+            atol=1e-6,
+        )
+
+    def test_point_cloud_and_reset_face_normals(self):
+        v, f = box()
+        m = Mesh(v=v, f=f, vc="SteelBlue")
+        pc = m.point_cloud()
+        assert len(pc.f) == 0
+        np.testing.assert_allclose(pc.v, m.v)
+        assert pc.vc.shape == m.vc.shape       # colors survive
+        m.reset_face_normals()
+        np.testing.assert_array_equal(m.fn, m.f)
+        assert hasattr(m, "vn")                # implied reset_normals ran
