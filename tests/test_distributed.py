@@ -1,0 +1,50 @@
+"""Multi-host bootstrap helpers (parallel/distributed.py) on the
+single-host virtual 8-device platform."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from mesh_tpu.parallel.distributed import (
+    global_device_mesh,
+    initialize_multihost,
+)
+
+
+def test_initialize_single_host_degrades_to_false():
+    # no arguments on a single host: auto-detect failure (or an already-
+    # initialized single-process group) must report "not multi-host"
+    assert initialize_multihost() is False
+
+
+def test_initialize_explicit_args_propagate_errors():
+    # explicit arguments mean the caller intends multi-host, so jax's
+    # error must propagate instead of degrading to single-process
+    # operation: ValueError (process_id >= num_processes) on a fresh
+    # process, RuntimeError (already initialized) when an earlier test's
+    # auto-detect bootstrap ran first
+    with pytest.raises((ValueError, RuntimeError)):
+        initialize_multihost(
+            coordinator_address="127.0.0.1:1", num_processes=2, process_id=5
+        )
+
+
+def test_global_device_mesh_1d_default():
+    mesh = global_device_mesh()
+    assert mesh.axis_names == ("dp",)
+    assert mesh.shape["dp"] == len(jax.devices())
+
+
+def test_global_device_mesh_2d_with_shape():
+    n = len(jax.devices())
+    if n % 2:
+        pytest.skip("needs an even device count")
+    mesh = global_device_mesh(("dp", "sp"), (n // 2, 2))
+    assert dict(mesh.shape) == {"dp": n // 2, "sp": 2}
+    assert np.asarray(mesh.devices).size == n
+
+
+def test_global_device_mesh_multi_axis_requires_shape():
+    with pytest.raises(ValueError, match="shape is required"):
+        global_device_mesh(("dp", "sp"))

@@ -194,15 +194,19 @@ class TestProcessing:
         v, f = box()
         m = Mesh(v=v, f=f)
         m.set_face_colors(np.tile([1.0, 0.0, 0.0], (len(f), 1)))
-        # keep only the two z=-0.5 faces: vertices 4-7 become orphans,
-        # so the dense remap genuinely renumbers
-        drop = list(range(2, len(f)))
-        before = self._tri_set(v, f[:2])
+        # keep faces 2 and 3 only: their vertices keep their original
+        # (non-prefix) ids, so the dense remap genuinely renumbers —
+        # keeping a vertex-id prefix would make the remap the identity
+        keep = [2, 3]
+        drop = [i for i in range(len(f)) if i not in keep]
+        before = self._tri_set(v, f[keep])
+        kept_ids = np.unique(f[keep])
+        assert kept_ids.min() > 0                  # non-identity remap
         m.remove_faces(drop)
         assert self._tri_set(m.v, m.f) == before   # surviving geometry
         assert m.f.shape[0] == 2
         assert m.fc.shape[0] == 2
-        assert len(m.v) == 4                       # orphans dropped
+        assert len(m.v) == len(kept_ids)           # orphans dropped
         assert m.f.max() == len(m.v) - 1           # dense remap
         assert len(np.unique(m.f)) == len(m.v)
 
