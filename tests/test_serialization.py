@@ -368,6 +368,59 @@ class TestLandmarkSniffing:
         m.set_landmark_indices_from_any(path)
         assert "side" in m.landm
 
+    def _lmrk_file(self, tmp_path):
+        # CAESAR layout: _scale/_translate/_rotation header then named
+        # landmark rows whose coordinates are stored (z, x, y) — the
+        # loader swizzles data[1], data[2], data[0] into xyz
+        # (reference serialization.py:343-361)
+        path = str(tmp_path / "subject.lmrk")
+        with open(path, "w") as fh:
+            fh.write(
+                "_scale 1.0\n"
+                "_translate 0.0 0.0 0.0\n"
+                "_rotation 1 0 0 0 1 0 0 0 1\n"
+                "\n"
+                "Sellion 0.5 0.5 0.5\n"          # -> xyz (0.5, 0.5, 0.5)
+                "Rt.Acromion -0.5 -0.5 -0.5\n"
+                "Missing 0.0 0.0 0.0\n"          # zero rows filtered out
+            )
+        return path
+
+    def test_lmrk_file_loads_with_swizzle(self, tmp_path):
+        m = self._mesh()
+        m.set_landmark_indices_from_lmrkfile(self._lmrk_file(tmp_path))
+        assert set(m.landm) == {"Sellion", "Rt.Acromion"}  # zero row dropped
+        np.testing.assert_allclose(m.landm_xyz["Sellion"], [0.5, 0.5, 0.5])
+        np.testing.assert_allclose(
+            m.landm_xyz["Rt.Acromion"], [-0.5, -0.5, -0.5]
+        )
+        np.testing.assert_allclose(m.caesar_rotation_matrix, np.eye(3))
+
+    def test_lmrk_sniffed_by_content_not_extension(self, tmp_path):
+        import shutil
+
+        m = self._mesh()
+        # sniffing keys on the _scale/_translate/_rotation header, so an
+        # arbitrary extension must still route to the lmrk loader
+        path = str(tmp_path / "landmarks.dat")
+        shutil.copy(self._lmrk_file(tmp_path), path)
+        m.set_landmark_indices_from_any(path)
+        assert set(m.landm) == {"Sellion", "Rt.Acromion"}
+
+    def test_lmrk_swizzle_maps_zxy_storage(self, tmp_path):
+        # asymmetric row proves the (z, x, y) -> (x, y, z) mapping: the
+        # stored triple (a, b, c) must surface as xyz == (b, c, a)
+        path = str(tmp_path / "s.lmrk")
+        with open(path, "w") as fh:
+            fh.write(
+                "_scale 1.0\n_translate 0 0 0\n"
+                "_rotation 1 0 0 0 1 0 0 0 1\n"
+                "P 0.5 -0.5 0.5\n"
+            )
+        m = self._mesh()
+        m.set_landmark_indices_from_lmrkfile(path)
+        np.testing.assert_allclose(m.landm_xyz["P"], [-0.5, 0.5, 0.5])
+
     def test_unknown_format_raises(self, tmp_path):
         m = self._mesh()
         path = str(tmp_path / "lm.bin")
