@@ -90,10 +90,10 @@ def _sqdist_tile_fast(px, py, pz,
 
     # region-selected squared distance; interior first (most common), then
     # progressively override with edge/vertex regions in priority order.
-    # A degenerate face (inv_n2 == 0) must not report plane-distance 0 if
-    # classification falls through to the interior case — use the vertex
-    # distance instead (the old reconstruction form did the equivalent).
-    d = jnp.where(inv_n2 > 0, n_ap * n_ap * inv_n2, ap2)
+    # (Degenerate faces — inv_n2 == 0 — are fully overridden by the
+    # segment minimum at the end, so the interior term's value for them
+    # is irrelevant.)
+    d = n_ap * n_ap * inv_n2
     on_bc = (va <= 0) & (d_bc >= 0) & (d5 - d6 >= 0)
     d = jnp.where(on_bc, bp2 - d_bc * d_bc * inv_bc2, d)
     on_ca = (vb <= 0) & (d2 >= 0) & (d6 <= 0)
@@ -106,6 +106,25 @@ def _sqdist_tile_fast(px, py, pz,
     d = jnp.where(in_b, bp2, d)
     in_a = (d1 <= 0) & (d2 <= 0)
     d = jnp.where(in_a, ap2, d)
+
+    # degenerate-face override (inv_n2 == 0, zeroed by fast_tile_rows'
+    # RELATIVE area cut): the va/vb/vc region tests above cancel to
+    # rounding noise on zero-area faces, so the selected region — and the
+    # distance — is arbitrary.  Such a face IS its edge segments; the
+    # best clamped segment projection is exact there and costs only
+    # already-loaded planes (mirrors point_triangle's override, which the
+    # epilogue recompute uses).  Padded faces (zero edges) reduce to ap2
+    # = +BIG and still never win.
+    t_ab = jnp.clip(d1 * inv_ab2, 0.0, 1.0)
+    e_ab = ap2 - t_ab * (d1 + d1 - t_ab * ab2)
+    t_ca = jnp.clip(d2 * inv_ac2, 0.0, 1.0)
+    e_ca = ap2 - t_ca * (d2 + d2 - t_ca * ac2)
+    bc2 = ab2 + ac2 - (abac + abac)
+    t_bc = jnp.clip(d_bc * inv_bc2, 0.0, 1.0)
+    e_bc = bp2 - t_bc * (d_bc + d_bc - t_bc * bc2)
+    d = jnp.where(
+        inv_n2 > 0, d, jnp.minimum(e_ab, jnp.minimum(e_ca, e_bc))
+    )
     # the edge forms subtract two nearly-equal squares; clamp the rounding
     return jnp.maximum(d, 0.0)
 
@@ -187,6 +206,7 @@ def fast_tile_rows(tri):
 
     ab2 = jnp.sum(ab * ab, axis=-1)
     ac2 = jnp.sum(ac * ac, axis=-1)
+    n2 = jnp.sum(n * n, axis=-1)
     rows = [
         a[..., 0], a[..., 1], a[..., 2],
         ab[..., 0], ab[..., 1], ab[..., 2],
@@ -196,7 +216,11 @@ def fast_tile_rows(tri):
         _safe_recip(ab2),
         _safe_recip(ac2),
         _safe_recip(jnp.sum(bc * bc, axis=-1)),
-        _safe_recip(jnp.sum(n * n, axis=-1)),
+        # the degeneracy cut must be RELATIVE: a collinear face at unit
+        # scale has n2 ~ rounding noise (1e-14), far above any absolute
+        # epsilon, and its huge reciprocal would turn the interior term
+        # into garbage.  Matches point_triangle's degenerate test.
+        jnp.where(n2 <= 1e-10 * ab2 * ac2, 0.0, _safe_recip(n2)),
     ]
     assert len(rows) == N_FACE_ROWS
     return rows

@@ -92,6 +92,52 @@ def closest_point_barycentric(p, a, b, c):
     for cond, bxyz, code in reversed(cand):
         out_bary = jnp.where(cond[..., None], bxyz, out_bary)
         out_part = jnp.where(cond, code, out_part)
+
+    # Degenerate-face override.  For (near-)zero-area triangles —
+    # duplicate corners, collinear corners — the region tests above ride
+    # on va/vb/vc, which are exact zeros cancelling in f32: rounding noise
+    # picks an arbitrary region and the reported point can be badly wrong
+    # (real meshes contain such faces: scan soup, decimation output, the
+    # reference's own vertex-only CGALClosestPointTree builds them on
+    # purpose, search.py:68-86).  A degenerate triangle IS its edge
+    # segments, so the best of the three clamped segment projections is
+    # exact there.  The threshold (squared-sine of the corner angle
+    # <= 1e-10) only fires where the override differs from the true
+    # distance by O(|edge| * 1e-5) — inside the framework's parity bar.
+    ab2 = _dot(ab, ab)
+    ac2 = _dot(ac, ac)
+    n = jnp.cross(ab, ac)
+    # the vertex regions (in_a/in_b/in_c) ride on plain dot comparisons
+    # that stay exact for degenerate faces — keep their classification
+    # (and part codes); only the cancellation-dependent edge/interior
+    # selection needs the segment override
+    degen = (_dot(n, n) <= 1e-10 * ab2 * ac2) & ~(in_a | in_b | in_c)
+
+    def on_segment(p0, s0, s1):
+        d = s1 - s0
+        t = jnp.clip(_safe_div(_dot(p0 - s0, d), _dot(d, d)), 0.0, 1.0)
+        pt = s0 + t[..., None] * d
+        diff = p0 - pt
+        return t, _dot(diff, diff)
+
+    t_e_ab, d_e_ab = on_segment(p, a, b)
+    t_e_bc, d_e_bc = on_segment(p, b, c)
+    t_e_ca, d_e_ca = on_segment(p, c, a)
+    seg_cands = [
+        (d_e_ab, bary(1.0 - t_e_ab, t_e_ab, zero), PART_EDGE_AB),
+        (d_e_bc, bary(zero, 1.0 - t_e_bc, t_e_bc), PART_EDGE_BC),
+        (d_e_ca, bary(t_e_ca, zero, 1.0 - t_e_ca), PART_EDGE_CA),
+    ]
+    seg_d, seg_bary, seg_part = seg_cands[0][0], seg_cands[0][1], jnp.full(
+        va.shape, PART_EDGE_AB, dtype=jnp.int32
+    )
+    for d_e, b_e, code in seg_cands[1:]:
+        closer = d_e < seg_d
+        seg_bary = jnp.where(closer[..., None], b_e, seg_bary)
+        seg_part = jnp.where(closer, code, seg_part)
+        seg_d = jnp.minimum(seg_d, d_e)
+    out_bary = jnp.where(degen[..., None], seg_bary, out_bary)
+    out_part = jnp.where(degen, seg_part, out_part)
     return out_bary, out_part
 
 

@@ -1,0 +1,132 @@
+"""Property-based invariants for the query kernels (hypothesis).
+
+The parity suites pin specific fixtures; these generate adversarial
+geometry — degenerate faces, coincident vertices, extreme scales — and
+assert invariants that must hold for ANY input:
+
+- closest-point distance equals the f64 brute-force oracle (exactness);
+- reported points lie on the reported face (consistency);
+- triangle-triangle intersection is symmetric in its arguments;
+- self-intersection counting never exceeds F and is 0 for a convex hull
+  shape (icosphere), regardless of scale/translation.
+
+Example counts are kept small: the point is the generator's shapes, not
+volume.
+"""
+
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from mesh_tpu.query import (
+    closest_faces_and_points,
+    intersections_mask,
+    self_intersection_count,
+)
+from mesh_tpu.query.point_triangle import closest_point_on_triangle
+
+from .fixtures import icosphere
+
+_SETTINGS = dict(max_examples=25, deadline=None)
+
+
+def _mesh_strategy(max_v=24, max_f=40):
+    """Random triangle soup, possibly with degenerate / repeated faces."""
+    return st.integers(0, 2 ** 31 - 1).map(_build_soup(max_v, max_f))
+
+
+def _build_soup(max_v, max_f):
+    def build(seed):
+        rng = np.random.RandomState(seed % (2 ** 31))
+        n_v = rng.randint(4, max_v)
+        n_f = rng.randint(1, max_f)
+        v = rng.randn(n_v, 3)
+        # mix of scales, incl. tiny and large
+        v *= 10.0 ** rng.randint(-2, 3)
+        f = rng.randint(0, n_v, size=(n_f, 3))
+        if rng.rand() < 0.5 and n_f > 1:
+            f[n_f // 2] = f[0]                     # duplicate face
+        if rng.rand() < 0.5:
+            f[0, 1] = f[0, 0]                      # degenerate edge
+        return v.astype(np.float32), f.astype(np.int32)
+
+    return build
+
+
+@settings(**_SETTINGS)
+@given(_mesh_strategy(), st.integers(0, 2 ** 31 - 1))
+def test_closest_point_matches_f64_oracle(mesh, qseed):
+    v, f = mesh
+    rng = np.random.RandomState(qseed % (2 ** 31))
+    pts = (rng.randn(8, 3) * np.abs(v).max()).astype(np.float32)
+    res = closest_faces_and_points(v, f, pts, chunk=8)
+    # f64 oracle: exact min over all faces
+    tri = v[f].astype(np.float64)
+    _, sq, _ = closest_point_on_triangle(
+        pts.astype(np.float64)[:, None], tri[:, 0], tri[:, 1], tri[:, 2]
+    )
+    oracle = np.asarray(sq).min(axis=1)
+    got = np.asarray(res["sqdist"], np.float64)
+    scale = max(1.0, float(np.abs(v).max()) ** 2)
+    np.testing.assert_allclose(got, oracle, atol=2e-4 * scale, rtol=2e-4)
+
+
+@settings(**_SETTINGS)
+@given(_mesh_strategy(), st.integers(0, 2 ** 31 - 1))
+def test_reported_point_lies_on_reported_face(mesh, qseed):
+    v, f = mesh
+    rng = np.random.RandomState(qseed % (2 ** 31))
+    pts = (rng.randn(6, 3) * np.abs(v).max()).astype(np.float32)
+    res = closest_faces_and_points(v, f, pts, chunk=8)
+    face = np.asarray(res["face"], np.int64)
+    point = np.asarray(res["point"], np.float64)
+    tri = v[f].astype(np.float64)[face]           # [Q, 3, 3]
+    # the reported point must be (within rounding) the closest point ON
+    # the reported face: re-projecting it onto that face is a fixpoint
+    _, sq, _ = closest_point_on_triangle(
+        point[:, None], tri[:, None, 0], tri[:, None, 1], tri[:, None, 2]
+    )
+    scale = max(1.0, float(np.abs(v).max()) ** 2)
+    assert float(np.asarray(sq).max()) < 2e-4 * scale
+
+
+@settings(**_SETTINGS)
+@given(st.integers(0, 2 ** 31 - 1))
+def test_tri_tri_mask_symmetric(seed):
+    rng = np.random.RandomState(seed % (2 ** 31))
+    v1 = rng.randn(12, 3).astype(np.float32)
+    f1 = rng.randint(0, 12, size=(8, 3)).astype(np.int32)
+    v2 = (rng.randn(12, 3) * 0.8).astype(np.float32)
+    f2 = rng.randint(0, 12, size=(8, 3)).astype(np.int32)
+    m12 = np.asarray(intersections_mask(v1, f1, v2, f2, chunk=8))
+    m21 = np.asarray(intersections_mask(v2, f2, v1, f1, chunk=8))
+    # any-intersection must agree in aggregate: if some face of mesh2
+    # crosses mesh1, then some face of mesh1 crosses mesh2
+    assert m12.any() == m21.any()
+
+
+@settings(**_SETTINGS)
+@given(
+    st.floats(0.01, 100.0),
+    st.floats(-5.0, 5.0),
+    st.integers(1, 2),
+)
+def test_convex_shape_never_self_intersects(scale, shift, level):
+    v, f = icosphere(level)
+    v = (v * scale + shift).astype(np.float32)
+    count = int(self_intersection_count(v, f.astype(np.int32), chunk=64))
+    assert count == 0
+
+
+@settings(**_SETTINGS)
+@given(_mesh_strategy(max_v=16, max_f=24), st.integers(0, 2 ** 31 - 1))
+def test_self_intersection_count_invariant_under_face_order(mesh, pseed):
+    # involved-face counting must not depend on face ordering or rigid
+    # motion — falsifiable for tolerance/indexing bugs, unlike a bound
+    v, f = mesh
+    count = int(self_intersection_count(v, f, chunk=16))
+    rng = np.random.RandomState(pseed % (2 ** 31))
+    perm = rng.permutation(f.shape[0])
+    assert int(self_intersection_count(v, f[perm], chunk=16)) == count
+    shifted = (v + np.float32(3.5)).astype(np.float32)
+    assert int(self_intersection_count(shifted, f, chunk=16)) == count

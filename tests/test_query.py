@@ -312,3 +312,58 @@ class TestSearchTreeShapeParity:
         idx_a, _ = m.closest_vertices(queries)
         idx_b, _ = m.closest_vertices(queries, use_cgal=True)
         np.testing.assert_array_equal(np.asarray(idx_a), np.asarray(idx_b))
+
+
+class TestDegenerateFaces:
+    """Zero-area faces (duplicate or collinear corners) must report the
+    exact segment distance on every path — the Voronoi region tests
+    cancel to rounding noise there and previously picked an arbitrary
+    region (found by hypothesis: a mesh whose true winner was a b==c
+    face returned a 30% larger distance)."""
+
+    def _meshes(self):
+        # face 0: degenerate b==c segment from (0,0,0) to (1,0,0)
+        # face 1: collinear corners spanning the same segment x in [0,2]
+        # face 2: a genuine, distant triangle
+        v = np.array(
+            [[0, 0, 0], [1, 0, 0], [2, 0, 0],
+             [10, 10, 10], [11, 10, 10], [10, 11, 10]], np.float32
+        )
+        f = np.array([[0, 1, 1], [0, 2, 1], [3, 4, 5]], np.int32)
+        return v, f
+
+    def test_xla_brute_segment_exact(self):
+        v, f = self._meshes()
+        pts = np.array(
+            [[0.5, 0.3, 0.0],      # above the b==c segment interior
+             [1.5, 0.0, 0.4],      # above the collinear span
+             [-1.0, 0.0, 0.0]],    # beyond corner a
+            np.float32,
+        )
+        res = closest_faces_and_points(v, f, pts, chunk=4)
+        np.testing.assert_allclose(
+            np.asarray(res["sqdist"]), [0.09, 0.16, 1.0], atol=1e-6
+        )
+
+    def test_pallas_interpret_matches(self):
+        from mesh_tpu.query.pallas_closest import closest_point_pallas
+
+        v, f = self._meshes()
+        rng = np.random.RandomState(3)
+        pts = np.vstack(
+            [[[0.5, 0.3, 0.0], [1.5, 0.0, 0.4]],
+             rng.randn(6, 3)]
+        ).astype(np.float32)
+        ref = closest_faces_and_points(v, f, pts, chunk=4)
+        out = closest_point_pallas(v, f, pts, tile_q=8, tile_f=8,
+                                   interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(out["sqdist"]), np.asarray(ref["sqdist"]), atol=1e-5
+        )
+
+    def test_part_code_is_an_edge_on_degenerate_faces(self):
+        v, f = self._meshes()
+        res = closest_faces_and_points(
+            v, f, np.array([[0.5, 0.3, 0.0]], np.float32), chunk=4
+        )
+        assert int(np.asarray(res["part"])[0]) in (1, 2, 3)
