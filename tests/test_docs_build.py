@@ -1,0 +1,40 @@
+"""`make docs` must keep producing a complete, link-closed HTML tree
+(tools/build_docs.py): a module that stops importing would silently
+degrade its API page otherwise."""
+
+import os
+import re
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_docs_build_complete_and_link_closed(tmp_path):
+    # prepend, never clobber, PYTHONPATH (dropping /root/.axon_site breaks
+    # backend init on the TPU host — see tests/test_examples.py)
+    pythonpath = os.pathsep.join(
+        p for p in (_REPO, os.environ.get("PYTHONPATH", "")) if p
+    )
+    out = str(tmp_path / "html")    # isolated: no stale pages can satisfy
+    res = subprocess.run(           # the closure check below
+        [sys.executable, os.path.join(_REPO, "tools", "build_docs.py"), out],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "PYTHONPATH": pythonpath},
+    )
+    # exit code 1 = at least one API module failed to import
+    assert res.returncode == 0, res.stdout[-2000:] + res.stderr[-2000:]
+    assert "WARNING" not in res.stdout, res.stdout[-2000:]
+
+    index = open(os.path.join(out, "index.html")).read()
+    links = set(
+        re.findall(r'href="([^"#]+\.html)(?:#[^"]*)?"', index)
+    )
+    assert len(links) >= 30            # guide pages + API modules
+    missing = [
+        l for l in links if not os.path.exists(os.path.join(out, l))
+    ]
+    assert not missing, missing
+    # spot-check an API page carries real signatures
+    api = open(os.path.join(out, "api_mesh_tpu_query.html")).read()
+    assert "api-sig" in api and "closest_faces_and_points" in api
