@@ -262,6 +262,13 @@ def main():
             )
         )
         sys.exit(1)
+    # rerun compiles load from disk instead of paying ~20-40 s each on the
+    # tunneled chip (content-keyed, so measurements are unaffected)
+    from mesh_tpu.utils.compilation_cache import (
+        enable_persistent_compilation_cache,
+    )
+
+    enable_persistent_compilation_cache()
     elapsed, total_queries, out, model, betas, pose, queries = tpu_workload()
     qps = total_queries / elapsed
     cpu_total = cpu_baseline(model, betas, pose, queries)

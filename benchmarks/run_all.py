@@ -538,6 +538,13 @@ def main(argv=None):
         print(json.dumps({"suite": "baseline_configs", "results": [],
                           "error": "jax backend probe failed: %s" % reason}))
         sys.exit(1)
+    # rerun compiles (a fresh process per gate, tools/run_tpu_gates.sh)
+    # load from disk instead of recompiling every config's programs
+    from mesh_tpu.utils.compilation_cache import (
+        enable_persistent_compilation_cache,
+    )
+
+    enable_persistent_compilation_cache()
     import contextlib
 
     if args.trace:

@@ -39,3 +39,16 @@ else:
 import tempfile
 
 os.environ.setdefault("MESH_TPU_CACHE", tempfile.mkdtemp(prefix="mesh_tpu_cache_"))
+
+# XLA's persistent compilation cache is content-keyed, so unlike the
+# topology cache it is safe (and worth minutes per run) to share across
+# test sessions; the throwaway MESH_TPU_CACHE above would defeat it
+os.environ.setdefault(
+    "MESH_TPU_XLA_CACHE",
+    os.path.expanduser(os.path.join("~", ".mesh_tpu", "xla_test_cache")),
+)
+from mesh_tpu.utils.compilation_cache import (  # noqa: E402
+    enable_persistent_compilation_cache,
+)
+
+enable_persistent_compilation_cache()
