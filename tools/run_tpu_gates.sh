@@ -1,27 +1,42 @@
 #!/bin/bash
-# On-chip revalidation gates, run STRICTLY one at a time (overlapping TPU
-# processes are what wedged the axon tunnel on 2026-07-30).  Run this as
-# soon as `python -c "from bench import backend_responsive; ..."` reports
-# the tunnel responsive:
+# On-chip revalidation gates, run STRICTLY one process at a time (overlapping
+# TPU processes are what wedged the axon tunnel on 2026-07-30; killing a TPU
+# process mid-call appears to wedge it too — give each step all the time it
+# needs rather than wrapping it in `timeout`).  Run this as soon as
+# `python -c "from bench import backend_responsive; ..."` reports the tunnel
+# responsive:
 #
 #   bash tools/run_tpu_gates.sh
 #
 # Order matters: the compiled-kernel tests validate every Pallas kernel
-# added since the last good window BEFORE the benchmarks quote numbers
-# from them.  Each step gets its own process; a failure stops the chain
-# (fix, then rerun from the top — the suite is cheap compared to a wedge).
+# BEFORE the benchmarks quote numbers from them.  Each step gets its own
+# process.  Benchmark configs run one process each so a mid-suite tunnel
+# failure keeps every completed config's row (logs under /tmp/tpu_gates/);
+# the persistent compilation cache (mesh_tpu/utils/compilation_cache.py)
+# makes the per-process restarts cheap after the first pass.
 set -e
 cd "$(dirname "$0")/.."
+LOGDIR=${LOGDIR:-/tmp/tpu_gates}
+mkdir -p "$LOGDIR"
 
-echo "=== gate 1/3: compiled-kernel tests on the real chip ==="
+echo "=== gate 1: compiled-kernel tests on the real chip ==="
 MESH_TPU_TEST_TPU=1 python -m pytest tests/test_tpu_compiled.py -m tpu -q
 
-echo "=== gate 2/3: north-star bench ==="
+echo "=== gate 2: north-star bench ==="
 python bench.py
 
-echo "=== gate 3/3: full benchmark suite (writes BASELINE rows) ==="
-# retry a single fixed config with `--configs N`; add `--trace DIR` for a
-# per-config jax.profiler capture
-python benchmarks/run_all.py
+echo "=== gate 3: benchmark configs, one process each ==="
+fail=0
+for n in 1 2 3 4 5 6; do
+    echo "--- config $n (log: $LOGDIR/config$n.log) ---"
+    if python -u benchmarks/run_all.py --configs "$n" 2>&1 | tee "$LOGDIR/config$n.log"; then
+        :
+    else
+        echo "config $n FAILED (rc=$?) — continuing; fix and rerun just it:"
+        echo "    python benchmarks/run_all.py --configs $n"
+        fail=1
+    fi
+done
+[ "$fail" = 0 ] || exit 1
 
 echo "=== all gates passed; update BASELINE.md with the new rows ==="
