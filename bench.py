@@ -244,23 +244,30 @@ def backend_responsive(probe_timeout=150, attempts=3):
     return False, reason
 
 
+_LAST_GOOD = os.path.join(_REPO, "bench_last_good.json")
+
+
 def main():
     ok, reason = backend_responsive()
     if not ok:
         # one honest JSON line beats a driver-side timeout with no record;
-        # null values (not 0) so metric collectors can't ingest a fake 0
-        print(
-            json.dumps(
-                {
-                    "metric": "batch256_smpl_normals_plus_closest_point",
-                    "value": None,
-                    "unit": "queries/sec",
-                    "vs_baseline": None,
-                    "error": "jax backend probe failed, no measurement "
-                             "possible (%s)" % reason,
-                }
-            )
-        )
+        # null values (not 0) so metric collectors can't ingest a fake 0.
+        # The committed last-good record rides along (clearly labelled, not
+        # as the value) so a wedged-tunnel capture still carries evidence.
+        record = {
+            "metric": "batch256_smpl_normals_plus_closest_point",
+            "value": None,
+            "unit": "queries/sec",
+            "vs_baseline": None,
+            "error": "jax backend probe failed, no measurement "
+                     "possible (%s)" % reason,
+        }
+        try:
+            with open(_LAST_GOOD) as fh:
+                record["last_good_onchip_run"] = json.load(fh)
+        except (OSError, ValueError):
+            pass
+        print(json.dumps(record))
         sys.exit(1)
     # rerun compiles load from disk instead of paying ~20-40 s each on the
     # tunneled chip (content-keyed, so measurements are unaffected)
@@ -286,17 +293,30 @@ def main():
         n_queries=total_queries, n_faces=n_faces, face_planes=19,
         platform=jax.devices()[0].platform,
     )
-    print(
-        json.dumps(
-            {
-                "metric": "batch256_smpl_normals_plus_closest_point",
-                "value": round(qps, 1),
-                "unit": "queries/sec",
-                "vs_baseline": round(vs_baseline, 2),
-                "device_absolute": absolute,
-            }
-        )
-    )
+    result = {
+        "metric": "batch256_smpl_normals_plus_closest_point",
+        "value": round(qps, 1),
+        "unit": "queries/sec",
+        "vs_baseline": round(vs_baseline, 2),
+        "device_absolute": absolute,
+    }
+    print(json.dumps(result))
+    if jax.devices()[0].platform != "cpu":
+        # persist the successful on-chip measurement for the wedged-tunnel
+        # record above (committed to the repo: provenance, not a live cache)
+        try:
+            # temp + rename: a crash mid-write (the wedge modes this record
+            # exists for) must not clobber the previous good record
+            with open(_LAST_GOOD + ".tmp", "w") as fh:
+                json.dump(
+                    dict(result, measured_utc=time.strftime(
+                        "%Y-%m-%dT%H:%M:%SZ", time.gmtime())),
+                    fh, indent=1,
+                )
+                fh.write("\n")
+            os.replace(_LAST_GOOD + ".tmp", _LAST_GOOD)
+        except OSError as e:
+            log("could not persist last-good record: %s" % e)
 
 
 if __name__ == "__main__":

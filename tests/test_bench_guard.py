@@ -1,0 +1,44 @@
+"""bench.py's wedged-tunnel guard: one honest JSON error line, carrying the
+committed last-good on-chip record as labelled provenance (never as the
+value — metric collectors must see null, not a stale number)."""
+
+import io
+import json
+import os
+import sys
+from contextlib import redirect_stdout
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+def test_wedge_record_carries_last_good(monkeypatch):
+    monkeypatch.setattr(
+        bench, "backend_responsive", lambda *a, **k: (False, "synthetic")
+    )
+    buf = io.StringIO()
+    with redirect_stdout(buf), pytest.raises(SystemExit) as e:
+        bench.main()
+    assert e.value.code == 1
+    rec = json.loads(buf.getvalue())
+    assert rec["value"] is None and rec["vs_baseline"] is None
+    assert "synthetic" in rec["error"]
+    # the committed provenance record rides along, clearly labelled
+    last = rec["last_good_onchip_run"]
+    assert last["value"] > 0 and "measured_utc" in last
+
+
+def test_wedge_record_without_last_good(monkeypatch, tmp_path):
+    monkeypatch.setattr(
+        bench, "backend_responsive", lambda *a, **k: (False, "synthetic")
+    )
+    monkeypatch.setattr(bench, "_LAST_GOOD", str(tmp_path / "missing.json"))
+    buf = io.StringIO()
+    with redirect_stdout(buf), pytest.raises(SystemExit):
+        bench.main()
+    rec = json.loads(buf.getvalue())
+    assert rec["value"] is None
+    assert "last_good_onchip_run" not in rec
