@@ -7,7 +7,14 @@ from .sharding import (  # noqa: F401
     sharded_visibility,
 )
 from .checkpoint import restore_fit_state, save_fit_state  # noqa: F401
-from .distributed import global_device_mesh, initialize_multihost  # noqa: F401
+from .distributed import (  # noqa: F401
+    gather_to_hosts,
+    global_device_mesh,
+    initialize_multihost,
+    multihost_closest_faces_and_points,
+    replicate_to_mesh,
+    shard_from_local,
+)
 from .fit import (  # noqa: F401
     FitState,
     fit_scan,

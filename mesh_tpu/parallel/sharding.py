@@ -119,6 +119,18 @@ def _closest_shard_fn(mesh, axis, chunk):
     return jax.jit(_run)
 
 
+def _unpack_closest(out, face):
+    """Result dict from _closest_shard_fn's packed lanes — the ONE place
+    that knows the lane layout (part, sqdist, point xyz), shared by the
+    single-host and multi-host facades."""
+    return {
+        "face": np.asarray(face).astype(np.int32),
+        "part": np.asarray(out[:, 0]).astype(np.int32),
+        "sqdist": np.asarray(out[:, 1]),
+        "point": np.asarray(out[:, 2:5]),
+    }
+
+
 def sharded_closest_faces_and_points(v, f, points, mesh, axis="dp", chunk=512):
     """Closest-point query sharded over the query axis of an ICI mesh.
 
@@ -141,12 +153,7 @@ def sharded_closest_faces_and_points(v, f, points, mesh, axis="dp", chunk=512):
     if pad:
         out = out[:-pad]
         face = face[:-pad]
-    return {
-        "face": face.astype(np.int32),
-        "part": out[:, 0].astype(np.int32),
-        "sqdist": out[:, 1],
-        "point": out[:, 2:5],
-    }
+    return _unpack_closest(out, face)
 
 
 @lru_cache(maxsize=32)

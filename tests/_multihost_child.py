@@ -1,0 +1,71 @@
+"""One process of the two-host multihost test (tests/test_multihost.py).
+
+Usage: python tests/_multihost_child.py <process_id> <coordinator_port>
+
+Forces a 4-device CPU platform (so two processes form an 8-device global
+mesh with Gloo collectives between them — the DCN stand-in), joins the
+process group, runs the multihost closest-point query on its local shard
+of the points, and checks the gathered result against the single-device
+reference computed locally.  Prints MULTIHOST_OK on success.
+"""
+
+import os
+import sys
+
+os.environ["PALLAS_AXON_POOL_IPS"] = ""     # disable the axon TPU hook
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_NUM_CPU_DEVICES"] = "4"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 4)
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mesh_tpu.parallel import (  # noqa: E402
+    initialize_multihost,
+    multihost_closest_faces_and_points,
+)
+
+
+def main():
+    pid, port = int(sys.argv[1]), int(sys.argv[2])
+    n_procs = 2
+    live = initialize_multihost(
+        coordinator_address="localhost:%d" % port,
+        num_processes=n_procs, process_id=pid,
+    )
+    assert live and jax.process_count() == n_procs
+    assert len(jax.devices()) == 8, jax.devices()
+
+    from mesh_tpu.query import closest_faces_and_points
+    from mesh_tpu.sphere import _icosphere
+
+    v, f = _icosphere(3)
+    rng = np.random.RandomState(7)
+    # 61 rows per process: NOT divisible by the 4 local devices, so the
+    # facade's per-process padding (and its per-block trim) is exercised
+    pts_global = rng.randn(122, 3).astype(np.float32)
+    local = pts_global[pid * 61:(pid + 1) * 61]       # this host's shard
+
+    res = multihost_closest_faces_and_points(
+        v.astype(np.float32), f.astype(np.int32), local
+    )
+    ref = closest_faces_and_points(
+        v.astype(np.float32), f.astype(np.int32), pts_global
+    )
+    np.testing.assert_array_equal(res["face"], np.asarray(ref["face"]))
+    np.testing.assert_allclose(
+        res["point"], np.asarray(ref["point"]), atol=1e-5
+    )
+    np.testing.assert_allclose(
+        res["sqdist"], np.asarray(ref["sqdist"]), atol=1e-5
+    )
+    print("MULTIHOST_OK process=%d" % pid, flush=True)
+
+
+if __name__ == "__main__":
+    main()
