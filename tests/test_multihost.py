@@ -62,3 +62,11 @@ def test_two_process_closest_point():
             "child %d rc=%s\n%s" % (pid, p.returncode, out[-3000:])
         )
         assert "MULTIHOST_OK process=%d" % pid in out, out[-3000:]
+    # the SPMD fit step must produce the identical loss on every host
+    losses = {
+        line.split()[1]
+        for out in outs
+        for line in out.splitlines()
+        if line.startswith("MULTIHOST_FIT_LOSS")
+    }
+    assert len(losses) == 1, "hosts disagree on the fit loss: %s" % losses
