@@ -25,10 +25,18 @@ import numpy as np  # noqa: E402
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import optax  # noqa: E402
+
+from mesh_tpu.models import synthetic_body_model  # noqa: E402
 from mesh_tpu.parallel import (  # noqa: E402
+    global_device_mesh,
+    init_fit_state,
     initialize_multihost,
+    make_fit_step,
     multihost_closest_faces_and_points,
 )
+from mesh_tpu.query import closest_faces_and_points  # noqa: E402
+from mesh_tpu.sphere import _icosphere  # noqa: E402
 
 
 def main():
@@ -40,9 +48,6 @@ def main():
     )
     assert live and jax.process_count() == n_procs
     assert len(jax.devices()) == 8, jax.devices()
-
-    from mesh_tpu.query import closest_faces_and_points
-    from mesh_tpu.sphere import _icosphere
 
     v, f = _icosphere(3)
     rng = np.random.RandomState(7)
@@ -67,15 +72,6 @@ def main():
 
     # the training step runs SPMD across hosts unchanged: batch sharded
     # dp over both processes' devices, scan points dp x sp
-    import optax
-
-    from mesh_tpu.models import synthetic_body_model
-    from mesh_tpu.parallel import (
-        global_device_mesh,
-        init_fit_state,
-        make_fit_step,
-    )
-
     model = synthetic_body_model(
         seed=0, n_betas=4, n_joints=6,
         template=(v * np.array([0.3, 0.2, 0.9]), f),

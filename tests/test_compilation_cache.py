@@ -9,6 +9,7 @@ tools/run_tpu_gates.sh) skips recompilation.
 import os
 
 import jax
+import numpy as np
 import jax.numpy as jnp
 import pytest
 
@@ -41,9 +42,14 @@ def test_compiles_are_persisted(tmp_path):
     path = str(tmp_path / "xla")
     enable_persistent_compilation_cache(path=path, min_compile_secs=0.0)
 
+    # a per-run random constant makes the HLO unique: an identical program
+    # compiled earlier in this process would be served from jax's
+    # in-memory cache layer and never reach the (fresh) disk cache
+    salt = float(np.random.uniform(1.0, 2.0))
+
     @jax.jit
     def fn(x):
-        return jnp.sin(x) @ jnp.cos(x).T
+        return jnp.sin(salt * x) @ jnp.cos(x).T
 
     fn(jnp.ones((64, 64))).block_until_ready()
     assert os.listdir(path), "no cache entry written for a fresh compile"
