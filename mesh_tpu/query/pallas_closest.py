@@ -26,6 +26,12 @@ from .point_triangle import closest_point_on_triangle
 
 _BIG = 1e30
 
+#: grid semantics shared by every (query-tiles, face-tiles) kernel: query
+#: tiles are independent ("parallel", Mosaic may split/reorder them); the
+#: face dim is "arbitrary" — it carries the VMEM/SMEM accumulators.  A 3D
+#: batched grid prepends another "parallel" (pallas_culled).
+DIMSEM_QF = ("parallel", "arbitrary")
+
 
 def _sqdist_tile_fast(px, py, pz,
                       ax, ay, az, abx, aby, abz, acx, acy, acz, nx, ny, nz,
@@ -293,6 +299,8 @@ def nearest_vertices_pallas(v, points, tile_q=256, tile_v=2048,
             pltpu.VMEM((tile_q, 1), jnp.float32),
             pltpu.VMEM((tile_q, 1), jnp.int32),
         ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=DIMSEM_QF),
         interpret=interpret,
     )(*p_cols, *v_rows)
 
@@ -339,6 +347,8 @@ def closest_point_pallas(v, f, points, tile_q=256, tile_f=2048, interpret=False)
             pltpu.VMEM((tile_q, 1), jnp.float32),
             pltpu.VMEM((tile_q, 1), jnp.int32),
         ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=DIMSEM_QF),
         interpret=interpret,
     )(*p_cols, *face_rows)
 

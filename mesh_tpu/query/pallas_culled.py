@@ -43,7 +43,12 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .pallas_closest import N_FACE_ROWS, _sqdist_tile_fast, fast_tile_rows
+from .pallas_closest import (
+    DIMSEM_QF,
+    N_FACE_ROWS,
+    _sqdist_tile_fast,
+    fast_tile_rows,
+)
 from .point_triangle import closest_point_on_triangle
 
 _SUB = 128          # sub-tile size for the seed upper bound
@@ -240,6 +245,8 @@ def closest_point_pallas_culled(
             pltpu.VMEM((tile_q, 1), jnp.int32),
             pltpu.SMEM((1,), jnp.float32),
         ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",) + DIMSEM_QF),
         interpret=interpret,
     )(qsph, fsph, seed, *p_planes, *t_planes)
 

@@ -21,8 +21,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..geometry.tri_normals import tri_normals
 from .pallas_closest import (
-    N_FACE_ROWS, _face_rows_fast, _pad_cols, _pad_rows, _sqdist_tile_fast,
-    make_argmin_kernel,
+    DIMSEM_QF, N_FACE_ROWS, _face_rows_fast, _pad_cols, _pad_rows,
+    _sqdist_tile_fast, make_argmin_kernel,
 )
 from .point_triangle import closest_point_on_triangle
 
@@ -86,6 +86,8 @@ def nearest_normal_weighted_pallas(v, f, points, normals, eps=0.1,
             pltpu.VMEM((tile_q, 1), jnp.float32),
             pltpu.VMEM((tile_q, 1), jnp.int32),
         ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=DIMSEM_QF),
         interpret=interpret,
     )(*p_cols, *n_cols, *face_rows, *tn_rows)
 

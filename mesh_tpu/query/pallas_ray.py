@@ -35,7 +35,13 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .pallas_closest import _BIG, _pad_cols, _pad_rows, make_argmin_kernel
+from .pallas_closest import (
+    _BIG,
+    _pad_cols,
+    _pad_rows,
+    DIMSEM_QF,
+    make_argmin_kernel,
+)
 from .ray import _BARY_EPS, _EPS
 
 
@@ -175,6 +181,8 @@ def ray_any_hit_pallas(origins, dirs, tri, t_lo=0.0, t_hi=None,
         out_specs=_QCOL(tile_q),
         out_shape=jax.ShapeDtypeStruct((q_pad, 1), jnp.int32),
         scratch_shapes=[pltpu.VMEM((tile_q, 1), jnp.int32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=DIMSEM_QF),
         interpret=interpret,
     )(*qcols, *frows)
     return out_b[:n_q, 0].astype(bool)
@@ -229,6 +237,8 @@ def nearest_alongnormal_pallas(v, f, points, normals, tile_q=256,
             pltpu.VMEM((tile_q, 1), jnp.float32),
             pltpu.VMEM((tile_q, 1), jnp.int32),
         ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=DIMSEM_QF),
         interpret=interpret,
     )(*qcols, *frows)
 
@@ -390,6 +400,8 @@ def self_intersection_count_pallas(v, f, tile_q=256, tile_f=512,
         out_specs=_QCOL(tile_q),
         out_shape=jax.ShapeDtypeStruct((q_pad, 1), jnp.int32),
         scratch_shapes=[pltpu.VMEM((tile_q, 1), jnp.int32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=DIMSEM_QF),
         interpret=interpret,
     )(*qcols, qi, *frows, mi)
     return jnp.sum(out_c[:n_f, 0] > 0)
@@ -420,6 +432,8 @@ def tri_tri_any_hit_pallas(q_tri, tri, tile_q=256, tile_f=512,
         out_specs=_QCOL(tile_q),
         out_shape=jax.ShapeDtypeStruct((q_pad, 1), jnp.int32),
         scratch_shapes=[pltpu.VMEM((tile_q, 1), jnp.int32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=DIMSEM_QF),
         interpret=interpret,
     )(*qcols, *frows)
     return out_b[:n_q, 0].astype(bool)
