@@ -1,0 +1,79 @@
+"""Tile-size sweep for the brute-force closest-point kernel.
+
+The production tiles (tile_q=256, tile_f=2048) were chosen analytically
+(VMEM budget: 19 face planes x tile_f + query columns).  This sweeps the
+neighborhood on the live backend at the north-star shape (BASELINE
+config 3: 13776 faces, batch-sized query sets) and prints one JSON line
+per combination, so a recovered tunnel window can answer "are we leaving
+tile-shape performance on the table?" in ~a minute.
+
+    python benchmarks/tile_sweep.py [--queries 262144] [--faces 13776]
+"""
+
+import itertools
+import json
+import sys
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from mesh_tpu.utils.profiling import time_fn  # noqa: E402
+
+
+def main(argv=None):
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--queries", type=int, default=262144)
+    parser.add_argument("--faces", type=int, default=13776)
+    parser.add_argument("--reps", type=int, default=5)
+    parser.add_argument("--mxu", action="store_true",
+                        help="sweep the experimental MXU-fed tile instead")
+    args = parser.parse_args(argv)
+
+    from bench import backend_responsive
+
+    ok, reason = backend_responsive()
+    if not ok:
+        print(json.dumps({"error": "backend probe failed: %s" % reason}))
+        sys.exit(1)
+
+    from mesh_tpu.query.autotune import _sphere_mesh
+    from mesh_tpu.query.pallas_closest import (
+        closest_point_pallas,
+        closest_point_pallas_mxu,
+    )
+    from mesh_tpu.utils.compilation_cache import (
+        enable_persistent_compilation_cache,
+    )
+
+    enable_persistent_compilation_cache()
+    kernel = closest_point_pallas_mxu if args.mxu else closest_point_pallas
+    v, f = _sphere_mesh(args.faces)
+    rng = np.random.RandomState(0)
+    pts = rng.randn(args.queries, 3).astype(np.float32)
+
+    best = None
+    for tile_q, tile_f in itertools.product(
+        (128, 256, 512, 1024), (512, 1024, 2048, 4096)
+    ):
+        try:
+            t = time_fn(
+                lambda: kernel(v, f, pts, tile_q=tile_q, tile_f=tile_f),
+                reps=args.reps,
+            )
+            rate = args.queries / t
+            row = {"tile_q": tile_q, "tile_f": tile_f,
+                   "queries_per_sec": round(rate, 1)}
+            if best is None or rate > best["queries_per_sec"]:
+                best = row
+        except Exception as e:  # VMEM overflow etc. — record, keep sweeping
+            row = {"tile_q": tile_q, "tile_f": tile_f,
+                   "error": str(e)[:120]}
+        print(json.dumps(row), flush=True)
+    print(json.dumps({"best": best}))
+
+
+if __name__ == "__main__":
+    main()

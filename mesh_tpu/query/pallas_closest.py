@@ -83,6 +83,16 @@ def _sqdist_tile_fast(px, py, pz,
     d2 = acx * apx + acy * apy + acz * apz
     ap2 = apx * apx + apy * apy + apz * apz
     n_ap = nx * apx + ny * apy + nz * apz
+    return _ericson_tail(d1, d2, ap2, n_ap, ab2, ac2, abac,
+                         inv_ab2, inv_ac2, inv_bc2, inv_n2)
+
+
+def _ericson_tail(d1, d2, ap2, n_ap, ab2, ac2, abac,
+                  inv_ab2, inv_ac2, inv_bc2, inv_n2):
+    """Region selection + distance from the four query-dependent scalars
+    (d1, d2, ap2, n_ap) and the hoisted per-face constants — the part of
+    the fast tile that is independent of HOW the dot products were
+    produced (VPU component planes, or the MXU tile's matmul)."""
     d3 = d1 - ab2
     d4 = d2 - abac
     d5 = d1 - abac
@@ -309,6 +319,32 @@ def nearest_vertices_pallas(v, points, tile_q=256, tile_v=2048,
     return best, dist
 
 
+def _center_inputs(v, f, points):
+    """Shared query prologue: f32 cast, centering (the f32-conditioning
+    step every kernel relies on), face corner gather."""
+    v = jnp.asarray(v, jnp.float32)
+    points = jnp.asarray(points, jnp.float32)
+    center = jnp.mean(v, axis=0)
+    vc_ = v - center
+    pts = points - center
+    return vc_, pts, center, vc_[jnp.asarray(f)]
+
+
+def _winner_epilogue(best, tri, pts, center):
+    """Shared epilogue: exact recompute on the winning faces (also yields
+    the CGAL part code) -> the closest_faces_and_points result dict."""
+    a, b, c = tri[:, 0], tri[:, 1], tri[:, 2]
+    point, sqd, part = closest_point_on_triangle(
+        pts, a[best], b[best], c[best]
+    )
+    return {
+        "face": best,
+        "part": part,
+        "point": point + center,
+        "sqdist": sqd,
+    }
+
+
 @partial(jax.jit, static_argnames=("tile_q", "tile_f", "interpret"))
 def closest_point_pallas(v, f, points, tile_q=256, tile_f=2048, interpret=False):
     """Pallas-accelerated closest_faces_and_points.
@@ -316,13 +352,7 @@ def closest_point_pallas(v, f, points, tile_q=256, tile_f=2048, interpret=False)
     Same contract as query.closest_faces_and_points: returns dict with
     ``face`` [Q] int32, ``part`` [Q] int32, ``point`` [Q, 3], ``sqdist`` [Q].
     """
-    v = jnp.asarray(v, jnp.float32)
-    points = jnp.asarray(points, jnp.float32)
-    center = jnp.mean(v, axis=0)
-    vc_ = v - center
-    pts = points - center
-
-    tri = vc_[f]  # (F, 3, 3)
+    vc_, pts, center, tri = _center_inputs(v, f, points)
     n_q = pts.shape[0]
 
     p_cols = [_pad_rows(pts[:, k:k + 1], tile_q, 0.0) for k in range(3)]
@@ -352,15 +382,123 @@ def closest_point_pallas(v, f, points, tile_q=256, tile_f=2048, interpret=False)
         interpret=interpret,
     )(*p_cols, *face_rows)
 
-    best = out_i[:n_q, 0]
-    # exact recompute on the winning faces (also yields the CGAL part code)
-    a, b, c = tri[:, 0], tri[:, 1], tri[:, 2]
-    point, sqd, part = closest_point_on_triangle(
-        pts, a[best], b[best], c[best]
-    )
-    return {
-        "face": best,
-        "part": part,
-        "point": point + center,
-        "sqdist": sqd,
-    }
+    return _winner_epilogue(out_i[:n_q, 0], tri, pts, center)
+
+
+# ---------------------------------------------------------------------------
+# EXPERIMENTAL MXU-fed tile.  The fast tile's four query-dependent dot
+# products (d1, d2, n.ap and the p.a term of ap2) are 20 of its ~65 VPU ops
+# per pair; here one (TQ, 3) x (3, 4*TF) matmul produces all four on the
+# MXU and the VPU keeps only the region logic (_ericson_tail).  Whether
+# Mosaic overlaps the K=3 matmul with the VPU tail enough to win is an
+# on-chip question (benchmarks/tile_sweep.py --mxu); parity with the
+# production tile is pinned in tests either way.
+#
+# Numerics: ap2 = p2 - 2 p.a + a2 cancels like the documented corner-b/c
+# derivation (absolute error ~ulp(|p|^2) after centering, vs ~ulp(ap2)
+# direct) — argmin tie-flips only; the epilogue's exact recompute is
+# unchanged.  The matmul runs at Precision.HIGHEST (3-pass f32).
+
+#: per-face planes the MXU tile consumes alongside the G matrix
+N_FACE_ROWS_MXU = 11
+
+
+def _sqdist_tile_mxu(p, p2, g, a_ab, a_ac, a_n, a2,
+                     ab2, ac2, abac, inv_ab2, inv_ac2, inv_bc2, inv_n2):
+    tf = a_ab.shape[1]
+    pg = jax.lax.dot_general(
+        p, g, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )                                                   # (TQ, 4*TF)
+    d1 = pg[:, :tf] - a_ab
+    d2 = pg[:, tf:2 * tf] - a_ac
+    n_ap = pg[:, 2 * tf:3 * tf] - a_n
+    pa = pg[:, 3 * tf:]
+    ap2 = jnp.maximum(p2 - (pa + pa) + a2, 0.0)
+    return _ericson_tail(d1, d2, ap2, n_ap, ab2, ac2, abac,
+                         inv_ab2, inv_ac2, inv_bc2, inv_n2)
+
+
+_kernel_mxu = make_argmin_kernel(_sqdist_tile_mxu)
+
+
+def _mxu_face_inputs(tri, tile_f):
+    """(G [3, T*4*tile_f], 11 padded (1, F_pad) planes) for the MXU tile.
+
+    G is laid out in per-tile groups — tile j's block columns are
+    [ab_j | ac_j | n_j | a_j], each tile_f wide — so the plain
+    (0, j)-indexed BlockSpec hands the kernel all four dot operands of
+    its face tile.  Padded faces: zero G columns and a2 = _BIG, so their
+    ap2 (hence every region distance) overflows and never wins."""
+    a = tri[:, 0]
+    ab = tri[:, 1] - a
+    ac = tri[:, 2] - a
+    n = jnp.cross(ab, ac)
+
+    def pad_f(x, fill=0.0):                 # [F] -> (1, F_pad)
+        return _pad_cols(x[None, :], tile_f, fill)
+
+    planes = [
+        pad_f(jnp.sum(a * ab, axis=-1)),
+        pad_f(jnp.sum(a * ac, axis=-1)),
+        pad_f(jnp.sum(a * n, axis=-1)),
+        pad_f(jnp.sum(a * a, axis=-1), _BIG),
+    ]
+    # reuse the production builder for the 7 shared constants (rows 12-18)
+    shared = fast_tile_rows(tri)[12:]
+    planes += [pad_f(x) for x in shared]
+    assert len(planes) == N_FACE_ROWS_MXU
+
+    f_pad = planes[0].shape[1]
+
+    def grouped(x):                          # [F, 3] -> [T, tile_f, 3]
+        x = jnp.pad(x, ((0, f_pad - x.shape[0]), (0, 0)))
+        return x.reshape(-1, tile_f, 3)
+
+    g = jnp.concatenate(
+        [grouped(ab), grouped(ac), grouped(n), grouped(a)], axis=1
+    )                                        # [T, 4*tile_f, 3]
+    g = jnp.moveaxis(g, -1, 0).reshape(3, -1)  # (3, T*4*tile_f)
+    return g, planes
+
+
+@partial(jax.jit, static_argnames=("tile_q", "tile_f", "interpret"))
+def closest_point_pallas_mxu(v, f, points, tile_q=256, tile_f=2048,
+                             interpret=False):
+    """Experimental MXU-fed closest_faces_and_points; same contract as
+    closest_point_pallas."""
+    vc_, pts, center, tri = _center_inputs(v, f, points)
+    n_q = pts.shape[0]
+
+    p = _pad_rows(pts, tile_q, 0.0)                      # (Qp, 3)
+    p2 = jnp.sum(p * p, axis=-1, keepdims=True)          # (Qp, 1)
+    g, planes = _mxu_face_inputs(tri, tile_f)
+    q_pad = p.shape[0]
+    f_pad = planes[0].shape[1]
+    grid = (q_pad // tile_q, f_pad // tile_f)
+
+    out_i = pl.pallas_call(
+        _kernel_mxu,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile_q, 3), lambda i, j: (i, 0)),
+            pl.BlockSpec((tile_q, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((3, 4 * tile_f), lambda i, j: (0, j)),
+            *[
+                pl.BlockSpec((1, tile_f), lambda i, j: (0, j))
+                for _ in range(N_FACE_ROWS_MXU)
+            ],
+        ],
+        out_specs=pl.BlockSpec((tile_q, 1), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((q_pad, 1), jnp.int32),
+        scratch_shapes=[
+            pltpu.VMEM((tile_q, 1), jnp.float32),
+            pltpu.VMEM((tile_q, 1), jnp.int32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=DIMSEM_QF),
+        interpret=interpret,
+    )(p, p2, g, *planes)
+
+    return _winner_epilogue(out_i[:n_q, 0], tri, pts, center)

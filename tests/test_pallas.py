@@ -156,3 +156,77 @@ class TestPallasClosestPoint:
             np.testing.assert_allclose(
                 float(np.asarray(out["sqdist"])[qi]), expect, rtol=1e-5
             )
+
+
+class TestMxuTile:
+    """Experimental MXU-fed tile (closest_point_pallas_mxu): same contract
+    as the production tile; face choice may differ only at exact-distance
+    ties (the documented corner-derivation behavior)."""
+
+    def test_matches_reference(self):
+        from mesh_tpu.query.pallas_closest import closest_point_pallas_mxu
+
+        rng = np.random.RandomState(5)
+        v, f = icosphere(2)
+        v = (v * np.array([0.3, 0.2, 0.9])).astype(np.float32)
+        f = f.astype(np.int32)
+        q = (rng.randn(500, 3) * 0.4).astype(np.float32)
+        ref = closest_faces_and_points(v, f, q)
+        out = closest_point_pallas_mxu(v, f, q, tile_q=64, tile_f=128,
+                                       interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(out["sqdist"]), np.asarray(ref["sqdist"]), atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(out["point"]), np.asarray(ref["point"]), atol=1e-4
+        )
+
+    def test_disagreements_are_ties(self):
+        from mesh_tpu.query.pallas_closest import (
+            closest_point_pallas,
+            closest_point_pallas_mxu,
+        )
+        from mesh_tpu.query.point_triangle import closest_point_on_triangle
+
+        rng = np.random.RandomState(7)
+        v, f = icosphere(2)
+        v = v.astype(np.float32)
+        f = f.astype(np.int32)
+        q = (rng.randn(400, 3) * 0.8).astype(np.float32)
+        a = closest_point_pallas_mxu(v, f, q, tile_q=64, tile_f=128,
+                                     interpret=True)
+        b = closest_point_pallas(v, f, q, tile_q=64, tile_f=128,
+                                 interpret=True)
+        fa, fb = np.asarray(a["face"]), np.asarray(b["face"])
+        dis = np.nonzero(fa != fb)[0]
+        if dis.size:
+            tri = v[f]
+
+            def exact(fi):
+                t = tri[fi]
+                _, sq, _ = closest_point_on_triangle(
+                    q[dis], t[:, 0], t[:, 1], t[:, 2]
+                )
+                return np.asarray(sq)
+
+            gap = np.abs(exact(fa[dis]) - exact(fb[dis]))
+            assert gap.max() < 1e-6, gap.max()
+
+    def test_degenerate_faces(self):
+        from mesh_tpu.query.pallas_closest import closest_point_pallas_mxu
+
+        rng = np.random.RandomState(9)
+        v, f = icosphere(1)
+        v = v.astype(np.float32)
+        # append a duplicate-corner face and a collinear face
+        v = np.vstack([v, v[:1] * 1.5, v[:1] * 2.0]).astype(np.float32)
+        nv = len(v)
+        f = np.vstack([f, [[0, nv - 2, nv - 2]], [[0, nv - 2, nv - 1]]])
+        f = f.astype(np.int32)
+        q = (rng.randn(200, 3) * 1.2).astype(np.float32)
+        ref = closest_faces_and_points(v, f, q)
+        out = closest_point_pallas_mxu(v, f, q, tile_q=64, tile_f=128,
+                                       interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(out["sqdist"]), np.asarray(ref["sqdist"]), atol=1e-5
+        )
