@@ -1,0 +1,148 @@
+"""Multi-host scan queries: two processes, one device mesh.
+
+    python examples/multihost_scan.py            # self-launches 2 hosts
+    # or run the two hosts yourself (any cluster launcher):
+    python examples/multihost_scan.py --process-id 0 --port 53517 &
+    python examples/multihost_scan.py --process-id 1 --port 53517
+
+Each "host" is a process owning 4 CPU devices (the stand-in for a real
+multi-host TPU slice; on a pod, drop the env forcing and let
+``initialize_multihost()`` auto-detect).  Both join one
+``jax.distributed`` process group, contribute their local shard of a
+synthetic scan, and run the closest-point query over every device of
+every host — the BASELINE config-5 shape at pod scale, with one
+cross-host collective at the end.  Each host then checks its own shard
+of the gathered result against a locally computed reference.
+"""
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(HERE))
+
+N_PROCS = 2
+LOCAL_DEVICES = 4
+SCAN_PER_HOST = 5_000
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--process-id", type=int, default=None)
+    parser.add_argument("--port", type=int, default=None)
+    return parser.parse_args(argv)
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run_pair_once(env, port):
+    """One launch attempt; kills the surviving host as soon as its sibling
+    fails, so a crashed/stuck pair never outlives this parent."""
+    import time
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--process-id", str(pid), "--port", str(port)],
+            env=env,
+        )
+        for pid in range(N_PROCS)
+    ]
+    try:
+        while True:
+            rcs = [p.poll() for p in procs]
+            if all(rc is not None for rc in rcs):
+                return 0 if all(rc == 0 for rc in rcs) else 1
+            if any(rc is not None and rc != 0 for rc in rcs):
+                return 1            # one host failed; finally kills the rest
+            time.sleep(0.2)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
+def launch_pair():
+    """Parent mode: spawn both hosts; retry on the free-port race."""
+    env = dict(os.environ)
+    # the CPU-host stand-in recipe (tests/conftest.py): disable the axon
+    # TPU hook and force an n-device CPU platform in each child
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_NUM_CPU_DEVICES"] = str(LOCAL_DEVICES)
+    env.pop("XLA_FLAGS", None)
+    # the bind-close-rebind gap can lose the port to another process
+    # (tests/test_multihost.py documents the same race); retry fresh ports
+    for attempt in range(3):
+        rc = _run_pair_once(env, _free_port())
+        if rc == 0 or attempt == 2:
+            sys.exit(rc)
+
+
+def run_host(pid, port):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", LOCAL_DEVICES)
+
+    import numpy as np
+
+    from mesh_tpu.models import smpl_sized_sphere
+    from mesh_tpu.parallel import (
+        initialize_multihost,
+        multihost_closest_faces_and_points,
+    )
+    from mesh_tpu.query import closest_faces_and_points
+
+    initialize_multihost(
+        coordinator_address="localhost:%d" % port,
+        num_processes=N_PROCS, process_id=pid,
+    )
+    print("[host %d] %d global devices across %d processes"
+          % (pid, len(jax.devices()), jax.process_count()), flush=True)
+
+    v, f = smpl_sized_sphere()
+    v = v.astype(np.float32)
+    f = f.astype(np.int32)
+    # each host owns its own slice of the scan (different seeds)
+    rng = np.random.RandomState(100 + pid)
+    sample = rng.randint(0, len(f), SCAN_PER_HOST)
+    bary = rng.dirichlet([1.0] * 3, SCAN_PER_HOST).astype(np.float32)
+    local_scan = (
+        (v[f[sample]] * bary[:, :, None]).sum(1)
+        + rng.randn(SCAN_PER_HOST, 3).astype(np.float32) * 0.01
+    )
+
+    res = multihost_closest_faces_and_points(v, f, local_scan)
+    total = res["face"].shape[0]
+
+    # every host holds the FULL result; check the rows this host produced
+    mine = slice(pid * SCAN_PER_HOST, (pid + 1) * SCAN_PER_HOST)
+    ref = closest_faces_and_points(v, f, local_scan)
+    err = np.abs(
+        np.sqrt(res["sqdist"][mine]) - np.sqrt(np.asarray(ref["sqdist"]))
+    ).max()
+    assert err < 1e-5, err
+    print("[host %d] %d global queries answered; my shard max |dist| err "
+          "vs local reference: %.2e" % (pid, total, err), flush=True)
+
+
+def main():
+    args = parse_args()
+    if args.process_id is None:
+        launch_pair()
+    else:
+        run_host(args.process_id, args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -61,3 +61,12 @@ def test_hand_body_contact_example(tmp_path):
     assert "contact vertices" in res.stdout
     assert (tmp_path / "hand.ply").exists()
     assert (tmp_path / "body.ply").exists()
+
+
+def test_multihost_scan_example():
+    res = _run_example("multihost_scan.py")
+    out = res.stdout
+    for pid in (0, 1):
+        assert "[host %d] 10000 global queries answered" % pid in out, (
+            out[-2000:] + res.stderr[-500:]
+        )
