@@ -46,10 +46,20 @@ def enable_persistent_compilation_cache(path=None, min_compile_secs=1.0):
         os.makedirs(path, exist_ok=True)
         import jax
 
+        prev = jax.config.jax_compilation_cache_dir
         jax.config.update("jax_compilation_cache_dir", path)
         jax.config.update(
             "jax_persistent_cache_min_compile_time_secs", min_compile_secs
         )
+        if prev is not None and prev != path:
+            # the cache backend binds its directory at first use; without a
+            # reset, re-pointing the config mid-process silently keeps
+            # writing to the old dir
+            from jax.experimental.compilation_cache import (
+                compilation_cache as _cc,
+            )
+
+            _cc.reset_cache()
         return path
     except Exception as e:  # never let a cache problem break real work
         _log.warning("persistent compilation cache unavailable: %s", e)

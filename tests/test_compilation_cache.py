@@ -21,13 +21,19 @@ from mesh_tpu.utils.compilation_cache import (
 @pytest.fixture(autouse=True)
 def _restore_cache_config():
     """These tests point the SESSION-GLOBAL cache dir at throwaway tmp
-    paths; restore the conftest-configured shared cache afterwards so the
-    rest of the suite keeps its cross-session compile reuse."""
+    paths; restore the conftest config afterwards and reset the cache
+    BACKEND (it binds its directory at first use — restoring the config
+    alone would leave later suite compiles writing into the deleted tmp
+    dir).  The reset is inline rather than via the helper because the
+    helper cannot restore a saved_dir of None."""
     saved_dir = jax.config.jax_compilation_cache_dir
     saved_min = jax.config.jax_persistent_cache_min_compile_time_secs
     yield
     jax.config.update("jax_compilation_cache_dir", saved_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", saved_min)
+    from jax.experimental.compilation_cache import compilation_cache as _cc
+
+    _cc.reset_cache()
 
 
 def test_cache_dir_created_and_configured(tmp_path):
