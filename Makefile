@@ -29,6 +29,12 @@ tests: import_tests unit_tests
 bench:
 	@python bench.py
 
+gates:
+	@bash tools/run_tpu_gates.sh
+
+sweep:
+	@python benchmarks/tile_sweep.py
+
 sdist:
 	@echo "----- [ ${package_name} ] Creating the source distribution"
 	@python -m build --sdist
@@ -49,4 +55,4 @@ docs:
 clean:
 	@rm -rf build dist *.egg-info doc/_build
 
-.PHONY: all import_tests unit_tests tpu_tests tests bench sdist wheel documentation docs clean
+.PHONY: all import_tests unit_tests tpu_tests tests bench gates sweep sdist wheel documentation docs clean
