@@ -18,7 +18,7 @@ import_tests:
 
 unit_tests:
 	@echo "----- [ ${package_name} ] Running pytest (virtual 8-device CPU platform)"
-	@MESH_TPU_CACHE=`mktemp -d -t mesh_tpu.XXXXXXXXXX` python -m pytest tests/ -q
+	@MESH_TPU_CACHE=`mktemp -d -t mesh_tpu.XXXXXXXXXX` python -m pytest tests/ -q -n 4
 
 tpu_tests:
 	@echo "----- [ ${package_name} ] Compiled-kernel tests on the real chip"
