@@ -15,6 +15,7 @@ from .mesh import Mesh  # noqa: F401
 from .batch import (  # noqa: F401
     batched_closest_faces_and_points,
     batched_vertex_normals,
+    batched_vertex_visibility,
     fused_normals_and_closest_points,
 )
 

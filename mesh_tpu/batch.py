@@ -30,6 +30,7 @@ __all__ = [
     "stack_mesh_batch",
     "batched_vertex_normals",
     "batched_closest_faces_and_points",
+    "batched_vertex_visibility",
     "fused_normals_and_closest_points",
 ]
 
@@ -160,6 +161,61 @@ def batched_closest_faces_and_points(meshes, points, chunk=512):
     )
     faces = np.asarray(res["face"]).astype(np.uint32)[:, None, :]
     return faces, np.asarray(res["point"], np.float64)
+
+
+@partial(jax.jit, static_argnames=("use_pallas", "chunk", "with_normals"))
+def _batch_visibility_step(vs, fj, cams, normals, min_dist, use_pallas,
+                           chunk, with_normals):
+    from .query.visibility import _visibility_local
+
+    # use_pallas is decided OUTSIDE the jit (like _batch_step) so the
+    # MESH_TPU_FORCE_XLA escape hatch is part of the cache key, and
+    # min_dist is traced so epsilon sweeps reuse one executable
+    if with_normals:
+        normals = vert_normals(vs, fj)
+
+    def body(v, n):
+        return _visibility_local(
+            v, v[fj], cams, n, None, min_dist,
+            chunk=chunk, use_pallas=use_pallas,
+        )
+
+    return jax.vmap(body)(vs, normals)
+
+
+def batched_vertex_visibility(meshes, cams, min_dist=1e-3, chunk=1024):
+    """Per-vertex visibility for every mesh in ONE dispatch.
+
+    The batched form of per-mesh `visibility_compute` calls (reference
+    py_visibility.cpp:81-213, each call building its own tree): every
+    mesh is tested against the same cameras, self-occluded by its own
+    faces.  Normals for the n.dir output come from each mesh's stored
+    ``vn`` when EVERY mesh has one (matching the facade's
+    vertex-normal reuse, mesh.py:300); otherwise area-weighted normals
+    are computed in the same dispatch.
+
+    :param cams: [C, 3] camera centers shared across the batch.
+    :returns: (vis [B, C, V] uint32, n_dot_cam [B, C, V] f64).
+    """
+    v, f = stack_mesh_batch(meshes)
+    stored_vn = None
+    if not isinstance(meshes, tuple) and all(
+        getattr(m, "vn", None) is not None for m in meshes
+    ):
+        stored_vn = np.stack(
+            [np.asarray(m.vn, np.float32) for m in meshes]
+        )
+    cams_j = jnp.atleast_2d(jnp.asarray(cams, jnp.float32))
+    vis, ndc = _batch_visibility_step(
+        jnp.asarray(v), jnp.asarray(f), cams_j,
+        jnp.zeros_like(jnp.asarray(v)) if stored_vn is None
+        else jnp.asarray(stored_vn),
+        jnp.float32(min_dist), pallas_default(), chunk, stored_vn is None,
+    )
+    return (
+        np.asarray(vis).astype(np.uint32),
+        np.asarray(ndc, np.float64),
+    )
 
 
 def fused_normals_and_closest_points(meshes, points, chunk=512):

@@ -131,3 +131,50 @@ class TestFused:
         f_ref, p_ref = m.closest_faces_and_points(pts)
         np.testing.assert_array_equal(faces, f_ref)
         np.testing.assert_allclose(points, p_ref, atol=1e-6)
+
+
+class TestBatchedVisibility:
+    def test_matches_per_mesh_facade(self):
+        from mesh_tpu import batched_vertex_visibility
+
+        meshes = _mesh_batch(3)
+        cams = np.array([[0, 0, 4.0], [4.0, 0, 0]], np.float32)
+        vis, ndc = batched_vertex_visibility(meshes, cams)
+        assert vis.shape == (3, 2, len(meshes[0].v))
+        assert vis.dtype == np.uint32
+        from mesh_tpu.query import visibility_compute
+
+        for k, m in enumerate(meshes):
+            n = np.asarray(m.estimate_vertex_normals(), np.float32)
+            ref_vis, ref_ndc = visibility_compute(
+                np.asarray(m.v, np.float32),
+                np.asarray(m.f, np.int64).astype(np.int32), cams, n=n,
+            )
+            np.testing.assert_array_equal(vis[k], np.asarray(ref_vis))
+            np.testing.assert_allclose(ndc[k], np.asarray(ref_ndc), atol=1e-5)
+
+    def test_single_camera_row_vector(self):
+        from mesh_tpu import batched_vertex_visibility
+
+        meshes = _mesh_batch(2)
+        vis, ndc = batched_vertex_visibility(meshes, np.array([0, 0, 4.0]))
+        assert vis.shape == (2, 1, len(meshes[0].v))
+        assert ndc.shape == vis.shape
+        # front cap visible from +z, back cap self-occluded (convex mesh)
+        for k, m in enumerate(meshes):
+            z = np.asarray(m.v)[:, 2] / np.linalg.norm(
+                np.asarray(m.v), axis=1
+            )
+            assert vis[k, 0][z > 0.5].all()
+            assert not vis[k, 0][z < -0.5].any()
+
+    def test_stored_vn_drives_n_dot_cam(self):
+        from mesh_tpu import batched_vertex_visibility
+
+        meshes = _mesh_batch(2)
+        cams = np.array([[0, 0, 4.0]], np.float32)
+        _, ndc_auto = batched_vertex_visibility(meshes, cams)
+        for m in meshes:
+            m.vn = -np.asarray(m.estimate_vertex_normals())  # flipped
+        _, ndc_vn = batched_vertex_visibility(meshes, cams)
+        np.testing.assert_allclose(ndc_vn, -ndc_auto, atol=1e-5)
