@@ -198,18 +198,26 @@ def batched_vertex_visibility(meshes, cams, min_dist=1e-3, chunk=1024):
     :returns: (vis [B, C, V] uint32, n_dot_cam [B, C, V] f64).
     """
     v, f = stack_mesh_batch(meshes)
+    # mirror stack_mesh_batch's own (v_stack, f) test: any OTHER container
+    # of mesh objects (list or tuple) gets the stored-vn scan
+    is_array_tuple = (
+        isinstance(meshes, tuple) and len(meshes) == 2
+        and not hasattr(meshes[0], "v")
+    )
     stored_vn = None
-    if not isinstance(meshes, tuple) and all(
+    if not is_array_tuple and all(
         getattr(m, "vn", None) is not None for m in meshes
     ):
         stored_vn = np.stack(
             [np.asarray(m.vn, np.float32) for m in meshes]
         )
     cams_j = jnp.atleast_2d(jnp.asarray(cams, jnp.float32))
+    vj = jnp.asarray(v)
     vis, ndc = _batch_visibility_step(
-        jnp.asarray(v), jnp.asarray(f), cams_j,
-        jnp.zeros_like(jnp.asarray(v)) if stored_vn is None
-        else jnp.asarray(stored_vn),
+        vj, jnp.asarray(f), cams_j,
+        # with_normals=True ignores the operand; reuse vj as the dummy
+        # (same shape/dtype) instead of shipping a zeros array
+        vj if stored_vn is None else jnp.asarray(stored_vn),
         jnp.float32(min_dist), pallas_default(), chunk, stored_vn is None,
     )
     return (

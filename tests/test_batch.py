@@ -178,3 +178,14 @@ class TestBatchedVisibility:
             m.vn = -np.asarray(m.estimate_vertex_normals())  # flipped
         _, ndc_vn = batched_vertex_visibility(meshes, cams)
         np.testing.assert_allclose(ndc_vn, -ndc_auto, atol=1e-5)
+
+    def test_tuple_batch_honors_stored_vn(self):
+        from mesh_tpu import batched_vertex_visibility
+
+        meshes = _mesh_batch(2)
+        cams = np.array([[0, 0, 4.0]], np.float32)
+        for m in meshes:
+            m.vn = -np.asarray(m.estimate_vertex_normals())
+        _, ndc_list = batched_vertex_visibility(meshes, cams)
+        _, ndc_tuple = batched_vertex_visibility(tuple(meshes), cams)
+        np.testing.assert_allclose(ndc_tuple, ndc_list, atol=1e-7)
