@@ -1,0 +1,72 @@
+"""Summarize on-chip gate logs into BASELINE-ready rows.
+
+    python tools/harvest_gates.py [logdir]     # default /tmp/tpu_gates
+
+Reads gate1.log / gate2.log / config*.log as written by
+tools/run_tpu_gates.sh (or /tmp's probe-and-gates variant), extracts the
+one-line JSON records, and prints a compact table plus the raw
+device_absolute blocks — the inputs for BASELINE.md's measurement
+columns after a tunnel-recovery run.
+"""
+
+import glob
+import json
+import os
+import sys
+
+
+def _json_lines(path):
+    out = []
+    try:
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if line.startswith("{"):
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        pass
+    except OSError:
+        pass
+    return out
+
+
+def main():
+    logdir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/tpu_gates"
+    if not os.path.isdir(logdir):
+        print("no log dir at %s" % logdir)
+        return 1
+
+    g1 = os.path.join(logdir, "gate1.log")
+    if os.path.exists(g1):
+        tail = open(g1).read().strip().splitlines()
+        print("gate1 (compiled kernels): %s" % (tail[-2:] or "?"))
+
+    rows = _json_lines(os.path.join(logdir, "gate2.log"))
+    for rec in rows:
+        if rec.get("value") is not None:
+            print("bench: %(value)s %(unit)s  vs_baseline=%(vs_baseline)s"
+                  % rec)
+
+    for path in sorted(glob.glob(os.path.join(logdir, "config*.log"))):
+        for rec in _json_lines(path):
+            if "suite" in rec or rec.get("metric") is None:
+                continue
+            extras = {
+                k: v for k, v in rec.items()
+                if k not in ("metric", "value", "unit", "vs_baseline")
+                and not k.startswith("device_absolute")
+            }
+            print("%-40s %12s %-12s vs=%s" % (
+                rec["metric"], rec.get("value"), rec.get("unit", ""),
+                rec.get("vs_baseline")))
+            if extras:
+                print("    %s" % json.dumps(extras))
+            for key in ("device_absolute", "device_absolute_brute"):
+                if key in rec:
+                    print("    %s: %s" % (key, json.dumps(rec[key])))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
