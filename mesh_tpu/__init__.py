@@ -19,7 +19,7 @@ from .batch import (  # noqa: F401
     fused_normals_and_closest_points,
 )
 
-__version__ = "0.2.0"          # keep in step with pyproject.toml
+__version__ = "0.3.0"          # keep in step with pyproject.toml
 
 texture_path = os.path.abspath(
     os.path.join(os.path.dirname(__file__), "ressources", "textures")
