@@ -55,6 +55,7 @@ def main(argv=None):
     pts = rng.randn(args.queries, 3).astype(np.float32)
 
     best = None
+    n_errors = 0
     for tile_q, tile_f in itertools.product(
         (128, 256, 512, 1024), (512, 1024, 2048, 4096)
     ):
@@ -69,10 +70,17 @@ def main(argv=None):
             if best is None or rate > best["queries_per_sec"]:
                 best = row
         except Exception as e:  # VMEM overflow etc. — record, keep sweeping
+            n_errors += 1
             row = {"tile_q": tile_q, "tile_f": tile_f,
                    "error": str(e)[:120]}
         print(json.dumps(row), flush=True)
-    print(json.dumps({"best": best}))
+    summary = {"best": best, "n_errors": n_errors}
+    if best is None:
+        # automation must not mistake an all-failed sweep for a healthy one
+        summary["error"] = "every tile combination failed"
+    print(json.dumps(summary))
+    if best is None:
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -46,33 +46,65 @@ def _free_port():
 
 def _run_pair_once(env, port):
     """One launch attempt; kills the surviving host as soon as its sibling
-    fails, so a crashed/stuck pair never outlives this parent."""
+    fails, so a crashed/stuck pair never outlives this parent.  Children's
+    output is captured (echoed live) so the caller can tell the free-port
+    race apart from a real failure.
+
+    :returns: (rc, combined_output)
+    """
+    import tempfile
     import time
 
+    logs = [tempfile.TemporaryFile(mode="w+") for _ in range(N_PROCS)]
     procs = [
         subprocess.Popen(
-            [sys.executable, os.path.abspath(__file__),
+            # -u: unbuffered children, so the live echo below actually
+            # streams and a killed sibling's output isn't lost in a block
+            # buffer
+            [sys.executable, "-u", os.path.abspath(__file__),
              "--process-id", str(pid), "--port", str(port)],
-            env=env,
+            env=env, stdout=logs[pid], stderr=subprocess.STDOUT,
         )
         for pid in range(N_PROCS)
     ]
+    offsets = [0] * N_PROCS
+
+    def _echo_new():
+        for i, log in enumerate(logs):
+            log.flush()
+            log.seek(offsets[i])
+            chunk = log.read()
+            offsets[i] = log.tell()
+            if chunk:
+                sys.stdout.write(chunk)
+                sys.stdout.flush()
+
     try:
         while True:
             rcs = [p.poll() for p in procs]
+            _echo_new()
             if all(rc is not None for rc in rcs):
-                return 0 if all(rc == 0 for rc in rcs) else 1
+                rc = 0 if all(rc == 0 for rc in rcs) else 1
+                break
             if any(rc is not None and rc != 0 for rc in rcs):
-                return 1            # one host failed; finally kills the rest
+                rc = 1              # one host failed; finally kills the rest
+                break
             time.sleep(0.2)
     finally:
         for p in procs:
             if p.poll() is None:
                 p.kill()
+    _echo_new()
+    combined = []
+    for log in logs:
+        log.seek(0)
+        combined.append(log.read())
+        log.close()
+    return rc, "\n".join(combined)
 
 
 def launch_pair():
-    """Parent mode: spawn both hosts; retry on the free-port race."""
+    """Parent mode: spawn both hosts; retry ONLY on the free-port race."""
     env = dict(os.environ)
     # the CPU-host stand-in recipe (tests/conftest.py): disable the axon
     # TPU hook and force an n-device CPU platform in each child
@@ -80,11 +112,13 @@ def launch_pair():
     env["JAX_PLATFORMS"] = "cpu"
     env["JAX_NUM_CPU_DEVICES"] = str(LOCAL_DEVICES)
     env.pop("XLA_FLAGS", None)
-    # the bind-close-rebind gap can lose the port to another process
-    # (tests/test_multihost.py documents the same race); retry fresh ports
+    # the bind-close-rebind gap can lose the port to another process;
+    # retry fresh ports on that signature only (tests/test_multihost.py
+    # gates its retry the same way) — a deterministic failure must surface
+    # its first traceback immediately, not run three times
     for attempt in range(3):
-        rc = _run_pair_once(env, _free_port())
-        if rc == 0 or attempt == 2:
+        rc, out = _run_pair_once(env, _free_port())
+        if rc == 0 or attempt == 2 or "already in use" not in out.lower():
             sys.exit(rc)
 
 

@@ -109,6 +109,40 @@ def gather_to_hosts(garrays):
     )
 
 
+def _process_blocks(mesh, n_local, local_devices):
+    """Exchange per-process (row count, local device count) and compute the
+    uniform rows-per-device the global array needs.
+
+    Per-process counts may be RAGGED (a real scan rarely splits evenly
+    across hosts): every process pads its local rows to
+    ``rows_per_device * its local device count`` and the gathered result is
+    trimmed per process block.  Requires the mesh's devices to be ordered
+    so each process's block is contiguous and in process order (true for
+    any mesh built from ``jax.devices()``, which sorts by process) —
+    checked loudly rather than silently returning misordered rows.
+
+    :returns: (counts [P], block_rows [P], rows_per_device)
+    """
+    from jax.experimental import multihost_utils
+
+    proc_order = [d.process_index for d in mesh.devices.flat]
+    if any(a > b for a, b in zip(proc_order, proc_order[1:])):
+        raise ValueError(
+            "multihost query needs a mesh whose device order keeps each "
+            "process's devices contiguous and in process order (build it "
+            "from jax.devices(), e.g. global_device_mesh()); got process "
+            "order %s" % (proc_order,))
+    if jax.process_count() == 1:
+        counts = np.array([[n_local, local_devices]])
+    else:
+        counts = np.asarray(multihost_utils.process_allgather(
+            np.array([n_local, local_devices], np.int64)))
+    rows_per_device = max(
+        1, int(max(-(-int(n) // int(ld)) for n, ld in counts)))
+    block_rows = counts[:, 1] * rows_per_device
+    return counts[:, 0], block_rows, rows_per_device
+
+
 def multihost_closest_faces_and_points(v, f, points_local, mesh=None,
                                        axis="dp", chunk=512):
     """Closest-point query sharded over every device of every host.
@@ -116,34 +150,40 @@ def multihost_closest_faces_and_points(v, f, points_local, mesh=None,
     The multi-host form of
     `parallel.sharding.sharded_closest_faces_and_points` (same compiled
     shard body): v/f are replicated to all hosts' devices, each process
-    contributes its own ``points_local`` rows (equal counts per process,
-    divisible by its local device count), and every host returns the FULL
-    result dict — numpy in/out like the reference facade.
+    contributes its own ``points_local`` rows — counts may differ across
+    processes (each is padded to the common per-device row count and the
+    gather trims per process block) — and every host returns the FULL
+    result dict, rows ordered process 0's points first, then process 1's,
+    etc.  Numpy in/out like the reference facade.
 
     The scan-registration shape (BASELINE config 5) at pod scale: 100k
-    scan points spread over N hosts x M chips, with one cross-host
-    collective (the output gather) at the end.  Exercised with real
-    processes in tests/test_multihost.py.
+    scan points spread over N hosts x M chips, with two cross-host
+    collectives (the count exchange and the output gather).  Exercised
+    with real processes at SMPL scale in tests/test_multihost.py.
     """
-    from .sharding import _closest_shard_fn, _pad_rows, _unpack_closest
+    from .sharding import _closest_shard_fn, _unpack_closest
 
     if mesh is None:
         mesh = global_device_mesh((axis,))
     points_local = np.ascontiguousarray(points_local, np.float32)
     n_local = points_local.shape[0]
-    # pad to the per-device multiple like the single-host facade; every
-    # process pads identically (equal local counts are already required),
-    # so the pad rows sit at the tail of each process's block
     local_devices = len(mesh.local_devices)
-    points_padded, pad = _pad_rows(points_local, local_devices)
+    counts, block_rows, rows_per_device = _process_blocks(
+        mesh, n_local, local_devices)
+    target = rows_per_device * local_devices
+    points_padded = np.zeros((target, 3), np.float32)
+    points_padded[:n_local] = points_local
     out, face = _closest_shard_fn(mesh, axis, chunk)(
         replicate_to_mesh(np.asarray(v, np.float32), mesh),
         replicate_to_mesh(np.asarray(f, np.int32), mesh),
         shard_from_local(points_padded, mesh, axis),
     )
     out, face = gather_to_hosts((out, face))       # one collective
-    if pad:
-        block = n_local + pad
-        keep = (np.arange(out.shape[0]) % block) < n_local
+    if int(counts.sum()) != out.shape[0]:
+        # trim each process's pad rows from the tail of its block
+        keep = np.concatenate([
+            (np.arange(block) < n).astype(bool)
+            for n, block in zip(counts, block_rows)
+        ])
         out, face = out[keep], face[keep]
     return _unpack_closest(out, face)

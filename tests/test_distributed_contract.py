@@ -1,0 +1,71 @@
+"""Unit contract of the multi-host query helpers (parallel/distributed.py).
+
+The real-process SMPL-scale path runs in test_multihost.py; these pin the
+host-side math and the loud-failure contract without spawning processes.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from mesh_tpu.parallel import distributed
+
+
+class _Dev:
+    def __init__(self, process_index):
+        self.process_index = process_index
+
+
+class _FakeMesh:
+    def __init__(self, proc_order):
+        self.devices = np.array([_Dev(p) for p in proc_order], dtype=object)
+
+
+def test_misordered_mesh_fails_loudly():
+    # a mesh whose device order interleaves processes would return rows in
+    # the wrong order — must raise, not silently misorder
+    with pytest.raises(ValueError, match="process order"):
+        distributed._process_blocks(_FakeMesh([0, 1, 0, 1]), 8, 2)
+
+
+def test_single_process_blocks():
+    counts, blocks, rpd = distributed._process_blocks(_FakeMesh([0, 0]), 7, 2)
+    assert list(counts) == [7]
+    assert rpd == 4 and list(blocks) == [8]
+
+
+def test_ragged_counts_across_processes(monkeypatch):
+    # two processes, 4 local devices each, ragged counts 6000/4100:
+    # rows_per_device is the max ceil(n/ld) and every block pads to it
+    from jax.experimental import multihost_utils
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(
+        multihost_utils, "process_allgather",
+        lambda x, **kw: np.array([[6000, 4], [4100, 4]], np.int64))
+    counts, blocks, rpd = distributed._process_blocks(
+        _FakeMesh([0, 0, 0, 0, 1, 1, 1, 1]), 6000, 4)
+    assert list(counts) == [6000, 4100]
+    assert rpd == 1500
+    assert list(blocks) == [6000, 6000]
+    # the trim mask the facade builds from these keeps exactly the real rows
+    keep = np.concatenate([
+        (np.arange(block) < n).astype(bool)
+        for n, block in zip(counts, blocks)
+    ])
+    assert keep.sum() == 10100 and keep.size == 12000
+
+
+def test_zero_row_process(monkeypatch):
+    # a host with no points still participates (pads a full empty block)
+    from jax.experimental import multihost_utils
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(
+        multihost_utils, "process_allgather",
+        lambda x, **kw: np.array([[0, 4], [8, 4]], np.int64))
+    counts, blocks, rpd = distributed._process_blocks(
+        _FakeMesh([0, 0, 0, 0, 1, 1, 1, 1]), 0, 4)
+    assert list(counts) == [0, 8]
+    assert rpd == 2 and list(blocks) == [8, 8]

@@ -1,0 +1,101 @@
+"""tools/harvest_gates.py: gate-log harvesting and BASELINE.md stamping.
+
+The watchdog (tools/tpu_watchdog.sh) depends on ``--write`` replacing the
+delimited auto-harvest section idempotently and never touching the
+hand-written rows around it.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import harvest_gates  # noqa: E402
+
+
+def _make_logdir(tmp_path):
+    d = tmp_path / "gates"
+    d.mkdir()
+    (d / "gate1.log").write_text(
+        "....................\n20 passed in 93.21s\n")
+    (d / "gate2.log").write_text(
+        "device: TPU\n"
+        + json.dumps({"metric": "batch256_smpl_normals_plus_closest_point",
+                      "value": 1723427.0, "unit": "queries/sec",
+                      "vs_baseline": 1125.0,
+                      "device_absolute": {"pct_vpu_peak": 42.6}}) + "\n")
+    (d / "config5.log").write_text(
+        json.dumps({"metric": "config5_scan100k_closest_faces",
+                    "value": 1324000.0, "unit": "queries/sec",
+                    "vs_baseline": 120.0,
+                    "device_absolute": {"pct_vpu_peak": 40.0}}) + "\n"
+        + json.dumps({"suite": "baseline_configs", "results": []}) + "\n")
+    (d / "sweep.log").write_text(
+        json.dumps({"tile_q": 256, "tile_f": 2048,
+                    "queries_per_sec": 1.7e6}) + "\n"
+        + json.dumps({"best": {"tile_q": 256, "tile_f": 2048,
+                               "queries_per_sec": 1.7e6},
+                      "n_errors": 0}) + "\n")
+    return str(d)
+
+
+def test_harvest_collects_all_gates(tmp_path):
+    h = harvest_gates.harvest(_make_logdir(tmp_path))
+    assert "20 passed" in h["gate1"]["summary"]
+    assert h["bench"]["value"] == 1723427.0
+    assert [c["metric"] for c in h["configs"]] == [
+        "config5_scan100k_closest_faces"]
+    assert h["sweeps"][0]["best"]["tile_f"] == 2048
+    table = harvest_gates.render_table(h)
+    assert "config5_scan100k_closest_faces" in table
+    assert "1723427.0" in table
+    assert "device_absolute" in table
+
+
+def test_write_baseline_is_idempotent_and_preserves_text(tmp_path):
+    h = harvest_gates.harvest(_make_logdir(tmp_path))
+    baseline = tmp_path / "BASELINE.md"
+    hand_written = "# BASELINE\n\nhand-written analysis row\n"
+    baseline.write_text(hand_written)
+
+    harvest_gates.write_baseline(h, str(baseline))
+    text1 = baseline.read_text()
+    assert hand_written.strip() in text1
+    assert text1.count(harvest_gates._BEGIN) == 1
+    assert "config5_scan100k_closest_faces" in text1
+
+    # restamp: section replaced, not duplicated; surrounding text intact
+    harvest_gates.write_baseline(h, str(baseline))
+    text2 = baseline.read_text()
+    assert text2.count(harvest_gates._BEGIN) == 1
+    assert text2.count("## Latest on-chip gate run") == 1
+    assert hand_written.strip() in text2
+
+
+def test_failed_captures_render_as_failures(tmp_path):
+    # a wedged capture (value null + error) must read as a failure in the
+    # stamped section, not as a meaningless "None None" row
+    d = tmp_path / "gates"
+    d.mkdir()
+    (d / "gate2.log").write_text(json.dumps(
+        {"metric": "m", "value": None, "unit": "queries/sec",
+         "vs_baseline": None, "error": "jax backend probe failed"}) + "\n")
+    (d / "config4.log").write_text(json.dumps(
+        {"metric": "config4_hand_body_intersection",
+         "error": "RESOURCE_EXHAUSTED: vmem"}) + "\n")
+    table = harvest_gates.render_table(harvest_gates.harvest(str(d)))
+    assert "CAPTURE FAILED" in table and "probe failed" in table
+    assert "FAILED: RESOURCE_EXHAUSTED" in table
+    assert "None None" not in table
+
+
+def test_stale_bench_record_is_labelled(tmp_path):
+    d = tmp_path / "gates"
+    d.mkdir()
+    (d / "gate2.log").write_text(json.dumps(
+        {"metric": "m", "value": 5.0, "unit": "q/s", "vs_baseline": 2.0,
+         "stale": True}) + "\n")
+    h = harvest_gates.harvest(str(d))
+    assert "STALE" in harvest_gates.render_table(h)

@@ -4,32 +4,59 @@
 # process mid-call appears to wedge it too — give each step all the time it
 # needs rather than wrapping it in `timeout`).  Run this as soon as
 # `python -c "from bench import backend_responsive; ..."` reports the tunnel
-# responsive:
+# responsive (tools/tpu_watchdog.sh does exactly that, automatically):
 #
 #   bash tools/run_tpu_gates.sh
 #
 # Order matters: the compiled-kernel tests validate every Pallas kernel
 # BEFORE the benchmarks quote numbers from them.  Each step gets its own
 # process.  Benchmark configs run one process each so a mid-suite tunnel
-# failure keeps every completed config's row (logs under /tmp/tpu_gates/);
-# the persistent compilation cache (mesh_tpu/utils/compilation_cache.py)
-# makes the per-process restarts cheap after the first pass.
+# failure keeps every completed config's row; every gate logs under
+# $LOGDIR (default /tmp/tpu_gates) in the layout tools/harvest_gates.py
+# reads.  The persistent compilation cache
+# (mesh_tpu/utils/compilation_cache.py) makes the per-process restarts
+# cheap after the first pass.
 set -e
+set -o pipefail
 cd "$(dirname "$0")/.."
 LOGDIR=${LOGDIR:-/tmp/tpu_gates}
 mkdir -p "$LOGDIR"
+# clear prior-cycle logs so a run that stops early can't pass yesterday's
+# rows off as this cycle's harvest
+rm -f "$LOGDIR"/*.log
+fail=0
 
 echo "=== gate 1: compiled-kernel tests on the real chip ==="
-MESH_TPU_TEST_TPU=1 python -m pytest tests/test_tpu_compiled.py -m tpu -q
+if MESH_TPU_TEST_TPU=1 python -m pytest tests/test_tpu_compiled.py -m tpu -q \
+        2>&1 | tee "$LOGDIR/gate1.log"; then
+    :
+else
+    echo "gate 1 FAILED — stopping: benchmarks must not quote numbers from"
+    echo "kernels whose compiled validation is red."
+    exit 1
+fi
 
 echo "=== gate 2: north-star bench ==="
-python bench.py
+if python bench.py 2>&1 | tee "$LOGDIR/gate2.log"; then
+    # bench.py exits 0 with a stale last-good record when the tunnel
+    # wedges between the outer probe and its own — an honest driver
+    # artifact, but NOT a fresh measurement, so the gate cycle must not
+    # claim a full pass (the watchdog would cool down on yesterday's
+    # number otherwise)
+    if grep -q '"stale": true' "$LOGDIR/gate2.log"; then
+        echo "gate 2 returned a STALE record (tunnel wedged mid-cycle) — not a fresh pass"
+        fail=1
+    fi
+else
+    echo "gate 2 FAILED (rc=$?) — continuing to per-config runs"
+    fail=1
+fi
 
 echo "=== gate 3: benchmark configs, one process each ==="
-fail=0
 for n in 1 2 3 4 5 6; do
     echo "--- config $n (log: $LOGDIR/config$n.log) ---"
-    if python -u benchmarks/run_all.py --configs "$n" 2>&1 | tee "$LOGDIR/config$n.log"; then
+    if python -u benchmarks/run_all.py --configs "$n" 2>&1 \
+            | tee "$LOGDIR/config$n.log"; then
         :
     else
         echo "config $n FAILED (rc=$?) — continuing; fix and rerun just it:"
@@ -37,6 +64,22 @@ for n in 1 2 3 4 5 6; do
         fail=1
     fi
 done
-[ "$fail" = 0 ] || exit 1
 
-echo "=== all gates passed; update BASELINE.md with the new rows ==="
+echo "=== gate 4: tile sweeps (VPU production grid, then MXU hypothesis) ==="
+for sweep in "" "--mxu"; do
+    name="sweep${sweep:+_mxu}"
+    echo "--- tile_sweep $sweep (log: $LOGDIR/$name.log) ---"
+    if python -u benchmarks/tile_sweep.py $sweep 2>&1 \
+            | tee "$LOGDIR/$name.log"; then
+        :
+    else
+        echo "tile_sweep $sweep FAILED (rc=$?) — continuing"
+        fail=1
+    fi
+done
+
+if [ "$fail" != 0 ]; then
+    echo "=== gates FINISHED WITH FAILURES (see above; logs in $LOGDIR) ==="
+    exit 1
+fi
+echo "=== all gates passed; harvest rows: python tools/harvest_gates.py ==="
