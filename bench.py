@@ -48,11 +48,24 @@ def tpu_workload():
 
     on_accelerator = jax.devices()[0].platform != "cpu"
     if on_accelerator:
-        from mesh_tpu.query.pallas_closest import closest_point_pallas
+        from mesh_tpu.query.pallas_closest import (
+            closest_point_pallas,
+            mesh_is_nondegenerate,
+        )
+
+        # assert (not assume) the nondegeneracy flag from the actual posed
+        # batch: materialize the LBS output once outside the timed loop and
+        # check every face of every mesh against the tile's relative area
+        # cut.  Costs one setup readback; compiles the query tile without
+        # its degenerate-face override when the data allows.
+        posed = np.asarray(lbs(model, betas, pose)[0])
+        nondegen = mesh_is_nondegenerate(posed, np.asarray(f))
+        log("batch nondegenerate:", nondegen)
 
         def per_mesh(args):
             v_mesh, q_mesh = args
-            res = closest_point_pallas(v_mesh, f, q_mesh)
+            res = closest_point_pallas(
+                v_mesh, f, q_mesh, assume_nondegenerate=nondegen)
             return res["face"], res["point"], res["sqdist"]
     else:
         def per_mesh(args):

@@ -13,6 +13,7 @@ tile-shape performance on the table?" in ~a minute.
 import itertools
 import json
 import sys
+from functools import partial
 
 import numpy as np
 
@@ -43,14 +44,21 @@ def main(argv=None):
     from mesh_tpu.query.pallas_closest import (
         closest_point_pallas,
         closest_point_pallas_mxu,
+        mesh_is_nondegenerate,
     )
     from mesh_tpu.utils.compilation_cache import (
         enable_persistent_compilation_cache,
     )
 
     enable_persistent_compilation_cache()
-    kernel = closest_point_pallas_mxu if args.mxu else closest_point_pallas
     v, f = _sphere_mesh(args.faces)
+    if args.mxu:
+        kernel = closest_point_pallas_mxu
+    else:
+        # sweep the tile the production facade would compile for this mesh
+        kernel = partial(
+            closest_point_pallas,
+            assume_nondegenerate=mesh_is_nondegenerate(v, f))
     rng = np.random.RandomState(0)
     pts = rng.randn(args.queries, 3).astype(np.float32)
 

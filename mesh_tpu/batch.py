@@ -67,21 +67,23 @@ def stack_mesh_batch(meshes):
     return v, f0.astype(np.int32)
 
 
-def _per_mesh_closest(v, f, pts, use_pallas, chunk):
+def _per_mesh_closest(v, f, pts, use_pallas, chunk, nondegen=False):
     if use_pallas:
         from .query.pallas_closest import closest_point_pallas
 
-        return closest_point_pallas(v, f, pts)
+        return closest_point_pallas(
+            v, f, pts, assume_nondegenerate=nondegen)
     return closest_faces_and_points(v, f, pts, chunk=chunk)
 
 
 @partial(jax.jit, static_argnames=("use_pallas", "use_culled", "chunk",
-                                   "with_normals"))
-def _batch_step(vs, fj, pts, use_pallas, use_culled, chunk, with_normals):
+                                   "with_normals", "nondegen"))
+def _batch_step(vs, fj, pts, use_pallas, use_culled, chunk, with_normals,
+                nondegen=False):
     normals = vert_normals(vs, fj) if with_normals else None
 
     def body(v, q):
-        return _per_mesh_closest(v, fj, q, use_pallas, chunk)
+        return _per_mesh_closest(v, fj, q, use_pallas, chunk, nondegen)
 
     if pts is None:
         res = None
@@ -132,6 +134,18 @@ def batched_vertex_normals(meshes):
     return np.asarray(normals, np.float64)
 
 
+def _batch_nondegen(v_host, f, use_pallas, use_culled):
+    """Data-derived assume_nondegenerate flag for the vmapped brute kernel
+    (pallas_closest._ericson_tail): checked from the HOST copy of the
+    batch at the numpy boundary, so no device readback is paid.  Only the
+    brute Pallas path consumes it."""
+    if not use_pallas or use_culled:
+        return False
+    from .query.pallas_closest import mesh_is_nondegenerate
+
+    return mesh_is_nondegenerate(v_host, np.asarray(f))
+
+
 def _broadcast_points(points, batch):
     pts = np.asarray(points, np.float32)
     if pts.ndim == 2:
@@ -158,6 +172,7 @@ def batched_closest_faces_and_points(meshes, points, chunk=512):
     _, res = _batch_step(
         jnp.asarray(v), jnp.asarray(f), jnp.asarray(pts),
         use_pallas, use_culled, chunk, False,
+        nondegen=_batch_nondegen(v, f, use_pallas, use_culled),
     )
     faces = np.asarray(res["face"]).astype(np.uint32)[:, None, :]
     return faces, np.asarray(res["point"], np.float64)
@@ -249,13 +264,16 @@ def fused_normals_and_closest_points(meshes, points, chunk=512):
             vj = jnp.asarray(np.asarray(meshes.v, np.float32))
             fj = jnp.asarray(np.asarray(meshes.f, np.int64).astype(np.int32))
         vs, fs, batch = vj[None], fj, 1
+        v_host, f_host = np.asarray(meshes.v), np.asarray(meshes.f)
     else:
         v, f = stack_mesh_batch(meshes)
         vs, fs, batch = jnp.asarray(v), jnp.asarray(f), v.shape[0]
+        v_host, f_host = v, f
     pts = _broadcast_points(points, batch)
     use_pallas, use_culled = _strategy(fs)
     normals, res = _batch_step(
         vs, fs, jnp.asarray(pts), use_pallas, use_culled, chunk, True,
+        nondegen=_batch_nondegen(v_host, f_host, use_pallas, use_culled),
     )
     normals = np.asarray(normals, np.float64)
     faces = np.asarray(res["face"]).astype(np.uint32)[:, None, :]

@@ -161,6 +161,7 @@ def multihost_closest_faces_and_points(v, f, points_local, mesh=None,
     collectives (the count exchange and the output gather).  Exercised
     with real processes at SMPL scale in tests/test_multihost.py.
     """
+    from ..query.pallas_closest import mesh_is_nondegenerate
     from .sharding import _closest_shard_fn, _unpack_closest
 
     if mesh is None:
@@ -173,7 +174,9 @@ def multihost_closest_faces_and_points(v, f, points_local, mesh=None,
     target = rows_per_device * local_devices
     points_padded = np.zeros((target, 3), np.float32)
     points_padded[:n_local] = points_local
-    out, face = _closest_shard_fn(mesh, axis, chunk)(
+    out, face = _closest_shard_fn(
+        mesh, axis, chunk, nondegen=mesh_is_nondegenerate(v, f)
+    )(
         replicate_to_mesh(np.asarray(v, np.float32), mesh),
         replicate_to_mesh(np.asarray(f, np.int32), mesh),
         shard_from_local(points_padded, mesh, axis),

@@ -74,21 +74,25 @@ def _pack_local_winner(local, axis, shard_faces):
     return packed, local["face"] + shard_id * shard_faces
 
 
-def _closest_local(v, f, pts, chunk, use_pallas):
+def _closest_local(v, f, pts, chunk, use_pallas, nondegen=False):
     """Per-shard closest-point body: the Pallas scan when the shards run
     on TPU cores (pallas_call composes with shard_map), the XLA tiling
     elsewhere (the virtual CPU test mesh)."""
     if use_pallas:
         from ..query.pallas_closest import closest_point_pallas
 
-        return closest_point_pallas(v, f, pts)
+        return closest_point_pallas(
+            v, f, pts, assume_nondegenerate=nondegen)
     return closest_faces_and_points(v, f, pts, chunk=chunk)
 
 
 @lru_cache(maxsize=32)
-def _closest_shard_fn(mesh, axis, chunk):
-    """Compiled sharded closest-point, cached per (mesh, axis, chunk) so
-    repeated calls reuse the executable instead of retracing."""
+def _closest_shard_fn(mesh, axis, chunk, nondegen=False):
+    """Compiled sharded closest-point, cached per (mesh, axis, chunk,
+    nondegen) so repeated calls reuse the executable instead of
+    retracing.  ``nondegen`` is the data-derived assume_nondegenerate
+    flag the host boundary checks (pallas_closest.mesh_is_nondegenerate);
+    it only affects the Pallas tile."""
     use_pallas = mesh_on_tpu(mesh)
 
     @partial(
@@ -101,7 +105,8 @@ def _closest_shard_fn(mesh, axis, chunk):
         check_vma=not use_pallas,
     )
     def _run(v_rep, f_rep, pts_shard):
-        res = _closest_local(v_rep, f_rep, pts_shard, chunk, use_pallas)
+        res = _closest_local(v_rep, f_rep, pts_shard, chunk, use_pallas,
+                             nondegen)
         packed = jnp.stack(
             [
                 res["part"].astype(jnp.float32),
@@ -142,7 +147,11 @@ def sharded_closest_faces_and_points(v, f, points, mesh, axis="dp", chunk=512):
     points = np.asarray(points, np.float32)
     points_padded, pad = _pad_rows(points, n_shards)
 
-    out, face = _closest_shard_fn(mesh, axis, chunk)(
+    from ..query.pallas_closest import mesh_is_nondegenerate
+
+    out, face = _closest_shard_fn(
+        mesh, axis, chunk, nondegen=mesh_is_nondegenerate(v, f)
+    )(
         jnp.asarray(v, jnp.float32), jnp.asarray(f, jnp.int32),
         jax.device_put(
             points_padded, NamedSharding(mesh, P(axis))

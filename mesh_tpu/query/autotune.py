@@ -126,10 +126,17 @@ def calibrate_crossover(ladder=(8192, 16384, 32768, 65536, 131072),
 
     use_pallas = pallas_default()
     if use_pallas:
+        from functools import partial
+
         from .pallas_closest import closest_point_pallas
         from .pallas_culled import closest_point_pallas_culled
 
-        brute, culled = closest_point_pallas, closest_point_pallas_culled
+        # mirror the facade dispatch (culled.py): the brute kernel runs
+        # with the nondegeneracy flag the facade would derive for the
+        # calibration mesh (a sphere — always nondegenerate), the culled
+        # kernel with its production configuration
+        brute = partial(closest_point_pallas, assume_nondegenerate=True)
+        culled = closest_point_pallas_culled
     else:
         from .culled import closest_faces_and_points_culled
 

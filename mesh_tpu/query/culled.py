@@ -131,18 +131,23 @@ def closest_faces_and_points_auto(
         brute_force_max_faces = crossover_faces()
     f = np.asarray(f)
     if pallas_default():
-        from .pallas_closest import closest_point_pallas
+        from .pallas_closest import closest_point_pallas, mesh_is_nondegenerate
         from .pallas_culled import closest_point_pallas_culled
 
-        kernel = (
-            closest_point_pallas
-            if f.shape[0] <= brute_force_max_faces
-            else closest_point_pallas_culled
-        )
-        res = kernel(
-            np.asarray(v, np.float32), f.astype(np.int32),
-            np.asarray(points, np.float32).reshape(-1, 3),
-        )
+        v32 = np.asarray(v, np.float32)
+        pts32 = np.asarray(points, np.float32).reshape(-1, 3)
+        if f.shape[0] <= brute_force_max_faces:
+            # the numpy boundary is the one place the nondegeneracy flag
+            # can be asserted from data: meshes whose every face clears
+            # the relative area cut compile the tile without its
+            # degenerate-face override (~25% fewer VPU ops, bit-identical
+            # results — pallas_closest._ericson_tail)
+            res = closest_point_pallas(
+                v32, f.astype(np.int32), pts32,
+                assume_nondegenerate=mesh_is_nondegenerate(v32, f),
+            )
+        else:
+            res = closest_point_pallas_culled(v32, f.astype(np.int32), pts32)
         return {key: np.asarray(val) for key, val in res.items()}
     if f.shape[0] <= brute_force_max_faces:
         res = closest_faces_and_points(v, f, points)

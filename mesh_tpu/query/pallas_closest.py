@@ -17,6 +17,8 @@ native (TQ, TF) VPU tile shape with no in-kernel transposes.
 
 from functools import partial
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
@@ -35,7 +37,8 @@ DIMSEM_QF = ("parallel", "arbitrary")
 
 def _sqdist_tile_fast(px, py, pz,
                       ax, ay, az, abx, aby, abz, acx, acy, acz, nx, ny, nz,
-                      ab2, ac2, abac, inv_ab2, inv_ac2, inv_bc2, inv_n2):
+                      ab2, ac2, abac, inv_ab2, inv_ac2, inv_bc2, inv_n2,
+                      degenerate_tail=True):
     """Division-free, gather-light Ericson closest-point squared distance
     on a (TQ, TF) tile.
 
@@ -84,11 +87,13 @@ def _sqdist_tile_fast(px, py, pz,
     ap2 = apx * apx + apy * apy + apz * apz
     n_ap = nx * apx + ny * apy + nz * apz
     return _ericson_tail(d1, d2, ap2, n_ap, ab2, ac2, abac,
-                         inv_ab2, inv_ac2, inv_bc2, inv_n2)
+                         inv_ab2, inv_ac2, inv_bc2, inv_n2,
+                         degenerate_tail=degenerate_tail)
 
 
 def _ericson_tail(d1, d2, ap2, n_ap, ab2, ac2, abac,
-                  inv_ab2, inv_ac2, inv_bc2, inv_n2):
+                  inv_ab2, inv_ac2, inv_bc2, inv_n2,
+                  degenerate_tail=True):
     """Region selection + distance from the four query-dependent scalars
     (d1, d2, ap2, n_ap) and the hoisted per-face constants — the part of
     the fast tile that is independent of HOW the dot products were
@@ -129,20 +134,76 @@ def _ericson_tail(d1, d2, ap2, n_ap, ab2, ac2, abac,
     # distance — is arbitrary.  Such a face IS its edge segments; the
     # best clamped segment projection is exact there and costs only
     # already-loaded planes (mirrors point_triangle's override, which the
-    # epilogue recompute uses).  Padded faces (zero edges) reduce to ap2
-    # = +BIG and still never win.
-    t_ab = jnp.clip(d1 * inv_ab2, 0.0, 1.0)
-    e_ab = ap2 - t_ab * (d1 + d1 - t_ab * ab2)
-    t_ca = jnp.clip(d2 * inv_ac2, 0.0, 1.0)
-    e_ca = ap2 - t_ca * (d2 + d2 - t_ca * ac2)
-    bc2 = ab2 + ac2 - (abac + abac)
-    t_bc = jnp.clip(d_bc * inv_bc2, 0.0, 1.0)
-    e_bc = bp2 - t_bc * (d_bc + d_bc - t_bc * bc2)
-    d = jnp.where(
-        inv_n2 > 0, d, jnp.minimum(e_ab, jnp.minimum(e_ca, e_bc))
-    )
+    # epilogue recompute uses).  Padded faces (zero edges) are safe with
+    # OR without this tail: d1 = d2 = 0 routes them to the in_a override
+    # above, where ap2 = +inf (corner-a planes pad with _BIG) never wins.
+    #
+    # ``degenerate_tail=False`` drops the override — ~30 of the tile's
+    # ~120 per-pair VPU ops — for callers that KNOW the mesh has no
+    # near-degenerate faces (n2 > 1e-10 * ab2 * ac2 for every face; the
+    # facade checks this at staging).  With the flag wrongly set, a
+    # near-degenerate face's interior term is garbage and it can steal or
+    # lose the argmin; the epilogue still reports the winner's exact
+    # distance either way.
+    if degenerate_tail:
+        t_ab = jnp.clip(d1 * inv_ab2, 0.0, 1.0)
+        e_ab = ap2 - t_ab * (d1 + d1 - t_ab * ab2)
+        t_ca = jnp.clip(d2 * inv_ac2, 0.0, 1.0)
+        e_ca = ap2 - t_ca * (d2 + d2 - t_ca * ac2)
+        bc2 = ab2 + ac2 - (abac + abac)
+        t_bc = jnp.clip(d_bc * inv_bc2, 0.0, 1.0)
+        e_bc = bp2 - t_bc * (d_bc + d_bc - t_bc * bc2)
+        d = jnp.where(
+            inv_n2 > 0, d, jnp.minimum(e_ab, jnp.minimum(e_ca, e_bc))
+        )
     # the edge forms subtract two nearly-equal squares; clamp the rounding
     return jnp.maximum(d, 0.0)
+
+
+#: content-keyed results of mesh_is_nondegenerate: repeated facade calls on
+#: an unchanged mesh (registration loops) must not pay the O(B*F) f64
+#: gather per call — crc the raw bytes instead (same pattern as mesh.py's
+#: crc-validated device-array cache).  Bounded FIFO.
+_NONDEGEN_CACHE = {}
+_NONDEGEN_CACHE_MAX = 64
+
+
+def mesh_is_nondegenerate(v, f, margin=100.0):
+    """Host-side staging check backing ``assume_nondegenerate``: True when
+    EVERY face clears the fast tile's relative area cut
+    (``n2 > 1e-10 * ab2 * ac2``, fast_tile_rows) with ``margin`` to spare —
+    the margin absorbs the f32 centering/rounding between this f64 check
+    and the planes the kernel actually sees.
+
+    ``v`` may carry leading batch axes ([..., V, 3]); the answer covers
+    every mesh in the batch.  Meant for the numpy-boundary staging points
+    (facade dispatch, benchmark setup) where the flag can be asserted
+    from data rather than assumed.  Results are cached by content crc, so
+    per-call facade dispatch on an unchanged mesh costs O(bytes) crc
+    rather than the O(F) geometric check.
+    """
+    import zlib
+
+    v = np.ascontiguousarray(np.asarray(v))
+    f = np.ascontiguousarray(np.asarray(f))
+    key = (v.shape, f.shape, float(margin), str(v.dtype), str(f.dtype),
+           zlib.crc32(v.tobytes()), zlib.crc32(f.tobytes()))
+    hit = _NONDEGEN_CACHE.get(key)
+    if hit is not None:
+        return hit
+    v64 = v.astype(np.float64)
+    tri = v64[..., f, :]
+    ab = tri[..., 1, :] - tri[..., 0, :]
+    ac = tri[..., 2, :] - tri[..., 0, :]
+    n = np.cross(ab, ac)
+    n2 = np.sum(n * n, axis=-1)
+    ab2 = np.sum(ab * ab, axis=-1)
+    ac2 = np.sum(ac * ac, axis=-1)
+    result = bool(np.all(n2 > margin * 1e-10 * ab2 * ac2))
+    if len(_NONDEGEN_CACHE) >= _NONDEGEN_CACHE_MAX:
+        _NONDEGEN_CACHE.pop(next(iter(_NONDEGEN_CACHE)))
+    _NONDEGEN_CACHE[key] = result
+    return result
 
 
 def make_argmin_kernel(cost_tile):
@@ -184,6 +245,8 @@ def make_argmin_kernel(cost_tile):
 
 
 _kernel = make_argmin_kernel(_sqdist_tile_fast)
+_kernel_nodegen = make_argmin_kernel(
+    partial(_sqdist_tile_fast, degenerate_tail=False))
 
 
 def _pad_cols(x, multiple, fill):
@@ -345,12 +408,23 @@ def _winner_epilogue(best, tri, pts, center):
     }
 
 
-@partial(jax.jit, static_argnames=("tile_q", "tile_f", "interpret"))
-def closest_point_pallas(v, f, points, tile_q=256, tile_f=2048, interpret=False):
+@partial(jax.jit,
+         static_argnames=("tile_q", "tile_f", "interpret",
+                          "assume_nondegenerate"))
+def closest_point_pallas(v, f, points, tile_q=256, tile_f=2048,
+                         interpret=False, assume_nondegenerate=False):
     """Pallas-accelerated closest_faces_and_points.
 
     Same contract as query.closest_faces_and_points: returns dict with
     ``face`` [Q] int32, ``part`` [Q] int32, ``point`` [Q, 3], ``sqdist`` [Q].
+
+    ``assume_nondegenerate=True`` compiles the tile without the
+    degenerate-face override (~25% fewer VPU ops) — bit-identical results
+    when every face passes the relative area cut
+    ``n2 > 1e-10 * ab2 * ac2`` (see _ericson_tail; the numpy facade
+    verifies this at staging via ``mesh_is_nondegenerate``); with actually
+    degenerate faces present the flag can misreport WHICH face is
+    closest, never the reported point/distance for the face it picks.
     """
     vc_, pts, center, tri = _center_inputs(v, f, points)
     n_q = pts.shape[0]
@@ -362,7 +436,7 @@ def closest_point_pallas(v, f, points, tile_q=256, tile_f=2048, interpret=False)
     grid = (q_pad // tile_q, f_pad // tile_f)
 
     out_i = pl.pallas_call(
-        _kernel,
+        _kernel_nodegen if assume_nondegenerate else _kernel,
         grid=grid,
         in_specs=[
             *[pl.BlockSpec((tile_q, 1), lambda i, j: (i, 0)) for _ in range(3)],

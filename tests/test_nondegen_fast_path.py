@@ -1,0 +1,85 @@
+"""The assume_nondegenerate fast path (VERDICT r3 weak #3).
+
+closest_point_pallas(assume_nondegenerate=True) compiles the tile without
+its degenerate-face override (~25% fewer VPU ops).  Contract: on a mesh
+where every face clears the relative area cut, the variant is
+BIT-IDENTICAL to the default (the dropped `where` is the identity there);
+`mesh_is_nondegenerate` is the staging check that licenses the flag, and
+the facades derive it from data rather than assuming it.
+"""
+
+import numpy as np
+
+from mesh_tpu.query.pallas_closest import (
+    closest_point_pallas,
+    mesh_is_nondegenerate,
+)
+from mesh_tpu.sphere import _icosphere
+
+
+def _sphere():
+    v, f = _icosphere(3)
+    return v.astype(np.float32), f.astype(np.int32)
+
+
+def test_mesh_is_nondegenerate_detects():
+    v, f = _sphere()
+    assert mesh_is_nondegenerate(v, f)
+
+    # inject a collinear (zero-area) face
+    f_bad = np.vstack([f, [[0, 1, 1]]]).astype(np.int32)
+    assert not mesh_is_nondegenerate(v, f_bad)
+
+    # a sliver 1e-6 of the area cut fails; margin keeps honest faces in
+    v_sliver = np.array(
+        [[0, 0, 0], [1, 0, 0], [0.5, 1e-9, 0]], np.float64)
+    assert not mesh_is_nondegenerate(v_sliver, [[0, 1, 2]])
+
+
+def test_mesh_is_nondegenerate_batched():
+    v, f = _sphere()
+    batch = np.stack([v, v * 2.0])
+    assert mesh_is_nondegenerate(batch, f)
+    # collapse one face of one mesh in the batch -> whole batch fails
+    bad = batch.copy()
+    bad[1, f[0, 2]] = bad[1, f[0, 1]]
+    assert not mesh_is_nondegenerate(bad, f)
+
+
+def test_flag_is_bit_identical_on_clean_mesh():
+    v, f = _sphere()
+    rng = np.random.RandomState(0)
+    pts = rng.randn(500, 3).astype(np.float32)
+    base = closest_point_pallas(v, f, pts, tile_q=64, tile_f=256,
+                                interpret=True)
+    fast = closest_point_pallas(v, f, pts, tile_q=64, tile_f=256,
+                                interpret=True, assume_nondegenerate=True)
+    np.testing.assert_array_equal(np.asarray(base["face"]),
+                                  np.asarray(fast["face"]))
+    np.testing.assert_array_equal(np.asarray(base["sqdist"]),
+                                  np.asarray(fast["sqdist"]))
+    np.testing.assert_array_equal(np.asarray(base["point"]),
+                                  np.asarray(fast["point"]))
+    np.testing.assert_array_equal(np.asarray(base["part"]),
+                                  np.asarray(fast["part"]))
+
+
+def test_flag_reported_distance_still_exact_with_degenerates():
+    # with the flag WRONGLY set on a degenerate mesh, the winner may be a
+    # different face, but the epilogue still reports the winner's exact
+    # distance — never garbage values
+    v, f = _sphere()
+    f_bad = np.vstack([f, [[0, 1, 1]], [[5, 5, 5]]]).astype(np.int32)
+    rng = np.random.RandomState(1)
+    pts = rng.randn(200, 3).astype(np.float32)
+    res = closest_point_pallas(v, f_bad, pts, tile_q=64, tile_f=256,
+                               interpret=True, assume_nondegenerate=True)
+    sqd = np.asarray(res["sqdist"])
+    assert np.all(np.isfinite(sqd)) and np.all(sqd >= 0)
+    # every reported distance equals the true distance to the reported face
+    from mesh_tpu.query.point_triangle import closest_point_on_triangle
+
+    tri = v[f_bad[np.asarray(res["face"])]]
+    _, true_sqd, _ = closest_point_on_triangle(
+        pts, tri[:, 0], tri[:, 1], tri[:, 2])
+    np.testing.assert_allclose(sqd, np.asarray(true_sqd), atol=1e-6)

@@ -454,3 +454,32 @@ class TestCompiledRound3Additions:
             np.testing.assert_array_equal(vis[k], np.asarray(ref_vis))
             np.testing.assert_allclose(ndc[k], np.asarray(ref_ndc),
                                        atol=1e-5)
+
+
+class TestNondegenFastPathCompiled:
+    """The assume_nondegenerate tile variant, compiled on the chip: must be
+    bit-identical to the default tile on a clean mesh (the dropped
+    degenerate-face override is the identity there) — the same Mosaic
+    lowering risk class every other kernel variant gets compiled coverage
+    for."""
+
+    @requires_tpu
+    def test_flag_parity_compiled(self):
+        from mesh_tpu.query.pallas_closest import (
+            closest_point_pallas,
+            mesh_is_nondegenerate,
+        )
+        from mesh_tpu.sphere import _icosphere
+
+        v, f = _icosphere(3)
+        v = v.astype(np.float32)
+        f = f.astype(np.int32)
+        assert mesh_is_nondegenerate(v, f)
+        rng = np.random.RandomState(0)
+        pts = rng.randn(2048, 3).astype(np.float32)
+        base = closest_point_pallas(v, f, pts)
+        fast = closest_point_pallas(v, f, pts, assume_nondegenerate=True)
+        np.testing.assert_array_equal(np.asarray(base["face"]),
+                                      np.asarray(fast["face"]))
+        np.testing.assert_array_equal(np.asarray(base["sqdist"]),
+                                      np.asarray(fast["sqdist"]))

@@ -22,14 +22,18 @@
 #
 # Env knobs: PROBE_INTERVAL (s between probes while wedged, default 480),
 # SUCCESS_COOLDOWN (s before re-running gates after a full pass, default
-# 14400), LOGDIR (gate logs, default /tmp/tpu_gates), WATCHDOG_ONESHOT=1
-# (exit after the first completed gate cycle instead of re-arming).
+# 14400), FAIL_COOLDOWN (s before retrying after a cycle that RAN but
+# failed, default 3600 — a deterministically red gate on a healthy tunnel
+# must not re-run the whole suite and commit every probe interval),
+# LOGDIR (gate logs, default /tmp/tpu_gates), WATCHDOG_ONESHOT=1 (exit
+# after the first completed gate cycle instead of re-arming).
 
 set -u
 cd "$(dirname "$0")/.."
 REPO=$(pwd)
 PROBE_INTERVAL=${PROBE_INTERVAL:-480}
 SUCCESS_COOLDOWN=${SUCCESS_COOLDOWN:-14400}
+FAIL_COOLDOWN=${FAIL_COOLDOWN:-3600}
 LOGDIR=${LOGDIR:-/tmp/tpu_gates}
 LOCK=/tmp/tpu.lock
 CYCLE_LOG=tools/WATCHDOG_LOG.md
@@ -106,8 +110,12 @@ while :; do
             sleep "$SUCCESS_COOLDOWN"
         else
             WEDGED_PROBES=0
-            note "partial cycle (tunnel likely re-wedged) — back to probing"
-            sleep "$PROBE_INTERVAL"
+            # the cycle RAN and failed: could be a mid-suite re-wedge (next
+            # probe will say) or a deterministically red gate on a healthy
+            # tunnel — cool down long enough that the latter can't spin the
+            # suite + a commit every probe interval
+            note "partial cycle — cooling down ${FAIL_COOLDOWN}s before re-probing"
+            sleep "$FAIL_COOLDOWN"
         fi
     else
         WEDGED_PROBES=$((WEDGED_PROBES + 1))
