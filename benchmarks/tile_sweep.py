@@ -86,6 +86,24 @@ def main(argv=None):
     if best is None:
         # automation must not mistake an all-failed sweep for a healthy one
         summary["error"] = "every tile combination failed"
+    elif not args.mxu:
+        # quantify the degenerate-tail cost on this backend: same kernel,
+        # best tile shape, safe tile (assume_nondegenerate=False) — the
+        # on-chip evidence for the facade's pay-per-use override
+        try:
+            t_safe = time_fn(
+                lambda: closest_point_pallas(
+                    v, f, pts, tile_q=best["tile_q"], tile_f=best["tile_f"],
+                    assume_nondegenerate=False),
+                reps=args.reps,
+            )
+            safe_rate = args.queries / t_safe
+            summary["safe_tile_queries_per_sec"] = round(safe_rate, 1)
+            summary["degenerate_tail_cost_pct"] = round(
+                100.0 * (best["queries_per_sec"] - safe_rate)
+                / best["queries_per_sec"], 1)
+        except Exception as e:
+            summary["safe_tile_error"] = str(e)[:120]
     print(json.dumps(summary))
     if best is None:
         sys.exit(1)
