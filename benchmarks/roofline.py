@@ -38,6 +38,9 @@ FLOPS_PER_PAIR = {
     # 6 edge-vs-face segment tests per triangle pair
     # (pallas_ray.py:_tri_tri_kernel)
     "tri_tri": 330,
+    # Möller no-div interval test (pallas_ray.py:_moller_hit): plane
+    # distances + D axis/projection + two interval computations + overlap
+    "tri_tri_moller": 180,
     # nearest-vertex argmin: diff + sqnorm + running min
     "nearest_vertex": 10,
 }

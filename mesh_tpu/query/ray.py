@@ -158,28 +158,78 @@ def tri_tri_intersects(p, q, eps=_EPS):
     return out
 
 
+def tri_tri_intersects_moller(p, q, eps=_EPS):
+    """Pairwise triangle intersection via the Möller '97 no-division
+    interval test — decision parity with ``tri_tri_intersects`` on
+    non-degenerate, non-coplanar, non-borderline pairs at ~half the
+    arithmetic.  A DEGENERATE (zero-normal) triangle is blind here
+    (reports no intersection even when its edges pierce the other
+    triangle), so callers must gate on
+    ``pallas_closest.mesh_is_nondegenerate`` for both sides — the facade
+    does (``intersections_mask``).  Coplanar overlap is not counted,
+    matching the segment formulation (module docstring).
+
+    :param p: [..., 3, 3] triangles; :param q: broadcast-compatible
+    :returns: boolean [...]
+    """
+    from .pallas_ray import _moller_hit, _tri_planes
+
+    p = jnp.asarray(p)
+    q = jnp.asarray(q, p.dtype)
+    pa, pb, pc, pn, pd = _tri_planes(p)
+    qa, qb, qc, qn, qd = _tri_planes(q)
+
+    def comps(arr):
+        return tuple(arr[..., k] for k in range(3))
+
+    return _moller_hit(
+        comps(pa), comps(pb), comps(pc), comps(pn), pd,
+        comps(qa), comps(qb), comps(qc), comps(qn), qd, eps,
+    )
+
+
 def intersections_mask(v, f, q_v, q_f, chunk=128):
     """Boolean mask over query faces: does q_f[i] intersect the (v, f) mesh?
 
     Fixed-shape replacement for AabbTree.intersections_indices
     (search.py:39-49); `np.nonzero(mask)` recovers the reference's index list.
     On accelerators the O(QF*F) pair grid runs in the Pallas triangle-
-    triangle kernel (pallas_ray.py); the XLA tiling below is the
-    CPU/interpret path.
+    triangle kernel (pallas_ray.py) — the Möller interval tile (~2x fewer
+    ops) when every face of both meshes is non-degenerate (checked from
+    data at this numpy boundary), the segment tile otherwise; the XLA
+    tiling below is the CPU/interpret path.
     """
     if pallas_default():
-        return _intersections_mask_pallas(v, f, q_v, q_f)
+        return _intersections_mask_pallas(
+            v, f, q_v, q_f,
+            algorithm=_tri_tri_algorithm(v, f, q_v, q_f),
+        )
     return _intersections_mask_xla(v, f, q_v, q_f, chunk=chunk)
 
 
-@jax.jit
-def _intersections_mask_pallas(v, f, q_v, q_f):
+def _tri_tri_algorithm(v, f, q_v, q_f):
+    """Kernel choice for the pair grid: the Möller interval tile needs
+    every triangle of BOTH meshes non-degenerate; anything else keeps the
+    segment tile, whose edge tests stay meaningful on zero-area faces."""
+    from .pallas_closest import mesh_is_nondegenerate
+
+    return (
+        "moller"
+        if mesh_is_nondegenerate(v, f) and mesh_is_nondegenerate(q_v, q_f)
+        else "segment"
+    )
+
+
+@partial(jax.jit, static_argnames=("algorithm",))
+def _intersections_mask_pallas(v, f, q_v, q_f, algorithm="segment"):
     # one jitted dispatch: the gathers fuse into the same launch as the
     # kernel instead of running as eager per-op round trips
     from .pallas_ray import tri_tri_any_hit_pallas
 
     v = jnp.asarray(v)
-    return tri_tri_any_hit_pallas(jnp.asarray(q_v, v.dtype)[q_f], v[f])
+    return tri_tri_any_hit_pallas(
+        jnp.asarray(q_v, v.dtype)[q_f], v[f], algorithm=algorithm
+    )
 
 
 @partial(jax.jit, static_argnames=("chunk",))

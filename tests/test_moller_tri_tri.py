@@ -1,0 +1,175 @@
+"""Möller no-div triangle-triangle variant: decision parity with the
+segment formulation (the semantic oracle) wherever the decision is robust,
+shared-arithmetic parity between the XLA and Pallas paths, and the
+degeneracy gate that keeps the blind spot out of production.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from mesh_tpu.query.ray import (
+    _tri_tri_algorithm,
+    tri_tri_intersects,
+    tri_tri_intersects_moller,
+)
+from mesh_tpu.query.pallas_ray import tri_tri_any_hit_pallas
+
+
+def _pair(p, q):
+    p = jnp.asarray(np.asarray(p, np.float64))[None]
+    q = jnp.asarray(np.asarray(q, np.float64))[None]
+    seg = bool(np.asarray(tri_tri_intersects(p, q))[0])
+    mol = bool(np.asarray(tri_tri_intersects_moller(p, q))[0])
+    return seg, mol
+
+
+CASES = [
+    # crossing: edge of one pierces the face of the other
+    ([[0, 0, 0], [2, 0, 0], [0, 2, 0]],
+     [[0.5, 0.5, -1], [0.5, 0.5, 1], [2.5, 2.5, 0.5]], True),
+    # clearly separated, parallel planes
+    ([[0, 0, 0], [1, 0, 0], [0, 1, 0]],
+     [[0, 0, 1], [1, 0, 1], [0, 1, 1]], False),
+    # separated in-plane direction, same plane band
+    ([[0, 0, 0], [1, 0, 0], [0, 1, 0]],
+     [[5, 5, -0.5], [6, 5, 0.5], [5, 6, 0.2]], False),
+    # perpendicular, T-configuration (edge hits interior)
+    ([[0, 0, 0], [2, 0, 0], [0, 2, 0]],
+     [[0.3, 0.3, -0.5], [0.3, 0.3, 0.5], [1.5, 0.3, 0.1]], True),
+    # star / mutual piercing
+    ([[-1, 0, 0], [1, 0, 0], [0, 0, 1.5]],
+     [[0, -1, 0.5], [0, 1, 0.5], [0, 0, -1]], True),
+    # near miss above the plane
+    ([[0, 0, 0], [2, 0, 0], [0, 2, 0]],
+     [[0.5, 0.5, 0.2], [1.5, 0.5, 1.0], [0.5, 1.5, 1.0]], False),
+    # coplanar overlapping: BOTH forms report no intersection (module
+    # docstring: coplanar pairs are not counted; generic float data never
+    # produces them)
+    ([[0, 0, 0], [2, 0, 0], [0, 2, 0]],
+     [[0.5, 0.5, 0], [1.5, 0.5, 0], [0.5, 1.5, 0]], False),
+]
+
+
+@pytest.mark.parametrize("p,q,expect", CASES)
+def test_structured_cases(p, q, expect):
+    seg, mol = _pair(p, q)
+    assert seg == expect, "segment oracle disagrees with the construction"
+    assert mol == expect, "moller disagrees with the construction"
+
+
+def test_symmetry():
+    for p, q, expect in CASES:
+        seg, mol = _pair(q, p)
+        assert mol == expect and seg == expect
+
+
+def test_random_battery_matches_segment_oracle_where_robust():
+    # 4000 random pairs at mixed scales; oracle = GENUINE f64 segment test
+    # (enable_x64 — without it jnp silently downcasts to f32, test_pallas
+    # guards the same pitfall).  A pair counts as ROBUST when the f64
+    # oracle's decision survives five 1e-6-scale jitters of every vertex —
+    # borderline grazing contact is exactly where eps conventions may
+    # differ, and is excluded from the parity claim (both answers are
+    # defensible there).
+    import jax
+
+    rng = np.random.RandomState(0)
+    n = 4000
+    p = rng.randn(n, 3, 3)
+    q = rng.randn(n, 3, 3) * rng.choice([0.3, 1.0, 3.0], (n, 1, 1))
+    q[:, :, 2] *= rng.choice([0.05, 1.0], (n, 1))   # some near-planar pairs
+
+    with jax.enable_x64(True):
+        pj = jnp.asarray(p)
+        qj = jnp.asarray(q)
+        assert pj.dtype == jnp.float64
+        oracle = np.asarray(tri_tri_intersects(pj, qj))
+        robust = np.ones(n, bool)
+        for k in range(5):
+            jit_rng = np.random.RandomState(100 + k)
+            pj2 = jnp.asarray(p + jit_rng.randn(*p.shape) * 1e-6)
+            qj2 = jnp.asarray(q + jit_rng.randn(*q.shape) * 1e-6)
+            robust &= np.asarray(tri_tri_intersects(pj2, qj2)) == oracle
+        assert robust.mean() > 0.97, "jitter filter removed too many pairs"
+
+        moller64 = np.asarray(tri_tri_intersects_moller(pj, qj))
+    mism64 = np.nonzero((moller64 != oracle) & robust)[0]
+    assert mism64.size == 0, (
+        "f64 moller disagrees with robust f64 segment oracle at %s"
+        % mism64[:10])
+
+    moller32 = np.asarray(tri_tri_intersects_moller(
+        jnp.asarray(p, jnp.float32), jnp.asarray(q, jnp.float32)))
+    mism32 = np.nonzero((moller32 != oracle) & robust)[0]
+    assert mism32.size == 0, (
+        "f32 moller disagrees with robust f64 segment oracle at %s"
+        % mism32[:10])
+
+
+def test_pallas_matches_xla_moller_exactly():
+    # identical arithmetic graph: the Pallas tile and the XLA path both
+    # call _moller_hit, so agreement is exact — including on degenerate
+    # triangles (where both are blind by construction)
+    rng = np.random.RandomState(3)
+    q_tri = rng.randn(137, 3, 3).astype(np.float32)
+    m_tri = rng.randn(201, 3, 3).astype(np.float32)
+    # inject degenerates on both sides
+    q_tri[5, 2] = q_tri[5, 1]
+    m_tri[7] = 0.0
+    m_tri[11, 2] = (m_tri[11, 0] + m_tri[11, 1]) / 2
+
+    got = np.asarray(tri_tri_any_hit_pallas(
+        q_tri, m_tri, tile_q=32, tile_f=64, interpret=True,
+        algorithm="moller"))
+    ref = np.asarray(jnp.any(tri_tri_intersects_moller(
+        jnp.asarray(q_tri)[:, None], jnp.asarray(m_tri)[None]), axis=1))
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_moller_blindness_and_the_gate():
+    # a zero-area needle whose edges pierce a face: the segment form sees
+    # it, moller is blind — exactly why the facade only selects moller
+    # when BOTH meshes pass the nondegeneracy check
+    tri = np.array([[[0, 0, 0], [2, 0, 0], [0, 2, 0]]], np.float64)
+    needle = np.array(
+        [[[0.5, 0.5, -1], [0.5, 0.5, 1], [0.5, 0.5, 3]]], np.float64)
+    seg, mol = (
+        bool(np.asarray(tri_tri_intersects(jnp.asarray(needle),
+                                           jnp.asarray(tri)))[0]),
+        bool(np.asarray(tri_tri_intersects_moller(jnp.asarray(needle),
+                                                  jnp.asarray(tri)))[0]),
+    )
+    assert seg is True and mol is False
+
+    v = np.array([[0, 0, 0], [2, 0, 0], [0, 2, 0]], np.float32)
+    f = np.array([[0, 1, 2]], np.int32)
+    nv = needle[0].astype(np.float32)
+    nf = np.array([[0, 1, 2]], np.int32)
+    assert _tri_tri_algorithm(v, f, nv, nf) == "segment"
+    # clean geometry on both sides -> the fast tile
+    hv = (v + np.array([0, 0, 1], np.float32)).astype(np.float32)
+    assert _tri_tri_algorithm(v, f, hv, f) == "moller"
+
+
+def test_config4_geometry_parity():
+    # the hand-body benchmark geometry (grazing icosphere vs body sphere):
+    # moller and segment must produce the same mask and count
+    from mesh_tpu.models import smpl_sized_sphere
+    from mesh_tpu.sphere import _icosphere
+
+    body_v, body_f = smpl_sized_sphere()
+    hand_v, hand_f = _icosphere(2)
+    hand_v = hand_v * 0.2 + np.array([0.9, 0, 0])
+
+    q_tri = jnp.asarray(hand_v.astype(np.float32))[jnp.asarray(
+        hand_f.astype(np.int32))]
+    m_tri = jnp.asarray(body_v.astype(np.float32))[jnp.asarray(
+        body_f.astype(np.int32))]
+    seg = np.asarray(jnp.any(tri_tri_intersects(
+        q_tri[:, None], m_tri[None]), axis=1))
+    mol = np.asarray(jnp.any(tri_tri_intersects_moller(
+        q_tri[:, None], m_tri[None]), axis=1))
+    np.testing.assert_array_equal(seg, mol)
+    assert seg.sum() > 0       # the fixture does graze the surface

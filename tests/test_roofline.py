@@ -49,7 +49,7 @@ def test_low_intensity_classifies_hbm_bound():
 def test_flop_table_covers_all_kernel_kinds():
     assert set(FLOPS_PER_PAIR) == {
         "closest_point", "ray_any_hit", "alongnormal", "tri_tri",
-        "nearest_vertex",
+        "tri_tri_moller", "nearest_vertex",
     }
 
 

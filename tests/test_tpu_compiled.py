@@ -483,3 +483,26 @@ class TestNondegenFastPathCompiled:
                                       np.asarray(fast["face"]))
         np.testing.assert_array_equal(np.asarray(base["sqdist"]),
                                       np.asarray(fast["sqdist"]))
+
+
+class TestMollerTriTriCompiled:
+    """The Möller interval tile, compiled on the chip: must agree with the
+    compiled segment tile on clean geometry (the facade's auto choice
+    between them must be invisible in results)."""
+
+    @requires_tpu
+    def test_moller_vs_segment_compiled(self):
+        from mesh_tpu.query.pallas_ray import tri_tri_any_hit_pallas
+        from mesh_tpu.sphere import _icosphere
+
+        body_v, body_f = _icosphere(3)
+        hand_v, hand_f = _icosphere(2)
+        hand_v = hand_v * 0.25 + np.array([0.92, 0, 0])
+        q_tri = hand_v.astype(np.float32)[hand_f]
+        m_tri = body_v.astype(np.float32)[body_f]
+        seg = np.asarray(tri_tri_any_hit_pallas(q_tri, m_tri,
+                                                algorithm="segment"))
+        mol = np.asarray(tri_tri_any_hit_pallas(q_tri, m_tri,
+                                                algorithm="moller"))
+        np.testing.assert_array_equal(seg, mol)
+        assert seg.sum() > 0
