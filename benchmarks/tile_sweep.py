@@ -1,13 +1,20 @@
-"""Tile-size sweep for the brute-force closest-point kernel.
+"""Tile-size sweep for the hot Pallas pair-grid kernels.
 
-The production tiles (tile_q=256, tile_f=2048) were chosen analytically
-(VMEM budget: 19 face planes x tile_f + query columns).  This sweeps the
-neighborhood on the live backend at the north-star shape (BASELINE
-config 3: 13776 faces, batch-sized query sets) and prints one JSON line
-per combination, so a recovered tunnel window can answer "are we leaving
-tile-shape performance on the table?" in ~a minute.
+The production tiles (closest-point: tile_q=256, tile_f=2048; tri-tri:
+256x512) were chosen analytically (VMEM budget: plane count x tile_f +
+query columns).  This sweeps the neighborhood on the live backend and
+prints one JSON line per combination, so a recovered tunnel window can
+answer "are we leaving tile-shape performance on the table?" in ~a
+minute per kernel.
 
     python benchmarks/tile_sweep.py [--queries 262144] [--faces 13776]
+    python benchmarks/tile_sweep.py --mxu        # experimental MXU tile
+    python benchmarks/tile_sweep.py --tri-tri    # Möller + segment tiles
+                                                 # at the config-4 shape
+
+The closest-point sweep also re-times the best tile with the safe
+(degenerate-tail) variant; the tri-tri sweep times segment and Möller at
+every shape, so the on-chip moller_speedup lands per tile shape.
 """
 
 import itertools
@@ -22,35 +29,35 @@ sys.path.insert(0, __file__.rsplit("/", 2)[0])
 from mesh_tpu.utils.profiling import time_fn  # noqa: E402
 
 
-def main(argv=None):
-    import argparse
+def _sweep(make_call, shapes, reps, n_items):
+    """Generic (tile_q, tile_f) sweep: prints one row per shape, returns
+    (best_row, n_errors)."""
+    best = None
+    n_errors = 0
+    for tile_q, tile_f in shapes:
+        try:
+            t = time_fn(partial(make_call, tile_q, tile_f), reps=reps)
+            rate = n_items / t
+            row = {"tile_q": tile_q, "tile_f": tile_f,
+                   "queries_per_sec": round(rate, 1)}
+            if best is None or rate > best["queries_per_sec"]:
+                best = row
+        except Exception as e:  # VMEM overflow etc. — record, keep sweeping
+            n_errors += 1
+            row = {"tile_q": tile_q, "tile_f": tile_f,
+                   "error": str(e)[:120]}
+        print(json.dumps(row), flush=True)
+    return best, n_errors
 
-    parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument("--queries", type=int, default=262144)
-    parser.add_argument("--faces", type=int, default=13776)
-    parser.add_argument("--reps", type=int, default=5)
-    parser.add_argument("--mxu", action="store_true",
-                        help="sweep the experimental MXU-fed tile instead")
-    args = parser.parse_args(argv)
 
-    from bench import backend_responsive
-
-    ok, reason = backend_responsive()
-    if not ok:
-        print(json.dumps({"error": "backend probe failed: %s" % reason}))
-        sys.exit(1)
-
+def _closest_point_sweep(args):
     from mesh_tpu.query.autotune import _sphere_mesh
     from mesh_tpu.query.pallas_closest import (
         closest_point_pallas,
         closest_point_pallas_mxu,
         mesh_is_nondegenerate,
     )
-    from mesh_tpu.utils.compilation_cache import (
-        enable_persistent_compilation_cache,
-    )
 
-    enable_persistent_compilation_cache()
     v, f = _sphere_mesh(args.faces)
     if args.mxu:
         kernel = closest_point_pallas_mxu
@@ -62,31 +69,13 @@ def main(argv=None):
     rng = np.random.RandomState(0)
     pts = rng.randn(args.queries, 3).astype(np.float32)
 
-    best = None
-    n_errors = 0
-    for tile_q, tile_f in itertools.product(
-        (128, 256, 512, 1024), (512, 1024, 2048, 4096)
-    ):
-        try:
-            t = time_fn(
-                lambda: kernel(v, f, pts, tile_q=tile_q, tile_f=tile_f),
-                reps=args.reps,
-            )
-            rate = args.queries / t
-            row = {"tile_q": tile_q, "tile_f": tile_f,
-                   "queries_per_sec": round(rate, 1)}
-            if best is None or rate > best["queries_per_sec"]:
-                best = row
-        except Exception as e:  # VMEM overflow etc. — record, keep sweeping
-            n_errors += 1
-            row = {"tile_q": tile_q, "tile_f": tile_f,
-                   "error": str(e)[:120]}
-        print(json.dumps(row), flush=True)
+    best, n_errors = _sweep(
+        lambda tq, tf: kernel(v, f, pts, tile_q=tq, tile_f=tf),
+        itertools.product((128, 256, 512, 1024), (512, 1024, 2048, 4096)),
+        args.reps, args.queries,
+    )
     summary = {"best": best, "n_errors": n_errors}
-    if best is None:
-        # automation must not mistake an all-failed sweep for a healthy one
-        summary["error"] = "every tile combination failed"
-    elif not args.mxu:
+    if best is not None and not args.mxu:
         # quantify the degenerate-tail cost on this backend: same kernel,
         # best tile shape, safe tile (assume_nondegenerate=False) — the
         # on-chip evidence for the facade's pay-per-use override
@@ -104,8 +93,87 @@ def main(argv=None):
                 / best["queries_per_sec"], 1)
         except Exception as e:
             summary["safe_tile_error"] = str(e)[:120]
+    return summary
+
+
+def _tri_tri_sweep(args):
+    """Both tri-tri tiles at the config-4 shape (MANO-sized query mesh vs
+    SMPL-sized body mesh), per tile shape — the per-shape moller_speedup."""
+    from mesh_tpu.models import smpl_sized_sphere
+    from mesh_tpu.query.pallas_ray import tri_tri_any_hit_pallas
+    from mesh_tpu.sphere import _icosphere
+
+    body_v, body_f = smpl_sized_sphere()
+    hand_v, hand_f = _icosphere(3)
+    hand_v = hand_v * 0.2 + np.array([0.9, 0, 0])
+    q_tri = hand_v.astype(np.float32)[hand_f]
+    m_tri = body_v.astype(np.float32)[body_f.astype(np.int64)]
+    n_items = len(q_tri)
+
+    shapes = list(itertools.product((128, 256, 512), (256, 512, 1024)))
+    results = {}
+    for algo in ("segment", "moller"):
+        print(json.dumps({"sweep_algorithm": algo}), flush=True)
+        best, n_errors = _sweep(
+            lambda tq, tf: tri_tri_any_hit_pallas(
+                q_tri, m_tri, tile_q=tq, tile_f=tf, algorithm=algo),
+            shapes, args.reps, n_items,
+        )
+        results[algo] = {"best": best, "n_errors": n_errors}
+    # overall health keys on EITHER tile family succeeding ("best" is what
+    # main() checks); a family that failed at every shape is flagged
+    # explicitly rather than conflated with total failure
+    summary = {
+        "best": results["moller"]["best"] or results["segment"]["best"],
+        "n_errors": sum(r["n_errors"] for r in results.values()),
+        "moller_best": results["moller"]["best"],
+        "segment_best": results["segment"]["best"],
+    }
+    for algo in ("segment", "moller"):
+        if results[algo]["best"] is None:
+            summary["%s_error" % algo] = (
+                "every %s tile combination failed" % algo)
+    if results["moller"]["best"] and results["segment"]["best"]:
+        summary["moller_speedup_best_tiles"] = round(
+            results["moller"]["best"]["queries_per_sec"]
+            / results["segment"]["best"]["queries_per_sec"], 2)
+    return summary
+
+
+def main(argv=None):
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--queries", type=int, default=262144)
+    parser.add_argument("--faces", type=int, default=13776)
+    parser.add_argument("--reps", type=int, default=5)
+    parser.add_argument("--mxu", action="store_true",
+                        help="sweep the experimental MXU-fed tile instead")
+    parser.add_argument("--tri-tri", action="store_true", dest="tri_tri",
+                        help="sweep the triangle-triangle tiles instead")
+    args = parser.parse_args(argv)
+    if args.mxu and args.tri_tri:
+        parser.error("--mxu and --tri-tri are mutually exclusive")
+
+    from bench import backend_responsive
+
+    ok, reason = backend_responsive()
+    if not ok:
+        print(json.dumps({"error": "backend probe failed: %s" % reason}))
+        sys.exit(1)
+
+    from mesh_tpu.utils.compilation_cache import (
+        enable_persistent_compilation_cache,
+    )
+
+    enable_persistent_compilation_cache()
+    summary = (_tri_tri_sweep(args) if args.tri_tri
+               else _closest_point_sweep(args))
+    if summary["best"] is None:
+        # automation must not mistake an all-failed sweep for a healthy one
+        summary["error"] = "every tile combination failed"
     print(json.dumps(summary))
-    if best is None:
+    if summary["best"] is None:
         sys.exit(1)
 
 

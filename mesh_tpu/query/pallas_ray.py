@@ -481,80 +481,140 @@ def _tri_tri_hit_tile(qa, qb, qc, ma, me1, me2, eps):
     return hit
 
 
-def _self_intersect_kernel(eps, *refs):
+def _make_self_intersect_kernel(eps, n_tri_planes):
     """Per-face count of intersecting other faces, excluding the face
     itself and any vertex-sharing pair (reference
-    Do_intersect_noself_traits, AABB_n_tree.h:95-117)."""
-    qa = tuple(r[:] for r in refs[0:3])
-    qb = tuple(r[:] for r in refs[3:6])
-    qc = tuple(r[:] for r in refs[6:9])
-    qi = refs[9][:]                     # (TQ, 3) int32 vertex ids
-    ma = tuple(r[:] for r in refs[10:13])
-    me1 = tuple(r[:] for r in refs[13:16])
-    me2 = tuple(r[:] for r in refs[16:19])
-    mi = refs[19][:]                    # (3, TF) int32 vertex ids
-    out_c, acc_c = refs[20:]
-    i = pl.program_id(0)
-    j = pl.program_id(1)
-    n_j = pl.num_programs(1)
-    tq = qi.shape[0]
-    tf = mi.shape[1]
+    Do_intersect_noself_traits, AABB_n_tree.h:95-117).  ``n_tri_planes``
+    selects the pair predicate: 9 -> segment formulation (corners/edges),
+    13 -> Möller interval tile (corners + hoisted normal/offset)."""
 
-    @pl.when(j == 0)
-    def _init():
-        acc_c[:] = jnp.zeros_like(acc_c)
+    def kernel(*refs):
+        n = n_tri_planes
+        qplanes = refs[0:n]
+        qi = refs[n][:]                 # (TQ, 3) int32 vertex ids
+        mplanes = refs[n + 1:2 * n + 1]
+        mi = refs[2 * n + 1][:]         # (3, TF) int32 vertex ids
+        out_c, acc_c = refs[2 * n + 2:]
+        i = pl.program_id(0)
+        j = pl.program_id(1)
+        n_j = pl.num_programs(1)
+        tq = qi.shape[0]
+        tf = mi.shape[1]
 
-    hit = _tri_tri_hit_tile(qa, qb, qc, ma, me1, me2, eps)
+        @pl.when(j == 0)
+        def _init():
+            acc_c[:] = jnp.zeros_like(acc_c)
 
-    # vertex-sharing exclusion: any of the 9 (row vertex, col vertex)
-    # index pairs equal; plus self-pair exclusion by global face id
-    shares = None
-    for r in range(3):
-        for c in range(3):
-            eq = qi[:, r:r + 1] == mi[c:c + 1, :]
-            shares = eq if shares is None else shares | eq
-    row_id = jax.lax.broadcasted_iota(jnp.int32, (tq, 1), 0) + i * tq
-    col_id = jax.lax.broadcasted_iota(jnp.int32, (1, tf), 1) + j * tf
-    not_self = row_id != col_id
-    counted = hit & ~shares & not_self
-    acc_c[:] = acc_c[:] + jnp.sum(
-        counted.astype(jnp.int32), axis=1, keepdims=True
-    )
+        if n == 9:
+            qa = tuple(r[:] for r in qplanes[0:3])
+            qb = tuple(r[:] for r in qplanes[3:6])
+            qc = tuple(r[:] for r in qplanes[6:9])
+            ma = tuple(r[:] for r in mplanes[0:3])
+            me1 = tuple(r[:] for r in mplanes[3:6])
+            me2 = tuple(r[:] for r in mplanes[6:9])
+            hit = _tri_tri_hit_tile(qa, qb, qc, ma, me1, me2, eps)
+        else:
+            q0 = tuple(r[:] for r in qplanes[0:3])
+            q1 = tuple(r[:] for r in qplanes[3:6])
+            q2 = tuple(r[:] for r in qplanes[6:9])
+            n1 = tuple(r[:] for r in qplanes[9:12])
+            d1 = qplanes[12][:]
+            m0 = tuple(r[:] for r in mplanes[0:3])
+            m1 = tuple(r[:] for r in mplanes[3:6])
+            m2 = tuple(r[:] for r in mplanes[6:9])
+            n2 = tuple(r[:] for r in mplanes[9:12])
+            d2 = mplanes[12][:]
+            hit = _moller_hit(q0, q1, q2, n1, d1, m0, m1, m2, n2, d2, eps)
 
-    @pl.when(j == n_j - 1)
-    def _write():
-        out_c[:] = acc_c[:]
+        # vertex-sharing exclusion: any of the 9 (row vertex, col vertex)
+        # index pairs equal; plus self-pair exclusion by global face id
+        shares = None
+        for r in range(3):
+            for c in range(3):
+                eq = qi[:, r:r + 1] == mi[c:c + 1, :]
+                shares = eq if shares is None else shares | eq
+        row_id = jax.lax.broadcasted_iota(jnp.int32, (tq, 1), 0) + i * tq
+        col_id = jax.lax.broadcasted_iota(jnp.int32, (1, tf), 1) + j * tf
+        not_self = row_id != col_id
+        counted = hit & ~shares & not_self
+        acc_c[:] = acc_c[:] + jnp.sum(
+            counted.astype(jnp.int32), axis=1, keepdims=True
+        )
+
+        @pl.when(j == n_j - 1)
+        def _write():
+            out_c[:] = acc_c[:]
+
+    return kernel
 
 
-@partial(jax.jit, static_argnames=("tile_q", "tile_f", "interpret"))
+def _moller_qcols(tri, tile_q):
+    """Query-side Möller planes: 13 (Q_pad, 1) cols (corners + hoisted
+    normal + plane offset), zero-padded — all-zero padding has zero plane
+    distances everywhere and lands in the coplanar reject."""
+    a, b, c, n, d = _tri_planes(tri)
+    qcols = _query_cols([a, b, c, n], tile_q)
+    qcols.append(_pad_rows(d[:, None], tile_q, 0.0))
+    return qcols
+
+
+def _moller_frows(tri, tile_f):
+    """Face-side Möller planes: 13 (1, F_pad) rows; padding as above."""
+    a, b, c, n, d = _tri_planes(tri)
+    frows = [
+        _pad_cols(x[None, :], tile_f, 0.0)
+        for arr in (a, b, c, n)
+        for x in (arr[:, 0], arr[:, 1], arr[:, 2])
+    ]
+    frows.append(_pad_cols(d[None, :], tile_f, 0.0))
+    return frows
+
+
+@partial(jax.jit, static_argnames=("tile_q", "tile_f", "interpret",
+                                   "algorithm"))
 def self_intersection_count_pallas(v, f, tile_q=256, tile_f=512,
-                                   interpret=False):
+                                   interpret=False, algorithm="segment"):
     """Pallas path of query.self_intersection_count: the number of faces
     intersecting at least one other non-vertex-sharing face (the kernel
-    accumulates per-face partner counts; involvement is counted here)."""
+    accumulates per-face partner counts; involvement is counted here).
+
+    ``algorithm="moller"`` runs the interval tile (~2x fewer ops; only
+    valid when every face is non-degenerate — the facade gates on
+    mesh_is_nondegenerate).  Count parity between the two algorithms is
+    pinned by the reference self-intersection fixtures
+    (tests/test_reference_fixtures.py)."""
     v = jnp.asarray(v, jnp.float32)
     f = jnp.asarray(f, jnp.int32)
     tri = v[f]
     n_f = tri.shape[0]
 
-    qcols = _query_cols([tri[:, 0], tri[:, 1], tri[:, 2]], tile_q)
+    if algorithm == "moller":
+        qcols = _moller_qcols(tri, tile_q)
+        frows = _moller_frows(tri, tile_f)
+        n_planes = 13
+    elif algorithm == "segment":
+        qcols = _query_cols([tri[:, 0], tri[:, 1], tri[:, 2]], tile_q)
+        frows = _tri_rows(tri, tile_f)
+        n_planes = 9
+    else:
+        raise ValueError("algorithm must be 'segment' or 'moller', got %r"
+                         % (algorithm,))
     # vertex-id planes: padded rows/cols get distinct negative ids so a
     # padded row never "shares" with a padded column; padded geometry is
     # degenerate (zero) and never intersects anyway
     qi = _pad_rows(f, tile_q, -1)
-    frows = _tri_rows(tri, tile_f)
     mi = _pad_cols(f.T, tile_f, -2)
     q_pad = qcols[0].shape[0]
     f_pad = frows[0].shape[1]
     grid = (q_pad // tile_q, f_pad // tile_f)
 
     out_c = pl.pallas_call(
-        partial(_self_intersect_kernel, float(_EPS)),
+        _make_self_intersect_kernel(float(_EPS), n_planes),
         grid=grid,
         in_specs=[
-            *[_QCOL(tile_q)] * 9,
+            *[_QCOL(tile_q)] * n_planes,
             pl.BlockSpec((tile_q, 3), lambda i, j: (i, 0)),
-            *[_FROW(tile_f)] * 9,
+            *[_FROW(tile_f)] * n_planes,
             pl.BlockSpec((3, tile_f), lambda i, j: (0, j)),
         ],
         out_specs=_QCOL(tile_q),
@@ -585,16 +645,8 @@ def tri_tri_any_hit_pallas(q_tri, tri, tile_q=256, tile_f=512,
     n_q = q_tri.shape[0]
 
     if algorithm == "moller":
-        qa, qb, qc, qn, qd = _tri_planes(q_tri)
-        ma, mb, mc, mn, md = _tri_planes(tri)
-        qcols = _query_cols([qa, qb, qc, qn], tile_q)
-        qcols.append(_pad_rows(qd[:, None], tile_q, 0.0))
-        frows = [
-            _pad_cols(x[None, :], tile_f, 0.0)
-            for arr in (ma, mb, mc, mn)
-            for x in (arr[:, 0], arr[:, 1], arr[:, 2])
-        ]
-        frows.append(_pad_cols(md[None, :], tile_f, 0.0))
+        qcols = _moller_qcols(q_tri, tile_q)
+        frows = _moller_frows(tri, tile_f)
         kernel = partial(_moller_tri_tri_kernel, float(_EPS))
         n_qcols, n_frows = 13, 13
     elif algorithm == "segment":

@@ -262,12 +262,18 @@ def self_intersection_count(v, f, chunk=128):
     wall fans cross in more than 8 pairs (tests/test_aabb_n_tree.py:85-89).
     Pairs sharing any vertex index are excluded (Do_intersect_noself_traits,
     AABB_n_tree.h:95-117).  On accelerators the O(F^2) pair grid runs in the
-    Pallas kernel (pallas_ray.py).
+    Pallas kernel (pallas_ray.py) — the Möller interval tile when every
+    face is non-degenerate (count parity with the segment tile is pinned
+    by the reference fixtures), the segment tile otherwise.
     """
     if pallas_default():
+        from .pallas_closest import mesh_is_nondegenerate
         from .pallas_ray import self_intersection_count_pallas
 
-        return self_intersection_count_pallas(v, f)
+        algorithm = (
+            "moller" if mesh_is_nondegenerate(v, f) else "segment"
+        )
+        return self_intersection_count_pallas(v, f, algorithm=algorithm)
     return _self_intersection_count_xla(v, f, chunk=chunk)
 
 

@@ -166,3 +166,39 @@ class TestAabbNormalsFixtureParity:
             SELF_INT_CYL_F.astype(np.int32),
         ))
         assert count == 2 * 8
+
+
+class TestSelfIntersectKernelAlgorithms:
+    """Both Pallas self-intersection tiles (segment / Möller interval)
+    must reproduce the reference fixture counts — the gate that lets the
+    facade pick the ~2x-cheaper Möller tile on clean meshes without
+    changing any reference-visible number."""
+
+    def _counts(self, v, f):
+        from mesh_tpu.query.pallas_closest import mesh_is_nondegenerate
+        from mesh_tpu.query.pallas_ray import self_intersection_count_pallas
+
+        v = v.astype(np.float32)
+        f = f.astype(np.int32)
+        assert mesh_is_nondegenerate(v, f), (
+            "fixture grew a degenerate face — the production gate would "
+            "route it to the segment tile; update this test's premise"
+        )
+        return {
+            algo: int(self_intersection_count_pallas(
+                v, f, tile_q=32, tile_f=64, interpret=True,
+                algorithm=algo))
+            for algo in ("segment", "moller")
+        }
+
+    def test_doublebox_both_algorithms(self):
+        counts = self._counts(DOUBLEBOX_V, DOUBLEBOX_F)
+        assert counts == {"segment": 0, "moller": 0}
+
+    def test_bent_cylinder_both_algorithms(self):
+        counts = self._counts(SELF_INT_CYL_V, SELF_INT_CYL_F)
+        assert counts == {"segment": 2 * 8, "moller": 2 * 8}
+
+    def test_translated_cylinder_both_algorithms(self):
+        counts = self._counts(CYL_V, CYL_F)
+        assert counts["segment"] == counts["moller"]

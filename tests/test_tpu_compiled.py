@@ -506,3 +506,17 @@ class TestMollerTriTriCompiled:
                                                 algorithm="moller"))
         np.testing.assert_array_equal(seg, mol)
         assert seg.sum() > 0
+
+    @requires_tpu
+    def test_self_intersect_moller_vs_segment_compiled(self):
+        from mesh_tpu.query.pallas_ray import self_intersection_count_pallas
+        from tests.test_reference_fixtures import (
+            SELF_INT_CYL_F,
+            SELF_INT_CYL_V,
+        )
+
+        v = SELF_INT_CYL_V.astype(np.float32)
+        f = SELF_INT_CYL_F.astype(np.int32)
+        seg = int(self_intersection_count_pallas(v, f, algorithm="segment"))
+        mol = int(self_intersection_count_pallas(v, f, algorithm="moller"))
+        assert seg == mol == 2 * 8

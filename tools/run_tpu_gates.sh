@@ -65,9 +65,13 @@ for n in 1 2 3 4 5 6; do
     fi
 done
 
-echo "=== gate 4: tile sweeps (VPU production grid, then MXU hypothesis) ==="
-for sweep in "" "--mxu"; do
-    name="sweep${sweep:+_mxu}"
+echo "=== gate 4: tile sweeps (VPU grid, MXU hypothesis, tri-tri tiles) ==="
+for sweep in "" "--mxu" "--tri-tri"; do
+    case "$sweep" in
+        --mxu) name=sweep_mxu ;;
+        --tri-tri) name=sweep_tritri ;;
+        *) name=sweep ;;
+    esac
     echo "--- tile_sweep $sweep (log: $LOGDIR/$name.log) ---"
     if python -u benchmarks/tile_sweep.py $sweep 2>&1 \
             | tee "$LOGDIR/$name.log"; then
