@@ -476,11 +476,12 @@ def config6():
         )
         from mesh_tpu.query.pallas_culled import closest_point_pallas_culled
 
-        # mirror the facade dispatch: brute runs with the data-derived
-        # nondegeneracy flag (culled.py does the same check)
-        brute = _partial(closest_point_pallas,
-                         assume_nondegenerate=mesh_is_nondegenerate(v, f))
-        culled = closest_point_pallas_culled
+        # mirror the facade dispatch: both kernels run with the
+        # data-derived nondegeneracy flag (culled.py does the same check)
+        _nd = mesh_is_nondegenerate(v, f)
+        brute = _partial(closest_point_pallas, assume_nondegenerate=_nd)
+        culled = _partial(closest_point_pallas_culled,
+                          assume_nondegenerate=_nd)
     else:
         brute = closest_faces_and_points
         culled = closest_faces_and_points_culled

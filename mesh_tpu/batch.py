@@ -92,7 +92,8 @@ def _batch_step(vs, fj, pts, use_pallas, use_culled, chunk, with_normals,
         # and it takes the [B, V, 3] batch natively — no vmap lift needed
         from .query.pallas_culled import closest_point_pallas_culled
 
-        res = closest_point_pallas_culled(vs, fj, pts)
+        res = closest_point_pallas_culled(
+            vs, fj, pts, assume_nondegenerate=nondegen)
     elif use_pallas:
         # vmap lifts the Pallas grid to a batch dimension: one kernel
         # launch for all B meshes (same shape as bench.py's fused step)
@@ -134,12 +135,12 @@ def batched_vertex_normals(meshes):
     return np.asarray(normals, np.float64)
 
 
-def _batch_nondegen(v_host, f, use_pallas, use_culled):
-    """Data-derived assume_nondegenerate flag for the vmapped brute kernel
-    (pallas_closest._ericson_tail): checked from the HOST copy of the
-    batch at the numpy boundary, so no device readback is paid.  Only the
-    brute Pallas path consumes it."""
-    if not use_pallas or use_culled:
+def _batch_nondegen(v_host, f, use_pallas):
+    """Data-derived assume_nondegenerate flag for the Pallas query tiles
+    (pallas_closest._ericson_tail, brute and culled): checked from the
+    HOST copy of the batch at the numpy boundary, so no device readback
+    is paid."""
+    if not use_pallas:
         return False
     from .query.pallas_closest import mesh_is_nondegenerate
 
@@ -172,7 +173,7 @@ def batched_closest_faces_and_points(meshes, points, chunk=512):
     _, res = _batch_step(
         jnp.asarray(v), jnp.asarray(f), jnp.asarray(pts),
         use_pallas, use_culled, chunk, False,
-        nondegen=_batch_nondegen(v, f, use_pallas, use_culled),
+        nondegen=_batch_nondegen(v, f, use_pallas),
     )
     faces = np.asarray(res["face"]).astype(np.uint32)[:, None, :]
     return faces, np.asarray(res["point"], np.float64)
@@ -273,7 +274,7 @@ def fused_normals_and_closest_points(meshes, points, chunk=512):
     use_pallas, use_culled = _strategy(fs)
     normals, res = _batch_step(
         vs, fs, jnp.asarray(pts), use_pallas, use_culled, chunk, True,
-        nondegen=_batch_nondegen(v_host, f_host, use_pallas, use_culled),
+        nondegen=_batch_nondegen(v_host, f_host, use_pallas),
     )
     normals = np.asarray(normals, np.float64)
     faces = np.asarray(res["face"]).astype(np.uint32)[:, None, :]

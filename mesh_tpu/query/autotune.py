@@ -131,12 +131,12 @@ def calibrate_crossover(ladder=(8192, 16384, 32768, 65536, 131072),
         from .pallas_closest import closest_point_pallas
         from .pallas_culled import closest_point_pallas_culled
 
-        # mirror the facade dispatch (culled.py): the brute kernel runs
-        # with the nondegeneracy flag the facade would derive for the
-        # calibration mesh (a sphere — always nondegenerate), the culled
-        # kernel with its production configuration
+        # mirror the facade dispatch (culled.py): both kernels run with
+        # the nondegeneracy flag the facade would derive for the
+        # calibration mesh (a sphere — always nondegenerate)
         brute = partial(closest_point_pallas, assume_nondegenerate=True)
-        culled = closest_point_pallas_culled
+        culled = partial(closest_point_pallas_culled,
+                         assume_nondegenerate=True)
     else:
         from .culled import closest_faces_and_points_culled
 

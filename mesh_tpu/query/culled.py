@@ -136,18 +136,22 @@ def closest_faces_and_points_auto(
 
         v32 = np.asarray(v, np.float32)
         pts32 = np.asarray(points, np.float32).reshape(-1, 3)
+        # the numpy boundary is the one place the nondegeneracy flag can
+        # be asserted from data: meshes whose every face clears the
+        # relative area cut compile their tile without its
+        # degenerate-face override (~25% fewer VPU ops, bit-identical
+        # results — pallas_closest._ericson_tail); content-crc cached
+        nondegen = mesh_is_nondegenerate(v32, f)
         if f.shape[0] <= brute_force_max_faces:
-            # the numpy boundary is the one place the nondegeneracy flag
-            # can be asserted from data: meshes whose every face clears
-            # the relative area cut compile the tile without its
-            # degenerate-face override (~25% fewer VPU ops, bit-identical
-            # results — pallas_closest._ericson_tail)
             res = closest_point_pallas(
                 v32, f.astype(np.int32), pts32,
-                assume_nondegenerate=mesh_is_nondegenerate(v32, f),
+                assume_nondegenerate=nondegen,
             )
         else:
-            res = closest_point_pallas_culled(v32, f.astype(np.int32), pts32)
+            res = closest_point_pallas_culled(
+                v32, f.astype(np.int32), pts32,
+                assume_nondegenerate=nondegen,
+            )
         return {key: np.asarray(val) for key, val in res.items()}
     if f.shape[0] <= brute_force_max_faces:
         res = closest_faces_and_points(v, f, points)

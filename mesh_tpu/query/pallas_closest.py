@@ -181,8 +181,19 @@ def mesh_is_nondegenerate(v, f, margin=100.0):
     from data rather than assumed.  Results are cached by content crc, so
     per-call facade dispatch on an unchanged mesh costs O(bytes) crc
     rather than the O(F) geometric check.
+
+    ``MESH_TPU_SAFE_TILES=1`` makes this always return False — the
+    escape hatch that pins every facade to the safe tile variants
+    (degenerate-tail closest point, segment tri-tri) should a fast tile
+    misbehave on a new backend, mirroring MESH_TPU_FORCE_XLA one level
+    down.
     """
     import zlib
+
+    from ..utils.dispatch import safe_tiles
+
+    if safe_tiles():
+        return False
 
     v = np.ascontiguousarray(np.asarray(v))
     f = np.ascontiguousarray(np.asarray(f))

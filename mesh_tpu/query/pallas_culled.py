@@ -133,54 +133,78 @@ def _prologue(vc, f, pts, tile_q, tile_f):
     }
 
 
-def _culled_kernel(*refs):
-    qsph, fsph, seed, px, py, pz = refs[:6]
-    face_refs = refs[6:6 + N_FACE_ROWS]
-    out_i, acc_d, acc_i, worst = refs[6 + N_FACE_ROWS:]
-    i = pl.program_id(1)
-    j = pl.program_id(2)
-    n_j = pl.num_programs(2)
+def _make_culled_kernel(degenerate_tail):
+    """The culled argmin kernel, with the exact tile's degenerate-face
+    override compile-time optional (pallas_closest._ericson_tail): the
+    tail-free variant is bit-identical when every face clears the
+    relative area cut — the facade gates on mesh_is_nondegenerate, same
+    as the brute kernel."""
 
-    @pl.when(j == 0)
-    def _init():
-        acc_d[:] = seed[0]
-        acc_i[:] = jnp.zeros_like(acc_i)
-        worst[0] = jnp.max(seed[0])
+    def kernel(*refs):
+        qsph, fsph, seed, px, py, pz = refs[:6]
+        face_refs = refs[6:6 + N_FACE_ROWS]
+        out_i, acc_d, acc_i, worst = refs[6 + N_FACE_ROWS:]
+        i = pl.program_id(1)
+        j = pl.program_id(2)
+        n_j = pl.num_programs(2)
 
-    # sphere-to-sphere lower bound from SMEM tile metadata (scalar ALU
-    # only); the metadata blocks are per-batch rows, so the batch index
-    # is already applied by the BlockSpec
-    dx = qsph[0, i, 0] - fsph[0, j, 0]
-    dy = qsph[0, i, 1] - fsph[0, j, 1]
-    dz = qsph[0, i, 2] - fsph[0, j, 2]
-    dist = jnp.sqrt(dx * dx + dy * dy + dz * dz)
-    lb = jnp.maximum(dist - qsph[0, i, 3] - fsph[0, j, 3], 0.0) * (1.0 - _MARGIN)
+        @pl.when(j == 0)
+        def _init():
+            acc_d[:] = seed[0]
+            acc_i[:] = jnp.zeros_like(acc_i)
+            worst[0] = jnp.max(seed[0])
 
-    @pl.when(lb * lb <= worst[0])
-    def _exact_tile():
-        d2 = _sqdist_tile_fast(
-            px[0], py[0], pz[0], *[r[0] for r in face_refs]
-        )  # (TQ, TF)
-        tf = d2.shape[1]
-        tile_min = jnp.min(d2, axis=1, keepdims=True)
-        tile_arg = jnp.argmin(d2, axis=1).astype(jnp.int32)[:, None] + j * tf
-        better = tile_min < acc_d[:]
-        acc_d[:] = jnp.where(better, tile_min, acc_d[:])
-        acc_i[:] = jnp.where(better, tile_arg, acc_i[:])
-        worst[0] = jnp.max(acc_d[:])
+        # sphere-to-sphere lower bound from SMEM tile metadata (scalar ALU
+        # only); the metadata blocks are per-batch rows, so the batch index
+        # is already applied by the BlockSpec
+        dx = qsph[0, i, 0] - fsph[0, j, 0]
+        dy = qsph[0, i, 1] - fsph[0, j, 1]
+        dz = qsph[0, i, 2] - fsph[0, j, 2]
+        dist = jnp.sqrt(dx * dx + dy * dy + dz * dz)
+        lb = jnp.maximum(
+            dist - qsph[0, i, 3] - fsph[0, j, 3], 0.0) * (1.0 - _MARGIN)
 
-    @pl.when(j == n_j - 1)
-    def _write():
-        out_i[0] = acc_i[:]
+        @pl.when(lb * lb <= worst[0])
+        def _exact_tile():
+            d2 = _sqdist_tile_fast(
+                px[0], py[0], pz[0], *[r[0] for r in face_refs],
+                degenerate_tail=degenerate_tail,
+            )  # (TQ, TF)
+            tf = d2.shape[1]
+            tile_min = jnp.min(d2, axis=1, keepdims=True)
+            tile_arg = (
+                jnp.argmin(d2, axis=1).astype(jnp.int32)[:, None] + j * tf
+            )
+            better = tile_min < acc_d[:]
+            acc_d[:] = jnp.where(better, tile_min, acc_d[:])
+            acc_i[:] = jnp.where(better, tile_arg, acc_i[:])
+            worst[0] = jnp.max(acc_d[:])
+
+        @pl.when(j == n_j - 1)
+        def _write():
+            out_i[0] = acc_i[:]
+
+    return kernel
 
 
-@partial(jax.jit, static_argnames=("tile_q", "tile_f", "interpret"))
+_culled_kernel = _make_culled_kernel(degenerate_tail=True)
+_culled_kernel_nodegen = _make_culled_kernel(degenerate_tail=False)
+
+
+@partial(jax.jit, static_argnames=("tile_q", "tile_f", "interpret",
+                                   "assume_nondegenerate"))
 def closest_point_pallas_culled(
-    v, f, points, tile_q=256, tile_f=1024, interpret=False
+    v, f, points, tile_q=256, tile_f=1024, interpret=False,
+    assume_nondegenerate=False,
 ):
     """Culled closest_faces_and_points on TPU.  Same contract as
     query.closest_faces_and_points; ``v`` [V, 3] or batched [B, V, 3] with
     ``points`` [Q, 3] resp. [B, Q, 3].  Exact (up to distance ties).
+
+    ``assume_nondegenerate=True`` drops the exact tile's degenerate-face
+    override (same contract as closest_point_pallas: bit-identical when
+    every face clears the relative area cut; the facades derive the flag
+    from data via mesh_is_nondegenerate).
     """
     v = jnp.asarray(v, jnp.float32)
     points = jnp.asarray(points, jnp.float32)
@@ -229,7 +253,7 @@ def closest_point_pallas_culled(
     frow_spec = pl.BlockSpec((1, 1, tile_f), lambda b, i, j: (b, 0, j))
 
     out_i = pl.pallas_call(
-        _culled_kernel,
+        _culled_kernel_nodegen if assume_nondegenerate else _culled_kernel,
         grid=grid,
         in_specs=[
             qsph_spec,

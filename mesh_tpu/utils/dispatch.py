@@ -15,13 +15,27 @@ import os
 
 import jax
 
-__all__ = ["force_xla", "pallas_default", "mesh_on_tpu"]
+__all__ = ["env_flag", "force_xla", "safe_tiles", "pallas_default",
+           "mesh_on_tpu"]
+
+
+def env_flag(name):
+    """Shared truthiness for the escape-hatch env vars: unset, '', '0',
+    'false', 'no', 'off' are all OFF (so '=0' disables, not enables)."""
+    value = os.environ.get(name, "").strip().lower()
+    return value not in ("", "0", "false", "no", "off")
 
 
 def force_xla():
     """True when MESH_TPU_FORCE_XLA requests the XLA paths everywhere."""
-    value = os.environ.get("MESH_TPU_FORCE_XLA", "").strip().lower()
-    return value not in ("", "0", "false", "no", "off")
+    return env_flag("MESH_TPU_FORCE_XLA")
+
+
+def safe_tiles():
+    """True when MESH_TPU_SAFE_TILES pins the Pallas kernels to their
+    safe tile variants (degenerate-tail closest point, segment tri-tri)
+    by forcing the data-derived nondegeneracy check to False."""
+    return env_flag("MESH_TPU_SAFE_TILES")
 
 
 def pallas_default():

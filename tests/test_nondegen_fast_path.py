@@ -64,6 +64,33 @@ def test_flag_is_bit_identical_on_clean_mesh():
                                   np.asarray(fast["part"]))
 
 
+def test_safe_tiles_escape_hatch(monkeypatch):
+    # MESH_TPU_SAFE_TILES pins every facade to the safe tile variants:
+    # the staging check reports False regardless of geometry (and must
+    # not poison the content cache for later un-hatched calls)
+    v, f = _sphere()
+    monkeypatch.setenv("MESH_TPU_SAFE_TILES", "1")
+    assert not mesh_is_nondegenerate(v, f)
+    monkeypatch.delenv("MESH_TPU_SAFE_TILES")
+    assert mesh_is_nondegenerate(v, f)
+
+
+def test_culled_flag_is_bit_identical_on_clean_mesh():
+    from mesh_tpu.query.pallas_culled import closest_point_pallas_culled
+
+    v, f = _sphere()
+    rng = np.random.RandomState(4)
+    pts = rng.randn(300, 3).astype(np.float32)
+    base = closest_point_pallas_culled(v, f, pts, tile_q=64, tile_f=128,
+                                       interpret=True)
+    fast = closest_point_pallas_culled(v, f, pts, tile_q=64, tile_f=128,
+                                       interpret=True,
+                                       assume_nondegenerate=True)
+    for key in ("face", "sqdist", "point", "part"):
+        np.testing.assert_array_equal(np.asarray(base[key]),
+                                      np.asarray(fast[key]))
+
+
 def test_flag_reported_distance_still_exact_with_degenerates():
     # with the flag WRONGLY set on a degenerate mesh, the winner may be a
     # different face, but the epilogue still reports the winner's exact

@@ -520,3 +520,21 @@ class TestMollerTriTriCompiled:
         seg = int(self_intersection_count_pallas(v, f, algorithm="segment"))
         mol = int(self_intersection_count_pallas(v, f, algorithm="moller"))
         assert seg == mol == 2 * 8
+
+    @requires_tpu
+    def test_culled_flag_parity_compiled(self):
+        from mesh_tpu.query.pallas_culled import closest_point_pallas_culled
+        from mesh_tpu.sphere import _icosphere
+
+        v, f = _icosphere(3)
+        v = v.astype(np.float32)
+        f = f.astype(np.int32)
+        rng = np.random.RandomState(1)
+        pts = rng.randn(1024, 3).astype(np.float32)
+        base = closest_point_pallas_culled(v, f, pts)
+        fast = closest_point_pallas_culled(v, f, pts,
+                                           assume_nondegenerate=True)
+        np.testing.assert_array_equal(np.asarray(base["face"]),
+                                      np.asarray(fast["face"]))
+        np.testing.assert_array_equal(np.asarray(base["sqdist"]),
+                                      np.asarray(fast["sqdist"]))
