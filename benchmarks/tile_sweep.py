@@ -59,13 +59,12 @@ def _closest_point_sweep(args):
     )
 
     v, f = _sphere_mesh(args.faces)
-    if args.mxu:
-        kernel = closest_point_pallas_mxu
-    else:
-        # sweep the tile the production facade would compile for this mesh
-        kernel = partial(
-            closest_point_pallas,
-            assume_nondegenerate=mesh_is_nondegenerate(v, f))
+    # sweep the tile variant the production facade would compile for this
+    # mesh (best-vs-best between the MXU and VPU families)
+    nondegen = mesh_is_nondegenerate(v, f)
+    kernel = partial(
+        closest_point_pallas_mxu if args.mxu else closest_point_pallas,
+        assume_nondegenerate=nondegen)
     rng = np.random.RandomState(0)
     pts = rng.randn(args.queries, 3).astype(np.float32)
 

@@ -489,7 +489,8 @@ N_FACE_ROWS_MXU = 11
 
 
 def _sqdist_tile_mxu(p, p2, g, a_ab, a_ac, a_n, a2,
-                     ab2, ac2, abac, inv_ab2, inv_ac2, inv_bc2, inv_n2):
+                     ab2, ac2, abac, inv_ab2, inv_ac2, inv_bc2, inv_n2,
+                     degenerate_tail=True):
     tf = a_ab.shape[1]
     pg = jax.lax.dot_general(
         p, g, dimension_numbers=(((1,), (0,)), ((), ())),
@@ -502,10 +503,13 @@ def _sqdist_tile_mxu(p, p2, g, a_ab, a_ac, a_n, a2,
     pa = pg[:, 3 * tf:]
     ap2 = jnp.maximum(p2 - (pa + pa) + a2, 0.0)
     return _ericson_tail(d1, d2, ap2, n_ap, ab2, ac2, abac,
-                         inv_ab2, inv_ac2, inv_bc2, inv_n2)
+                         inv_ab2, inv_ac2, inv_bc2, inv_n2,
+                         degenerate_tail=degenerate_tail)
 
 
 _kernel_mxu = make_argmin_kernel(_sqdist_tile_mxu)
+_kernel_mxu_nodegen = make_argmin_kernel(
+    partial(_sqdist_tile_mxu, degenerate_tail=False))
 
 
 def _mxu_face_inputs(tri, tile_f):
@@ -548,11 +552,12 @@ def _mxu_face_inputs(tri, tile_f):
     return g, planes
 
 
-@partial(jax.jit, static_argnames=("tile_q", "tile_f", "interpret"))
+@partial(jax.jit, static_argnames=("tile_q", "tile_f", "interpret",
+                                   "assume_nondegenerate"))
 def closest_point_pallas_mxu(v, f, points, tile_q=256, tile_f=2048,
-                             interpret=False):
-    """Experimental MXU-fed closest_faces_and_points; same contract as
-    closest_point_pallas."""
+                             interpret=False, assume_nondegenerate=False):
+    """Experimental MXU-fed closest_faces_and_points; same contract (and
+    ``assume_nondegenerate`` semantics) as closest_point_pallas."""
     vc_, pts, center, tri = _center_inputs(v, f, points)
     n_q = pts.shape[0]
 
@@ -564,7 +569,7 @@ def closest_point_pallas_mxu(v, f, points, tile_q=256, tile_f=2048,
     grid = (q_pad // tile_q, f_pad // tile_f)
 
     out_i = pl.pallas_call(
-        _kernel_mxu,
+        _kernel_mxu_nodegen if assume_nondegenerate else _kernel_mxu,
         grid=grid,
         in_specs=[
             pl.BlockSpec((tile_q, 3), lambda i, j: (i, 0)),

@@ -91,6 +91,22 @@ def test_culled_flag_is_bit_identical_on_clean_mesh():
                                       np.asarray(fast[key]))
 
 
+def test_mxu_flag_is_bit_identical_on_clean_mesh():
+    from mesh_tpu.query.pallas_closest import closest_point_pallas_mxu
+
+    v, f = _sphere()
+    rng = np.random.RandomState(5)
+    pts = rng.randn(200, 3).astype(np.float32)
+    base = closest_point_pallas_mxu(v, f, pts, tile_q=64, tile_f=128,
+                                    interpret=True)
+    fast = closest_point_pallas_mxu(v, f, pts, tile_q=64, tile_f=128,
+                                    interpret=True,
+                                    assume_nondegenerate=True)
+    for key in ("face", "sqdist", "point", "part"):
+        np.testing.assert_array_equal(np.asarray(base[key]),
+                                      np.asarray(fast[key]))
+
+
 def test_flag_reported_distance_still_exact_with_degenerates():
     # with the flag WRONGLY set on a degenerate mesh, the winner may be a
     # different face, but the epilogue still reports the winner's exact
