@@ -82,6 +82,16 @@ for sweep in "" "--mxu" "--tri-tri"; do
     fi
 done
 
+echo "=== gate 5: kernel trace for the north-star config (limiter analysis) ==="
+# a jax.profiler trace of config 3 (view with tensorboard/xprof); failure
+# here is non-fatal — the trace is analysis material, not a measurement
+if python -u benchmarks/run_all.py --configs 3 --trace "$LOGDIR/trace" 2>&1 \
+        | tee "$LOGDIR/gate5_trace.log"; then
+    echo "trace written under $LOGDIR/trace"
+else
+    echo "gate 5 trace capture failed (rc=$?) — continuing (non-fatal)"
+fi
+
 if [ "$fail" != 0 ]; then
     echo "=== gates FINISHED WITH FAILURES (see above; logs in $LOGDIR) ==="
     exit 1
