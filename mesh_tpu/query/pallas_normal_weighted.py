@@ -27,26 +27,31 @@ from .pallas_closest import (
 from .point_triangle import closest_point_on_triangle
 
 
-def _nw_cost_tile(eps, *planes):
+def _nw_cost_tile(eps, degenerate_tail, *planes):
     """Blended-metric cost on a (TQ, TF) tile: plugged into the shared
     make_argmin_kernel scaffold (init/merge/write semantics live there)."""
     (px, py, pz, qnx, qny, qnz) = planes[:6]
     face_planes = planes[6:6 + N_FACE_ROWS]
     tnx, tny, tnz = planes[6 + N_FACE_ROWS:]
-    d2 = _sqdist_tile_fast(px, py, pz, *face_planes)  # (TQ, TF)
+    d2 = _sqdist_tile_fast(px, py, pz, *face_planes,
+                           degenerate_tail=degenerate_tail)  # (TQ, TF)
     ndot = qnx * tnx + qny * tny + qnz * tnz
     return jnp.sqrt(d2) + eps * (1.0 - ndot)
 
 
-@partial(jax.jit, static_argnames=("eps", "tile_q", "tile_f", "interpret"))
+@partial(jax.jit, static_argnames=("eps", "tile_q", "tile_f", "interpret",
+                                   "assume_nondegenerate"))
 def nearest_normal_weighted_pallas(v, f, points, normals, eps=0.1,
-                                   tile_q=256, tile_f=2048, interpret=False):
+                                   tile_q=256, tile_f=2048, interpret=False,
+                                   assume_nondegenerate=False):
     """Pallas-accelerated AabbNormalsTree.nearest.
 
     Same contract as normal_weighted.nearest_normal_weighted: returns
     ``(face [Q] int32, point [Q, 3])`` minimizing the blended metric.  Query
     normals are used as given (the reference does not normalize them,
     search.py:96-100); triangle normals are unit.
+    ``assume_nondegenerate`` has the closest_point_pallas semantics (the
+    facade derives it from data via mesh_is_nondegenerate).
     """
     v = jnp.asarray(v, jnp.float32)
     points = jnp.asarray(points, jnp.float32)
@@ -71,7 +76,8 @@ def nearest_normal_weighted_pallas(v, f, points, normals, eps=0.1,
 
     out_i = pl.pallas_call(
         # static python float eps: baked literal, one kernel per value
-        make_argmin_kernel(partial(_nw_cost_tile, float(eps))),
+        make_argmin_kernel(partial(_nw_cost_tile, float(eps),
+                                   not assume_nondegenerate)),
         grid=grid,
         in_specs=[
             *[pl.BlockSpec((tile_q, 1), lambda i, j: (i, 0)) for _ in range(6)],

@@ -149,12 +149,14 @@ class AabbNormalsTree(object):
         pts = np.asarray(v_samples, np.float32).reshape(-1, 3)
         nrm = np.asarray(n_samples, np.float32).reshape(-1, 3)
         if pallas_default():
+            from .query.pallas_closest import mesh_is_nondegenerate
             from .query.pallas_normal_weighted import (
                 nearest_normal_weighted_pallas,
             )
 
             face, point = nearest_normal_weighted_pallas(
-                self.v, self.f, pts, nrm, eps=float(self.eps)
+                self.v, self.f, pts, nrm, eps=float(self.eps),
+                assume_nondegenerate=mesh_is_nondegenerate(self.v, self.f),
             )
         else:
             face, point = query.nearest_normal_weighted(

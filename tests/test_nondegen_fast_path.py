@@ -107,6 +107,24 @@ def test_mxu_flag_is_bit_identical_on_clean_mesh():
                                       np.asarray(fast[key]))
 
 
+def test_normal_weighted_flag_is_bit_identical_on_clean_mesh():
+    from mesh_tpu.query.pallas_normal_weighted import (
+        nearest_normal_weighted_pallas,
+    )
+
+    v, f = _sphere()
+    rng = np.random.RandomState(6)
+    pts = rng.randn(150, 3).astype(np.float32)
+    nrm = rng.randn(150, 3).astype(np.float32)
+    base = nearest_normal_weighted_pallas(
+        v, f, pts, nrm, eps=0.1, tile_q=64, tile_f=128, interpret=True)
+    fast = nearest_normal_weighted_pallas(
+        v, f, pts, nrm, eps=0.1, tile_q=64, tile_f=128, interpret=True,
+        assume_nondegenerate=True)
+    np.testing.assert_array_equal(np.asarray(base[0]), np.asarray(fast[0]))
+    np.testing.assert_array_equal(np.asarray(base[1]), np.asarray(fast[1]))
+
+
 def test_flag_reported_distance_still_exact_with_degenerates():
     # with the flag WRONGLY set on a degenerate mesh, the winner may be a
     # different face, but the epilogue still reports the winner's exact
