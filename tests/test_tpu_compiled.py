@@ -538,3 +538,22 @@ class TestMollerTriTriCompiled:
                                       np.asarray(fast["face"]))
         np.testing.assert_array_equal(np.asarray(base["sqdist"]),
                                       np.asarray(fast["sqdist"]))
+
+    @requires_tpu
+    def test_normal_weighted_flag_parity_compiled(self):
+        from mesh_tpu.query.pallas_normal_weighted import (
+            nearest_normal_weighted_pallas,
+        )
+        from mesh_tpu.sphere import _icosphere
+
+        v, f = _icosphere(3)
+        v = v.astype(np.float32)
+        f = f.astype(np.int32)
+        rng = np.random.RandomState(2)
+        pts = rng.randn(512, 3).astype(np.float32)
+        nrm = rng.randn(512, 3).astype(np.float32)
+        base = nearest_normal_weighted_pallas(v, f, pts, nrm, eps=0.1)
+        fast = nearest_normal_weighted_pallas(v, f, pts, nrm, eps=0.1,
+                                              assume_nondegenerate=True)
+        np.testing.assert_array_equal(np.asarray(base[0]),
+                                      np.asarray(fast[0]))
