@@ -288,6 +288,55 @@ def nearest_alongnormal_pallas(v, f, points, normals, tile_q=256,
 # re-sorted before comparing.
 
 
+def moller_prescale(*tris):
+    """Jointly center and scale triangle arrays into the unit box before
+    the Möller interval computation.
+
+    The no-div intervals multiply tolerances through instead of dividing,
+    so the compared terms (``a * XX * YY`` etc., _moller_hit) scale as
+    coordinate-extent^13: raw mm-scale scans (extents ~1e3) overflow f32
+    to inf/NaN, and a NaN endpoint makes ``~((hi1 < lo2) | (hi2 < lo1))``
+    report overlap — spurious intersections for plane-straddling but
+    disjoint pairs (advisor round-4 finding).  Mapping every input to
+    max-abs 1 bounds the degree-13 terms at O(1) for ANY input extent,
+    leaves the per-pair arithmetic graph untouched (so the Pallas/XLA
+    parity tests still pin identical graphs), and puts the fixed EPSILON
+    plane-thickening at the O(1) data scale the published algorithm — and
+    this repo's random battery — assume.  Intersection decisions are
+    scale-invariant (every compared pair of terms shares its degree), so
+    only rounding-level borderline pairs can move.
+
+    All inputs share one (center, scale) — the pair test mixes both
+    meshes, so per-mesh normalization would change the geometry.  Sharing
+    a scale across pairs of very different sizes is safe because
+    _tri_planes normalizes the plane normals: the eps-thickened plane
+    distances scale LINEARLY with the shared scale (not cubically), so a
+    small pair in a large scene is thickened at f32-noise level, never
+    clamped to coplanar.
+
+    f32 representational limit: features smaller than ~1e-7 of the joint
+    scene extent do not survive the centering subtraction itself
+    (ulp(center offset) exceeds their edges) — true of ANY f32 transform
+    of such data, not a prescale artifact.  Pairs in batches spanning
+    more than ~7 orders of magnitude need f64 inputs (the f64 path keeps
+    full precision through the same code).
+    """
+    flats = [t.reshape(-1, 3) for t in tris if t.size]
+    if not flats:
+        # nothing to measure (empty query or face set) — shapes are
+        # static under jit, so plain Python control flow is fine here
+        return tris
+    lo = flats[0].min(axis=0)
+    hi = flats[0].max(axis=0)
+    for c in flats[1:]:
+        lo = jnp.minimum(lo, c.min(axis=0))
+        hi = jnp.maximum(hi, c.max(axis=0))
+    center = (lo + hi) * 0.5
+    m = jnp.max(hi - lo) * 0.5
+    s = jnp.where(m > 0, 1.0 / jnp.maximum(m, 1e-30), 1.0)
+    return tuple((t - center) * s for t in tris)
+
+
 def _moller_intervals(vp0, vp1, vp2, dv0, dv1, dv2, dv0dv1, dv0dv2):
     """(A, B, C, X0, X1, coplanar) of the no-div interval computation for
     one triangle's projections ``vp*`` and plane distances ``dv*``."""
@@ -418,12 +467,36 @@ def _moller_tri_tri_kernel(eps, *refs):
 
 
 def _tri_planes(tri):
-    """Per-triangle Möller quantities: corners, unnormalized normal n,
-    plane offset d = -n.corner0 — hoisted once, like fast_tile_rows."""
+    """Per-triangle Möller quantities: corners, UNIT normal, plane offset
+    d = -n.corner0 — hoisted once, like fast_tile_rows.
+
+    Normalizing the normal (one rsqrt per triangle, hoisted out of the
+    O(Q*F) scan) makes the plane distances in _moller_hit true distances:
+    the fixed eps thickening is then uniform across triangle sizes (small
+    faces of a finely tessellated mesh are not clamped to coplanar), and
+    the interval-overlap terms drop from degree 13 to degree 5 in the
+    coordinate extent, so f32 holds to extents ~1e7 even before
+    moller_prescale's unit-box mapping (advisor round-4 overflow
+    finding).  Degenerate (zero-normal) triangles keep n = 0 -> every
+    plane distance is 0 -> coplanar reject: the documented Möller blind
+    spot, unchanged.  The degeneracy cut is RELATIVE (n2 vs |e1|^2|e2|^2,
+    like fast_tile_rows'): an absolute epsilon would zero the normals of
+    VALID triangles that are merely tiny relative to the prescaled scene
+    (a far outlier in the batch shrinks everyone else), turning real
+    intersections into coplanar rejects."""
     a = tri[..., 0, :]
     e1 = tri[..., 1, :] - a
     e2 = tri[..., 2, :] - a
     n = jnp.cross(e1, e2)
+    n2 = jnp.sum(n * n, axis=-1, keepdims=True)
+    e12 = jnp.sum(e1 * e1, axis=-1, keepdims=True)
+    e22 = jnp.sum(e2 * e2, axis=-1, keepdims=True)
+    # collinear-at-any-scale has n2 ~ (eps_f32 * |e1||e2|)^2 ~ 1e-14 of
+    # e12*e22; 1e-12 sits above that rounding floor with margin
+    degenerate = n2 <= 1e-12 * e12 * e22
+    n = n * jnp.where(
+        degenerate, 0.0, jax.lax.rsqrt(jnp.where(degenerate, 1.0, n2))
+    )
     d = -jnp.sum(n * a, axis=-1)
     return a, tri[..., 1, :], tri[..., 2, :], n, d
 
@@ -589,8 +662,9 @@ def self_intersection_count_pallas(v, f, tile_q=256, tile_f=512,
     n_f = tri.shape[0]
 
     if algorithm == "moller":
-        qcols = _moller_qcols(tri, tile_q)
-        frows = _moller_frows(tri, tile_f)
+        (tri_n,) = moller_prescale(tri)
+        qcols = _moller_qcols(tri_n, tile_q)
+        frows = _moller_frows(tri_n, tile_f)
         n_planes = 13
     elif algorithm == "segment":
         qcols = _query_cols([tri[:, 0], tri[:, 1], tri[:, 2]], tile_q)
@@ -645,8 +719,9 @@ def tri_tri_any_hit_pallas(q_tri, tri, tile_q=256, tile_f=512,
     n_q = q_tri.shape[0]
 
     if algorithm == "moller":
-        qcols = _moller_qcols(q_tri, tile_q)
-        frows = _moller_frows(tri, tile_f)
+        q_tri_n, tri_n = moller_prescale(q_tri, tri)
+        qcols = _moller_qcols(q_tri_n, tile_q)
+        frows = _moller_frows(tri_n, tile_f)
         kernel = partial(_moller_tri_tri_kernel, float(_EPS))
         n_qcols, n_frows = 13, 13
     elif algorithm == "segment":

@@ -172,10 +172,13 @@ def tri_tri_intersects_moller(p, q, eps=_EPS):
     :param p: [..., 3, 3] triangles; :param q: broadcast-compatible
     :returns: boolean [...]
     """
-    from .pallas_ray import _moller_hit, _tri_planes
+    from .pallas_ray import _moller_hit, _tri_planes, moller_prescale
 
     p = jnp.asarray(p)
     q = jnp.asarray(q, p.dtype)
+    # joint unit-box prescale: the interval terms scale as extent^13 and
+    # overflow f32 on mm-scale inputs otherwise (moller_prescale docstring)
+    p, q = moller_prescale(p, q)
     pa, pb, pc, pn, pd = _tri_planes(p)
     qa, qb, qc, qn, qd = _tri_planes(q)
 

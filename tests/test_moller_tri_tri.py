@@ -108,6 +108,110 @@ def test_random_battery_matches_segment_oracle_where_robust():
         % mism32[:10])
 
 
+def test_large_coordinate_extents_no_overflow():
+    # the no-div interval terms scale as extent^13; before the joint
+    # unit-box prescale (pallas_ray.moller_prescale), mm-scale coordinates
+    # (extent ~2e3) overflowed f32 to inf/NaN and NaN interval endpoints
+    # reported overlap — spurious intersections for plane-straddling but
+    # disjoint pairs (advisor round-4).  Every CASES decision must hold
+    # verbatim at extent ~2e3 and with a far-from-origin offset in f32.
+    for scale, offset in ((2e3, 0.0), (1.0, 1e4), (2e3, 5e4)):
+        for p, q, expect in CASES:
+            pf = (np.asarray(p, np.float32) * scale + offset)
+            qf = (np.asarray(q, np.float32) * scale + offset)
+            mol = bool(np.asarray(tri_tri_intersects_moller(
+                jnp.asarray(pf)[None], jnp.asarray(qf)[None]))[0])
+            assert mol == expect, (
+                "moller decision changed at scale %g offset %g" % (
+                    scale, offset))
+
+
+def test_random_battery_at_mm_scale_matches_unit_scale():
+    # scaling is a similarity transform: every decision at extent ~1 must
+    # survive a uniform x2000 (and offset) in f32 — the regime the advisor
+    # flagged.  Uses moller-vs-moller (not the segment oracle) so the only
+    # variable is the coordinate scale.
+    rng = np.random.RandomState(7)
+    n = 2000
+    p = rng.randn(n, 3, 3).astype(np.float32)
+    q = (rng.randn(n, 3, 3) * rng.choice([0.3, 1.0, 3.0], (n, 1, 1))
+         ).astype(np.float32)
+    base = np.asarray(tri_tri_intersects_moller(
+        jnp.asarray(p), jnp.asarray(q)))
+    scaled = np.asarray(tri_tri_intersects_moller(
+        jnp.asarray(p * 2000.0 + 1e4), jnp.asarray(q * 2000.0 + 1e4)))
+    # f32 rounding of (x * 2000 + 1e4) itself perturbs vertices by ~1e-3
+    # relative, so a few borderline pairs may legitimately flip; overflow
+    # flipped ~half of the straddling-disjoint population
+    assert (scaled != base).mean() < 0.005, (
+        "mm-scale decisions diverged from unit-scale on %d/%d pairs"
+        % (int((scaled != base).sum()), n))
+
+
+def test_heterogeneous_batch_no_scale_coupling():
+    # the prescale is shared across the whole batch; with unit plane
+    # normals the shared scale shrinks plane distances only LINEARLY, so
+    # a unit-scale intersecting pair must keep its decision even when a
+    # far-away pair in the same batch blows the joint bbox up to ~1e4
+    # (code-review round-5 scenario: cubic scaling clamped the near pair
+    # below eps and flipped it to coplanar-reject)
+    near_p = np.asarray(CASES[0][0], np.float32)
+    near_q = np.asarray(CASES[0][1], np.float32)
+    far_p = np.asarray(CASES[1][0], np.float32) + 1e4
+    far_q = np.asarray(CASES[1][1], np.float32) + 1e4
+    p = jnp.asarray(np.stack([near_p, far_p]))
+    q = jnp.asarray(np.stack([near_q, far_q]))
+    got = np.asarray(tri_tri_intersects_moller(p, q))
+    assert got[0] == CASES[0][2] and got[1] == CASES[1][2]
+
+
+def test_small_triangles_in_large_scene_not_coplanar_clamped():
+    # fine tessellation: unit-ish triangles in a scene of extent ~2e3
+    # (mm-scale scan).  After the unit-box prescale the triangles are
+    # ~1e-3 of the scene; unit normals keep their plane distances ~1e-3,
+    # far above eps=1e-9 — an intersecting pair must still be seen
+    cross_p = np.asarray(CASES[0][0], np.float32)          # unit pair,
+    cross_q = np.asarray(CASES[0][1], np.float32)          # intersecting
+    anchor = np.float32([[1e3, 1e3, 1e3], [1e3 + 1, 1e3, 1e3],
+                         [1e3, 1e3 + 1, 1e3]])             # stretches bbox
+    p = jnp.asarray(np.stack([cross_p, anchor]))
+    q = jnp.asarray(np.stack([cross_q, anchor + np.float32([0, 0, 9])]))
+    got = np.asarray(tri_tri_intersects_moller(p, q))
+    assert bool(got[0]) is True
+
+
+def test_outlier_does_not_blind_small_pairs():
+    # the degeneracy cut in _tri_planes is RELATIVE (n2 vs |e1|^2|e2|^2),
+    # so a unit pair stays live however the joint prescale shrinks it —
+    # up to f32's representational floor: past ~1e7 relative scene
+    # extent the CENTERING itself quantizes small features away
+    # (ulp(offset) exceeds the edges), which no cutoff choice can save
+    # (documented in moller_prescale).  Assert the whole supported range.
+    near_p = np.asarray(CASES[0][0], np.float32)
+    near_q = np.asarray(CASES[0][1], np.float32)
+    for off in (1e4, 1e5, 3e6):
+        outlier = np.float32([[off, off, off],
+                              [off * 1.001, off, off],
+                              [off, off * 1.001, off]])
+        p = jnp.asarray(np.stack([near_p, outlier]))
+        q = jnp.asarray(np.stack(
+            [near_q, outlier + np.float32([0, 0, off / 10])]))
+        got = np.asarray(tri_tri_intersects_moller(p, q))
+        assert bool(got[0]) is True, (
+            "unit pair blinded by an outlier at %g" % off)
+
+
+def test_empty_inputs():
+    # empty query/face sets must trace and return empty, not crash in the
+    # prescale reduction (code-review round-5 finding)
+    empty = jnp.zeros((0, 3, 3), jnp.float32)
+    tri = jnp.asarray(np.asarray(CASES[0][0], np.float32))[None]
+    assert np.asarray(tri_tri_intersects_moller(empty, empty)).shape == (0,)
+    got = np.asarray(tri_tri_any_hit_pallas(
+        tri, tri, tile_q=8, tile_f=8, interpret=True, algorithm="moller"))
+    assert got.shape == (1,)
+
+
 def test_pallas_matches_xla_moller_exactly():
     # identical arithmetic graph: the Pallas tile and the XLA path both
     # call _moller_hit, so agreement is exact — including on degenerate
