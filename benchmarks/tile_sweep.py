@@ -75,23 +75,39 @@ def _closest_point_sweep(args):
     )
     summary = {"best": best, "n_errors": n_errors}
     if best is not None and not args.mxu:
-        # quantify the degenerate-tail cost on this backend: same kernel,
-        # best tile shape, safe tile (assume_nondegenerate=False) — the
-        # on-chip evidence for the facade's pay-per-use override
-        try:
-            t_safe = time_fn(
-                lambda: closest_point_pallas(
-                    v, f, pts, tile_q=best["tile_q"], tile_f=best["tile_f"],
-                    assume_nondegenerate=False),
-                reps=args.reps,
-            )
-            safe_rate = args.queries / t_safe
-            summary["safe_tile_queries_per_sec"] = round(safe_rate, 1)
-            summary["degenerate_tail_cost_pct"] = round(
-                100.0 * (best["queries_per_sec"] - safe_rate)
-                / best["queries_per_sec"], 1)
-        except Exception as e:
-            summary["safe_tile_error"] = str(e)[:120]
+        # quantify the round-4/5 variant family at the best tile shape —
+        # each row is the on-chip evidence for (or against) one variant:
+        #   degenerate_tail   — the pay-per-use override's cost
+        #                       (gate 4's degenerate_tail_cost_pct)
+        #   sliver_safe       — the direct-corner tile's cost (VERDICT r4
+        #                       #7: price of reference-grade conditioning)
+        #   fused_reduction   — the packed single-pass min+argmin
+        #                       (VERDICT r4 #4: the post-55% lever)
+        def _try(label, **kw):
+            try:
+                t_var = time_fn(
+                    lambda: closest_point_pallas(
+                        v, f, pts, tile_q=best["tile_q"],
+                        tile_f=best["tile_f"], **kw),
+                    reps=args.reps,
+                )
+                rate = args.queries / t_var
+                summary["%s_queries_per_sec" % label] = round(rate, 1)
+                summary["%s_cost_pct" % label] = round(
+                    100.0 * (best["queries_per_sec"] - rate)
+                    / best["queries_per_sec"], 1)
+            except Exception as e:
+                summary["%s_error" % label] = str(e)[:120]
+
+        _try("safe_tile", assume_nondegenerate=False)
+        if "safe_tile_cost_pct" in summary:
+            # gate-4's historical name for this row (harvest_gates reads it)
+            summary["degenerate_tail_cost_pct"] = summary.pop(
+                "safe_tile_cost_pct")
+        _try("sliver_safe", assume_nondegenerate=nondegen,
+             tile_variant="safe")
+        _try("fused_reduction", assume_nondegenerate=nondegen,
+             reduction="fused")
     return summary
 
 

@@ -67,23 +67,21 @@ def stack_mesh_batch(meshes):
     return v, f0.astype(np.int32)
 
 
-def _per_mesh_closest(v, f, pts, use_pallas, chunk, nondegen=False):
-    if use_pallas:
-        from .query.pallas_closest import closest_point_pallas
-
-        return closest_point_pallas(
-            v, f, pts, assume_nondegenerate=nondegen)
-    return closest_faces_and_points(v, f, pts, chunk=chunk)
+# one shared Pallas-vs-XLA dispatch body with the sharded facades
+from .query.closest_point import (  # noqa: E402
+    closest_point_dispatch as _per_mesh_closest,
+)
 
 
 @partial(jax.jit, static_argnames=("use_pallas", "use_culled", "chunk",
-                                   "with_normals", "nondegen"))
+                                   "with_normals", "nondegen", "variant"))
 def _batch_step(vs, fj, pts, use_pallas, use_culled, chunk, with_normals,
-                nondegen=False):
+                nondegen=False, variant="fast"):
     normals = vert_normals(vs, fj) if with_normals else None
 
     def body(v, q):
-        return _per_mesh_closest(v, fj, q, use_pallas, chunk, nondegen)
+        return _per_mesh_closest(v, fj, q, chunk, use_pallas, nondegen,
+                                 variant)
 
     if pts is None:
         res = None
@@ -117,6 +115,13 @@ def _strategy(f):
     use_pallas = pallas_default()
     if not use_pallas:
         return False, False
+    from .utils.dispatch import safe_tiles
+
+    if safe_tiles():
+        # the escape hatch pins the sliver-safe BRUTE tile; the culled
+        # kernel has no safe variant, so it is routed around (correctness
+        # over the cull's large-F speed, like the auto facade)
+        return True, False
     from .query.autotune import crossover_faces
 
     return True, int(f.shape[0]) > crossover_faces()
@@ -170,10 +175,13 @@ def batched_closest_faces_and_points(meshes, points, chunk=512):
     v, f = stack_mesh_batch(meshes)
     pts = _broadcast_points(points, v.shape[0])
     use_pallas, use_culled = _strategy(f)
+    from .utils.dispatch import tile_variant
+
     _, res = _batch_step(
         jnp.asarray(v), jnp.asarray(f), jnp.asarray(pts),
         use_pallas, use_culled, chunk, False,
         nondegen=_batch_nondegen(v, f, use_pallas),
+        variant=tile_variant(),
     )
     faces = np.asarray(res["face"]).astype(np.uint32)[:, None, :]
     return faces, np.asarray(res["point"], np.float64)
@@ -272,9 +280,12 @@ def fused_normals_and_closest_points(meshes, points, chunk=512):
         v_host, f_host = v, f
     pts = _broadcast_points(points, batch)
     use_pallas, use_culled = _strategy(fs)
+    from .utils.dispatch import tile_variant
+
     normals, res = _batch_step(
         vs, fs, jnp.asarray(pts), use_pallas, use_culled, chunk, True,
         nondegen=_batch_nondegen(v_host, f_host, use_pallas),
+        variant=tile_variant(),
     )
     normals = np.asarray(normals, np.float64)
     faces = np.asarray(res["face"]).astype(np.uint32)[:, None, :]

@@ -123,3 +123,19 @@ def _closest_vertices_xla(v, points, chunk=2048):
 def closest_vertices(v, points, chunk=2048):
     """Nearest-vertex indices only (reference ClosestPointTree.nearest)."""
     return closest_vertices_with_distance(v, points, chunk=chunk)[0]
+
+
+def closest_point_dispatch(v, f, pts, chunk=512, use_pallas=False,
+                           nondegen=False, variant="fast"):
+    """The one Pallas-vs-XLA closest-point dispatch body shared by the
+    batched and sharded facades (batch.py, parallel/sharding.py): the
+    Pallas tile — with the staging-derived ``nondegen`` flag and the
+    MESH_TPU_SAFE_TILES ``variant`` — when the caller runs on TPU, the
+    chunked XLA tiling elsewhere.  One body means a new kernel flag is
+    threaded once, not once per facade."""
+    if use_pallas:
+        from .pallas_closest import closest_point_pallas
+
+        return closest_point_pallas(
+            v, f, pts, assume_nondegenerate=nondegen, tile_variant=variant)
+    return closest_faces_and_points(v, f, pts, chunk=chunk)

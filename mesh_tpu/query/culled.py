@@ -142,7 +142,21 @@ def closest_faces_and_points_auto(
         # degenerate-face override (~25% fewer VPU ops, bit-identical
         # results — pallas_closest._ericson_tail); content-crc cached
         nondegen = mesh_is_nondegenerate(v32, f)
-        if f.shape[0] <= brute_force_max_faces:
+        # MESH_TPU_SAFE_TILES pins the sliver-safe direct-corner tile as
+        # well as the degenerate tail (mesh_is_nondegenerate already
+        # returns False under it): untrusted long-edge sliver meshes keep
+        # reference-grade argmin conditioning (_sqdist_tile_safe).  The
+        # culled kernel has no safe variant, so the flag also pins the
+        # brute path at ANY face count — correctness over the cull's
+        # large-F speed is the escape hatch's contract.
+        from ..utils.dispatch import safe_tiles
+
+        if safe_tiles():
+            res = closest_point_pallas(
+                v32, f.astype(np.int32), pts32,
+                assume_nondegenerate=nondegen, tile_variant="safe",
+            )
+        elif f.shape[0] <= brute_force_max_faces:
             res = closest_point_pallas(
                 v32, f.astype(np.int32), pts32,
                 assume_nondegenerate=nondegen,

@@ -104,6 +104,18 @@ def _ericson_tail(d1, d2, ap2, n_ap, ab2, ac2, abac,
     d6 = d2 - ac2
     bp2 = ap2 - (d1 + d1) + ab2
     cp2 = ap2 - (d2 + d2) + ac2
+    return _region_select(d1, d2, d3, d4, d5, d6, ap2, bp2, cp2, n_ap,
+                          ab2, ac2, abac, inv_ab2, inv_ac2, inv_bc2,
+                          inv_n2, degenerate_tail=degenerate_tail)
+
+
+def _region_select(d1, d2, d3, d4, d5, d6, ap2, bp2, cp2, n_ap,
+                   ab2, ac2, abac, inv_ab2, inv_ac2, inv_bc2, inv_n2,
+                   degenerate_tail=True):
+    """Ericson region classification + squared distance from the full set
+    of per-pair dot products — shared by the fast tile (which DERIVES the
+    b/c-corner terms from corner-a quantities) and the sliver-safe tile
+    (which computes each term directly from its own corner difference)."""
     va = d3 * d6 - d5 * d4
     vb = d5 * d2 - d1 * d6
     vc = d1 * d4 - d3 * d2
@@ -162,8 +174,8 @@ def _ericson_tail(d1, d2, ap2, n_ap, ab2, ac2, abac,
 
 #: content-keyed results of mesh_is_nondegenerate: repeated facade calls on
 #: an unchanged mesh (registration loops) must not pay the O(B*F) f64
-#: gather per call — crc the raw bytes instead (same pattern as mesh.py's
-#: crc-validated device-array cache).  Bounded FIFO.
+#: gather per call — digest the raw bytes instead (blake2b, not crc: the
+#: flag gates kernel correctness, see mesh_is_nondegenerate).  Bounded FIFO.
 _NONDEGEN_CACHE = {}
 _NONDEGEN_CACHE_MAX = 64
 
@@ -178,9 +190,11 @@ def mesh_is_nondegenerate(v, f, margin=100.0):
     ``v`` may carry leading batch axes ([..., V, 3]); the answer covers
     every mesh in the batch.  Meant for the numpy-boundary staging points
     (facade dispatch, benchmark setup) where the flag can be asserted
-    from data rather than assumed.  Results are cached by content crc, so
-    per-call facade dispatch on an unchanged mesh costs O(bytes) crc
-    rather than the O(F) geometric check.
+    from data rather than assumed.  Results are cached by a blake2b
+    content digest — the flag is correctness-bearing (it selects a kernel
+    that is wrong on degenerate data), so a 32-bit crc's collision odds
+    were too loose (advisor round-4); the 128-bit digest costs the same
+    O(bytes) pass and makes collisions effectively impossible.
 
     ``MESH_TPU_SAFE_TILES=1`` makes this always return False — the
     escape hatch that pins every facade to the safe tile variants
@@ -188,7 +202,7 @@ def mesh_is_nondegenerate(v, f, margin=100.0):
     misbehave on a new backend, mirroring MESH_TPU_FORCE_XLA one level
     down.
     """
-    import zlib
+    import hashlib
 
     from ..utils.dispatch import safe_tiles
 
@@ -197,8 +211,12 @@ def mesh_is_nondegenerate(v, f, margin=100.0):
 
     v = np.ascontiguousarray(np.asarray(v))
     f = np.ascontiguousarray(np.asarray(f))
+    digest = hashlib.blake2b(digest_size=16)
+    digest.update(v.tobytes())
+    digest.update(b"\0")
+    digest.update(f.tobytes())
     key = (v.shape, f.shape, float(margin), str(v.dtype), str(f.dtype),
-           zlib.crc32(v.tobytes()), zlib.crc32(f.tobytes()))
+           digest.digest())
     hit = _NONDEGEN_CACHE.get(key)
     if hit is not None:
         return hit
@@ -255,9 +273,57 @@ def make_argmin_kernel(cost_tile):
     return kernel
 
 
-_kernel = make_argmin_kernel(_sqdist_tile_fast)
-_kernel_nodegen = make_argmin_kernel(
-    partial(_sqdist_tile_fast, degenerate_tail=False))
+def make_fused_argmin_kernel(cost_tile):
+    """Experimental single-pass fused min+argmin scaffold (VERDICT r4 #4:
+    doc/perf.md names the two-pass tile reduction as the next lever after
+    the degenerate tail).
+
+    Instead of a min pass plus an argmin pass over each (TQ, TF) tile,
+    the cost's f32 bit pattern (monotonic as int32 for the tile's
+    non-negative distances) is masked down by log2(TF) low mantissa bits
+    and OR-ed with the within-tile column index, and ONE int32 min
+    reduction yields both the (quantized) best distance and the winning
+    column; the face-tile index rides in a second (TQ, 1) accumulator
+    updated per tile, not per pair.
+
+    Accuracy contract: faces whose distances agree to within 2^-(23 -
+    log2(TF)) RELATIVE (~2.4e-4 for TF=2048) form a tie group and the
+    lowest packed key — not necessarily the lowest face index — wins; the
+    epilogue still reports the winner's exact distance/point.  That tie
+    radius is far wider than the exact scaffold's, so this kernel is
+    opt-in (``reduction="fused"``) and only becomes a default if the
+    on-chip sweep (tile_sweep.py fused arm) shows a win worth the
+    documented tie semantics.  NaN costs pack to large positive keys and
+    can never win (unlike jnp.min, which would propagate them).
+    """
+
+    def kernel(*refs):
+        ins = refs[:-3]
+        out_i, acc_p, acc_j = refs[-3:]
+        j = pl.program_id(1)
+        n_j = pl.num_programs(1)
+
+        @pl.when(j == 0)
+        def _init():
+            acc_p[:] = jnp.full_like(acc_p, jnp.iinfo(jnp.int32).max)
+            acc_j[:] = jnp.zeros_like(acc_j)
+
+        cost = cost_tile(*[r[:] for r in ins])           # (TQ, TF)
+        tf = cost.shape[1]
+        assert tf & (tf - 1) == 0, "fused reduction wants power-of-two TF"
+        bits = jax.lax.bitcast_convert_type(cost, jnp.int32)
+        col = jax.lax.broadcasted_iota(jnp.int32, cost.shape, 1)
+        packed = (bits & jnp.int32(~(tf - 1))) | col
+        tile_min = jnp.min(packed, axis=1, keepdims=True)
+        better = tile_min < acc_p[:]
+        acc_p[:] = jnp.where(better, tile_min, acc_p[:])
+        acc_j[:] = jnp.where(better, j, acc_j[:])
+
+        @pl.when(j == n_j - 1)
+        def _write():
+            out_i[:] = acc_j[:] * tf + (acc_p[:] & (tf - 1))
+
+    return kernel
 
 
 def _pad_cols(x, multiple, fill):
@@ -336,6 +402,131 @@ def _pad_rows(x, multiple, fill):
     if pad:
         x = jnp.pad(x, ((0, pad), (0, 0)), constant_values=fill)
     return x
+
+
+# ---------------------------------------------------------------------------
+# Sliver-safe tile (VERDICT r4 #7).  The fast tile's long-edge failure
+# mode (tests/test_sliver_numerics.py) is CANCELLATION at the ap2 scale:
+# both its derived corner terms (bp2 = ap2 - 2*d1 + ab2) and every
+# closed-form edge distance (ap2 - t*(2*d1 - t*ab2)) subtract nearly
+# equal ~|ap|^2-sized quantities, so the absolute error is ~ulp(ap2) =
+# eps * length^2 regardless of how small the true distance is.  This
+# tile restores reference-grade conditioning at f32 by
+#
+# - loading the b/c corner planes and computing every dot product and
+#   squared corner distance from its own corner difference, and
+# - computing each clamped edge distance from the RESIDUAL VECTOR
+#   (p - foot point) formed componentwise first and squared second: the
+#   component subtractions cancel benignly (error ~ eps * |t*edge| per
+#   component), so the squared distance's error is ~ eps * length *
+#   |residual| + (eps * length)^2 instead of eps * length^2.
+#
+# Same plane count as the fast tile (19: three corners + unnormalized
+# normal + the seven shared scalars; edges are rebuilt on the cheap
+# (1, TF) broadcast axis), ~+55 VPU ops/pair for the direct dots and the
+# three residual-vector edge distances (which double as the degenerate
+# tail, so the tail costs nothing extra here).  The on-chip price is
+# measured by tile_sweep's sliver_safe arm; `MESH_TPU_SAFE_TILES=1` pins
+# facades to this tile.
+
+
+def _sqdist_tile_safe(px, py, pz,
+                      ax, ay, az, bx, by, bz, cx, cy, cz, nx, ny, nz,
+                      ab2, ac2, abac, inv_ab2, inv_ac2, inv_bc2, inv_n2,
+                      degenerate_tail=True):
+    """Direct-corner, residual-vector Ericson squared distance on a
+    (TQ, TF) tile — the sliver-safe counterpart of _sqdist_tile_fast
+    (same contract; ``degenerate_tail=False`` drops only the final
+    override select, the edge distances themselves are shared)."""
+    # per-face edges from the corner planes: (1, TF) work, amortized by TQ
+    abx, aby, abz = bx - ax, by - ay, bz - az
+    acx, acy, acz = cx - ax, cy - ay, cz - az
+    bcx, bcy, bcz = cx - bx, cy - by, cz - bz
+    apx, apy, apz = px - ax, py - ay, pz - az
+    bpx, bpy, bpz = px - bx, py - by, pz - bz
+    cpx, cpy, cpz = px - cx, py - cy, pz - cz
+    d1 = abx * apx + aby * apy + abz * apz
+    d2 = acx * apx + acy * apy + acz * apz
+    d3 = abx * bpx + aby * bpy + abz * bpz
+    d4 = acx * bpx + acy * bpy + acz * bpz
+    d5 = abx * cpx + aby * cpy + abz * cpz
+    d6 = acx * cpx + acy * cpy + acz * cpz
+    ap2 = apx * apx + apy * apy + apz * apz
+    bp2 = bpx * bpx + bpy * bpy + bpz * bpz
+    cp2 = cpx * cpx + cpy * cpy + cpz * cpz
+    n_ap = nx * apx + ny * apy + nz * apz
+
+    # clamped-foot residual-vector edge distances; inside an edge's
+    # Voronoi region the clamp is the identity, so these serve the edge
+    # regions AND the degenerate tail
+    def seg_sqdist(t, ox_, oy_, oz_, ex_, ey_, ez_):
+        rx = ox_ - t * ex_
+        ry = oy_ - t * ey_
+        rz = oz_ - t * ez_
+        return rx * rx + ry * ry + rz * rz
+
+    e_ab = seg_sqdist(jnp.clip(d1 * inv_ab2, 0.0, 1.0),
+                      apx, apy, apz, abx, aby, abz)
+    e_ca = seg_sqdist(jnp.clip(d2 * inv_ac2, 0.0, 1.0),
+                      apx, apy, apz, acx, acy, acz)
+    d_bc = d4 - d3
+    e_bc = seg_sqdist(jnp.clip(d_bc * inv_bc2, 0.0, 1.0),
+                      bpx, bpy, bpz, bcx, bcy, bcz)
+
+    # same region predicates as _region_select, residual-form distances
+    va = d3 * d6 - d5 * d4
+    vb = d5 * d2 - d1 * d6
+    vc = d1 * d4 - d3 * d2
+    d = n_ap * n_ap * inv_n2
+    d = jnp.where((va <= 0) & (d_bc >= 0) & (d5 - d6 >= 0), e_bc, d)
+    d = jnp.where((vb <= 0) & (d2 >= 0) & (d6 <= 0), e_ca, d)
+    d = jnp.where((vc <= 0) & (d1 >= 0) & (d3 <= 0), e_ab, d)
+    d = jnp.where((d6 >= 0) & (d5 <= d6), cp2, d)
+    d = jnp.where((d3 >= 0) & (d4 <= d3), bp2, d)
+    d = jnp.where((d1 <= 0) & (d2 <= 0), ap2, d)
+    if degenerate_tail:
+        d = jnp.where(
+            inv_n2 > 0, d, jnp.minimum(e_ab, jnp.minimum(e_ca, e_bc))
+        )
+    return jnp.maximum(d, 0.0)
+
+
+#: number of per-face planes `safe_tile_rows` produces (same as fast)
+N_FACE_ROWS_SAFE = 19
+
+
+def safe_tile_rows(tri):
+    """The 19 per-face quantities `_sqdist_tile_safe` consumes, in its
+    face-parameter order: the three corners, the unnormalized normal, and
+    the same seven hoisted scalars as `fast_tile_rows` (rows 12-18 are
+    shared with it)."""
+    a = tri[..., 0, :]
+    b = tri[..., 1, :]
+    c = tri[..., 2, :]
+    n = jnp.cross(b - a, c - a)
+    rows = [
+        a[..., 0], a[..., 1], a[..., 2],
+        b[..., 0], b[..., 1], b[..., 2],
+        c[..., 0], c[..., 1], c[..., 2],
+        n[..., 0], n[..., 1], n[..., 2],
+        *fast_tile_rows(tri)[12:],
+    ]
+    assert len(rows) == N_FACE_ROWS_SAFE
+    return rows
+
+
+def _face_rows_safe(tri, tile_f):
+    """`safe_tile_rows` as padded (1, F_pad) planes.  Padding: every
+    corner plane gets _BIG, so a padded face's corners coincide (edges and
+    all dot products exactly zero — no inf*0 NaNs) while ap2/bp2/cp2
+    overflow to +inf; the region chain always lands on one of those, so a
+    padded face can never win the argmin."""
+    face_rows = safe_tile_rows(tri)
+    fills = [_BIG] * 9 + [0.0] * (len(face_rows) - 9)
+    return [
+        _pad_cols(x[None, :], tile_f, fill)
+        for x, fill in zip(face_rows, fills, strict=True)
+    ]
 
 
 def _vertex_sqdist_tile(px, py, pz, vx, vy, vz):
@@ -419,11 +610,32 @@ def _winner_epilogue(best, tri, pts, center):
     }
 
 
+#: (variant, nondegen, reduction) -> built kernel; kernels are tiny
+#: closures, built once per combination
+_CLOSEST_KERNELS = {}
+
+
+def _closest_kernel(tile_variant, assume_nondegenerate, reduction):
+    key = (tile_variant, bool(assume_nondegenerate), reduction)
+    kernel = _CLOSEST_KERNELS.get(key)
+    if kernel is None:
+        tile = {"fast": _sqdist_tile_fast, "safe": _sqdist_tile_safe}[
+            tile_variant]
+        cost = (partial(tile, degenerate_tail=False)
+                if assume_nondegenerate else tile)
+        make = {"exact": make_argmin_kernel,
+                "fused": make_fused_argmin_kernel}[reduction]
+        kernel = _CLOSEST_KERNELS[key] = make(cost)
+    return kernel
+
+
 @partial(jax.jit,
          static_argnames=("tile_q", "tile_f", "interpret",
-                          "assume_nondegenerate"))
+                          "assume_nondegenerate", "tile_variant",
+                          "reduction"))
 def closest_point_pallas(v, f, points, tile_q=256, tile_f=2048,
-                         interpret=False, assume_nondegenerate=False):
+                         interpret=False, assume_nondegenerate=False,
+                         tile_variant="fast", reduction="exact"):
     """Pallas-accelerated closest_faces_and_points.
 
     Same contract as query.closest_faces_and_points: returns dict with
@@ -436,30 +648,52 @@ def closest_point_pallas(v, f, points, tile_q=256, tile_f=2048,
     verifies this at staging via ``mesh_is_nondegenerate``); with actually
     degenerate faces present the flag can misreport WHICH face is
     closest, never the reported point/distance for the face it picks.
+
+    ``tile_variant="safe"`` selects the sliver-safe direct-corner tile
+    (see _sqdist_tile_safe: no ap2-scale cancellation on long-edged
+    slivers, ~+55 VPU ops/pair); ``MESH_TPU_SAFE_TILES=1`` makes the
+    facades pick it.  ``reduction="fused"`` selects the experimental
+    single-pass packed min+argmin (make_fused_argmin_kernel: wider
+    documented tie radius, measured by the tile sweep's fused arm).
     """
+    if tile_variant not in ("fast", "safe"):
+        raise ValueError("tile_variant must be 'fast' or 'safe', got %r"
+                         % (tile_variant,))
+    if reduction not in ("exact", "fused"):
+        raise ValueError("reduction must be 'exact' or 'fused', got %r"
+                         % (reduction,))
+    if reduction == "fused" and tile_f & (tile_f - 1):
+        # the packed key masks the low log2(tile_f) bits; a non-power-of-
+        # two tile would corrupt cost bits with the OR-ed column index
+        raise ValueError(
+            "reduction='fused' requires a power-of-two tile_f, got %d"
+            % tile_f)
     vc_, pts, center, tri = _center_inputs(v, f, points)
     n_q = pts.shape[0]
 
     p_cols = [_pad_rows(pts[:, k:k + 1], tile_q, 0.0) for k in range(3)]
-    face_rows = _face_rows_fast(tri, tile_f)
+    rows_builder = (_face_rows_fast if tile_variant == "fast"
+                    else _face_rows_safe)
+    face_rows = rows_builder(tri, tile_f)
     q_pad = p_cols[0].shape[0]
     f_pad = face_rows[0].shape[1]
     grid = (q_pad // tile_q, f_pad // tile_f)
+    acc_d_dtype = jnp.float32 if reduction == "exact" else jnp.int32
 
     out_i = pl.pallas_call(
-        _kernel_nodegen if assume_nondegenerate else _kernel,
+        _closest_kernel(tile_variant, assume_nondegenerate, reduction),
         grid=grid,
         in_specs=[
             *[pl.BlockSpec((tile_q, 1), lambda i, j: (i, 0)) for _ in range(3)],
             *[
                 pl.BlockSpec((1, tile_f), lambda i, j: (0, j))
-                for _ in range(N_FACE_ROWS)
+                for _ in range(len(face_rows))
             ],
         ],
         out_specs=pl.BlockSpec((tile_q, 1), lambda i, j: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((q_pad, 1), jnp.int32),
         scratch_shapes=[
-            pltpu.VMEM((tile_q, 1), jnp.float32),
+            pltpu.VMEM((tile_q, 1), acc_d_dtype),
             pltpu.VMEM((tile_q, 1), jnp.int32),
         ],
         compiler_params=pltpu.CompilerParams(

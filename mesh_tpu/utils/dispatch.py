@@ -15,8 +15,8 @@ import os
 
 import jax
 
-__all__ = ["env_flag", "force_xla", "safe_tiles", "pallas_default",
-           "mesh_on_tpu"]
+__all__ = ["env_flag", "force_xla", "safe_tiles", "tile_variant",
+           "pallas_default", "mesh_on_tpu"]
 
 
 def env_flag(name):
@@ -33,9 +33,19 @@ def force_xla():
 
 def safe_tiles():
     """True when MESH_TPU_SAFE_TILES pins the Pallas kernels to their
-    safe tile variants (degenerate-tail closest point, segment tri-tri)
-    by forcing the data-derived nondegeneracy check to False."""
+    safe tile variants (sliver-safe + degenerate-tail closest point,
+    segment tri-tri) by forcing the data-derived nondegeneracy check to
+    False and routing every closest-point facade to the sliver-safe
+    brute tile (tile_variant below)."""
     return env_flag("MESH_TPU_SAFE_TILES")
+
+
+def tile_variant():
+    """The closest-point tile the facades should compile: ``"safe"``
+    (sliver-safe direct-corner tile) under MESH_TPU_SAFE_TILES, else
+    ``"fast"``.  Threaded through the auto, batched, sharded, and
+    multi-host facades so the escape hatch reaches every entry point."""
+    return "safe" if safe_tiles() else "fast"
 
 
 def pallas_default():

@@ -45,3 +45,17 @@ def cylinder(n=16, radius=1.0, height=2.0):
         f.append([i, j, n + i])
         f.append([j, n + j, n + i])
     return v, np.array(f, dtype=np.uint32)
+
+
+def separated_sphere_queries(n, seed):
+    """Query points clearly inside or outside a unit sphere (r in
+    [0.3, 0.7] or [1.3, 2.0]), away from the surface: the nearest face is
+    then generically unique, so argmin agreement between kernel variants
+    is a meaningful assertion (gaussian points near the surface are
+    near-equidistant to many faces and tie-flip legitimately)."""
+    rng = np.random.RandomState(seed)
+    u = rng.randn(n, 3)
+    u /= np.linalg.norm(u, axis=1, keepdims=True)
+    r = np.where(rng.rand(n) < 0.5,
+                 rng.uniform(1.3, 2.0, n), rng.uniform(0.3, 0.7, n))
+    return (u * r[:, None]).astype(np.float32)

@@ -78,7 +78,7 @@ def _sliver_fan(n_faces, length, width):
     return v, f.astype(np.int32)
 
 
-def _run_case(length, width, seed=0):
+def _run_case(length, width, seed=0, tile_variant="fast"):
     v, f = _sliver_fan(48, length, width)
     rng = np.random.RandomState(seed)
     # queries AT the shared far corners (the cancellation hot spot, each
@@ -90,7 +90,7 @@ def _run_case(length, width, seed=0):
 
     res = closest_point_pallas(
         v.astype(np.float32), f, points, tile_q=8, tile_f=128,
-        interpret=True)
+        interpret=True, tile_variant=tile_variant)
     face = np.asarray(res["face"])
     sqd = np.asarray(res["sqdist"], np.float64)
 
@@ -118,6 +118,32 @@ def test_sliver_fan_reported_distance_and_tieflip_bound(length, width):
     assert excess.max() <= bound, (
         "tie-flip excess %.3e exceeds the documented ulp(ap2) bound %.3e"
         % (excess.max(), bound))
+
+
+@pytest.mark.parametrize("length,width", [(50.0, 1e-4), (200.0, 1e-3)])
+def test_sliver_safe_tile_kills_the_cancellation(length, width):
+    # the sliver-safe tile (VERDICT r4 #7) computes corner distances
+    # directly and edge distances from residual vectors, so its argmin
+    # excess on the SAME adversarial fan drops from the fast tile's
+    # cancellation bound ~eps*length^2 to the residual-form error
+    # ~eps*length*|residual| — 4-5 orders of magnitude at these shapes
+    # (measured: 8.5e-10 vs 2.1e-5 at length=50)
+    face, sqd, d2_all = _run_case(length, width, tile_variant="safe")
+    rows = np.arange(len(face))
+    winner_true = d2_all[rows, face]
+    min_true = d2_all.min(axis=1)
+    excess = winner_true - min_true
+    eps = np.finfo(np.float32).eps
+    residual_bound = 32 * eps * length * (width * 10)
+    fast_bound = 8 * eps * length ** 2
+    assert residual_bound < fast_bound / 100     # the claim being made
+    assert excess.max() <= residual_bound, (
+        "safe-tile excess %.3e exceeds the residual-form bound %.3e "
+        "(fast-tile cancellation bound: %.3e)" % (
+            excess.max(), residual_bound, fast_bound))
+    # and the reported distance is still the winner's true distance
+    np.testing.assert_allclose(
+        sqd, winner_true, atol=1e-5 * max(1.0, length ** 2) * 1e-2)
 
 
 def test_short_edge_control_near_exact_argmin():

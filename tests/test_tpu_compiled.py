@@ -14,6 +14,8 @@ forces a virtual CPU platform) and run via:
 import numpy as np
 import pytest
 
+from tests.fixtures import separated_sphere_queries as _separated_sphere_queries
+
 pytestmark = pytest.mark.tpu
 
 
@@ -36,6 +38,7 @@ def _random_mesh(n_v=200, n_f=380, seed=0):
     v = rng.randn(n_v, 3).astype(np.float32)
     f = rng.randint(0, n_v, size=(n_f, 3)).astype(np.int32)
     return v, f
+
 
 
 @requires_tpu
@@ -538,6 +541,75 @@ class TestMollerTriTriCompiled:
                                       np.asarray(fast["face"]))
         np.testing.assert_array_equal(np.asarray(base["sqdist"]),
                                       np.asarray(fast["sqdist"]))
+
+    @requires_tpu
+    def test_sliver_safe_tile_compiled(self):
+        """The direct-corner sliver-safe tile (round 5), compiled: same
+        distances as the fast tile on clean geometry."""
+        from mesh_tpu.query.pallas_closest import closest_point_pallas
+        from mesh_tpu.sphere import _icosphere
+
+        v, f = _icosphere(3)
+        v = v.astype(np.float32)
+        f = f.astype(np.int32)
+        pts = _separated_sphere_queries(1024, seed=30)
+        fast = closest_point_pallas(v, f, pts)
+        safe = closest_point_pallas(v, f, pts, tile_variant="safe")
+        np.testing.assert_allclose(np.asarray(safe["sqdist"]),
+                                   np.asarray(fast["sqdist"]), atol=1e-6)
+        # flips only in near-edge tie bands (see test_tile_variants)
+        flipped = np.asarray(safe["face"]) != np.asarray(fast["face"])
+        assert flipped.mean() < 0.15, flipped.mean()
+        np.testing.assert_allclose(
+            np.asarray(safe["sqdist"], np.float64)[flipped],
+            np.asarray(fast["sqdist"], np.float64)[flipped],
+            rtol=1e-5, atol=1e-7)
+
+    @requires_tpu
+    def test_fused_reduction_compiled(self):
+        """The packed single-pass min+argmin reduction (round 5),
+        compiled: winners within the documented tie radius of the exact
+        scaffold's, distances exact via the epilogue."""
+        from mesh_tpu.query.pallas_closest import closest_point_pallas
+        from mesh_tpu.sphere import _icosphere
+
+        v, f = _icosphere(3)
+        v = v.astype(np.float32)
+        f = f.astype(np.int32)
+        pts = _separated_sphere_queries(1024, seed=31)
+        exact = closest_point_pallas(v, f, pts, assume_nondegenerate=True)
+        fused = closest_point_pallas(v, f, pts, assume_nondegenerate=True,
+                                     reduction="fused")
+        sq_e = np.asarray(exact["sqdist"], np.float64)
+        sq_f = np.asarray(fused["sqdist"], np.float64)
+        radius = 2.0 ** -(23 - 11)        # tile_f=2048 -> 11 masked bits
+        assert np.all(sq_f <= sq_e * (1 + 4 * radius) + 1e-12)
+        # the tie-radius clause is the contract; the rate check only
+        # guards gross misrouting (flips live in sqrt(radius)-wide
+        # near-edge tie bands, which are sizeable at 11 masked bits)
+        agree = (np.asarray(fused["face"]) == np.asarray(exact["face"])).mean()
+        assert agree > 0.5, agree
+
+    @requires_tpu
+    def test_moller_prescale_large_scale_compiled(self):
+        """mm-scale coordinates through the compiled Möller tile (round-5
+        overflow fix): decisions must match the segment tile, which
+        operates on raw coordinates."""
+        from mesh_tpu.query.pallas_ray import tri_tri_any_hit_pallas
+        from mesh_tpu.sphere import _icosphere
+
+        body_v, body_f = _icosphere(3)
+        hand_v, hand_f = _icosphere(2)
+        hand_v = hand_v * 0.25 + np.array([0.92, 0, 0])
+        scale = np.float32(1.8e3)
+        q_tri = (hand_v.astype(np.float32) * scale)[hand_f]
+        m_tri = (body_v.astype(np.float32) * scale)[body_f]
+        seg = np.asarray(tri_tri_any_hit_pallas(q_tri, m_tri,
+                                                algorithm="segment"))
+        mol = np.asarray(tri_tri_any_hit_pallas(q_tri, m_tri,
+                                                algorithm="moller"))
+        np.testing.assert_array_equal(seg, mol)
+        assert seg.sum() > 0
 
     @requires_tpu
     def test_normal_weighted_flag_parity_compiled(self):

@@ -137,6 +137,14 @@ def render_table(h):
         lines.append("tile %s (%s): best=`%s` n_errors=%s" % (
             sw["sweep"], sw["mtime_utc"], json.dumps(sw.get("best")),
             sw.get("n_errors")))
+        extras = {
+            k: v for k, v in sw.items()
+            if k not in ("sweep", "mtime_utc", "best", "n_errors")
+        }
+        if extras:
+            # the variant rows (degenerate_tail / sliver_safe /
+            # fused_reduction / moller splits) ride in the summary line
+            lines.append("- variants: `%s`" % json.dumps(extras))
     return "\n".join(lines)
 
 

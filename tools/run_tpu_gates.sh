@@ -22,8 +22,10 @@ cd "$(dirname "$0")/.."
 LOGDIR=${LOGDIR:-/tmp/tpu_gates}
 mkdir -p "$LOGDIR"
 # clear prior-cycle logs so a run that stops early can't pass yesterday's
-# rows off as this cycle's harvest
+# rows off as this cycle's harvest; gate 5's profiler traces live under
+# $LOGDIR/trace and accumulate the same way (advisor round-4)
 rm -f "$LOGDIR"/*.log
+rm -rf "$LOGDIR/trace"
 fail=0
 
 echo "=== gate 1: compiled-kernel tests on the real chip ==="
