@@ -4,6 +4,7 @@ from .sharding import (  # noqa: F401
     sharded_closest_faces_and_points,
     sharded_closest_faces_sharded_topology,
     sharded_batched_vert_normals,
+    sharded_batched_visibility,
     sharded_visibility,
 )
 from .checkpoint import restore_fit_state, save_fit_state  # noqa: F401

@@ -162,6 +162,7 @@ def multihost_closest_faces_and_points(v, f, points_local, mesh=None,
     with real processes at SMPL scale in tests/test_multihost.py.
     """
     from ..query.pallas_closest import mesh_is_nondegenerate
+    from ..utils.dispatch import tile_variant
     from .sharding import _closest_shard_fn, _unpack_closest
 
     if mesh is None:
@@ -175,7 +176,8 @@ def multihost_closest_faces_and_points(v, f, points_local, mesh=None,
     points_padded = np.zeros((target, 3), np.float32)
     points_padded[:n_local] = points_local
     out, face = _closest_shard_fn(
-        mesh, axis, chunk, nondegen=mesh_is_nondegenerate(v, f)
+        mesh, axis, chunk, nondegen=mesh_is_nondegenerate(v, f),
+        variant=tile_variant(),
     )(
         replicate_to_mesh(np.asarray(v, np.float32), mesh),
         replicate_to_mesh(np.asarray(f, np.int32), mesh),

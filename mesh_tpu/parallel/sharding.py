@@ -74,25 +74,22 @@ def _pack_local_winner(local, axis, shard_faces):
     return packed, local["face"] + shard_id * shard_faces
 
 
-def _closest_local(v, f, pts, chunk, use_pallas, nondegen=False):
-    """Per-shard closest-point body: the Pallas scan when the shards run
-    on TPU cores (pallas_call composes with shard_map), the XLA tiling
-    elsewhere (the virtual CPU test mesh)."""
-    if use_pallas:
-        from ..query.pallas_closest import closest_point_pallas
-
-        return closest_point_pallas(
-            v, f, pts, assume_nondegenerate=nondegen)
-    return closest_faces_and_points(v, f, pts, chunk=chunk)
+# per-shard closest-point body (Pallas on TPU cores — pallas_call
+# composes with shard_map — XLA tiling on the virtual CPU test mesh);
+# one shared dispatch body with the batched facade, see its docstring
+from ..query.closest_point import (  # noqa: E402
+    closest_point_dispatch as _closest_local,
+)
 
 
 @lru_cache(maxsize=32)
-def _closest_shard_fn(mesh, axis, chunk, nondegen=False):
+def _closest_shard_fn(mesh, axis, chunk, nondegen=False, variant="fast"):
     """Compiled sharded closest-point, cached per (mesh, axis, chunk,
-    nondegen) so repeated calls reuse the executable instead of
+    nondegen, variant) so repeated calls reuse the executable instead of
     retracing.  ``nondegen`` is the data-derived assume_nondegenerate
     flag the host boundary checks (pallas_closest.mesh_is_nondegenerate);
-    it only affects the Pallas tile."""
+    ``variant`` is the MESH_TPU_SAFE_TILES tile choice
+    (dispatch.tile_variant); both only affect the Pallas tile."""
     use_pallas = mesh_on_tpu(mesh)
 
     @partial(
@@ -106,7 +103,7 @@ def _closest_shard_fn(mesh, axis, chunk, nondegen=False):
     )
     def _run(v_rep, f_rep, pts_shard):
         res = _closest_local(v_rep, f_rep, pts_shard, chunk, use_pallas,
-                             nondegen)
+                             nondegen, variant)
         packed = jnp.stack(
             [
                 res["part"].astype(jnp.float32),
@@ -148,9 +145,11 @@ def sharded_closest_faces_and_points(v, f, points, mesh, axis="dp", chunk=512):
     points_padded, pad = _pad_rows(points, n_shards)
 
     from ..query.pallas_closest import mesh_is_nondegenerate
+    from ..utils.dispatch import tile_variant
 
     out, face = _closest_shard_fn(
-        mesh, axis, chunk, nondegen=mesh_is_nondegenerate(v, f)
+        mesh, axis, chunk, nondegen=mesh_is_nondegenerate(v, f),
+        variant=tile_variant(),
     )(
         jnp.asarray(v, jnp.float32), jnp.asarray(f, jnp.int32),
         jax.device_put(
@@ -166,7 +165,7 @@ def sharded_closest_faces_and_points(v, f, points, mesh, axis="dp", chunk=512):
 
 
 @lru_cache(maxsize=32)
-def _closest_fsharded_fn(mesh, axis, chunk):
+def _closest_fsharded_fn(mesh, axis, chunk, variant="fast"):
     """Compiled closest-point with the TRIANGLES sharded across devices.
 
     Each device scans its face shard for every query and the winners merge
@@ -186,7 +185,8 @@ def _closest_fsharded_fn(mesh, axis, chunk):
         check_vma=False,
     )
     def _run(v_rep, f_shard, pts_rep):
-        local = _closest_local(v_rep, f_shard, pts_rep, chunk, use_pallas)
+        local = _closest_local(v_rep, f_shard, pts_rep, chunk, use_pallas,
+                               variant=variant)
         packed, faces_global = _pack_local_winner(
             local, axis, f_shard.shape[0]
         )
@@ -203,7 +203,7 @@ def _closest_fsharded_fn(mesh, axis, chunk):
 
 
 @lru_cache(maxsize=32)
-def _closest_fsharded_ring_fn(mesh, axis, chunk):
+def _closest_fsharded_ring_fn(mesh, axis, chunk, variant="fast"):
     """Ring-merge variant of _closest_fsharded_fn: the per-device winner
     circulates around the ICI ring via `lax.ppermute`, each device folding
     the incoming candidate into its accumulator by lexicographic
@@ -230,7 +230,8 @@ def _closest_fsharded_ring_fn(mesh, axis, chunk):
         check_vma=False,
     )
     def _run(v_rep, f_shard, pts_rep):
-        local = _closest_local(v_rep, f_shard, pts_rep, chunk, use_pallas)
+        local = _closest_local(v_rep, f_shard, pts_rep, chunk, use_pallas,
+                               variant=variant)
         acc = _pack_local_winner(local, axis, f_shard.shape[0])
         perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
 
@@ -286,9 +287,11 @@ def sharded_closest_faces_sharded_topology(v, f, points, mesh, axis="dp",
     # only tie, never beat, the true winner (strict < keeps lowest id)
     f_np, _ = _pad_rows(np.asarray(f, np.int64), n_shards)
 
+    from ..utils.dispatch import tile_variant
+
     fn = (_closest_fsharded_ring_fn if merge == "ring"
           else _closest_fsharded_fn)
-    out, face = fn(mesh, axis, chunk)(
+    out, face = fn(mesh, axis, chunk, variant=tile_variant())(
         jnp.asarray(v, jnp.float32),
         jax.device_put(
             jnp.asarray(f_np, jnp.int32), NamedSharding(mesh, P(axis))
@@ -363,6 +366,70 @@ def sharded_visibility(v, f, cams, n=None, mesh=None, axis="dp",
     vis, ndc = np.asarray(vis), np.asarray(ndc, np.float64)
     if pad:
         vis, ndc = vis[:, :-pad], ndc[:, :-pad]
+    return vis.astype(np.uint32), ndc
+
+
+@lru_cache(maxsize=32)
+def _batched_visibility_shard_fn(mesh, axis, chunk, min_dist):
+    from ..query.visibility import _visibility_local
+
+    use_pallas = mesh_on_tpu(mesh)
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(axis), P(), P()),
+        out_specs=(P(axis), P(axis)),
+        # see _closest_shard_fn: pallas outputs carry no vma annotation
+        check_vma=not use_pallas,
+    )
+    def _run(v_shard, f_rep, cams_rep):
+        def body(v):
+            n = vert_normals(v, f_rep)
+            return _visibility_local(
+                v, v[f_rep], cams_rep, n, None, jnp.float32(min_dist),
+                chunk=chunk, use_pallas=use_pallas,
+            )
+
+        return jax.vmap(body)(v_shard)
+
+    return jax.jit(_run)
+
+
+def sharded_batched_visibility(v_batch, f, cams, mesh, axis="dp",
+                               min_dist=1e-3, chunk=1024):
+    """Batched per-vertex visibility with the MESH BATCH sharded over the
+    device mesh: the one-dispatch B x C x V visibility of
+    batch.batched_vertex_visibility (capability P5) at multi-chip scale
+    (P6) — each device self-occludes its own shard of meshes against the
+    replicated topology and cameras; no collective is needed (the batch
+    axis is embarrassingly parallel).  Area-weighted normals for the
+    n.dir output are computed inside the same dispatch.
+
+    :param v_batch: [B, V, 3] stacked same-topology vertex sets
+    :param f: [F, 3] shared faces
+    :param cams: [C, 3] camera centers shared across the batch
+    :returns: (vis [B, C, V] uint32, n_dot_cam [B, C, V] f64)
+    """
+    v_np = np.asarray(v_batch, np.float32)
+    n_shards = mesh.shape[axis]
+    pad = (-v_np.shape[0]) % n_shards
+    if pad:
+        v_np = np.concatenate([v_np, np.repeat(v_np[-1:], pad, axis=0)])
+    # clamp like sharded_visibility: the XLA body pads each mesh's vertex
+    # axis up to the chunk multiple, so an oversized chunk wastes work
+    chunk = min(chunk, v_np.shape[1])
+    shard = NamedSharding(mesh, P(axis))
+    vis, ndc = _batched_visibility_shard_fn(
+        mesh, axis, chunk, float(min_dist)
+    )(
+        jax.device_put(jnp.asarray(v_np), shard),
+        jnp.asarray(f, jnp.int32),
+        jnp.atleast_2d(jnp.asarray(cams, jnp.float32)),
+    )
+    vis, ndc = np.asarray(vis), np.asarray(ndc, np.float64)
+    if pad:
+        vis, ndc = vis[:-pad], ndc[:-pad]
     return vis.astype(np.uint32), ndc
 
 

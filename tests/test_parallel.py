@@ -193,6 +193,28 @@ class TestShardedQueries:
         )
         np.testing.assert_allclose(out, expected, atol=1e-6)
 
+    @pytest.mark.parametrize("n_meshes", [8, 5])   # even and padded splits
+    def test_batched_visibility_sharded(self, n_meshes):
+        # the mesh BATCH sharded over dp (P5 x P6): parity vs the
+        # replicated one-dispatch batched kernel, incl. a batch size the
+        # device count does not divide (pad + trim path)
+        from mesh_tpu.batch import batched_vertex_visibility
+        from mesh_tpu.parallel import sharded_batched_visibility
+
+        rng = np.random.RandomState(3)
+        v, f = icosphere(2)
+        f = f.astype(np.int32)
+        batch = (
+            v[None] * (1 + 0.1 * rng.rand(n_meshes, 1, 1))
+        ).astype(np.float32)
+        cams = np.array([[0, 0, 4.0], [4.0, 0, 0]], np.float32)
+        mesh = make_device_mesh(8, ("dp",))
+        vis_s, ndc_s = sharded_batched_visibility(batch, f, cams, mesh)
+        vis_r, ndc_r = batched_vertex_visibility((batch, f), cams)
+        assert vis_s.shape == (n_meshes, 2, len(v))
+        np.testing.assert_array_equal(vis_s, vis_r)
+        np.testing.assert_allclose(ndc_s, ndc_r, atol=1e-5)
+
 
 @needs_devices
 class TestDistributedFit:
