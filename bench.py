@@ -29,6 +29,14 @@ def log(*args):
     print(*args, file=sys.stderr, flush=True)
 
 
+def _bench_knobs():
+    """(tile_variant, reduction) for the accelerator query kernel."""
+    return (
+        os.environ.get("MESH_TPU_BENCH_VARIANT", "fast"),
+        os.environ.get("MESH_TPU_BENCH_REDUCTION", "exact"),
+    )
+
+
 def tpu_workload():
     import jax
     import jax.numpy as jnp
@@ -61,11 +69,21 @@ def tpu_workload():
         posed = np.asarray(lbs(model, betas, pose)[0])
         nondegen = mesh_is_nondegenerate(posed, np.asarray(f))
         log("batch nondegenerate:", nondegen)
+        # window-time A/B knobs for the round-5 kernel variants: measure
+        # MESH_TPU_BENCH_REDUCTION=fused / MESH_TPU_BENCH_VARIANT=safe on
+        # the full north-star workload without a code edit.  Non-default
+        # runs are labeled in the JSON record and never overwrite the
+        # headline last-good provenance (see main()).
+        variant, reduction = _bench_knobs()
+        if (variant, reduction) != ("fast", "exact"):
+            log("kernel knobs: tile_variant=%s reduction=%s"
+                % (variant, reduction))
 
         def per_mesh(args):
             v_mesh, q_mesh = args
             res = closest_point_pallas(
-                v_mesh, f, q_mesh, assume_nondegenerate=nondegen)
+                v_mesh, f, q_mesh, assume_nondegenerate=nondegen,
+                tile_variant=variant, reduction=reduction)
             return res["face"], res["point"], res["sqdist"]
     else:
         def per_mesh(args):
@@ -282,6 +300,14 @@ def wedged_record(reason):
         "error": "jax backend probe failed, no fresh measurement "
                  "possible (%s)" % reason,
     }
+    variant, reduction = _bench_knobs()
+    if (variant, reduction) != ("fast", "exact"):
+        # the stale value below (if any) is the DEFAULT-kernel headline;
+        # record what this attempt would have measured so a wedged A/B
+        # run cannot be mistaken for a variant measurement
+        record["kernel_knobs_requested"] = {
+            "tile_variant": variant, "reduction": reduction,
+        }
     try:
         with open(_LAST_GOOD) as fh:
             last_good = json.load(fh)
@@ -337,8 +363,20 @@ def main():
         "vs_baseline": round(vs_baseline, 2),
         "device_absolute": absolute,
     }
+    on_accelerator = jax.devices()[0].platform != "cpu"
+    variant, reduction = _bench_knobs()
+    knobs_default = (variant, reduction) == ("fast", "exact")
+    if not knobs_default:
+        if on_accelerator:
+            result["kernel_knobs"] = {
+                "tile_variant": variant, "reduction": reduction,
+            }
+        else:
+            # the CPU fallback path never reads the knobs — labeling the
+            # record would claim a variant kernel that did not run
+            log("kernel knobs ignored on the CPU fallback path")
     print(json.dumps(result))
-    if jax.devices()[0].platform != "cpu":
+    if on_accelerator and knobs_default:
         # persist the successful on-chip measurement for the wedged-tunnel
         # record above (committed to the repo: provenance, not a live cache)
         try:

@@ -69,6 +69,13 @@ def harvest(logdir):
         if rec.get("metric"):
             out["bench"] = dict(rec, mtime_utc=_mtime_utc(g2))
 
+    out["bench_variants"] = []
+    for path in sorted(glob.glob(os.path.join(logdir, "gate2b*.log"))):
+        for rec in _json_lines(path):
+            if rec.get("metric"):
+                out["bench_variants"].append(
+                    dict(rec, mtime_utc=_mtime_utc(path)))
+
     for path in sorted(glob.glob(os.path.join(logdir, "config*.log"))):
         for rec in _json_lines(path):
             if "suite" in rec or rec.get("metric") is None:
@@ -104,6 +111,28 @@ def render_table(h):
                 "gate 2 (bench.py, %s): %s %s  vs_baseline=%s%s" % (
                     b["mtime_utc"], b.get("value"), b.get("unit", ""),
                     b.get("vs_baseline"), stale))
+    for b in h.get("bench_variants", ()):
+        if b.get("value") is None:
+            lines.append(
+                "gate 2b (bench.py A/B, %s): CAPTURE FAILED — %s" % (
+                    b["mtime_utc"],
+                    b.get("error", "no value, no error recorded")))
+        elif "kernel_knobs" not in b:
+            # a wedged A/B attempt carries the DEFAULT-kernel stale
+            # headline plus kernel_knobs_requested — never render that
+            # value as a variant measurement
+            lines.append(
+                "gate 2b (bench.py A/B requested=%s, %s): NOT MEASURED — "
+                "tunnel wedged; stale value shown is the DEFAULT-kernel "
+                "headline, not an A/B result" % (
+                    json.dumps(b.get("kernel_knobs_requested", {})),
+                    b["mtime_utc"]))
+        else:
+            lines.append(
+                "gate 2b (bench.py A/B %s, %s): %s %s  vs_baseline=%s" % (
+                    json.dumps(b["kernel_knobs"]), b["mtime_utc"],
+                    b.get("value"), b.get("unit", ""),
+                    b.get("vs_baseline")))
     if h["configs"]:
         lines.append("")
         lines.append("| config metric | value | unit | vs CPU | measured (log mtime, UTC) |")
@@ -193,7 +222,8 @@ def main():
 
     h = harvest(logdir)
     print(render_table(h))
-    if not (h["gate1"] or h["bench"] or h["configs"] or h["sweeps"]):
+    if not (h["gate1"] or h["bench"] or h["configs"] or h["sweeps"]
+            or h["bench_variants"]):
         print("nothing harvested from %s" % logdir)
         return 1
     if write:

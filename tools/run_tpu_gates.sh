@@ -54,6 +54,17 @@ else
     fail=1
 fi
 
+echo "=== gate 2b: north-star bench with the fused-reduction knob (A/B) ==="
+# experimental round-5 variant on the FULL workload (the sweep times it at
+# the sweep shape only); never overwrites the headline last-good record
+# (bench.py guards on non-default knobs) and never fails the cycle
+if MESH_TPU_BENCH_REDUCTION=fused python bench.py 2>&1 \
+        | tee "$LOGDIR/gate2b_fused.log"; then
+    :
+else
+    echo "gate 2b (fused knob) FAILED (rc=$?) — non-fatal, continuing"
+fi
+
 echo "=== gate 3: benchmark configs, one process each ==="
 for n in 1 2 3 4 5 6; do
     echo "--- config $n (log: $LOGDIR/config$n.log) ---"
