@@ -53,6 +53,17 @@ def _build_soup(max_v, max_f):
     return build
 
 
+def _oracle_min_sqdist(v, f, pts):
+    """f64 exact min squared distance over all faces, plus the f32-scale
+    tolerance both oracle tests assert against."""
+    tri = v[f].astype(np.float64)
+    _, sq, _ = closest_point_on_triangle(
+        pts.astype(np.float64)[:, None], tri[:, 0], tri[:, 1], tri[:, 2]
+    )
+    scale = max(1.0, float(np.abs(v).max()) ** 2)
+    return np.asarray(sq).min(axis=1), scale
+
+
 @settings(**_SETTINGS)
 @given(_mesh_strategy(), st.integers(0, 2 ** 31 - 1))
 def test_closest_point_matches_f64_oracle(mesh, qseed):
@@ -60,15 +71,37 @@ def test_closest_point_matches_f64_oracle(mesh, qseed):
     rng = np.random.RandomState(qseed % (2 ** 31))
     pts = (rng.randn(8, 3) * np.abs(v).max()).astype(np.float32)
     res = closest_faces_and_points(v, f, pts, chunk=8)
-    # f64 oracle: exact min over all faces
-    tri = v[f].astype(np.float64)
-    _, sq, _ = closest_point_on_triangle(
-        pts.astype(np.float64)[:, None], tri[:, 0], tri[:, 1], tri[:, 2]
-    )
-    oracle = np.asarray(sq).min(axis=1)
+    oracle, scale = _oracle_min_sqdist(v, f, pts)
     got = np.asarray(res["sqdist"], np.float64)
-    scale = max(1.0, float(np.abs(v).max()) ** 2)
     np.testing.assert_allclose(got, oracle, atol=2e-4 * scale, rtol=2e-4)
+
+
+@settings(**_SETTINGS)
+@given(_mesh_strategy(), st.integers(0, 2 ** 31 - 1),
+       st.sampled_from(["fast", "safe"]),
+       st.sampled_from(["exact", "fused"]))
+def test_pallas_tile_variants_match_f64_oracle(mesh, qseed, variant,
+                                               reduction):
+    # the round-5 kernel variants under the same adversarial generator
+    # (degenerate faces, coincident vertices, extreme scales), interpret
+    # mode: reported distance must match the f64 exact minimum within
+    # each variant's documented bound (the fused reduction adds its
+    # 2^-(23-log2(TF)) relative tie radius on top of f32 rounding)
+    from mesh_tpu.query.pallas_closest import closest_point_pallas
+
+    v, f = mesh
+    rng = np.random.RandomState(qseed % (2 ** 31))
+    pts = (rng.randn(8, 3) * np.abs(v).max()).astype(np.float32)
+    tile_f = 32
+    res = closest_point_pallas(
+        v, f, pts, tile_q=8, tile_f=tile_f, interpret=True,
+        tile_variant=variant, reduction=reduction)
+    oracle, scale = _oracle_min_sqdist(v, f, pts)
+    got = np.asarray(res["sqdist"], np.float64)
+    tie = (2.0 ** -(23 - int(np.log2(tile_f)))
+           if reduction == "fused" else 0.0)
+    np.testing.assert_allclose(
+        got, oracle, atol=2e-4 * scale, rtol=2e-4 + 4 * tie)
 
 
 @settings(**_SETTINGS)
