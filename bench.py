@@ -277,6 +277,69 @@ def backend_responsive(probe_timeout=150, attempts=3):
 
 _LAST_GOOD = os.path.join(_REPO, "bench_last_good.json")
 
+#: small-Q sweep for --dispatch-latency: spans three engine Q-buckets
+#: (128, 256, 512) so the plan cache is exercised across rungs while the
+#: direct path retraces once per distinct Q
+_DISPATCH_QS = (100, 130, 170, 220, 256, 300, 350, 400)
+
+
+def dispatch_latency_small_q(repeats=5):
+    """Steady-state facade latency for small varying-Q closest-point
+    queries: the serving profile the engine's bucketed plan cache exists
+    for (doc/engine.md).  Returns one JSON-able record comparing the
+    engine path against MESH_TPU_NO_ENGINE=1 per call, with the engine's
+    plan-cache counters split into warm-up vs timed-window compiles —
+    ``engine_compiles_timed`` MUST be 0 (tests/test_bench_guard.py pins
+    it): a steady-state window that still compiles is measuring XLA, not
+    dispatch.
+    """
+    from mesh_tpu import Mesh, engine
+    from mesh_tpu.sphere import _icosphere
+
+    rng = np.random.RandomState(0)
+    v, f = _icosphere(2)
+    mesh = Mesh(v=v, f=f)
+    query_sets = [
+        np.asarray(rng.randn(q, 3) * 0.4, np.float32) for q in _DISPATCH_QS
+    ]
+
+    def sweep():
+        for q in query_sets:
+            mesh.closest_faces_and_points(q)
+
+    def timed(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            sweep()
+        return (time.perf_counter() - t0) / (n * len(query_sets))
+
+    # direct path: every distinct Q is its own trace (warmed first so the
+    # timed window measures dispatch, not compilation, on both sides)
+    os.environ["MESH_TPU_NO_ENGINE"] = "1"
+    try:
+        sweep()
+        direct_s = timed(repeats)
+    finally:
+        del os.environ["MESH_TPU_NO_ENGINE"]
+
+    engine.reset_stats()
+    sweep()                         # warm-up: compiles the bucketed plans
+    warm_misses = engine.stats()["plan_cache"]["misses"]
+    engine.reset_stats()
+    engine_s = timed(repeats)
+    snap = engine.stats()
+    return {
+        "metric": "dispatch_latency_small_q",
+        "value": round(engine_s * 1e3, 3),
+        "unit": "ms/call",
+        "vs_baseline": round(direct_s / engine_s, 2) if engine_s else None,
+        "direct_ms_per_call": round(direct_s * 1e3, 3),
+        "engine_ms_per_call": round(engine_s * 1e3, 3),
+        "engine_compiles_warm": warm_misses,
+        "engine_compiles_timed": snap["plan_cache"]["misses"],
+        "pad_waste": snap["pad_waste"],
+    }
+
 
 def wedged_record(reason):
     """The JSON record (and exit code) for a capture attempted while the
@@ -329,9 +392,27 @@ def wedged_record(reason):
 def main():
     ok, reason = backend_responsive()
     if not ok:
+        if "--dispatch-latency" in sys.argv[1:]:
+            # the sweep record has no last-good provenance file; null out
+            # rather than borrowing the north-star headline's
+            print(json.dumps({
+                "metric": "dispatch_latency_small_q", "value": None,
+                "unit": "ms/call", "vs_baseline": None,
+                "error": "jax backend probe failed, no fresh measurement "
+                         "possible (%s)" % reason,
+            }))
+            sys.exit(1)
         record, rc = wedged_record(reason)
         print(json.dumps(record))
         sys.exit(rc)
+    if "--dispatch-latency" in sys.argv[1:]:
+        from mesh_tpu.utils.compilation_cache import (
+            enable_persistent_compilation_cache,
+        )
+
+        enable_persistent_compilation_cache()
+        print(json.dumps(dispatch_latency_small_q()))
+        return
     # rerun compiles load from disk instead of paying ~20-40 s each on the
     # tunneled chip (content-keyed, so measurements are unaffected)
     from mesh_tpu.utils.compilation_cache import (

@@ -102,6 +102,31 @@ def _batch_step(vs, fj, pts, use_pallas, use_culled, chunk, with_normals,
     return normals, res
 
 
+def _run_batch_step(v, f, pts, use_pallas, use_culled, chunk, with_normals,
+                    nondegen=False, variant="fast", op="closest_point"):
+    """Route one batched query through the engine's shape-bucketed plan
+    cache (mesh_tpu.engine.planner: pad B/Q up a bucket ladder, reuse an
+    AOT-compiled executable) — or through today's direct exact-shape jit
+    when MESH_TPU_NO_ENGINE=1 or the shape defeats bucketing (empty
+    query sets)."""
+    from .utils.dispatch import no_engine
+
+    if not no_engine() and v.shape[0] and (pts is None or pts.shape[1]):
+        from .engine.planner import get_planner
+
+        return get_planner().run_batch_step(
+            v, f, pts, use_pallas=use_pallas, use_culled=use_culled,
+            chunk=chunk, with_normals=with_normals, nondegen=nondegen,
+            variant=variant, op=op,
+        )
+    return _batch_step(
+        jnp.asarray(v), jnp.asarray(f),
+        None if pts is None else jnp.asarray(pts),
+        use_pallas, use_culled, chunk, with_normals,
+        nondegen=nondegen, variant=variant,
+    )
+
+
 def _strategy(f):
     """(use_pallas, use_culled) for a face array — the batch analog of
     closest_faces_and_points_auto's measured-crossover switch on the
@@ -134,9 +159,8 @@ def batched_vertex_normals(meshes):
     mesh.py:208-216).  Returns [B, V, 3] float64.
     """
     v, f = stack_mesh_batch(meshes)
-    normals, _ = _batch_step(
-        jnp.asarray(v), jnp.asarray(f), None, False, False, 512, True
-    )
+    normals, _ = _run_batch_step(v, f, None, False, False, 512, True,
+                                 op="normals")
     return np.asarray(normals, np.float64)
 
 
@@ -177,9 +201,8 @@ def batched_closest_faces_and_points(meshes, points, chunk=512):
     use_pallas, use_culled = _strategy(f)
     from .utils.dispatch import tile_variant
 
-    _, res = _batch_step(
-        jnp.asarray(v), jnp.asarray(f), jnp.asarray(pts),
-        use_pallas, use_culled, chunk, False,
+    _, res = _run_batch_step(
+        v, f, pts, use_pallas, use_culled, chunk, False,
         nondegen=_batch_nondegen(v, f, use_pallas),
         variant=tile_variant(),
     )
@@ -235,15 +258,28 @@ def batched_vertex_visibility(meshes, cams, min_dist=1e-3, chunk=1024):
         stored_vn = np.stack(
             [np.asarray(m.vn, np.float32) for m in meshes]
         )
-    cams_j = jnp.atleast_2d(jnp.asarray(cams, jnp.float32))
-    vj = jnp.asarray(v)
-    vis, ndc = _batch_visibility_step(
-        vj, jnp.asarray(f), cams_j,
-        # with_normals=True ignores the operand; reuse vj as the dummy
-        # (same shape/dtype) instead of shipping a zeros array
-        vj if stored_vn is None else jnp.asarray(stored_vn),
-        jnp.float32(min_dist), pallas_default(), chunk, stored_vn is None,
-    )
+    cams_np = np.atleast_2d(np.asarray(cams, np.float32))
+    from .utils.dispatch import no_engine
+
+    if not no_engine() and v.shape[0] and cams_np.shape[0]:
+        from .engine.planner import get_planner
+
+        vis, ndc = get_planner().run_visibility_step(
+            v, f, cams_np,
+            # with_normals=True ignores the operand; reuse v as the dummy
+            # (same shape/dtype) instead of shipping a zeros array
+            v if stored_vn is None else stored_vn,
+            min_dist, use_pallas=pallas_default(), chunk=chunk,
+            with_normals=stored_vn is None,
+        )
+    else:
+        vj = jnp.asarray(v)
+        vis, ndc = _batch_visibility_step(
+            vj, jnp.asarray(f), jnp.asarray(cams_np),
+            vj if stored_vn is None else jnp.asarray(stored_vn),
+            jnp.float32(min_dist), pallas_default(), chunk,
+            stored_vn is None,
+        )
     return (
         np.asarray(vis).astype(np.uint32),
         np.asarray(ndc, np.float64),
@@ -282,10 +318,10 @@ def fused_normals_and_closest_points(meshes, points, chunk=512):
     use_pallas, use_culled = _strategy(fs)
     from .utils.dispatch import tile_variant
 
-    normals, res = _batch_step(
-        vs, fs, jnp.asarray(pts), use_pallas, use_culled, chunk, True,
+    normals, res = _run_batch_step(
+        vs, fs, pts, use_pallas, use_culled, chunk, True,
         nondegen=_batch_nondegen(v_host, f_host, use_pallas),
-        variant=tile_variant(),
+        variant=tile_variant(), op="fused",
     )
     normals = np.asarray(normals, np.float64)
     faces = np.asarray(res["face"]).astype(np.uint32)[:, None, :]

@@ -1,0 +1,87 @@
+"""mesh_tpu.engine: query-execution engine for the serving facades.
+
+Sits between the `Mesh` facade / `mesh_tpu.batch` entry points and the
+kernels, and makes steady-state traffic compile-free and
+dispatch-amortized:
+
+- **planner** — shape-bucketed plan cache: Q and B pad up a geometric
+  ladder, one AOT-compiled executable per (op, bucket, topology,
+  strategy) key, LRU-kept, pre-compilable via ``warmup()`` through the
+  persistent XLA compilation cache;
+- **executor** — coalescing submit/future dispatch: concurrently
+  pending same-topology requests ride one stacked launch;
+- **stats** — hits/misses/retraces, pad-waste, coalesced batch sizes,
+  per-op dispatch latency (``engine.stats()``; dumped by
+  ``bench.py --dispatch-latency``).
+
+``MESH_TPU_NO_ENGINE=1`` bypasses everything: the facades keep today's
+direct exact-shape jit path.  See doc/engine.md.
+"""
+
+import numpy as np
+
+from .executor import EngineExecutor, get_executor, submit  # noqa: F401
+from .planner import (  # noqa: F401
+    B_LADDER,
+    Q_LADDER,
+    Planner,
+    bucket_size,
+    get_planner,
+    warmup,
+)
+from .stats import STATS, reset_stats, stats  # noqa: F401
+
+__all__ = [
+    "engine_enabled", "stats", "reset_stats", "warmup",
+    "get_planner", "get_executor", "submit",
+    "facade_closest_faces_and_points",
+    "Q_LADDER", "B_LADDER", "bucket_size",
+]
+
+
+def engine_enabled():
+    """False when MESH_TPU_NO_ENGINE pins the direct facade paths."""
+    from ..utils.dispatch import no_engine
+
+    return not no_engine()
+
+
+def facade_closest_faces_and_points(mesh, points):
+    """Engine route for ``Mesh.closest_faces_and_points``.
+
+    Returns the reference AabbTree.nearest convention —
+    ``(faces [1, Q] uint32, points [Q, 3] f64)`` — or None when the
+    engine is bypassed (MESH_TPU_NO_ENGINE=1) or this shape regime is
+    better served by the direct path (the XLA culled+certificate
+    strategy for very large F has data-dependent re-run shapes that a
+    fixed plan cannot hold).
+    """
+    if not engine_enabled():
+        return None
+    pts = np.asarray(points, np.float32).reshape(-1, 3)
+    if not pts.shape[0]:
+        return None
+    from ..batch import _batch_nondegen, _strategy
+    from ..utils.dispatch import tile_variant
+
+    if hasattr(mesh, "device_arrays"):
+        vj, fj = mesh.device_arrays()
+    else:
+        vj = np.asarray(mesh.v, np.float32)
+        fj = np.asarray(mesh.f, np.int64).astype(np.int32)
+    use_pallas, use_culled = _strategy(fj)
+    if not use_pallas:
+        from ..query.autotune import crossover_faces
+
+        if int(fj.shape[0]) > crossover_faces():
+            return None     # direct path: culled + exact-fallback re-runs
+    v_host = np.asarray(mesh.v, np.float32)
+    _, res = get_planner().run_batch_step(
+        vj[None], fj, pts[None],
+        use_pallas=use_pallas, use_culled=use_culled, chunk=512,
+        with_normals=False,
+        nondegen=_batch_nondegen(v_host[None], fj, use_pallas),
+        variant=tile_variant(), op="closest_point",
+    )
+    faces = np.asarray(res["face"]).astype(np.uint32)[0][None, :]
+    return faces, np.asarray(res["point"], np.float64)[0]

@@ -1,0 +1,337 @@
+"""Shape-bucketed plan cache: steady-state facade traffic compiles nothing.
+
+The facades jit on exact shapes, so a caller that shows up with a new
+query count Q (or mesh-batch size B) pays a fresh trace+compile — ~20-40 s
+per program on the tunneled TPU, and even on CPU enough to dwarf the
+actual query work for small Q.  The planner closes that hole the way
+SOPTX separates its cached execution plan from the kernel layer:
+
+1. **Bucketing** — Q and B are padded up to a small geometric ladder
+   (powers of two), so the infinite space of caller shapes collapses to a
+   handful of compiled programs.  Padding replicates edge rows; every
+   per-query / per-mesh result is independent, so real rows are
+   bit-identical to the direct path and the pad rows are sliced off.
+2. **Plan cache** — one AOT-compiled executable
+   (``jit(...).lower(...).compile()``) per
+   ``(op, B-bucket, Q-bucket, V, F, dtype, strategy)`` key, kept in an
+   LRU.  A hit dispatches with zero Python->XLA retracing; misses are the
+   ``retraces`` counter in ``engine.stats()``.
+3. **Warm-up** — ``warmup()`` pre-compiles the SMPL/FLAME-shaped buckets
+   through the persistent compilation cache (utils/compilation_cache.py),
+   so even the first request of a fresh process loads plans from disk
+   instead of compiling.
+
+``MESH_TPU_NO_ENGINE=1`` (utils/dispatch.no_engine) routes every facade
+back to today's direct jit path.  See doc/engine.md.
+"""
+
+import threading
+import time
+from collections import OrderedDict
+
+import numpy as np
+
+from .stats import STATS
+
+__all__ = [
+    "Q_LADDER", "B_LADDER", "bucket_size", "Planner", "get_planner",
+    "warmup",
+]
+
+#: geometric ladder of query-count buckets.  The bottom rung keeps tiny
+#: probe queries from compiling one plan per Q; past the top rung sizes
+#: round up to the next multiple of it (pad waste <= 50% everywhere,
+#: and <= top-rung/Q for the giant sizes).
+Q_LADDER = (32, 64, 128, 256, 512, 1024, 2048, 4096, 8192, 16384)
+
+#: mesh-batch (and camera-count) ladder; starts at 1 so single-mesh
+#: facade calls pad nothing.
+B_LADDER = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
+
+
+def bucket_size(n, ladder):
+    """Smallest ladder rung >= n (next multiple of the top rung beyond)."""
+    n = int(n)
+    if n <= 0:
+        raise ValueError("bucket_size wants a positive count, got %d" % n)
+    for b in ladder:
+        if n <= b:
+            return b
+    top = ladder[-1]
+    return ((n + top - 1) // top) * top
+
+
+def _pad_edge(x, target, axis):
+    """Pad ``x`` up to ``target`` along ``axis`` by replicating the edge
+    row (numpy in -> numpy out, jax in -> jax out: the fused single-mesh
+    path hands the planner its crc-cached device arrays and must not be
+    forced through a host round trip)."""
+    n = x.shape[axis]
+    if n == target:
+        return x
+    import jax
+
+    xp = np
+    if isinstance(x, jax.Array):
+        import jax.numpy as xp  # noqa: F811
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, target - n)
+    return xp.pad(x, widths, mode="edge")
+
+
+class Planner(object):
+    """LRU of AOT-compiled executables, keyed on (op, buckets, topology,
+    dtype, strategy).  Thread-safe: the coalescing executor's worker and
+    direct facade callers share one planner."""
+
+    def __init__(self, q_ladder=Q_LADDER, b_ladder=B_LADDER, max_plans=64):
+        self.q_ladder = tuple(q_ladder)
+        self.b_ladder = tuple(b_ladder)
+        self.max_plans = int(max_plans)
+        self._plans = OrderedDict()
+        self._lock = threading.RLock()
+
+    # ------------------------------------------------------------------
+    # cache core
+
+    def _get_or_compile(self, key, builder):
+        """The plan for ``key``, compiling via ``builder()`` on a miss.
+        Compilation happens inside the lock: two threads racing on the
+        same cold key must not both pay the compile."""
+        with self._lock:
+            plan = self._plans.get(key)
+            if plan is not None:
+                self._plans.move_to_end(key)
+                STATS.record_plan_hit()
+                return plan
+            t0 = time.perf_counter()
+            plan = builder()
+            STATS.record_plan_miss(time.perf_counter() - t0)
+            self._plans[key] = plan
+            while len(self._plans) > self.max_plans:
+                self._plans.popitem(last=False)
+                STATS.record_plan_eviction()
+            return plan
+
+    def cached_keys(self):
+        with self._lock:
+            return list(self._plans.keys())
+
+    def clear(self):
+        with self._lock:
+            self._plans.clear()
+
+    # ------------------------------------------------------------------
+    # closest-point / fused-normals plans (batch._batch_step)
+
+    def _batch_step_key(self, op, bb, qb, n_verts, n_faces, dtype,
+                        use_pallas, use_culled, chunk, with_normals,
+                        nondegen, variant):
+        return (op, bb, qb, n_verts, n_faces, np.dtype(dtype).name,
+                use_pallas, use_culled, chunk, with_normals, nondegen,
+                variant)
+
+    def _build_batch_step(self, bb, qb, n_verts, n_faces, v_dtype, f_dtype,
+                          use_pallas, use_culled, chunk, with_normals,
+                          nondegen, variant):
+        import jax
+
+        from ..batch import _batch_step
+
+        vs_spec = jax.ShapeDtypeStruct((bb, n_verts, 3), v_dtype)
+        f_spec = jax.ShapeDtypeStruct((n_faces, 3), f_dtype)
+        pts_spec = (
+            None if qb is None
+            else jax.ShapeDtypeStruct((bb, qb, 3), v_dtype)
+        )
+        return _batch_step.lower(
+            vs_spec, f_spec, pts_spec,
+            use_pallas=use_pallas, use_culled=use_culled, chunk=chunk,
+            with_normals=with_normals, nondegen=nondegen, variant=variant,
+        ).compile()
+
+    def run_batch_step(self, v, f, pts, *, use_pallas, use_culled, chunk,
+                       with_normals, nondegen, variant, op):
+        """Bucket-pad -> plan -> dispatch -> slice for batch._batch_step.
+
+        :param v: [B, V, 3] f32 vertices (numpy or device array)
+        :param f: [F, 3] int32 faces
+        :param pts: [B, Q, 3] f32 queries, or None (normals-only ops)
+        :returns: ``(normals, res)`` exactly like ``_batch_step``, sliced
+            back to the caller's true B and Q.
+        """
+        import jax.numpy as jnp
+
+        n_batch, n_verts = v.shape[0], v.shape[1]
+        bb = bucket_size(n_batch, self.b_ladder)
+        vs = _pad_edge(v, bb, axis=0)
+        if pts is None:
+            qb = n_queries = None
+            pts_p = None
+        else:
+            n_queries = pts.shape[1]
+            qb = bucket_size(n_queries, self.q_ladder)
+            pts_p = _pad_edge(_pad_edge(pts, qb, axis=1), bb, axis=0)
+        v_dtype = np.dtype(vs.dtype)
+        f_dtype = np.dtype(f.dtype)
+        key = self._batch_step_key(
+            op, bb, qb, n_verts, f.shape[0], v_dtype, use_pallas,
+            use_culled, chunk, with_normals, nondegen, variant,
+        )
+        plan = self._get_or_compile(
+            key,
+            lambda: self._build_batch_step(
+                bb, qb, n_verts, f.shape[0], v_dtype, f_dtype,
+                use_pallas, use_culled, chunk, with_normals, nondegen,
+                variant,
+            ),
+        )
+        import jax
+
+        t0 = time.perf_counter()
+        normals, res = plan(
+            jnp.asarray(vs), jnp.asarray(f),
+            None if pts_p is None else jnp.asarray(pts_p),
+        )
+        jax.block_until_ready((normals, res))
+        STATS.record_dispatch(op, time.perf_counter() - t0)
+        STATS.record_padding(
+            n_batch * (n_queries or 1), bb * (qb or 1)
+        )
+        if normals is not None:
+            normals = normals[:n_batch]
+        if res is not None:
+            res = {k: val[:n_batch, :n_queries] if val.ndim > 1
+                   else val[:n_batch] for k, val in res.items()}
+        return normals, res
+
+    # ------------------------------------------------------------------
+    # visibility plans (batch._batch_visibility_step)
+
+    def run_visibility_step(self, v, f, cams, normals, min_dist, *,
+                            use_pallas, chunk, with_normals):
+        """Bucket-pad -> plan -> dispatch -> slice for
+        batch._batch_visibility_step.  B and the camera count C are both
+        bucketed (per-mesh and per-camera results are independent)."""
+        import jax
+        import jax.numpy as jnp
+
+        n_batch, n_verts = v.shape[0], v.shape[1]
+        n_cams = cams.shape[0]
+        bb = bucket_size(n_batch, self.b_ladder)
+        cb = bucket_size(n_cams, self.b_ladder)
+        vs = _pad_edge(v, bb, axis=0)
+        cams_p = _pad_edge(cams, cb, axis=0)
+        nrm_p = _pad_edge(normals, bb, axis=0)
+        v_dtype = vs.dtype
+        key = ("visibility", bb, cb, n_verts, f.shape[0], str(v_dtype),
+               use_pallas, chunk, with_normals)
+
+        def build():
+            from ..batch import _batch_visibility_step
+
+            return _batch_visibility_step.lower(
+                jax.ShapeDtypeStruct((bb, n_verts, 3), v_dtype),
+                jax.ShapeDtypeStruct(f.shape, f.dtype),
+                jax.ShapeDtypeStruct((cb, 3), v_dtype),
+                jax.ShapeDtypeStruct((bb, n_verts, 3), v_dtype),
+                jax.ShapeDtypeStruct((), jnp.float32),
+                use_pallas=use_pallas, chunk=chunk,
+                with_normals=with_normals,
+            ).compile()
+
+        plan = self._get_or_compile(key, build)
+        t0 = time.perf_counter()
+        vis, ndc = plan(
+            jnp.asarray(vs), jnp.asarray(f), jnp.asarray(cams_p),
+            jnp.asarray(nrm_p), jnp.float32(min_dist),
+        )
+        jax.block_until_ready((vis, ndc))
+        STATS.record_dispatch("visibility", time.perf_counter() - t0)
+        STATS.record_padding(n_batch * n_cams, bb * cb)
+        return vis[:n_batch, :n_cams], ndc[:n_batch, :n_cams]
+
+
+_PLANNER = None
+_PLANNER_LOCK = threading.Lock()
+
+
+def get_planner():
+    """The process-wide planner (one plan cache per process)."""
+    global _PLANNER
+    with _PLANNER_LOCK:
+        if _PLANNER is None:
+            _PLANNER = Planner()
+        return _PLANNER
+
+
+#: (V, F) of the body/face model topologies the serving fleet sees most;
+#: warmup() pre-compiles their buckets so the first real request of a
+#: fresh process is already compile-free.
+MODEL_SHAPES = {
+    "smpl": (6890, 13776),
+    "flame": (5023, 9976),
+}
+
+
+def warmup(mesh_shapes=None, q_buckets=(512, 1024), b_buckets=(1,),
+           ops=("closest_point", "fused"), chunk=512):
+    """Pre-compile the plans steady-state traffic will hit.
+
+    Routes through the persistent XLA compilation cache first, so a warm
+    disk cache turns these compiles into loads — and a fresh process
+    leaves compiled artifacts behind for the next one.  Lowering is
+    shape-abstract (jax.ShapeDtypeStruct): no model files or device data
+    are needed, only topology shapes.
+
+    :param mesh_shapes: iterable of (V, F) pairs; default SMPL + FLAME.
+    :param q_buckets: query-count rungs to compile per shape.
+    :param b_buckets: mesh-batch rungs to compile per shape.
+    :param ops: any of "closest_point" (queries only) and "fused"
+        (normals + queries in one dispatch).
+    :returns: number of NEW plans compiled (0 when already warm).
+    """
+    import jax.numpy as jnp
+
+    from ..utils.compilation_cache import enable_persistent_compilation_cache
+    from ..utils.dispatch import pallas_default, safe_tiles, tile_variant
+
+    enable_persistent_compilation_cache()
+    planner = get_planner()
+    use_pallas = pallas_default()
+    if not use_pallas:
+        use_culled, nondegens = False, (False,)
+    elif safe_tiles():
+        use_culled, nondegens = False, (False,)
+    else:
+        # on-chip traffic arrives with the data-derived flag either way
+        use_culled, nondegens = False, (False, True)
+    variant = tile_variant()
+    if mesh_shapes is None:
+        mesh_shapes = MODEL_SHAPES.values()
+
+    compiled = 0
+    for n_verts, n_faces in mesh_shapes:
+        for op in ops:
+            with_normals = op == "fused"
+            for bb in b_buckets:
+                for qb in q_buckets:
+                    for nondegen in nondegens:
+                        key = planner._batch_step_key(
+                            op, bb, qb, n_verts, n_faces, jnp.float32,
+                            use_pallas, use_culled, chunk, with_normals,
+                            nondegen, variant,
+                        )
+                        before = STATS.snapshot()["plan_cache"]["misses"]
+                        planner._get_or_compile(
+                            key,
+                            lambda bb=bb, qb=qb, nd=nondegen, wn=with_normals:
+                            planner._build_batch_step(
+                                bb, qb, n_verts, n_faces, jnp.float32,
+                                jnp.int32, use_pallas, use_culled, chunk,
+                                wn, nd, variant,
+                            ),
+                        )
+                        after = STATS.snapshot()["plan_cache"]["misses"]
+                        compiled += after - before
+    return compiled

@@ -1,0 +1,137 @@
+"""Engine observability: counters for the plan cache and dispatcher.
+
+The engine's whole value proposition is *negative* work — compiles that
+did not happen, dispatches that were coalesced away, padding that stayed
+small.  None of that is visible from results, so every engine component
+reports here and ``mesh_tpu.engine.stats()`` exposes one snapshot dict:
+
+- ``plan_cache``: hits / misses / evictions plus compile seconds paid;
+- ``retraces``: alias of plan-cache misses — each miss is exactly one
+  trace+compile, so "retrace counter stays flat" is the reuse proof the
+  tests pin;
+- ``pad_waste``: fraction of dispatched (batch x query) elements that
+  were bucket padding, cumulative over all engine dispatches;
+- ``coalesced``: how many submit/future requests rode in how many
+  stacked dispatches (mean/max batch size);
+- ``dispatch_latency``: per-op wall-clock of the engine's device
+  dispatches (count / total / max seconds).
+
+Thread-safe: the coalescing executor's worker thread and facade callers
+record concurrently.  ``bench.py --dispatch-latency`` dumps a snapshot
+alongside its timing record.
+"""
+
+import threading
+
+__all__ = ["EngineStats", "STATS", "stats", "reset_stats"]
+
+
+class EngineStats(object):
+    """Mutable counter block shared by planner and executor."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self):
+        with getattr(self, "_lock", threading.Lock()):
+            self.plan_hits = 0
+            self.plan_misses = 0
+            self.plan_evictions = 0
+            self.compile_seconds = 0.0
+            self.padded_elements = 0
+            self.useful_elements = 0
+            self.coalesced_dispatches = 0
+            self.coalesced_requests = 0
+            self.coalesced_max_batch = 0
+            self.op_latency = {}
+
+    # ------------------------------------------------------------------
+    # recording
+
+    def record_plan_hit(self):
+        with self._lock:
+            self.plan_hits += 1
+
+    def record_plan_miss(self, compile_seconds):
+        with self._lock:
+            self.plan_misses += 1
+            self.compile_seconds += float(compile_seconds)
+
+    def record_plan_eviction(self):
+        with self._lock:
+            self.plan_evictions += 1
+
+    def record_padding(self, useful, padded):
+        """One dispatch moved ``padded`` bucket elements of which
+        ``useful`` were real (batch x query granularity)."""
+        with self._lock:
+            self.useful_elements += int(useful)
+            self.padded_elements += int(padded)
+
+    def record_coalesced(self, batch_size):
+        with self._lock:
+            self.coalesced_dispatches += 1
+            self.coalesced_requests += int(batch_size)
+            self.coalesced_max_batch = max(
+                self.coalesced_max_batch, int(batch_size)
+            )
+
+    def record_dispatch(self, op, seconds):
+        with self._lock:
+            rec = self.op_latency.setdefault(
+                op, {"count": 0, "total_s": 0.0, "max_s": 0.0}
+            )
+            rec["count"] += 1
+            rec["total_s"] += float(seconds)
+            rec["max_s"] = max(rec["max_s"], float(seconds))
+
+    # ------------------------------------------------------------------
+    # reporting
+
+    def snapshot(self):
+        """One JSON-able dict of everything above, with derived rates."""
+        with self._lock:
+            pad_waste = (
+                1.0 - self.useful_elements / self.padded_elements
+                if self.padded_elements else 0.0
+            )
+            latency = {}
+            for op, rec in self.op_latency.items():
+                latency[op] = dict(
+                    rec,
+                    mean_ms=round(1e3 * rec["total_s"] / rec["count"], 3)
+                    if rec["count"] else 0.0,
+                )
+            return {
+                "plan_cache": {
+                    "hits": self.plan_hits,
+                    "misses": self.plan_misses,
+                    "evictions": self.plan_evictions,
+                    "compile_seconds": round(self.compile_seconds, 3),
+                },
+                "retraces": self.plan_misses,
+                "pad_waste": round(pad_waste, 4),
+                "coalesced": {
+                    "dispatches": self.coalesced_dispatches,
+                    "requests": self.coalesced_requests,
+                    "max_batch": self.coalesced_max_batch,
+                    "mean_batch": round(
+                        self.coalesced_requests / self.coalesced_dispatches, 2
+                    ) if self.coalesced_dispatches else 0.0,
+                },
+                "dispatch_latency": latency,
+            }
+
+
+#: process-wide stats block (the engine is one planner + one executor)
+STATS = EngineStats()
+
+
+def stats():
+    """Snapshot of the engine counters (``mesh_tpu.engine.stats``)."""
+    return STATS.snapshot()
+
+
+def reset_stats():
+    STATS.reset()

@@ -540,6 +540,17 @@ class Mesh(object):
         return self.closest_faces_and_points(vertices)[1]
 
     def closest_faces_and_points(self, vertices):
+        """Nearest face + point per query (reference AabbTree.nearest
+        convention).  Routed through the query engine's shape-bucketed
+        plan cache (mesh_tpu.engine) so repeated facade calls with
+        varying query counts reuse one compiled executable; falls back
+        to the direct per-call path under MESH_TPU_NO_ENGINE=1 or in
+        shape regimes the engine does not plan (doc/engine.md)."""
+        from .engine import facade_closest_faces_and_points
+
+        res = facade_closest_faces_and_points(self, vertices)
+        if res is not None:
+            return res
         return self.compute_aabb_tree().nearest(vertices)
 
     def normals_and_closest_points(self, vertices):

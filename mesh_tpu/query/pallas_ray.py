@@ -288,9 +288,15 @@ def nearest_alongnormal_pallas(v, f, points, normals, tile_q=256,
 # re-sorted before comparing.
 
 
-def moller_prescale(*tris):
+def moller_prescale(*tris, with_scale=False):
     """Jointly center and scale triangle arrays into the unit box before
     the Möller interval computation.
+
+    ``with_scale=True`` additionally returns the applied scale factor
+    ``s`` (``scaled = (t - center) * s``) so callers can map tolerances
+    expressed in input units into the prescaled frame (a length ``L`` in
+    input coordinates is ``L * s`` after prescale) — see
+    ``ray.tri_tri_intersects_moller``'s eps handling.
 
     The no-div intervals multiply tolerances through instead of dividing,
     so the compared terms (``a * XX * YY`` etc., _moller_hit) scale as
@@ -325,7 +331,7 @@ def moller_prescale(*tris):
     if not flats:
         # nothing to measure (empty query or face set) — shapes are
         # static under jit, so plain Python control flow is fine here
-        return tris
+        return (tris, 1.0) if with_scale else tris
     lo = flats[0].min(axis=0)
     hi = flats[0].max(axis=0)
     for c in flats[1:]:
@@ -334,7 +340,8 @@ def moller_prescale(*tris):
     center = (lo + hi) * 0.5
     m = jnp.max(hi - lo) * 0.5
     s = jnp.where(m > 0, 1.0 / jnp.maximum(m, 1e-30), 1.0)
-    return tuple((t - center) * s for t in tris)
+    scaled = tuple((t - center) * s for t in tris)
+    return (scaled, s) if with_scale else scaled
 
 
 def _moller_intervals(vp0, vp1, vp2, dv0, dv1, dv2, dv0dv1, dv0dv2):
@@ -491,9 +498,13 @@ def _tri_planes(tri):
     n2 = jnp.sum(n * n, axis=-1, keepdims=True)
     e12 = jnp.sum(e1 * e1, axis=-1, keepdims=True)
     e22 = jnp.sum(e2 * e2, axis=-1, keepdims=True)
-    # collinear-at-any-scale has n2 ~ (eps_f32 * |e1||e2|)^2 ~ 1e-14 of
-    # e12*e22; 1e-12 sits above that rounding floor with margin
-    degenerate = n2 <= 1e-12 * e12 * e22
+    # collinear-at-any-scale has n2 ~ (eps(dtype) * |e1||e2|)^2 of
+    # e12*e22 (~1.4e-14 in f32, ~4.9e-32 in f64); 1e2 * eps^2 sits above
+    # that rounding floor with margin in EITHER width.  A fixed f32-tuned
+    # 1e-12 would coplanar-reject valid f64 slivers with corner-angle
+    # sine down at ~1e-6 that f64 resolves perfectly well (advisor
+    # round-5 finding).
+    degenerate = n2 <= 1e2 * jnp.finfo(tri.dtype).eps ** 2 * e12 * e22
     n = n * jnp.where(
         degenerate, 0.0, jax.lax.rsqrt(jnp.where(degenerate, 1.0, n2))
     )

@@ -158,7 +158,7 @@ def tri_tri_intersects(p, q, eps=_EPS):
     return out
 
 
-def tri_tri_intersects_moller(p, q, eps=_EPS):
+def tri_tri_intersects_moller(p, q, eps=None):
     """Pairwise triangle intersection via the Möller '97 no-division
     interval test — decision parity with ``tri_tri_intersects`` on
     non-degenerate, non-coplanar, non-borderline pairs at ~half the
@@ -170,6 +170,11 @@ def tri_tri_intersects_moller(p, q, eps=_EPS):
     matching the segment formulation (module docstring).
 
     :param p: [..., 3, 3] triangles; :param q: broadcast-compatible
+    :param eps: plane-thickening tolerance in INPUT units, rescaled
+        internally into the unit-box frame the intervals run in (the
+        joint prescale maps a length L to L * s, so eps rides along).
+        None (default) uses the module ``_EPS`` directly in prescaled
+        units — the O(1) data scale the published algorithm assumes.
     :returns: boolean [...]
     """
     from .pallas_ray import _moller_hit, _tri_planes, moller_prescale
@@ -178,7 +183,8 @@ def tri_tri_intersects_moller(p, q, eps=_EPS):
     q = jnp.asarray(q, p.dtype)
     # joint unit-box prescale: the interval terms scale as extent^13 and
     # overflow f32 on mm-scale inputs otherwise (moller_prescale docstring)
-    p, q = moller_prescale(p, q)
+    (p, q), scale = moller_prescale(p, q, with_scale=True)
+    eps = _EPS if eps is None else eps * scale
     pa, pb, pc, pn, pd = _tri_planes(p)
     qa, qb, qc, qn, qd = _tri_planes(q)
 

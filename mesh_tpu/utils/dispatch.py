@@ -16,7 +16,7 @@ import os
 import jax
 
 __all__ = ["env_flag", "force_xla", "safe_tiles", "tile_variant",
-           "pallas_default", "mesh_on_tpu"]
+           "pallas_default", "mesh_on_tpu", "no_engine"]
 
 
 def env_flag(name):
@@ -46,6 +46,14 @@ def tile_variant():
     ``"fast"``.  Threaded through the auto, batched, sharded, and
     multi-host facades so the escape hatch reaches every entry point."""
     return "safe" if safe_tiles() else "fast"
+
+
+def no_engine():
+    """True when MESH_TPU_NO_ENGINE requests today's direct dispatch path
+    (exact-shape jit per call) instead of the shape-bucketed plan-cache
+    engine (mesh_tpu.engine).  Read per call like the other hatches, so a
+    misbehaving plan can be routed around at runtime without a restart."""
+    return env_flag("MESH_TPU_NO_ENGINE")
 
 
 def pallas_default():

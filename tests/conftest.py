@@ -32,7 +32,12 @@ else:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:
+        # older jax (< 0.5) has no jax_num_cpu_devices option; the
+        # XLA_FLAGS host-platform device count above covers it there
+        pass
 
 # per-test-session topology cache (reference Makefile:9-25 uses a throwaway
 # PSBODY_MESH_CACHE for the same reason)

@@ -65,3 +65,36 @@ def test_wedge_record_ignores_null_valued_last_good(monkeypatch, tmp_path):
     rec, code = _run_wedged(monkeypatch)
     assert code == 1
     assert rec["value"] is None and "stale" not in rec
+
+
+def test_dispatch_latency_small_q_record(monkeypatch):
+    monkeypatch.delenv("MESH_TPU_NO_ENGINE", raising=False)
+    rec = bench.dispatch_latency_small_q(repeats=1)
+    assert rec["metric"] == "dispatch_latency_small_q"
+    assert rec["unit"] == "ms/call"
+    assert rec["value"] > 0
+    assert rec["direct_ms_per_call"] > 0
+    assert rec["engine_ms_per_call"] == rec["value"]
+    # the warm-up sweep compiles one plan per Q-bucket spanned (the sweep
+    # covers 3 rungs), and the timed window must be compile-free — a
+    # steady-state measurement that still compiles is measuring XLA
+    assert rec["engine_compiles_warm"] >= 1
+    assert rec["engine_compiles_timed"] == 0
+    assert 0.0 <= rec["pad_waste"] < 1.0
+
+
+def test_dispatch_latency_wedged_is_null(monkeypatch):
+    monkeypatch.setattr(
+        bench, "backend_responsive", lambda *a, **k: (False, "synthetic")
+    )
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--dispatch-latency"])
+    buf = io.StringIO()
+    with redirect_stdout(buf), pytest.raises(SystemExit) as e:
+        bench.main()
+    rec = json.loads(buf.getvalue())
+    # no last-good provenance exists for this metric: null + rc=1, never
+    # the north-star headline's stale value
+    assert e.value.code == 1
+    assert rec["metric"] == "dispatch_latency_small_q"
+    assert rec["value"] is None and "stale" not in rec
+    assert "synthetic" in rec["error"]

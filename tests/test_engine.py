@@ -1,0 +1,253 @@
+"""mesh_tpu.engine contract (doc/engine.md).
+
+The acceptance bar for the engine PR: after warm-up, facade calls with
+DISTINCT query counts inside one bucket reuse a cached plan (the retrace
+counter stays flat) and return results bit-identical to the un-engined
+path.  Also pins the coalescing executor (futures == sequential facade
+calls), the MESH_TPU_NO_ENGINE bypass, warmup(), and the stats surface.
+"""
+
+import numpy as np
+import pytest
+
+from mesh_tpu import Mesh, engine
+from mesh_tpu.batch import (
+    batched_closest_faces_and_points,
+    batched_vertex_normals,
+    batched_vertex_visibility,
+    fused_normals_and_closest_points,
+)
+from mesh_tpu.sphere import _icosphere
+
+
+@pytest.fixture
+def mesh():
+    v, f = _icosphere(2)                    # 162 verts / 320 faces
+    return Mesh(v=v, f=f)
+
+
+@pytest.fixture
+def meshes():
+    rng = np.random.RandomState(3)
+    v, f = _icosphere(2)
+    return [Mesh(v=v + 0.01 * rng.randn(*v.shape), f=f) for _ in range(3)]
+
+
+def _queries(q, seed=0):
+    return np.asarray(np.random.RandomState(seed).randn(q, 3), np.float32)
+
+
+def _direct(call, monkeypatch):
+    """Run `call` with the engine bypassed (the pre-engine facade path)."""
+    monkeypatch.setenv("MESH_TPU_NO_ENGINE", "1")
+    try:
+        return call()
+    finally:
+        monkeypatch.delenv("MESH_TPU_NO_ENGINE")
+
+
+# ---------------------------------------------------------------------------
+# planner: bucketing + plan reuse
+
+
+def test_bucket_size_ladder():
+    ladder = engine.Q_LADDER
+    assert engine.bucket_size(1, ladder) == ladder[0]
+    assert engine.bucket_size(ladder[0], ladder) == ladder[0]
+    assert engine.bucket_size(ladder[0] + 1, ladder) == ladder[1]
+    assert engine.bucket_size(ladder[-1], ladder) == ladder[-1]
+    # beyond the top rung: next multiple of the top, never an error
+    assert engine.bucket_size(ladder[-1] + 1, ladder) == 2 * ladder[-1]
+    for bad in (0, -4):
+        with pytest.raises(ValueError):
+            engine.bucket_size(bad, ladder)
+
+
+def test_plan_reuse_within_bucket_flat_retraces(mesh, monkeypatch):
+    """The PR's acceptance test: after warm-up, 10 facade calls with
+    distinct Q inside one bucket add ZERO plan-cache misses and match the
+    direct path bit-for-bit."""
+    monkeypatch.delenv("MESH_TPU_NO_ENGINE", raising=False)
+    mesh.closest_faces_and_points(_queries(300))    # warm the 512-bucket
+    engine.reset_stats()
+    for i, q in enumerate(range(260, 510, 25)):     # 10 distinct Q, one bucket
+        pts = _queries(q, seed=q)
+        faces, points = mesh.closest_faces_and_points(pts)
+        f_direct, p_direct = _direct(
+            lambda: mesh.closest_faces_and_points(pts), monkeypatch)
+        assert np.array_equal(faces, f_direct)
+        assert np.array_equal(points, p_direct)
+    snap = engine.stats()
+    assert snap["retraces"] == 0
+    assert snap["plan_cache"]["misses"] == 0
+    assert snap["plan_cache"]["hits"] == 10
+    assert 0.0 <= snap["pad_waste"] < 1.0
+
+
+def test_crossing_a_bucket_boundary_compiles_once(mesh, monkeypatch):
+    monkeypatch.delenv("MESH_TPU_NO_ENGINE", raising=False)
+    mesh.closest_faces_and_points(_queries(40))     # warm the 64-bucket
+    engine.reset_stats()
+    mesh.closest_faces_and_points(_queries(50))     # same bucket: hit
+    assert engine.stats()["plan_cache"]["misses"] == 0
+    mesh.closest_faces_and_points(_queries(65))     # 128-bucket
+    mesh.closest_faces_and_points(_queries(100))    # 128 again: hit
+    snap = engine.stats()["plan_cache"]
+    assert snap["misses"] <= 1 and snap["hits"] >= 2
+
+
+def test_no_engine_bypass(mesh, monkeypatch):
+    monkeypatch.setenv("MESH_TPU_NO_ENGINE", "1")
+    assert not engine.engine_enabled()
+    engine.reset_stats()
+    pts = _queries(120)
+    faces, points = mesh.closest_faces_and_points(pts)
+    # the direct path must leave the engine completely untouched
+    snap = engine.stats()
+    assert snap["plan_cache"]["hits"] == 0
+    assert snap["plan_cache"]["misses"] == 0
+    assert faces.shape == (1, 120) and points.shape == (120, 3)
+    monkeypatch.delenv("MESH_TPU_NO_ENGINE")
+    assert engine.engine_enabled()
+
+
+# ---------------------------------------------------------------------------
+# batched entry points: engine path is bit-exact vs the direct path
+
+
+def test_batched_closest_parity(meshes, monkeypatch):
+    monkeypatch.delenv("MESH_TPU_NO_ENGINE", raising=False)
+    pts = np.asarray(np.random.RandomState(5).randn(3, 77, 3), np.float32)
+    f_eng, p_eng = batched_closest_faces_and_points(meshes, pts)
+    f_dir, p_dir = _direct(
+        lambda: batched_closest_faces_and_points(meshes, pts), monkeypatch)
+    assert np.array_equal(np.asarray(f_eng), np.asarray(f_dir))
+    assert np.array_equal(np.asarray(p_eng), np.asarray(p_dir))
+
+
+def test_batched_normals_parity(meshes, monkeypatch):
+    monkeypatch.delenv("MESH_TPU_NO_ENGINE", raising=False)
+    n_eng = batched_vertex_normals(meshes)
+    n_dir = _direct(lambda: batched_vertex_normals(meshes), monkeypatch)
+    assert np.array_equal(np.asarray(n_eng), np.asarray(n_dir))
+
+
+def test_fused_parity(meshes, monkeypatch):
+    monkeypatch.delenv("MESH_TPU_NO_ENGINE", raising=False)
+    pts = np.asarray(np.random.RandomState(7).randn(3, 55, 3), np.float32)
+    eng = fused_normals_and_closest_points(meshes, pts)
+    dire = _direct(
+        lambda: fused_normals_and_closest_points(meshes, pts), monkeypatch)
+    for a, b in zip(eng, dire):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_visibility_parity(meshes, monkeypatch):
+    monkeypatch.delenv("MESH_TPU_NO_ENGINE", raising=False)
+    cams = np.asarray([[0.0, 0.0, 3.0], [3.0, 0.0, 0.0]], np.float32)
+    vis_eng = batched_vertex_visibility(meshes, cams)
+    vis_dir = _direct(
+        lambda: batched_vertex_visibility(meshes, cams), monkeypatch)
+    assert np.array_equal(np.asarray(vis_eng), np.asarray(vis_dir))
+
+
+# ---------------------------------------------------------------------------
+# executor: coalesced futures == sequential facade calls
+
+
+def test_executor_coalesces_same_topology(meshes, monkeypatch):
+    monkeypatch.delenv("MESH_TPU_NO_ENGINE", raising=False)
+    rng = np.random.RandomState(11)
+    ptss = [np.asarray(rng.randn(q, 3), np.float32) for q in (150, 200, 90)]
+    ex = engine.get_executor()
+    engine.reset_stats()
+    with ex.coalesce():
+        futs = [
+            ex.submit("closest_point", m, p) for m, p in zip(meshes, ptss)
+        ]
+    ex.drain()
+    snap = engine.stats()["coalesced"]
+    # all three share one topology -> ONE stacked dispatch
+    assert snap["dispatches"] == 1
+    assert snap["requests"] == 3 and snap["max_batch"] == 3
+    for m, p, fut in zip(meshes, ptss, futs):
+        faces, points = fut.result(timeout=60)
+        f_seq, p_seq = m.closest_faces_and_points(p)
+        assert np.array_equal(faces, f_seq)
+        assert np.array_equal(points, p_seq)
+
+
+def test_executor_fused_future(meshes, monkeypatch):
+    monkeypatch.delenv("MESH_TPU_NO_ENGINE", raising=False)
+    pts = _queries(130, seed=13)
+    fut = engine.submit("fused", meshes[0], pts)
+    normals, faces, points = fut.result(timeout=60)
+    n_dir, f_dir, p_dir = _direct(
+        lambda: fused_normals_and_closest_points([meshes[0]], pts[None]),
+        monkeypatch)
+    assert np.array_equal(normals, np.asarray(n_dir)[0])
+    assert np.array_equal(faces, np.asarray(f_dir)[0])
+    assert np.array_equal(points, np.asarray(p_dir)[0])
+
+
+def test_executor_splits_mixed_topologies(mesh, meshes, monkeypatch):
+    monkeypatch.delenv("MESH_TPU_NO_ENGINE", raising=False)
+    small_v, small_f = _icosphere(1)
+    other = Mesh(v=small_v, f=small_f)
+    ex = engine.get_executor()
+    engine.reset_stats()
+    with ex.coalesce():
+        f1 = ex.submit("closest_point", mesh, _queries(60, seed=1))
+        f2 = ex.submit("closest_point", other, _queries(60, seed=2))
+    ex.drain()
+    # different topologies cannot stack: two dispatches
+    assert engine.stats()["coalesced"]["dispatches"] == 2
+    assert f1.result(timeout=60)[0].shape == (1, 60)
+    assert f2.result(timeout=60)[0].shape == (1, 60)
+
+
+def test_executor_rejects_bad_requests(mesh):
+    ex = engine.get_executor()
+    with pytest.raises(ValueError):
+        ex.submit("no_such_op", mesh, _queries(10))
+    with pytest.raises(ValueError):
+        ex.submit("closest_point", mesh, np.zeros((0, 3), np.float32))
+
+
+# ---------------------------------------------------------------------------
+# warmup + stats surface
+
+
+def test_warmup_precompiles_then_hits(monkeypatch):
+    monkeypatch.delenv("MESH_TPU_NO_ENGINE", raising=False)
+    shapes = ((162, 320),)
+    engine.warmup(mesh_shapes=shapes, q_buckets=(256,), b_buckets=(1,),
+                  ops=("closest_point",))
+    # idempotent: everything is already in the plan cache
+    assert engine.warmup(
+        mesh_shapes=shapes, q_buckets=(256,), b_buckets=(1,),
+        ops=("closest_point",)) == 0
+    engine.reset_stats()
+    v, f = _icosphere(2)
+    Mesh(v=v, f=f).closest_faces_and_points(_queries(250))
+    snap = engine.stats()["plan_cache"]
+    assert snap["misses"] == 0 and snap["hits"] == 1
+
+
+def test_stats_shape(mesh, monkeypatch):
+    monkeypatch.delenv("MESH_TPU_NO_ENGINE", raising=False)
+    engine.reset_stats()
+    mesh.closest_faces_and_points(_queries(33))
+    snap = engine.stats()
+    assert set(snap) == {
+        "plan_cache", "retraces", "pad_waste", "coalesced",
+        "dispatch_latency",
+    }
+    assert set(snap["plan_cache"]) == {
+        "hits", "misses", "evictions", "compile_seconds",
+    }
+    assert snap["retraces"] == snap["plan_cache"]["misses"]
+    lat = snap["dispatch_latency"]["closest_point"]
+    assert lat["count"] == 1 and lat["mean_ms"] > 0
+    engine.reset_stats()
+    assert engine.stats()["plan_cache"]["hits"] == 0

@@ -99,3 +99,28 @@ def test_stale_bench_record_is_labelled(tmp_path):
          "stale": True}) + "\n")
     h = harvest_gates.harvest(str(d))
     assert "STALE" in harvest_gates.render_table(h)
+
+
+def test_gate2b_wedged_vs_cpu_fallback_are_distinct(tmp_path):
+    # both records lack "kernel_knobs", but for different reasons: the
+    # wedged attempt carries the stale default headline + the knobs it
+    # WOULD have measured, while a live CPU-fallback run simply ignored
+    # the knobs.  Neither may render as an A/B measurement, and the
+    # CPU-fallback one must not claim the tunnel was wedged.
+    d = tmp_path / "gates"
+    d.mkdir()
+    (d / "gate2b_safe.log").write_text(json.dumps(
+        {"metric": "m", "value": 5.0, "unit": "q/s", "vs_baseline": 2.0,
+         "stale": True,
+         "kernel_knobs_requested": {"tile_variant": "safe",
+                                    "reduction": "exact"}}) + "\n")
+    (d / "gate2b_cpu.log").write_text(json.dumps(
+        {"metric": "m", "value": 7.0, "unit": "q/s",
+         "vs_baseline": 1.0}) + "\n")
+    table = harvest_gates.render_table(harvest_gates.harvest(str(d)))
+    assert "tunnel wedged" in table
+    assert '"tile_variant": "safe"' in table
+    assert "CPU fallback" in table
+    assert "knobs ignored" in table
+    # the CPU-fallback line carries its (default-path) value, labelled
+    assert "7.0 q/s is a default-path measurement" in table

@@ -277,3 +277,44 @@ def test_config4_geometry_parity():
         q_tri[:, None], m_tri[None]), axis=1))
     np.testing.assert_array_equal(seg, mol)
     assert seg.sum() > 0       # the fixture does graze the surface
+
+
+def test_user_eps_is_scale_invariant():
+    # a caller-supplied eps is in INPUT units (rescaled internally by the
+    # unit-box prescale): scaling the geometry AND the eps by the same
+    # factor must not change the decision.  Before the rescale fix, eps
+    # was applied raw in prescaled coordinates, so its meaning silently
+    # changed with scene extent.
+    p = np.array([[[0, 0, 0], [2, 0, 0], [0, 2, 0]]], np.float64)
+    # pierces p's plane by only 0.02: a tight eps sees the real
+    # intersection; a generous plane-thickening eps clamps all the plane
+    # distances to zero -> coplanar classification -> not counted
+    # (module docstring: neither form counts coplanar pairs)
+    q = np.array([[[0.5, 0.5, -0.02], [1.5, 0.5, 0.01],
+                   [0.5, 1.5, 0.01]]], np.float64)
+
+    def run(k, eps):
+        return bool(np.asarray(tri_tri_intersects_moller(
+            jnp.asarray(p * k), jnp.asarray(q * k), eps=eps))[0])
+
+    for k in (1.0, 1e3):
+        assert run(k, 1e-9 * k) is True, "tight input-unit eps, k=%g" % k
+        assert run(k, 0.1 * k) is False, "loose input-unit eps, k=%g" % k
+    # a FIXED eps shrinks relative to a larger scene: 0.1 units of plane
+    # thickening is coplanar-clamping at extent ~2 but negligible at
+    # extent ~2000.  Pre-fix, eps lived in unit-box coordinates and 0.1
+    # clamped at every scale.
+    assert run(1e3, 0.1) is True
+
+
+def test_f64_sliver_is_not_degeneracy_rejected():
+    # corner-angle sine ~3e-7: under the old fixed f32-tuned 1e-12
+    # relative cut this valid f64 sliver got a zeroed normal (coplanar
+    # reject, blind); the dtype-dependent cut keeps it live in f64
+    sliver = np.array(
+        [[[0, 0, -1], [0, 0, 1], [1, 3e-7, 0]]], np.float64)
+    target = np.array(
+        [[[-1, -1, 0], [1, -1, 0], [0, 1, 0]]], np.float64)
+    seg, mol = _pair(sliver, target)
+    assert seg is True
+    assert mol is True

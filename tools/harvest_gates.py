@@ -118,15 +118,27 @@ def render_table(h):
                     b["mtime_utc"],
                     b.get("error", "no value, no error recorded")))
         elif "kernel_knobs" not in b:
-            # a wedged A/B attempt carries the DEFAULT-kernel stale
-            # headline plus kernel_knobs_requested — never render that
-            # value as a variant measurement
-            lines.append(
-                "gate 2b (bench.py A/B requested=%s, %s): NOT MEASURED — "
-                "tunnel wedged; stale value shown is the DEFAULT-kernel "
-                "headline, not an A/B result" % (
-                    json.dumps(b.get("kernel_knobs_requested", {})),
-                    b["mtime_utc"]))
+            if "kernel_knobs_requested" in b or b.get("stale"):
+                # a wedged A/B attempt carries the DEFAULT-kernel stale
+                # headline plus kernel_knobs_requested — never render
+                # that value as a variant measurement
+                lines.append(
+                    "gate 2b (bench.py A/B requested=%s, %s): NOT "
+                    "MEASURED — tunnel wedged; stale value shown is the "
+                    "DEFAULT-kernel headline, not an A/B result" % (
+                        json.dumps(b.get("kernel_knobs_requested", {})),
+                        b["mtime_utc"]))
+            else:
+                # live run, but the record never echoed its knobs: the
+                # CPU-fallback path ignores kernel knobs entirely, so
+                # this is a healthy DEFAULT-path measurement that must
+                # not be read as a variant A/B either
+                lines.append(
+                    "gate 2b (bench.py A/B, %s): NOT AN A/B — kernel "
+                    "knobs ignored on the CPU fallback path; %s %s is a "
+                    "default-path measurement" % (
+                        b["mtime_utc"], b.get("value"),
+                        b.get("unit", "")))
         else:
             lines.append(
                 "gate 2b (bench.py A/B %s, %s): %s %s  vs_baseline=%s" % (
