@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from .geometry.vert_normals import vert_normals
+from .obs.trace import span as obs_span
 from .query.closest_point import closest_faces_and_points
 from .utils.dispatch import pallas_default
 
@@ -158,10 +159,12 @@ def batched_vertex_normals(meshes):
     Batched counterpart of Mesh.estimate_vertex_normals (reference
     mesh.py:208-216).  Returns [B, V, 3] float64.
     """
-    v, f = stack_mesh_batch(meshes)
-    normals, _ = _run_batch_step(v, f, None, False, False, 512, True,
-                                 op="normals")
-    return np.asarray(normals, np.float64)
+    with obs_span("batch.vertex_normals") as sp:
+        v, f = stack_mesh_batch(meshes)
+        sp.set(b=v.shape[0])
+        normals, _ = _run_batch_step(v, f, None, False, False, 512, True,
+                                     op="normals")
+        return np.asarray(normals, np.float64)
 
 
 def _batch_nondegen(v_host, f, use_pallas):
@@ -196,18 +199,20 @@ def batched_closest_faces_and_points(meshes, points, chunk=512):
         row matches the reference's AabbTree.nearest convention
         (search.py:29-37 row-vector index shape).
     """
-    v, f = stack_mesh_batch(meshes)
-    pts = _broadcast_points(points, v.shape[0])
-    use_pallas, use_culled = _strategy(f)
-    from .utils.dispatch import tile_variant
+    with obs_span("batch.closest_faces_and_points") as sp:
+        v, f = stack_mesh_batch(meshes)
+        pts = _broadcast_points(points, v.shape[0])
+        sp.set(b=v.shape[0], q=pts.shape[1])
+        use_pallas, use_culled = _strategy(f)
+        from .utils.dispatch import tile_variant
 
-    _, res = _run_batch_step(
-        v, f, pts, use_pallas, use_culled, chunk, False,
-        nondegen=_batch_nondegen(v, f, use_pallas),
-        variant=tile_variant(),
-    )
-    faces = np.asarray(res["face"]).astype(np.uint32)[:, None, :]
-    return faces, np.asarray(res["point"], np.float64)
+        _, res = _run_batch_step(
+            v, f, pts, use_pallas, use_culled, chunk, False,
+            nondegen=_batch_nondegen(v, f, use_pallas),
+            variant=tile_variant(),
+        )
+        faces = np.asarray(res["face"]).astype(np.uint32)[:, None, :]
+        return faces, np.asarray(res["point"], np.float64)
 
 
 @partial(jax.jit, static_argnames=("use_pallas", "chunk", "with_normals"))
@@ -244,46 +249,48 @@ def batched_vertex_visibility(meshes, cams, min_dist=1e-3, chunk=1024):
     :param cams: [C, 3] camera centers shared across the batch.
     :returns: (vis [B, C, V] uint32, n_dot_cam [B, C, V] f64).
     """
-    v, f = stack_mesh_batch(meshes)
-    # mirror stack_mesh_batch's own (v_stack, f) test: any OTHER container
-    # of mesh objects (list or tuple) gets the stored-vn scan
-    is_array_tuple = (
-        isinstance(meshes, tuple) and len(meshes) == 2
-        and not hasattr(meshes[0], "v")
-    )
-    stored_vn = None
-    if not is_array_tuple and all(
-        getattr(m, "vn", None) is not None for m in meshes
-    ):
-        stored_vn = np.stack(
-            [np.asarray(m.vn, np.float32) for m in meshes]
+    with obs_span("batch.vertex_visibility") as sp:
+        v, f = stack_mesh_batch(meshes)
+        # mirror stack_mesh_batch's own (v_stack, f) test: any OTHER
+        # container of mesh objects (list or tuple) gets the stored-vn scan
+        is_array_tuple = (
+            isinstance(meshes, tuple) and len(meshes) == 2
+            and not hasattr(meshes[0], "v")
         )
-    cams_np = np.atleast_2d(np.asarray(cams, np.float32))
-    from .utils.dispatch import no_engine
+        stored_vn = None
+        if not is_array_tuple and all(
+            getattr(m, "vn", None) is not None for m in meshes
+        ):
+            stored_vn = np.stack(
+                [np.asarray(m.vn, np.float32) for m in meshes]
+            )
+        cams_np = np.atleast_2d(np.asarray(cams, np.float32))
+        sp.set(b=v.shape[0], cams=cams_np.shape[0])
+        from .utils.dispatch import no_engine
 
-    if not no_engine() and v.shape[0] and cams_np.shape[0]:
-        from .engine.planner import get_planner
+        if not no_engine() and v.shape[0] and cams_np.shape[0]:
+            from .engine.planner import get_planner
 
-        vis, ndc = get_planner().run_visibility_step(
-            v, f, cams_np,
-            # with_normals=True ignores the operand; reuse v as the dummy
-            # (same shape/dtype) instead of shipping a zeros array
-            v if stored_vn is None else stored_vn,
-            min_dist, use_pallas=pallas_default(), chunk=chunk,
-            with_normals=stored_vn is None,
+            vis, ndc = get_planner().run_visibility_step(
+                v, f, cams_np,
+                # with_normals=True ignores the operand; reuse v as the
+                # dummy (same shape/dtype) instead of shipping zeros
+                v if stored_vn is None else stored_vn,
+                min_dist, use_pallas=pallas_default(), chunk=chunk,
+                with_normals=stored_vn is None,
+            )
+        else:
+            vj = jnp.asarray(v)
+            vis, ndc = _batch_visibility_step(
+                vj, jnp.asarray(f), jnp.asarray(cams_np),
+                vj if stored_vn is None else jnp.asarray(stored_vn),
+                jnp.float32(min_dist), pallas_default(), chunk,
+                stored_vn is None,
+            )
+        return (
+            np.asarray(vis).astype(np.uint32),
+            np.asarray(ndc, np.float64),
         )
-    else:
-        vj = jnp.asarray(v)
-        vis, ndc = _batch_visibility_step(
-            vj, jnp.asarray(f), jnp.asarray(cams_np),
-            vj if stored_vn is None else jnp.asarray(stored_vn),
-            jnp.float32(min_dist), pallas_default(), chunk,
-            stored_vn is None,
-        )
-    return (
-        np.asarray(vis).astype(np.uint32),
-        np.asarray(ndc, np.float64),
-    )
 
 
 def fused_normals_and_closest_points(meshes, points, chunk=512):
@@ -298,34 +305,38 @@ def fused_normals_and_closest_points(meshes, points, chunk=512):
     :returns: (normals [B, V, 3] f64, faces [B, 1, Q] uint32,
         points [B, Q, 3] f64); no leading B for a single Mesh input.
     """
-    single = hasattr(meshes, "v") and hasattr(meshes, "f")
-    if single:
-        # route through the mesh's crc-validated device cache (mesh.py:78)
-        # so repeated fused calls on an unchanged mesh skip the re-upload,
-        # like the unfused facade calls they replace
-        if hasattr(meshes, "device_arrays"):
-            vj, fj = meshes.device_arrays()
+    with obs_span("batch.fused_normals_and_closest_points") as sp:
+        single = hasattr(meshes, "v") and hasattr(meshes, "f")
+        if single:
+            # route through the mesh's crc-validated device cache
+            # (mesh.py:78) so repeated fused calls on an unchanged mesh
+            # skip the re-upload, like the unfused facade calls they
+            # replace
+            if hasattr(meshes, "device_arrays"):
+                vj, fj = meshes.device_arrays()
+            else:
+                vj = jnp.asarray(np.asarray(meshes.v, np.float32))
+                fj = jnp.asarray(
+                    np.asarray(meshes.f, np.int64).astype(np.int32))
+            vs, fs, batch = vj[None], fj, 1
+            v_host, f_host = np.asarray(meshes.v), np.asarray(meshes.f)
         else:
-            vj = jnp.asarray(np.asarray(meshes.v, np.float32))
-            fj = jnp.asarray(np.asarray(meshes.f, np.int64).astype(np.int32))
-        vs, fs, batch = vj[None], fj, 1
-        v_host, f_host = np.asarray(meshes.v), np.asarray(meshes.f)
-    else:
-        v, f = stack_mesh_batch(meshes)
-        vs, fs, batch = jnp.asarray(v), jnp.asarray(f), v.shape[0]
-        v_host, f_host = v, f
-    pts = _broadcast_points(points, batch)
-    use_pallas, use_culled = _strategy(fs)
-    from .utils.dispatch import tile_variant
+            v, f = stack_mesh_batch(meshes)
+            vs, fs, batch = jnp.asarray(v), jnp.asarray(f), v.shape[0]
+            v_host, f_host = v, f
+        pts = _broadcast_points(points, batch)
+        sp.set(b=batch, q=pts.shape[1])
+        use_pallas, use_culled = _strategy(fs)
+        from .utils.dispatch import tile_variant
 
-    normals, res = _run_batch_step(
-        vs, fs, pts, use_pallas, use_culled, chunk, True,
-        nondegen=_batch_nondegen(v_host, f_host, use_pallas),
-        variant=tile_variant(), op="fused",
-    )
-    normals = np.asarray(normals, np.float64)
-    faces = np.asarray(res["face"]).astype(np.uint32)[:, None, :]
-    points_out = np.asarray(res["point"], np.float64)
-    if single:
-        return normals[0], faces[0], points_out[0]
-    return normals, faces, points_out
+        normals, res = _run_batch_step(
+            vs, fs, pts, use_pallas, use_culled, chunk, True,
+            nondegen=_batch_nondegen(v_host, f_host, use_pallas),
+            variant=tile_variant(), op="fused",
+        )
+        normals = np.asarray(normals, np.float64)
+        faces = np.asarray(res["face"]).astype(np.uint32)[:, None, :]
+        points_out = np.asarray(res["point"], np.float64)
+        if single:
+            return normals[0], faces[0], points_out[0]
+        return normals, faces, points_out

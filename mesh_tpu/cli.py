@@ -1,18 +1,23 @@
-"""meshviewer CLI (reference bin/meshviewer:1-379).
+"""meshviewer / mesh-tpu CLI (reference bin/meshviewer:1-379).
 
 Subcommands:
   open  — start a standalone viewer server window on a known port
   view  — display mesh files, locally or in a remote viewer
   snap  — take a snapshot of a running viewer
+  stats — run a workload and dump the metrics registry (JSON/Prometheus)
+  trace — run a workload with spans on and print the span tree
 
 Examples:
   meshviewer view body.ply
   meshviewer view --nx 2 --ny 2 a.obj b.obj c.obj d.obj
   meshviewer open --port 5555
   meshviewer snap --port 5555 out.png
+  mesh-tpu stats --prom
+  mesh-tpu trace --mesh body.ply --jsonl /tmp/spans.jsonl
 """
 
 import argparse
+import os
 import sys
 import time
 
@@ -118,6 +123,61 @@ def cmd_snap(args):
         sys.exit(1)
 
 
+def _obs_workload(mesh_file, queries, seed=0):
+    """The observability subcommands' demo workload: one facade
+    closest-point batch (plus a normals call) against either the given
+    mesh file or a built-in tetrahedron — enough to light up the whole
+    facade -> engine.submit -> plan -> dispatch span chain and the
+    engine/query metric series."""
+    import numpy as np
+
+    from mesh_tpu import Mesh
+
+    if mesh_file:
+        m = Mesh(filename=mesh_file)
+    else:
+        m = Mesh(
+            v=np.array(
+                [[0, 0, 0], [1, 0, 0], [0, 1, 0], [0, 0, 1]], float),
+            f=np.array([[0, 1, 2], [0, 1, 3], [0, 2, 3], [1, 2, 3]],
+                       np.uint32),
+        )
+    pts = np.random.RandomState(seed).rand(queries, 3).astype(np.float64)
+    m.closest_faces_and_points(pts)
+    m.estimate_vertex_normals()
+    return m
+
+
+def cmd_stats(args):
+    import json
+
+    from mesh_tpu import obs
+
+    if not args.no_workload:
+        _obs_workload(args.mesh, args.queries)
+    if args.prom:
+        sys.stdout.write(obs.prometheus_text())
+    else:
+        json.dump(obs.metrics_snapshot(), sys.stdout, indent=2,
+                  sort_keys=True)
+        sys.stdout.write("\n")
+
+
+def cmd_trace(args):
+    # spans are the whole point here: flip the gate on before any
+    # workload runs, whatever the caller's environment says
+    os.environ["MESH_TPU_OBS"] = "1"
+    from mesh_tpu import obs
+
+    if not args.no_workload:
+        _obs_workload(args.mesh, args.queries)
+    if args.jsonl:
+        n = obs.write_jsonl(args.jsonl)
+        print("wrote %d lines to %s" % (n, args.jsonl), file=sys.stderr)
+    sys.stdout.write(obs.render_tree())
+    sys.stdout.write("\n")
+
+
 def main():
     parser = argparse.ArgumentParser(prog="meshviewer", description=__doc__)
     sub = parser.add_subparsers(dest="command", required=True)
@@ -155,6 +215,32 @@ def main():
     p_snap.add_argument("--host", default="127.0.0.1")
     p_snap.add_argument("-p", "--port", type=int, required=True)
     p_snap.set_defaults(func=cmd_snap)
+
+    p_stats = sub.add_parser(
+        "stats", help="run a workload and dump the metrics registry")
+    p_stats.add_argument("--mesh", default=None,
+                         help="mesh file for the workload (default: "
+                              "built-in tetrahedron)")
+    p_stats.add_argument("--queries", type=int, default=256,
+                         help="closest-point queries in the workload")
+    p_stats.add_argument("--no-workload", action="store_true",
+                         help="dump whatever the process already recorded")
+    p_stats.add_argument("--prom", action="store_true",
+                         help="Prometheus text format instead of JSON")
+    p_stats.set_defaults(func=cmd_stats)
+
+    p_trace = sub.add_parser(
+        "trace", help="run a workload with MESH_TPU_OBS=1, print span tree")
+    p_trace.add_argument("--mesh", default=None,
+                         help="mesh file for the workload (default: "
+                              "built-in tetrahedron)")
+    p_trace.add_argument("--queries", type=int, default=256,
+                         help="closest-point queries in the workload")
+    p_trace.add_argument("--no-workload", action="store_true",
+                         help="render spans already buffered this process")
+    p_trace.add_argument("--jsonl", default=None,
+                         help="also write spans + metrics as JSON lines")
+    p_trace.set_defaults(func=cmd_trace)
 
     args = parser.parse_args()
     args.func(args)

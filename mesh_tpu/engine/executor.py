@@ -33,6 +33,8 @@ from contextlib import contextmanager
 
 import numpy as np
 
+from ..obs.clock import monotonic as _now
+from ..obs.trace import span as obs_span
 from .stats import STATS
 
 __all__ = ["EngineExecutor", "get_executor", "submit"]
@@ -43,7 +45,8 @@ _OPS = ("closest_point", "fused")
 
 
 class _Request(object):
-    __slots__ = ("op", "mesh", "points", "chunk", "future", "key")
+    __slots__ = ("op", "mesh", "points", "chunk", "future", "key",
+                 "t_submit")
 
     def __init__(self, op, mesh, points, chunk, key):
         self.op = op
@@ -52,6 +55,7 @@ class _Request(object):
         self.chunk = chunk
         self.key = key
         self.future = Future()
+        self.t_submit = _now()
 
 
 class EngineExecutor(object):
@@ -97,11 +101,12 @@ class EngineExecutor(object):
         key = (op, chunk, f.shape, zlib.crc32(
             np.ascontiguousarray(f).tobytes()), np.asarray(mesh.v).shape)
         req = _Request(op, mesh, pts, chunk, key)
-        with self._cond:
-            if self._shutdown:
-                raise RuntimeError("executor is shut down")
-            self._pending.append(req)
-            self._cond.notify_all()
+        with obs_span("engine.enqueue", op=op, q=pts.shape[0]):
+            with self._cond:
+                if self._shutdown:
+                    raise RuntimeError("executor is shut down")
+                self._pending.append(req)
+                self._cond.notify_all()
         return req.future
 
     def hold(self):
@@ -177,26 +182,35 @@ class EngineExecutor(object):
         from ..utils.dispatch import tile_variant
         from .planner import bucket_size, get_planner
 
-        planner = get_planner()
-        v, f = stack_mesh_batch([req.mesh for req in group])
-        q_max = max(req.points.shape[0] for req in group)
-        qb = bucket_size(q_max, planner.q_ladder)
-        pts = np.stack([
-            np.pad(req.points,
-                   ((0, qb - req.points.shape[0]), (0, 0)), mode="edge")
-            for req in group
-        ])
         op = group[0].op
-        chunk = group[0].chunk
-        use_pallas, use_culled = _strategy(f)
-        normals, res = planner.run_batch_step(
-            v, f, pts,
-            use_pallas=use_pallas, use_culled=use_culled, chunk=chunk,
-            with_normals=(op == "fused"),
-            nondegen=_batch_nondegen(v, f, use_pallas),
-            variant=tile_variant(), op=op,
-        )
-        STATS.record_coalesced(len(group))
+        with obs_span("engine.coalesce", op=op, requests=len(group)):
+            drained = _now()
+            for req in group:
+                # submit-to-dispatch wait: the queue-time half of the
+                # queue-vs-device latency split (device time is the
+                # engine.dispatch histogram)
+                STATS.record_queue_wait(drained - req.t_submit)
+            planner = get_planner()
+            with obs_span("engine.stack", meshes=len(group)):
+                v, f = stack_mesh_batch([req.mesh for req in group])
+                q_max = max(req.points.shape[0] for req in group)
+                qb = bucket_size(q_max, planner.q_ladder)
+                pts = np.stack([
+                    np.pad(req.points,
+                           ((0, qb - req.points.shape[0]), (0, 0)),
+                           mode="edge")
+                    for req in group
+                ])
+            chunk = group[0].chunk
+            use_pallas, use_culled = _strategy(f)
+            normals, res = planner.run_batch_step(
+                v, f, pts,
+                use_pallas=use_pallas, use_culled=use_culled, chunk=chunk,
+                with_normals=(op == "fused"),
+                nondegen=_batch_nondegen(v, f, use_pallas),
+                variant=tile_variant(), op=op,
+            )
+            STATS.record_coalesced(len(group))
         faces_all = np.asarray(res["face"]).astype(np.uint32)
         points_all = np.asarray(res["point"], np.float64)
         normals_all = (

@@ -26,11 +26,13 @@ back to today's direct jit path.  See doc/engine.md.
 """
 
 import threading
-import time
 from collections import OrderedDict
 
 import numpy as np
 
+from ..obs.clock import monotonic as _now
+from ..obs.trace import span as obs_span
+from ..obs.trace import timed_span
 from .stats import STATS
 
 __all__ = [
@@ -98,20 +100,25 @@ class Planner(object):
         """The plan for ``key``, compiling via ``builder()`` on a miss.
         Compilation happens inside the lock: two threads racing on the
         same cold key must not both pay the compile."""
-        with self._lock:
-            plan = self._plans.get(key)
-            if plan is not None:
-                self._plans.move_to_end(key)
-                STATS.record_plan_hit()
+        with obs_span("engine.plan", op=str(key[0])) as sp:
+            with self._lock:
+                plan = self._plans.get(key)
+                if plan is not None:
+                    self._plans.move_to_end(key)
+                    STATS.record_plan_hit()
+                    sp.set(outcome="hit")
+                    return plan
+                t0 = _now()
+                plan = builder()
+                compile_seconds = _now() - t0
+                STATS.record_plan_miss(compile_seconds)
+                sp.set(outcome="compile",
+                       compile_seconds=round(compile_seconds, 3))
+                self._plans[key] = plan
+                while len(self._plans) > self.max_plans:
+                    self._plans.popitem(last=False)
+                    STATS.record_plan_eviction()
                 return plan
-            t0 = time.perf_counter()
-            plan = builder()
-            STATS.record_plan_miss(time.perf_counter() - t0)
-            self._plans[key] = plan
-            while len(self._plans) > self.max_plans:
-                self._plans.popitem(last=False)
-                STATS.record_plan_eviction()
-            return plan
 
     def cached_keys(self):
         with self._lock:
@@ -164,40 +171,42 @@ class Planner(object):
 
         n_batch, n_verts = v.shape[0], v.shape[1]
         bb = bucket_size(n_batch, self.b_ladder)
-        vs = _pad_edge(v, bb, axis=0)
-        if pts is None:
-            qb = n_queries = None
-            pts_p = None
-        else:
-            n_queries = pts.shape[1]
-            qb = bucket_size(n_queries, self.q_ladder)
-            pts_p = _pad_edge(_pad_edge(pts, qb, axis=1), bb, axis=0)
-        v_dtype = np.dtype(vs.dtype)
-        f_dtype = np.dtype(f.dtype)
-        key = self._batch_step_key(
-            op, bb, qb, n_verts, f.shape[0], v_dtype, use_pallas,
-            use_culled, chunk, with_normals, nondegen, variant,
-        )
-        plan = self._get_or_compile(
-            key,
-            lambda: self._build_batch_step(
-                bb, qb, n_verts, f.shape[0], v_dtype, f_dtype,
-                use_pallas, use_culled, chunk, with_normals, nondegen,
-                variant,
-            ),
-        )
-        import jax
+        with obs_span("engine.submit", op=op, b=n_batch, bucket_b=bb) as sub:
+            vs = _pad_edge(v, bb, axis=0)
+            if pts is None:
+                qb = n_queries = None
+                pts_p = None
+            else:
+                n_queries = pts.shape[1]
+                qb = bucket_size(n_queries, self.q_ladder)
+                pts_p = _pad_edge(_pad_edge(pts, qb, axis=1), bb, axis=0)
+                sub.set(q=n_queries, bucket_q=qb)
+            v_dtype = np.dtype(vs.dtype)
+            f_dtype = np.dtype(f.dtype)
+            key = self._batch_step_key(
+                op, bb, qb, n_verts, f.shape[0], v_dtype, use_pallas,
+                use_culled, chunk, with_normals, nondegen, variant,
+            )
+            plan = self._get_or_compile(
+                key,
+                lambda: self._build_batch_step(
+                    bb, qb, n_verts, f.shape[0], v_dtype, f_dtype,
+                    use_pallas, use_culled, chunk, with_normals, nondegen,
+                    variant,
+                ),
+            )
+            import jax
 
-        t0 = time.perf_counter()
-        normals, res = plan(
-            jnp.asarray(vs), jnp.asarray(f),
-            None if pts_p is None else jnp.asarray(pts_p),
-        )
-        jax.block_until_ready((normals, res))
-        STATS.record_dispatch(op, time.perf_counter() - t0)
-        STATS.record_padding(
-            n_batch * (n_queries or 1), bb * (qb or 1)
-        )
+            with timed_span("engine.dispatch", op=op) as disp:
+                normals, res = plan(
+                    jnp.asarray(vs), jnp.asarray(f),
+                    None if pts_p is None else jnp.asarray(pts_p),
+                )
+                jax.block_until_ready((normals, res))
+            STATS.record_dispatch(op, disp.elapsed)
+            STATS.record_padding(
+                n_batch * (n_queries or 1), bb * (qb or 1)
+            )
         if normals is not None:
             normals = normals[:n_batch]
         if res is not None:
@@ -240,15 +249,17 @@ class Planner(object):
                 with_normals=with_normals,
             ).compile()
 
-        plan = self._get_or_compile(key, build)
-        t0 = time.perf_counter()
-        vis, ndc = plan(
-            jnp.asarray(vs), jnp.asarray(f), jnp.asarray(cams_p),
-            jnp.asarray(nrm_p), jnp.float32(min_dist),
-        )
-        jax.block_until_ready((vis, ndc))
-        STATS.record_dispatch("visibility", time.perf_counter() - t0)
-        STATS.record_padding(n_batch * n_cams, bb * cb)
+        with obs_span("engine.submit", op="visibility", b=n_batch,
+                      bucket_b=bb, cams=n_cams, bucket_c=cb):
+            plan = self._get_or_compile(key, build)
+            with timed_span("engine.dispatch", op="visibility") as disp:
+                vis, ndc = plan(
+                    jnp.asarray(vs), jnp.asarray(f), jnp.asarray(cams_p),
+                    jnp.asarray(nrm_p), jnp.float32(min_dist),
+                )
+                jax.block_until_ready((vis, ndc))
+            STATS.record_dispatch("visibility", disp.elapsed)
+            STATS.record_padding(n_batch * n_cams, bb * cb)
         return vis[:n_batch, :n_cams], ndc[:n_batch, :n_cams]
 
 

@@ -1,9 +1,15 @@
-"""Engine observability: counters for the plan cache and dispatcher.
+"""Engine observability: a compatibility view over the metrics registry.
 
 The engine's whole value proposition is *negative* work — compiles that
 did not happen, dispatches that were coalesced away, padding that stayed
-small.  None of that is visible from results, so every engine component
-reports here and ``mesh_tpu.engine.stats()`` exposes one snapshot dict:
+small.  PR 2 migrated the backing store from this module's private
+counters into the unified observability registry
+(``mesh_tpu.obs.metrics.REGISTRY``, doc/observability.md), so the same
+numbers now show up in Prometheus dumps, JSON-lines exports, the
+``mesh-tpu stats`` CLI, and every bench.py record's ``"obs"`` key.
+
+``mesh_tpu.engine.stats()`` keeps its exact pre-migration snapshot dict
+(pinned by tests/test_obs.py against the registry):
 
 - ``plan_cache``: hits / misses / evictions plus compile seconds paid;
 - ``retraces``: alias of plan-cache misses — each miss is exactly one
@@ -14,11 +20,12 @@ reports here and ``mesh_tpu.engine.stats()`` exposes one snapshot dict:
 - ``coalesced``: how many submit/future requests rode in how many
   stacked dispatches (mean/max batch size);
 - ``dispatch_latency``: per-op wall-clock of the engine's device
-  dispatches (count / total / max seconds).
+  dispatches (count / total / max seconds), now derived from the
+  ``mesh_tpu_engine_dispatch_seconds`` histogram.
 
 Thread-safe: the coalescing executor's worker thread and facade callers
-record concurrently.  ``bench.py --dispatch-latency`` dumps a snapshot
-alongside its timing record.
+record concurrently (the registry serializes every update; ``reset()``
+takes its own lock so the multi-instrument zeroing is atomic too).
 """
 
 import threading
@@ -27,98 +34,148 @@ __all__ = ["EngineStats", "STATS", "stats", "reset_stats"]
 
 
 class EngineStats(object):
-    """Mutable counter block shared by planner and executor."""
+    """The engine's recording facade over the metrics registry, shared by
+    planner and executor."""
 
-    def __init__(self):
+    def __init__(self, registry=None):
+        # the lock exists BEFORE reset() runs and is taken unconditionally
+        # (the pre-PR-2 getattr dance acquired a fresh throwaway lock on
+        # first construction, guarding nothing)
         self._lock = threading.Lock()
+        if registry is None:
+            from ..obs.metrics import REGISTRY as registry
+        self.registry = registry
+        self._plan_hits = registry.counter(
+            "mesh_tpu_engine_plan_hits_total",
+            "Plan-cache hits (dispatches with zero retracing).",
+        )
+        self._plan_misses = registry.counter(
+            "mesh_tpu_engine_plan_misses_total",
+            "Plan-cache misses; each one is exactly one trace+compile.",
+        )
+        self._plan_evictions = registry.counter(
+            "mesh_tpu_engine_plan_evictions_total",
+            "Plans dropped from the LRU.",
+        )
+        self._compile_seconds = registry.counter(
+            "mesh_tpu_engine_compile_seconds_total",
+            "Wall seconds paid compiling plans on cache misses.",
+        )
+        self._useful_elements = registry.counter(
+            "mesh_tpu_engine_useful_elements_total",
+            "Real (batch x query) elements moved by engine dispatches.",
+        )
+        self._dispatched_elements = registry.counter(
+            "mesh_tpu_engine_dispatched_elements_total",
+            "Total bucket elements moved, padding included.",
+        )
+        self._coalesced_dispatches = registry.counter(
+            "mesh_tpu_engine_coalesced_dispatches_total",
+            "Stacked dispatches launched by the coalescing executor.",
+        )
+        self._coalesced_requests = registry.counter(
+            "mesh_tpu_engine_coalesced_requests_total",
+            "Submit/future requests that rode stacked dispatches.",
+        )
+        self._coalesced_max_batch = registry.gauge(
+            "mesh_tpu_engine_coalesced_max_batch",
+            "Largest request count coalesced into one dispatch.",
+        )
+        self._dispatch_seconds = registry.histogram(
+            "mesh_tpu_engine_dispatch_seconds",
+            "Per-op wall-clock of engine device dispatches.",
+        )
+        self._queue_wait_seconds = registry.histogram(
+            "mesh_tpu_engine_queue_wait_seconds",
+            "Submit-to-dispatch wait of coalesced executor requests.",
+        )
         self.reset()
 
     def reset(self):
-        with getattr(self, "_lock", threading.Lock()):
-            self.plan_hits = 0
-            self.plan_misses = 0
-            self.plan_evictions = 0
-            self.compile_seconds = 0.0
-            self.padded_elements = 0
-            self.useful_elements = 0
-            self.coalesced_dispatches = 0
-            self.coalesced_requests = 0
-            self.coalesced_max_batch = 0
-            self.op_latency = {}
+        with self._lock:
+            for metric in (
+                self._plan_hits, self._plan_misses, self._plan_evictions,
+                self._compile_seconds, self._useful_elements,
+                self._dispatched_elements, self._coalesced_dispatches,
+                self._coalesced_requests, self._coalesced_max_batch,
+                self._dispatch_seconds, self._queue_wait_seconds,
+            ):
+                metric.reset()
 
     # ------------------------------------------------------------------
     # recording
 
     def record_plan_hit(self):
-        with self._lock:
-            self.plan_hits += 1
+        self._plan_hits.inc()
 
     def record_plan_miss(self, compile_seconds):
-        with self._lock:
-            self.plan_misses += 1
-            self.compile_seconds += float(compile_seconds)
+        self._plan_misses.inc()
+        self._compile_seconds.inc(float(compile_seconds))
 
     def record_plan_eviction(self):
-        with self._lock:
-            self.plan_evictions += 1
+        self._plan_evictions.inc()
 
     def record_padding(self, useful, padded):
         """One dispatch moved ``padded`` bucket elements of which
         ``useful`` were real (batch x query granularity)."""
-        with self._lock:
-            self.useful_elements += int(useful)
-            self.padded_elements += int(padded)
+        self._useful_elements.inc(int(useful))
+        self._dispatched_elements.inc(int(padded))
 
     def record_coalesced(self, batch_size):
-        with self._lock:
-            self.coalesced_dispatches += 1
-            self.coalesced_requests += int(batch_size)
-            self.coalesced_max_batch = max(
-                self.coalesced_max_batch, int(batch_size)
-            )
+        self._coalesced_dispatches.inc()
+        self._coalesced_requests.inc(int(batch_size))
+        self._coalesced_max_batch.set_max(int(batch_size))
 
     def record_dispatch(self, op, seconds):
-        with self._lock:
-            rec = self.op_latency.setdefault(
-                op, {"count": 0, "total_s": 0.0, "max_s": 0.0}
-            )
-            rec["count"] += 1
-            rec["total_s"] += float(seconds)
-            rec["max_s"] = max(rec["max_s"], float(seconds))
+        self._dispatch_seconds.observe(float(seconds), op=op)
+
+    def record_queue_wait(self, seconds):
+        """Executor-only: submit-to-dispatch latency of one request
+        (registry series, intentionally NOT in the compat snapshot)."""
+        self._queue_wait_seconds.observe(float(seconds))
 
     # ------------------------------------------------------------------
     # reporting
 
     def snapshot(self):
-        """One JSON-able dict of everything above, with derived rates."""
+        """One JSON-able dict of everything above, with derived rates —
+        the exact pre-migration ``engine.stats()`` shape."""
         with self._lock:
-            pad_waste = (
-                1.0 - self.useful_elements / self.padded_elements
-                if self.padded_elements else 0.0
-            )
+            hits = self._plan_hits.value()
+            misses = self._plan_misses.value()
+            evictions = self._plan_evictions.value()
+            compile_seconds = self._compile_seconds.value()
+            useful = self._useful_elements.value()
+            dispatched = self._dispatched_elements.value()
+            co_dispatches = self._coalesced_dispatches.value()
+            co_requests = self._coalesced_requests.value()
+            co_max = self._coalesced_max_batch.value()
             latency = {}
-            for op, rec in self.op_latency.items():
-                latency[op] = dict(
-                    rec,
-                    mean_ms=round(1e3 * rec["total_s"] / rec["count"], 3)
-                    if rec["count"] else 0.0,
-                )
+            for labels in self._dispatch_seconds.label_sets():
+                op = labels.get("op", "")
+                stat = self._dispatch_seconds.stat(**labels)
+                latency[op] = {
+                    "count": stat["count"],
+                    "total_s": stat["sum"],
+                    "max_s": stat["max"],
+                    "mean_ms": round(1e3 * stat["mean"], 3),
+                }
+            pad_waste = 1.0 - useful / dispatched if dispatched else 0.0
             return {
                 "plan_cache": {
-                    "hits": self.plan_hits,
-                    "misses": self.plan_misses,
-                    "evictions": self.plan_evictions,
-                    "compile_seconds": round(self.compile_seconds, 3),
+                    "hits": hits,
+                    "misses": misses,
+                    "evictions": evictions,
+                    "compile_seconds": round(compile_seconds, 3),
                 },
-                "retraces": self.plan_misses,
+                "retraces": misses,
                 "pad_waste": round(pad_waste, 4),
                 "coalesced": {
-                    "dispatches": self.coalesced_dispatches,
-                    "requests": self.coalesced_requests,
-                    "max_batch": self.coalesced_max_batch,
-                    "mean_batch": round(
-                        self.coalesced_requests / self.coalesced_dispatches, 2
-                    ) if self.coalesced_dispatches else 0.0,
+                    "dispatches": co_dispatches,
+                    "requests": co_requests,
+                    "max_batch": co_max,
+                    "mean_batch": round(co_requests / co_dispatches, 2)
+                    if co_dispatches else 0.0,
                 },
                 "dispatch_latency": latency,
             }

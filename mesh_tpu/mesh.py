@@ -16,6 +16,7 @@ import numpy as np
 
 from . import colors, landmarks, processing, texture
 from .core import MeshArrays
+from .obs.trace import span as obs_span
 from .serialization import serialization
 
 __all__ = ["Mesh"]
@@ -240,8 +241,10 @@ class Mesh(object):
         host->device upload."""
         from .geometry.vert_normals import vert_normals_jit
 
-        vj, fj = self.device_arrays()
-        return np.asarray(vert_normals_jit(vj, fj), dtype=np.float64)
+        with obs_span("facade.estimate_vertex_normals",
+                      v=int(self.v.shape[0])):
+            vj, fj = self.device_arrays()
+            return np.asarray(vert_normals_jit(vj, fj), dtype=np.float64)
 
     def barycentric_coordinates_for_points(self, points, face_indices):
         """(corner vertex ids, barycentric coeffs) of each point projected
@@ -548,10 +551,14 @@ class Mesh(object):
         shape regimes the engine does not plan (doc/engine.md)."""
         from .engine import facade_closest_faces_and_points
 
-        res = facade_closest_faces_and_points(self, vertices)
-        if res is not None:
-            return res
-        return self.compute_aabb_tree().nearest(vertices)
+        with obs_span("facade.closest_faces_and_points",
+                      q=int(np.asarray(vertices).reshape(-1, 3).shape[0])) as sp:
+            res = facade_closest_faces_and_points(self, vertices)
+            if res is not None:
+                sp.set(route="engine")
+                return res
+            sp.set(route="aabb_tree")
+            return self.compute_aabb_tree().nearest(vertices)
 
     def normals_and_closest_points(self, vertices):
         """estimate_vertex_normals + closest_faces_and_points fused into ONE
@@ -562,7 +569,8 @@ class Mesh(object):
         For many meshes at once see mesh_tpu.batch."""
         from .batch import fused_normals_and_closest_points
 
-        return fused_normals_and_closest_points(self, vertices)
+        with obs_span("facade.normals_and_closest_points"):
+            return fused_normals_and_closest_points(self, vertices)
 
     # ------------------------------------------------------------------
     # Serialization (delegates, reference mesh.py:460-492)

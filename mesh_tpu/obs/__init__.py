@@ -1,0 +1,87 @@
+"""mesh_tpu.obs: the unified observability subsystem.
+
+One place for everything the serving stack measures (doc/observability.md;
+SURVEY.md section 5 names the reference's total lack of tracing/profiling
+as a gap to fill, and the engine's value is invisible without it):
+
+- **spans** (obs/trace.py) — nested, thread-aware timed regions through
+  the hot path: facade -> engine submit -> (plan hit|compile) ->
+  dispatch, plus the executor worker and batch entry points.  Gated by
+  ``MESH_TPU_OBS`` (off by default: no-ops, < 5% overhead pinned by
+  ``bench.py --obs-overhead`` and tests/test_bench_guard.py).
+- **metrics** (obs/metrics.py) — the always-on labeled
+  counter/gauge/histogram registry; ``engine.stats()`` is a
+  compatibility snapshot view over it since the PR-2 migration.
+- **exporters** (obs/export.py) — JSON-lines (live sink via
+  ``MESH_TPU_OBS_JSONL=path`` or pull via ``export_jsonl``), Prometheus
+  text, the ascii span tree, and a ``jax.profiler.TraceAnnotation``
+  bridge (``MESH_TPU_OBS_JAX_TRACE=1``) annotating TensorBoard device
+  traces.  CLI: ``mesh-tpu stats`` / ``mesh-tpu trace``.
+- **jax bridge** (obs/jax_bridge.py) — jax.monitoring events
+  (persistent compilation-cache hits/misses, compile durations) folded
+  into the same registry.
+
+Import cost: stdlib only — jax is touched lazily and never required.
+"""
+
+from .clock import enabled, monotonic, wall  # noqa: F401
+from .export import prometheus_text, render_tree, write_jsonl  # noqa: F401
+from .jax_bridge import install_jax_monitoring_bridge  # noqa: F401
+from .metrics import (  # noqa: F401
+    LATENCY_BUCKETS_S,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    REGISTRY,
+)
+from .trace import (  # noqa: F401
+    TRACER,
+    Tracer,
+    configure,
+    jsonl_sink,
+    span,
+    timed_span,
+    traced,
+)
+
+__all__ = [
+    "enabled", "span", "timed_span", "traced", "configure", "jsonl_sink",
+    "TRACER", "Tracer",
+    "REGISTRY", "Registry", "Counter", "Gauge", "Histogram",
+    "LATENCY_BUCKETS_S",
+    "counter", "gauge", "histogram", "metrics_snapshot", "reset",
+    "prometheus_text", "render_tree", "write_jsonl", "export_jsonl",
+    "install_jax_monitoring_bridge",
+    "monotonic", "wall",
+]
+
+
+def counter(name, help=""):
+    """Get-or-create a counter in the process registry."""
+    return REGISTRY.counter(name, help)
+
+
+def gauge(name, help=""):
+    return REGISTRY.gauge(name, help)
+
+
+def histogram(name, help="", buckets=LATENCY_BUCKETS_S):
+    return REGISTRY.histogram(name, help, buckets=buckets)
+
+
+def metrics_snapshot():
+    """JSON-able snapshot of every registered metric (the exact object
+    bench.py appends to its records under the ``"obs"`` key)."""
+    return REGISTRY.snapshot()
+
+
+#: pull-mode JSON-lines export (spans + final metrics snapshot)
+export_jsonl = write_jsonl
+
+
+def reset():
+    """Zero every metric series and drop buffered spans (tests, and the
+    per-run isolation of the CLI subcommands)."""
+    REGISTRY.reset()
+    TRACER.clear()

@@ -1,0 +1,42 @@
+"""The observability subsystem's clocks and its env gate.
+
+This is the ONE place in ``mesh_tpu/`` hot paths where the raw ``time``
+module is read (tests/test_timing_lint.py pins it, with
+``utils/profiling.py`` as the only other allowed reader): every span,
+metric timestamp, and engine latency counter goes through these
+aliases, so a future swap to a different clock (or a test fake) is a
+one-line change.
+
+``enabled()`` is the master gate: ``MESH_TPU_OBS`` unset/''/'0'/'false'
+/'no'/'off' means OFF (same truthiness as the utils/dispatch escape
+hatches, re-read per call so tests can toggle it), and OFF means spans
+are no-ops — the overhead bound is pinned by tests/test_bench_guard.py
+via ``bench.py --obs-overhead``.
+"""
+
+import os
+import time
+
+__all__ = ["monotonic", "wall", "enabled", "env_flag", "OBS_ENV"]
+
+#: the observability master gate (spans; metrics counters stay always-on
+#: because the engine's pre-existing stats contract depends on them)
+OBS_ENV = "MESH_TPU_OBS"
+
+#: monotonic high-resolution clock for durations
+monotonic = time.perf_counter
+
+#: wall clock for event timestamps (exporters)
+wall = time.time
+
+
+def env_flag(name):
+    """Shared truthiness with utils/dispatch.env_flag (duplicated here so
+    the obs primitives never import jax transitively)."""
+    value = os.environ.get(name, "").strip().lower()
+    return value not in ("", "0", "false", "no", "off")
+
+
+def enabled():
+    """True when MESH_TPU_OBS turns span tracing on (read per call)."""
+    return env_flag(OBS_ENV)

@@ -1,0 +1,95 @@
+"""jax.monitoring -> metrics-registry bridge.
+
+JAX instruments its own internals (persistent compilation-cache hits and
+misses, tracing/compile durations) through ``jax.monitoring`` events.
+Registering listeners here folds those into the framework registry, so
+the question PR 1 left open — "did warmup() actually LOAD plans from the
+disk cache, or recompile them?" — is answered by
+``mesh_tpu_xla_cache_hits_total`` in the same snapshot as the engine's
+own plan-cache counters.
+
+Installed (idempotently) by
+``utils.compilation_cache.enable_persistent_compilation_cache``; safe on
+any jax version — an absent/renamed monitoring API degrades to a logged
+no-op, never an error.
+"""
+
+import logging
+import threading
+
+from .metrics import REGISTRY
+
+__all__ = ["install_jax_monitoring_bridge"]
+
+_log = logging.getLogger(__name__)
+
+_install_lock = threading.Lock()
+_installed = False
+
+#: jax event key -> framework counter (other events fall through to the
+#: generic per-event counter below, so new jax versions stay visible)
+_EVENT_COUNTERS = {
+    "/jax/compilation_cache/cache_hits": (
+        "mesh_tpu_xla_cache_hits_total",
+        "Persistent XLA compilation-cache hits (compiles served from disk).",
+    ),
+    "/jax/compilation_cache/cache_misses": (
+        "mesh_tpu_xla_cache_misses_total",
+        "Persistent XLA compilation-cache misses (fresh compiles).",
+    ),
+    "/jax/compilation_cache/task_disabled_cache": (
+        "mesh_tpu_xla_cache_disabled_total",
+        "Compilation tasks that ran with the persistent cache disabled.",
+    ),
+}
+
+
+def install_jax_monitoring_bridge(registry=None):
+    """Register the jax.monitoring listeners once per process.
+
+    :returns: True when the listeners are active (now or already).
+    """
+    global _installed
+    registry = registry or REGISTRY
+    with _install_lock:
+        if _installed:
+            return True
+        try:
+            from jax import monitoring
+        except Exception as e:
+            _log.debug("jax.monitoring unavailable: %s", e)
+            return False
+
+        generic = registry.counter(
+            "mesh_tpu_jax_events_total",
+            "Unmapped jax.monitoring events, labeled by event key.",
+        )
+        durations = registry.histogram(
+            "mesh_tpu_jax_event_duration_seconds",
+            "jax.monitoring duration events (compiles, tracing, ...).",
+        )
+
+        def on_event(event, **kwargs):
+            try:
+                mapped = _EVENT_COUNTERS.get(event)
+                if mapped is not None:
+                    registry.counter(*mapped).inc()
+                else:
+                    generic.inc(event=event)
+            except Exception:   # monitoring must never break compilation
+                pass
+
+        def on_duration(event, duration, **kwargs):
+            try:
+                durations.observe(duration, event=event)
+            except Exception:
+                pass
+
+        try:
+            monitoring.register_event_listener(on_event)
+            monitoring.register_event_duration_secs_listener(on_duration)
+        except Exception as e:
+            _log.debug("jax.monitoring listener registration failed: %s", e)
+            return False
+        _installed = True
+        return True

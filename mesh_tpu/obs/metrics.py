@@ -1,0 +1,253 @@
+"""Metrics registry: labeled counters, gauges, and histograms.
+
+One process-wide ``Registry`` (``REGISTRY``) holds every series the
+framework records: the engine's plan-cache/coalescing/pad-waste counters
+(mesh_tpu/engine/stats.py is a compatibility view over this registry),
+backend-selection counts (utils/dispatch.py), query-strategy and
+Pallas-fallback counts (query/culled.py), XLA compilation-cache hits
+(obs/jax_bridge.py), and per-op dispatch-latency histograms.
+
+Unlike spans (gated by MESH_TPU_OBS), metrics are ALWAYS on: they are
+plain locked dict updates — the same cost the pre-obs ``engine.stats()``
+counters already paid — and the engine's stats contract depends on them.
+
+Exporters: ``Registry.snapshot()`` (JSON-able, appended to every
+bench.py record), ``obs.export.prometheus_text()``, and the
+``mesh-tpu stats`` CLI.  See doc/observability.md for the name table.
+"""
+
+import threading
+from collections import OrderedDict
+
+__all__ = [
+    "Registry", "Counter", "Gauge", "Histogram", "REGISTRY",
+    "LATENCY_BUCKETS_S",
+]
+
+#: log-spaced latency bucket bounds in seconds: 50 us to 60 s covers
+#: everything from a plan-cache hit to a cold tunneled-TPU compile
+LATENCY_BUCKETS_S = (
+    5e-5, 1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3, 1e-2, 2.5e-2, 5e-2,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+def _label_key(labels):
+    """Canonical hashable form of a label set (sorted, values stringified
+    so snapshots are stable and JSON-able)."""
+    if not labels:
+        return ()
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+class _Metric(object):
+    """Base: one named instrument holding labeled series under the
+    registry's shared lock (snapshots see a consistent cut of every
+    instrument at once)."""
+
+    kind = "untyped"
+
+    def __init__(self, name, help, lock):
+        self.name = name
+        self.help = help
+        self._lock = lock
+        self._series = OrderedDict()    # _label_key -> value/state
+
+    def reset(self):
+        with self._lock:
+            self._series.clear()
+
+    def _labelled(self):
+        with self._lock:
+            return [
+                (dict(key), value) for key, value in self._series.items()
+            ]
+
+    def snapshot(self):
+        return {
+            "type": self.kind,
+            "help": self.help,
+            "series": [
+                {"labels": labels, "value": value}
+                for labels, value in self._labelled()
+            ],
+        }
+
+
+class Counter(_Metric):
+    """Monotonically increasing sum (resets only via reset())."""
+
+    kind = "counter"
+
+    def inc(self, amount=1, **labels):
+        if amount < 0:
+            raise ValueError("counters only go up, got %r" % (amount,))
+        key = _label_key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0) + amount
+
+    def value(self, **labels):
+        with self._lock:
+            return self._series.get(_label_key(labels), 0)
+
+    def total(self):
+        """Sum across every label combination."""
+        with self._lock:
+            return sum(self._series.values())
+
+
+class Gauge(_Metric):
+    """A value that can go anywhere (or only up, via set_max)."""
+
+    kind = "gauge"
+
+    def set(self, value, **labels):
+        with self._lock:
+            self._series[_label_key(labels)] = value
+
+    def inc(self, amount=1, **labels):
+        key = _label_key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0) + amount
+
+    def set_max(self, value, **labels):
+        """Keep the running maximum (the engine's max-batch gauge)."""
+        key = _label_key(labels)
+        with self._lock:
+            self._series[key] = max(self._series.get(key, value), value)
+
+    def value(self, **labels):
+        with self._lock:
+            return self._series.get(_label_key(labels), 0)
+
+
+class Histogram(_Metric):
+    """Cumulative-bucket histogram with exact count/sum/min/max per
+    labeled series (so mean and max survive even when every observation
+    lands in one bucket)."""
+
+    kind = "histogram"
+
+    def __init__(self, name, help, lock, buckets=LATENCY_BUCKETS_S):
+        super(Histogram, self).__init__(name, help, lock)
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+
+    def observe(self, value, **labels):
+        value = float(value)
+        key = _label_key(labels)
+        with self._lock:
+            state = self._series.get(key)
+            if state is None:
+                state = {
+                    "count": 0, "sum": 0.0,
+                    "min": value, "max": value,
+                    "bucket_counts": [0] * (len(self.buckets) + 1),
+                }
+                self._series[key] = state
+            state["count"] += 1
+            state["sum"] += value
+            state["min"] = min(state["min"], value)
+            state["max"] = max(state["max"], value)
+            for i, bound in enumerate(self.buckets):
+                if value <= bound:
+                    state["bucket_counts"][i] += 1
+                    break
+            else:
+                state["bucket_counts"][-1] += 1     # +Inf bucket
+
+    def stat(self, **labels):
+        """{count, sum, min, max, mean} for one labeled series (zeros when
+        the series has never been observed)."""
+        with self._lock:
+            state = self._series.get(_label_key(labels))
+            if state is None:
+                return {"count": 0, "sum": 0.0, "min": 0.0, "max": 0.0,
+                        "mean": 0.0}
+            return {
+                "count": state["count"], "sum": state["sum"],
+                "min": state["min"], "max": state["max"],
+                "mean": state["sum"] / state["count"],
+            }
+
+    def label_sets(self):
+        with self._lock:
+            return [dict(key) for key in self._series]
+
+    def snapshot(self):
+        out = {"type": self.kind, "help": self.help, "series": []}
+        with self._lock:
+            for key, state in self._series.items():
+                cumulative, running = [], 0
+                for i, bound in enumerate(self.buckets):
+                    running += state["bucket_counts"][i]
+                    cumulative.append([bound, running])
+                cumulative.append(["+Inf", running + state["bucket_counts"][-1]])
+                out["series"].append({
+                    "labels": dict(key),
+                    "count": state["count"],
+                    "sum": round(state["sum"], 9),
+                    "min": state["min"],
+                    "max": state["max"],
+                    "buckets": cumulative,
+                })
+        return out
+
+
+class Registry(object):
+    """Named instruments, get-or-create, one shared lock.
+
+    ``counter()``/``gauge()``/``histogram()`` are idempotent for a given
+    name; asking for an existing name as a different type raises (a
+    silent type change would corrupt whoever recorded first).
+    """
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._metrics = OrderedDict()
+
+    def _get_or_create(self, cls, name, help, **kwargs):
+        with self._lock:
+            metric = self._metrics.get(name)
+            if metric is not None:
+                if not isinstance(metric, cls):
+                    raise TypeError(
+                        "metric %r already registered as %s, wanted %s"
+                        % (name, metric.kind, cls.kind)
+                    )
+                return metric
+            metric = cls(name, help, self._lock, **kwargs)
+            self._metrics[name] = metric
+            return metric
+
+    def counter(self, name, help=""):
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name, help=""):
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(self, name, help="", buckets=LATENCY_BUCKETS_S):
+        return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    def get(self, name):
+        with self._lock:
+            return self._metrics.get(name)
+
+    def names(self):
+        with self._lock:
+            return list(self._metrics)
+
+    def snapshot(self):
+        """JSON-able dump of every instrument (the bench.py "obs" key)."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return OrderedDict((m.name, m.snapshot()) for m in metrics)
+
+    def reset(self):
+        """Zero every series (instruments stay registered)."""
+        with self._lock:
+            for metric in self._metrics.values():
+                metric.reset()
+
+
+#: the process-wide registry every subsystem records into
+REGISTRY = Registry()

@@ -1,0 +1,341 @@
+"""Span tracer: nested, thread-aware spans over the serving hot path.
+
+A span is one timed region with a name, attributes, and a parent — the
+enclosing span on the SAME thread, so the facade -> engine submit ->
+(plan hit|compile) -> dispatch chain of one request renders as one tree
+while the coalescing executor's worker thread grows its own (events
+carry the thread name, so trees never interleave).
+
+Spans record wall time and, separately, device-sync time: ``watch(out)``
+registers a jax pytree that is host-synced (utils/profiling.host_sync)
+just before the span closes, with the sync cost reported as
+``sync_elapsed`` — the queue-time vs device-time split the engine
+latency counters need.
+
+Gate: ``MESH_TPU_OBS`` (obs/clock.enabled).  Off — the default — means
+``span()`` returns a shared no-op object: no allocation, no clock read,
+no buffer append; the < 5% overhead bound on the dispatch-latency
+benchmark is pinned by tests/test_bench_guard.py.  ``timed_span()``
+always measures (two clock reads) but only records when the gate is on;
+it exists so the engine can feed its always-on latency counters through
+one primitive.
+
+Finished spans land in a bounded in-memory ring (``TRACER.events()``)
+and fan out to sinks: a JSON-lines file (``MESH_TPU_OBS_JSONL=path`` or
+``configure(jsonl=...)``) and, under ``MESH_TPU_OBS_JAX_TRACE``, a
+``jax.profiler.TraceAnnotation`` wrapping each span so device traces
+captured with ``utils.profiling.trace`` show the framework's phases on
+the TensorBoard timeline.
+"""
+
+import functools
+import itertools
+import json
+import sys
+import threading
+from collections import deque
+
+from .clock import enabled, env_flag, monotonic, wall
+
+__all__ = [
+    "Span", "Tracer", "TRACER", "span", "timed_span", "traced",
+    "configure", "jsonl_sink",
+]
+
+#: jax.profiler.TraceAnnotation bridge gate (adds real per-span cost on
+#: the device timeline, so it is opt-in on top of MESH_TPU_OBS)
+JAX_TRACE_ENV = "MESH_TPU_OBS_JAX_TRACE"
+
+#: default JSON-lines sink path gate
+JSONL_ENV = "MESH_TPU_OBS_JSONL"
+
+_span_ids = itertools.count(1)
+
+
+class Span(object):
+    """One live traced region; use via ``with span("name", k=v) as sp:``."""
+
+    __slots__ = (
+        "name", "attrs", "span_id", "parent_id", "thread_name",
+        "t_start", "wall_start", "elapsed", "sync_elapsed", "status",
+        "_tracer", "_watched", "_jax_ctx",
+    )
+
+    def __init__(self, tracer, name, attrs):
+        self.name = name
+        self.attrs = attrs
+        self.span_id = next(_span_ids)
+        self.parent_id = None
+        self.thread_name = None
+        self.t_start = None
+        self.wall_start = None
+        self.elapsed = None
+        self.sync_elapsed = None
+        self.status = "ok"
+        self._tracer = tracer
+        self._watched = None
+        self._jax_ctx = None
+
+    def set(self, **attrs):
+        """Attach/update attributes mid-span; returns self for chaining."""
+        self.attrs.update(attrs)
+        return self
+
+    def watch(self, out):
+        """Register a jax pytree to host-sync before the span closes (the
+        sync cost lands in ``sync_elapsed``).  Returns ``out`` unchanged
+        so call sites can wrap a computation inline."""
+        self._watched = out
+        return out
+
+    def __enter__(self):
+        tracer = self._tracer
+        stack = tracer._stack()
+        if stack:
+            self.parent_id = stack[-1].span_id
+        stack.append(self)
+        thread = threading.current_thread()
+        self.thread_name = thread.name
+        if env_flag(JAX_TRACE_ENV) and "jax" in sys.modules:
+            try:
+                import jax
+
+                self._jax_ctx = jax.profiler.TraceAnnotation(self.name)
+                self._jax_ctx.__enter__()
+            except Exception:   # the bridge must never break the workload
+                self._jax_ctx = None
+        self.wall_start = wall()
+        self.t_start = monotonic()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        t_end = monotonic()
+        self.elapsed = t_end - self.t_start
+        if exc_type is None and self._watched is not None:
+            try:
+                from ..utils.profiling import host_sync
+
+                host_sync(self._watched)
+            finally:
+                t_sync = monotonic()
+                self.sync_elapsed = t_sync - t_end
+                self.elapsed = t_sync - self.t_start
+        if exc_type is not None:
+            self.status = "error"
+            self.attrs.setdefault("error", exc_type.__name__)
+        if self._jax_ctx is not None:
+            try:
+                self._jax_ctx.__exit__(exc_type, exc, tb)
+            except Exception:
+                pass
+        stack = self._tracer._stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+        elif self in stack:                  # unbalanced exit: be lenient
+            stack.remove(self)
+        self._tracer._finish(self)
+        return False
+
+    def to_dict(self):
+        return {
+            "kind": "span",
+            "name": self.name,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "thread": self.thread_name,
+            "ts": self.wall_start,
+            "t_mono": self.t_start,
+            "elapsed_s": self.elapsed,
+            "sync_elapsed_s": self.sync_elapsed,
+            "status": self.status,
+            "attrs": dict(self.attrs),
+        }
+
+
+class _NoopSpan(object):
+    """The shared do-nothing span handed out while MESH_TPU_OBS is off."""
+
+    __slots__ = ()
+    elapsed = None
+    sync_elapsed = None
+    attrs = {}
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs):
+        return self
+
+    def watch(self, out):
+        return out
+
+
+_NOOP = _NoopSpan()
+
+
+class _TimedOnlySpan(object):
+    """timed_span() fallback while tracing is off: measures elapsed (and
+    sync time via watch) but records nothing anywhere."""
+
+    __slots__ = ("elapsed", "sync_elapsed", "_t0", "_watched")
+
+    def __init__(self):
+        self.elapsed = None
+        self.sync_elapsed = None
+        self._watched = None
+
+    def set(self, **attrs):
+        return self
+
+    def watch(self, out):
+        self._watched = out
+        return out
+
+    def __enter__(self):
+        self._t0 = monotonic()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        t_end = monotonic()
+        self.elapsed = t_end - self._t0
+        if exc_type is None and self._watched is not None:
+            from ..utils.profiling import host_sync
+
+            host_sync(self._watched)
+            t_sync = monotonic()
+            self.sync_elapsed = t_sync - t_end
+            self.elapsed = t_sync - self._t0
+        return False
+
+
+class Tracer(object):
+    """Per-process span collector: thread-local nesting stacks, a bounded
+    ring of finished spans, and push sinks."""
+
+    def __init__(self, max_events=4096):
+        self._events = deque(maxlen=max_events)
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self._sinks = []
+        self._env_sink_checked = False
+
+    # -- span lifecycle ------------------------------------------------
+
+    def span(self, name, **attrs):
+        return Span(self, name, attrs)
+
+    def _stack(self):
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    def _finish(self, span):
+        event = span.to_dict()
+        with self._lock:
+            if not self._env_sink_checked:
+                self._env_sink_checked = True
+                self._install_env_sink_locked()
+            self._events.append(event)
+            sinks = list(self._sinks)
+        for sink in sinks:
+            try:
+                sink(event)
+            except Exception:   # a broken sink must never break serving
+                pass
+
+    def _install_env_sink_locked(self):
+        import os
+
+        path = os.environ.get(JSONL_ENV, "").strip()
+        if path:
+            self._sinks.append(jsonl_sink(path))
+
+    # -- consumption ---------------------------------------------------
+
+    def events(self):
+        """Finished spans, oldest first (bounded ring)."""
+        with self._lock:
+            return list(self._events)
+
+    def clear(self):
+        with self._lock:
+            self._events.clear()
+
+    def add_sink(self, sink):
+        with self._lock:
+            self._sinks.append(sink)
+        return sink
+
+    def remove_sink(self, sink):
+        with self._lock:
+            if sink in self._sinks:
+                self._sinks.remove(sink)
+
+
+#: the process-wide tracer (one request path, one tracer)
+TRACER = Tracer()
+
+
+def span(name, **attrs):
+    """A traced region — or THE no-op singleton while MESH_TPU_OBS is
+    off, which is the whole overhead story: one env read, no object."""
+    if not enabled():
+        return _NOOP
+    return TRACER.span(name, **attrs)
+
+
+def timed_span(name, **attrs):
+    """Like ``span`` but ``elapsed``/``sync_elapsed`` are measured even
+    when tracing is off — the engine's always-on latency counters feed
+    from this, so hot paths never read raw clocks themselves."""
+    if not enabled():
+        return _TimedOnlySpan()
+    return TRACER.span(name, **attrs)
+
+
+def traced(name=None, **attrs):
+    """Decorator form: ``@traced`` or ``@traced("custom.name", k=v)``.
+
+    Zero work beyond one env read per call while tracing is off.
+    """
+    def decorate(fn, label=None):
+        label = label or getattr(fn, "__qualname__", fn.__name__)
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if not enabled():
+                return fn(*args, **kwargs)
+            with TRACER.span(label, **attrs):
+                return fn(*args, **kwargs)
+        return wrapper
+
+    if callable(name):          # bare @traced
+        return decorate(name)
+    return lambda fn: decorate(fn, name)
+
+
+def jsonl_sink(path):
+    """A push sink appending one JSON line per finished span to ``path``
+    (opened lazily, line-buffered under a lock; errors are swallowed —
+    observability must never take serving down)."""
+    lock = threading.Lock()
+    state = {"fh": None}
+
+    def sink(event):
+        with lock:
+            if state["fh"] is None:
+                state["fh"] = open(path, "a", buffering=1)
+            state["fh"].write(json.dumps(event) + "\n")
+    return sink
+
+
+def configure(jsonl=None):
+    """Programmatic sink setup (the env-var-free path for tests and
+    embedding apps).  Returns the sink handle for ``remove_sink``."""
+    if jsonl is not None:
+        return TRACER.add_sink(jsonl_sink(jsonl))
+    return None

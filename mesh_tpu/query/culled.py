@@ -34,6 +34,38 @@ from .closest_point import _pad_to_multiple, closest_faces_and_points
 from .point_triangle import closest_point_on_triangle
 from ..utils.dispatch import pallas_default
 
+_STRATEGY_COUNTER = None
+_FALLBACK_COUNTER = None
+
+
+def _record_strategy(path):
+    """Count which kernel the auto facade picked
+    (``mesh_tpu_query_strategy_total{path=}``) — the Pallas-vs-XLA and
+    brute-vs-culled routing visibility doc/observability.md promises."""
+    global _STRATEGY_COUNTER
+    if _STRATEGY_COUNTER is None:
+        from ..obs.metrics import REGISTRY
+
+        _STRATEGY_COUNTER = REGISTRY.counter(
+            "mesh_tpu_query_strategy_total",
+            "closest_faces_and_points_auto kernel-path decisions.",
+        )
+    _STRATEGY_COUNTER.inc(path=path)
+
+
+def _record_fallback(queries):
+    """Count certificate-miss re-runs: queries whose culled result could
+    not be proven optimal and went back through brute force."""
+    global _FALLBACK_COUNTER
+    if _FALLBACK_COUNTER is None:
+        from ..obs.metrics import REGISTRY
+
+        _FALLBACK_COUNTER = REGISTRY.counter(
+            "mesh_tpu_query_certificate_fallback_total",
+            "Loose-certificate queries re-run through exact brute force.",
+        )
+    _FALLBACK_COUNTER.inc(int(queries))
+
 
 def triangle_bounds(v, f):
     """Per-triangle centroid [F, 3] and bounding radius [F] (max distance
@@ -152,29 +184,35 @@ def closest_faces_and_points_auto(
         from ..utils.dispatch import safe_tiles
 
         if safe_tiles():
+            _record_strategy("pallas_safe")
             res = closest_point_pallas(
                 v32, f.astype(np.int32), pts32,
                 assume_nondegenerate=nondegen, tile_variant="safe",
             )
         elif f.shape[0] <= brute_force_max_faces:
+            _record_strategy("pallas_brute")
             res = closest_point_pallas(
                 v32, f.astype(np.int32), pts32,
                 assume_nondegenerate=nondegen,
             )
         else:
+            _record_strategy("pallas_culled")
             res = closest_point_pallas_culled(
                 v32, f.astype(np.int32), pts32,
                 assume_nondegenerate=nondegen,
             )
         return {key: np.asarray(val) for key, val in res.items()}
     if f.shape[0] <= brute_force_max_faces:
+        _record_strategy("xla_brute")
         res = closest_faces_and_points(v, f, points)
         return {key: np.asarray(val) for key, val in res.items()}
+    _record_strategy("xla_culled")
     res = closest_faces_and_points_culled(v, f, points, k=k, chunk=chunk)
     out = {key: np.asarray(val) for key, val in res.items()}
     tight = out.pop("tight")
     loose = np.nonzero(~tight)[0]
     if loose.size:
+        _record_fallback(loose.size)
         fix = closest_faces_and_points(v, f, np.asarray(points)[loose])
         for key in ("face", "part", "sqdist"):
             out[key] = out[key].copy()

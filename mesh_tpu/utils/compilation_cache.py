@@ -60,6 +60,16 @@ def enable_persistent_compilation_cache(path=None, min_compile_secs=1.0):
             )
 
             _cc.reset_cache()
+        # fold jax.monitoring's cache hit/miss events into the metrics
+        # registry and flag the cache as live for `mesh-tpu stats`
+        from ..obs.jax_bridge import install_jax_monitoring_bridge
+        from ..obs.metrics import REGISTRY
+
+        install_jax_monitoring_bridge()
+        REGISTRY.gauge(
+            "mesh_tpu_compilation_cache_enabled",
+            "1 when the persistent XLA compilation cache is active.",
+        ).set(1)
         return path
     except Exception as e:  # never let a cache problem break real work
         _log.warning("persistent compilation cache unavailable: %s", e)

@@ -56,16 +56,38 @@ def no_engine():
     return env_flag("MESH_TPU_NO_ENGINE")
 
 
+_BACKEND_COUNTER = None
+
+
+def _record_backend(use_pallas, reason):
+    """Count every backend decision in the metrics registry
+    (``mesh_tpu_dispatch_backend_total{backend=,reason=}`` — the
+    "how often did the escape hatch fire" series, doc/observability.md)."""
+    global _BACKEND_COUNTER
+    if _BACKEND_COUNTER is None:
+        from ..obs.metrics import REGISTRY
+
+        _BACKEND_COUNTER = REGISTRY.counter(
+            "mesh_tpu_dispatch_backend_total",
+            "Pallas-vs-XLA dispatch decisions by backend and reason.",
+        )
+    _BACKEND_COUNTER.inc(
+        backend="pallas" if use_pallas else "xla", reason=reason)
+    return use_pallas
+
+
 def pallas_default():
     """Whether Pallas kernels should be the default for this process:
     the default jax backend is TPU and the escape hatch is not set."""
     if force_xla():
-        return False
-    return jax.devices()[0].platform == "tpu"
+        return _record_backend(False, "forced")
+    return _record_backend(
+        jax.devices()[0].platform == "tpu", "platform")
 
 
 def mesh_on_tpu(mesh):
     """Same policy for an explicit device mesh (sharded paths)."""
     if force_xla():
-        return False
-    return mesh.devices.flat[0].platform == "tpu"
+        return _record_backend(False, "forced")
+    return _record_backend(
+        mesh.devices.flat[0].platform == "tpu", "platform")

@@ -39,6 +39,12 @@ class Timer:
     >>> with Timer("normals") as t:
     ...     out = vert_normals(v, f)
     >>> t.elapsed  # seconds; sync=True (default) host-syncs `out` via t.watch
+
+    ``elapsed`` is recorded even when the body raises (sync is skipped
+    then — the watched output may be half-built), so a timing harness
+    around flaky device code never reads back ``None``.  On success
+    ``sync_elapsed`` holds the host-sync share of ``elapsed``: the
+    dispatch-vs-device split the span tracer reports (doc/observability.md).
     """
 
     def __init__(self, name="", sync=True, log=None):
@@ -46,6 +52,7 @@ class Timer:
         self.sync = sync
         self.log = log
         self.elapsed = None
+        self.sync_elapsed = None
         self._watched = None
 
     def watch(self, out):
@@ -57,9 +64,11 @@ class Timer:
         self._t0 = time.perf_counter()
         return self
 
-    def __exit__(self, *exc):
-        if self.sync and self._watched is not None:
+    def __exit__(self, exc_type, *exc):
+        if exc_type is None and self.sync and self._watched is not None:
+            t_sync = time.perf_counter()
             host_sync(self._watched)
+            self.sync_elapsed = time.perf_counter() - t_sync
         self.elapsed = time.perf_counter() - self._t0
         if self.log is not None:
             self.log("%s: %.3f ms" % (self.name or "timer", self.elapsed * 1e3))

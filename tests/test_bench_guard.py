@@ -98,3 +98,57 @@ def test_dispatch_latency_wedged_is_null(monkeypatch):
     assert rec["metric"] == "dispatch_latency_small_q"
     assert rec["value"] is None and "stale" not in rec
     assert "synthetic" in rec["error"]
+
+
+def test_obs_overhead_guard(monkeypatch):
+    """PR-2 acceptance: with MESH_TPU_OBS unset, span no-ops must cost
+    under 5% of steady-state dispatch latency (ISSUE overhead bound)."""
+    monkeypatch.delenv("MESH_TPU_NO_ENGINE", raising=False)
+    monkeypatch.delenv("MESH_TPU_OBS", raising=False)
+    rec = bench.obs_overhead(rounds=3, sweeps_per_round=2)
+    assert rec["metric"] == "obs_overhead_small_q"
+    assert rec["unit"] == "overhead_frac"
+    assert rec["off_ms_per_call"] > 0
+    assert rec["on_ms_per_call"] > 0
+    assert rec["overhead_frac"] == rec["value"]
+    assert rec["overhead_frac"] < 0.05
+    # the obs-on windows actually recorded spans (the comparison is
+    # measuring something, not two identical no-op runs)
+    assert rec["spans_recorded"] > 0
+    # the gate is restored: a guard run must not leave spans enabled
+    import os
+
+    assert "MESH_TPU_OBS" not in os.environ
+    # obs-off latency is the same steady-state sweep the pre-PR
+    # dispatch-latency guard measures — it must stay within noise of it
+    # (3x either way; the plans are shared in-process, so this re-run
+    # is compile-free)
+    lat = bench.dispatch_latency_small_q(repeats=1)
+    assert lat["engine_ms_per_call"] / 3 < rec["off_ms_per_call"] < (
+        3 * lat["engine_ms_per_call"])
+
+
+def test_obs_overhead_wedged_is_null(monkeypatch):
+    monkeypatch.setattr(
+        bench, "backend_responsive", lambda *a, **k: (False, "synthetic")
+    )
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--obs-overhead"])
+    buf = io.StringIO()
+    with redirect_stdout(buf), pytest.raises(SystemExit) as e:
+        bench.main()
+    rec = json.loads(buf.getvalue())
+    assert e.value.code == 1
+    assert rec["metric"] == "obs_overhead_small_q"
+    assert rec["value"] is None and "stale" not in rec
+    assert "synthetic" in rec["error"]
+
+
+def test_bench_records_carry_metrics_snapshot(monkeypatch):
+    """Every live bench record carries the final metrics-registry
+    snapshot under "obs" (satellite f)."""
+    rec = bench._with_obs({"metric": "m", "value": 1})
+    assert "obs" in rec
+    # the engine series migrated in PR 2 are present in the snapshot
+    assert "mesh_tpu_engine_plan_hits_total" in rec["obs"]
+    assert rec["obs"]["mesh_tpu_engine_dispatch_seconds"]["type"] == (
+        "histogram")

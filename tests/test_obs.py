@@ -1,0 +1,341 @@
+"""Unified observability subsystem (mesh_tpu.obs, doc/observability.md).
+
+Covers the PR-2 tentpole contracts:
+
+- registry semantics (labeled counters/gauges/histograms, kind conflicts,
+  loss-free concurrent writes from the executor worker + facade threads);
+- ``engine.stats()`` as an exact compatibility view over the registry;
+- span gating (``MESH_TPU_OBS`` off -> the shared no-op singleton) and
+  the acceptance span tree: one facade closest-point call yields
+  facade -> engine.submit -> (plan hit|compile) -> dispatch with correct
+  parent chains;
+- exporters: JSON-lines (spans + final metrics line), Prometheus text,
+  ascii tree.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from mesh_tpu import obs
+from mesh_tpu.obs.metrics import Registry
+from mesh_tpu.obs.trace import _NOOP, TRACER, span, timed_span, traced
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs(monkeypatch):
+    monkeypatch.delenv("MESH_TPU_OBS", raising=False)
+    obs.reset()
+    yield
+    obs.reset()
+
+
+def _tetra_mesh():
+    from mesh_tpu.mesh import Mesh
+
+    return Mesh(
+        v=np.array([[0, 0, 0], [1, 0, 0], [0, 1, 0], [0, 0, 1]], float),
+        f=np.array([[0, 1, 2], [0, 1, 3], [0, 2, 3], [1, 2, 3]], np.uint32),
+    )
+
+
+# ----------------------------------------------------------------------
+# registry
+
+
+class TestRegistry:
+    def test_counter_labels_and_total(self):
+        r = Registry()
+        c = r.counter("requests_total", "help")
+        c.inc(op="a")
+        c.inc(2, op="b")
+        c.inc(op="a")
+        assert c.value(op="a") == 2
+        assert c.value(op="b") == 2
+        assert c.total() == 4
+
+    def test_gauge_set_and_set_max(self):
+        r = Registry()
+        g = r.gauge("depth")
+        g.set(3)
+        g.set_max(2)        # lower: ignored
+        g.set_max(7)
+        assert g.value() == 7
+
+    def test_histogram_stat_and_buckets(self):
+        r = Registry()
+        h = r.histogram("lat", buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 5.0):
+            h.observe(v)
+        s = h.stat()
+        assert s["count"] == 3
+        assert s["min"] == 0.05 and s["max"] == 5.0
+        assert s["sum"] == pytest.approx(5.55)
+        snap = r.snapshot()["lat"]["series"][0]
+        # cumulative: <=0.1 holds 1, <=1.0 holds 2, +Inf holds all 3
+        assert snap["buckets"] == [[0.1, 1], [1.0, 2], ["+Inf", 3]]
+
+    def test_get_or_create_idempotent_and_kind_conflict(self):
+        r = Registry()
+        assert r.counter("x") is r.counter("x")
+        with pytest.raises(TypeError):
+            r.gauge("x")
+
+    def test_reset_zeroes_everything(self):
+        r = Registry()
+        r.counter("c").inc(5)
+        r.histogram("h").observe(1.0)
+        r.reset()
+        assert r.counter("c").total() == 0
+        assert r.histogram("h").stat()["count"] == 0
+
+    def test_concurrent_writers_lose_nothing(self):
+        """Satellite (c): executor-worker + N facade threads hammering one
+        counter and one histogram; the final snapshot is exact."""
+        r = Registry()
+        c = r.counter("hits_total")
+        h = r.histogram("lat_s")
+        n_threads, n_iter = 8, 500
+        barrier = threading.Barrier(n_threads)
+
+        def worker(tid):
+            barrier.wait()
+            for i in range(n_iter):
+                c.inc(thread=tid % 2)
+                h.observe(1e-4 * (i + 1))
+
+        threads = [
+            threading.Thread(target=worker, args=(t,))
+            for t in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        # concurrent readers must never see a torn series
+        for _ in range(50):
+            snap = r.snapshot()
+            assert set(snap) == {"hits_total", "lat_s"}
+        for t in threads:
+            t.join()
+        assert c.total() == n_threads * n_iter
+        assert h.stat()["count"] == n_threads * n_iter
+
+
+class TestEngineStatsCompat:
+    def test_snapshot_matches_registry(self):
+        """Satellite (c): engine.stats() is a view — every number in the
+        compat snapshot equals the registry series backing it."""
+        from mesh_tpu import engine
+        from mesh_tpu.engine.stats import STATS
+
+        engine.reset_stats()
+        STATS.record_plan_miss(0.25)
+        STATS.record_plan_hit()
+        STATS.record_plan_hit()
+        STATS.record_padding(useful=30, padded=40)
+        STATS.record_coalesced(3)
+        STATS.record_dispatch("closest_point", 0.002)
+        snap = engine.stats()
+        reg = obs.REGISTRY
+        assert snap["plan_cache"]["hits"] == reg.counter(
+            "mesh_tpu_engine_plan_hits_total").value()
+        assert snap["plan_cache"]["misses"] == reg.counter(
+            "mesh_tpu_engine_plan_misses_total").value()
+        assert snap["retraces"] == snap["plan_cache"]["misses"]
+        assert snap["plan_cache"]["compile_seconds"] == 0.25
+        assert snap["pad_waste"] == 0.25
+        assert snap["coalesced"]["dispatches"] == 1
+        assert snap["coalesced"]["requests"] == 3
+        assert snap["coalesced"]["max_batch"] == 3
+        lat = snap["dispatch_latency"]["closest_point"]
+        hist = reg.histogram("mesh_tpu_engine_dispatch_seconds")
+        assert lat["count"] == hist.stat(op="closest_point")["count"]
+        assert lat["total_s"] == pytest.approx(0.002)
+
+    def test_snapshot_shape_is_pinned(self):
+        from mesh_tpu import engine
+
+        snap = engine.stats()
+        assert set(snap) == {
+            "plan_cache", "retraces", "pad_waste", "coalesced",
+            "dispatch_latency",
+        }
+
+    def test_reset_is_safe_and_locked(self):
+        # satellite (a): the lock exists before reset() and is taken
+        # unconditionally — a fresh instance must construct cleanly and
+        # reset concurrently without error
+        from mesh_tpu.engine.stats import EngineStats
+
+        s = EngineStats(registry=Registry())
+        s.record_plan_hit()
+        threads = [threading.Thread(target=s.reset) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert s.snapshot()["plan_cache"]["hits"] == 0
+
+
+# ----------------------------------------------------------------------
+# spans
+
+
+class TestSpanGating:
+    def test_off_by_default_returns_noop_singleton(self):
+        s = span("anything", key=1)
+        assert s is _NOOP
+        with s as inner:
+            inner.set(more=2)
+        assert TRACER.events() == []
+
+    def test_on_records_nested_spans(self, monkeypatch):
+        monkeypatch.setenv("MESH_TPU_OBS", "1")
+        with span("outer") as o:
+            with span("inner", k=2):
+                pass
+            o.set(done=True)
+        ev = TRACER.events()
+        names = {e["name"]: e for e in ev}
+        assert set(names) == {"outer", "inner"}
+        assert names["inner"]["parent_id"] == names["outer"]["span_id"]
+        assert names["outer"]["parent_id"] is None
+        assert names["outer"]["attrs"]["done"] is True
+        assert names["inner"]["elapsed_s"] >= 0
+
+    def test_error_status_on_exception(self, monkeypatch):
+        monkeypatch.setenv("MESH_TPU_OBS", "1")
+        with pytest.raises(ValueError):
+            with span("boom"):
+                raise ValueError("nope")
+        (ev,) = TRACER.events()
+        assert ev["status"] == "error"
+        assert ev["attrs"]["error"] == "ValueError"
+
+    def test_falsey_env_values_stay_off(self, monkeypatch):
+        for off in ("0", "false", "no", "off", ""):
+            monkeypatch.setenv("MESH_TPU_OBS", off)
+            assert span("x") is _NOOP
+
+    def test_timed_span_measures_even_when_off(self):
+        with timed_span("d") as t:
+            pass
+        assert t.elapsed is not None and t.elapsed >= 0
+        assert TRACER.events() == []
+
+    def test_traced_decorator(self, monkeypatch):
+        monkeypatch.setenv("MESH_TPU_OBS", "1")
+
+        @traced
+        def add(a, b):
+            return a + b
+
+        assert add(2, 3) == 5
+        (ev,) = TRACER.events()
+        assert ev["name"].endswith("add")
+
+
+class TestSpanTreeAcceptance:
+    def test_facade_call_produces_full_chain(self, monkeypatch):
+        """ISSUE acceptance: with MESH_TPU_OBS=1 a single facade
+        closest-point call produces at least
+        facade -> engine.submit -> (plan hit|compile) -> dispatch."""
+        monkeypatch.delenv("MESH_TPU_NO_ENGINE", raising=False)
+        monkeypatch.setenv("MESH_TPU_OBS", "1")
+        m = _tetra_mesh()
+        pts = np.random.RandomState(0).rand(37, 3)
+        faces, cps = m.closest_faces_and_points(pts)
+        assert faces.shape == (1, 37) and cps.shape == (37, 3)
+        ev = {e["name"]: e for e in TRACER.events()}
+        assert {"facade.closest_faces_and_points", "engine.submit",
+                "engine.plan", "engine.dispatch"} <= set(ev)
+        facade = ev["facade.closest_faces_and_points"]
+        submit = ev["engine.submit"]
+        plan = ev["engine.plan"]
+        disp = ev["engine.dispatch"]
+        # parent chain: facade is the root of the others
+        assert facade["parent_id"] is None
+        assert submit["parent_id"] == facade["span_id"]
+        assert plan["parent_id"] == submit["span_id"]
+        assert disp["parent_id"] == submit["span_id"]
+        assert plan["attrs"]["outcome"] in ("hit", "compile")
+        # and it all exports as JSON lines + renders as a tree
+        tree = obs.render_tree()
+        assert "facade.closest_faces_and_points" in tree
+        assert "engine.submit" in tree
+
+
+# ----------------------------------------------------------------------
+# exporters
+
+
+class TestExporters:
+    def test_write_jsonl_spans_plus_metrics_line(self, monkeypatch,
+                                                 tmp_path):
+        monkeypatch.setenv("MESH_TPU_OBS", "1")
+        obs.counter("exported_total").inc(3)
+        with span("a"):
+            pass
+        path = tmp_path / "out.jsonl"
+        n = obs.write_jsonl(str(path))
+        lines = [json.loads(line) for line in path.read_text().splitlines()]
+        assert n == len(lines) == 2
+        assert lines[0]["kind"] == "span" and lines[0]["name"] == "a"
+        assert lines[-1]["kind"] == "metrics"
+        assert lines[-1]["metrics"]["exported_total"]["series"][0][
+            "value"] == 3
+
+    def test_prometheus_text(self):
+        obs.counter("prom_total", "a counter").inc(2, op="x")
+        obs.histogram("prom_lat", buckets=(0.5,)).observe(0.1)
+        text = obs.prometheus_text()
+        assert "# TYPE prom_total counter" in text
+        assert 'prom_total{op="x"} 2' in text
+        assert 'prom_lat_bucket{le="0.5"} 1' in text
+        assert 'prom_lat_bucket{le="+Inf"} 1' in text
+        assert "prom_lat_count 1" in text
+
+    def test_render_tree_empty_hint(self):
+        assert "MESH_TPU_OBS" in obs.render_tree()
+
+    def test_jsonl_sink_streams_live(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("MESH_TPU_OBS", "1")
+        path = tmp_path / "live.jsonl"
+        sink = obs.jsonl_sink(str(path))
+        TRACER.add_sink(sink)
+        try:
+            with span("streamed"):
+                pass
+        finally:
+            TRACER.remove_sink(sink)
+        (line,) = path.read_text().splitlines()
+        assert json.loads(line)["name"] == "streamed"
+
+
+# ----------------------------------------------------------------------
+# executor integration
+
+
+class TestExecutorObservability:
+    def test_queue_wait_recorded_per_request(self, monkeypatch):
+        monkeypatch.delenv("MESH_TPU_NO_ENGINE", raising=False)
+        from mesh_tpu import engine
+        from mesh_tpu.engine.executor import get_executor
+
+        engine.reset_stats()
+        m = _tetra_mesh()
+        pts = np.random.RandomState(1).rand(16, 3).astype(np.float32)
+        ex = get_executor()
+        with ex.coalesce():
+            futures = [
+                ex.submit("closest_point", m, pts) for _ in range(3)
+            ]
+        for fut in futures:
+            faces, cps = fut.result(timeout=120)
+            assert cps.shape == (16, 3)
+        hist = obs.REGISTRY.histogram("mesh_tpu_engine_queue_wait_seconds")
+        assert hist.stat()["count"] == 3
+        snap = engine.stats()
+        assert snap["coalesced"]["requests"] == 3
+        assert snap["coalesced"]["dispatches"] == 1

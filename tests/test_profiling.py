@@ -29,6 +29,30 @@ class TestTimer:
             pass
         assert len(lines) == 1 and lines[0].startswith("named:")
 
+    def test_elapsed_recorded_when_body_raises(self):
+        # PR-2 satellite: a raising body must still leave a measurement
+        # (sync is skipped — the watched output may be half-built)
+        t = Timer("boom")
+        try:
+            with t:
+                t.watch(jnp.arange(4))
+                raise RuntimeError("device flaked")
+        except RuntimeError:
+            pass
+        assert t.elapsed is not None and t.elapsed > 0
+        assert t.sync_elapsed is None
+
+    def test_sync_elapsed_split(self):
+        with Timer("s") as t:
+            t.watch(jnp.sum(jnp.arange(1000)))
+        assert t.sync_elapsed is not None and t.sync_elapsed >= 0
+        assert t.elapsed >= t.sync_elapsed
+
+    def test_sync_elapsed_none_without_watch(self):
+        with Timer("n") as t:
+            pass
+        assert t.elapsed >= 0 and t.sync_elapsed is None
+
 
 class TestTimeFn:
     def test_times_jax_fn(self):

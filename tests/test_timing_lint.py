@@ -1,0 +1,51 @@
+"""Timing discipline lint (ISSUE PR-2 satellite e).
+
+Raw clock reads scattered through the hot path are how timing code rots:
+they bypass the span tracer's sync-aware measurement and the overhead
+gate.  Every wall-clock read in ``mesh_tpu/`` must go through
+``utils/profiling.py`` (Timer / time_fn) or ``obs/`` (obs.clock
+re-exports the clocks; spans build on them).  ``viewer/`` is exempt —
+its deadlines and UI latencies are not hot-path measurements.
+"""
+
+import os
+import re
+
+_PKG = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "mesh_tpu"
+)
+
+#: a raw clock CALL — `monotonic = time.perf_counter` aliasing (obs.clock)
+#: deliberately does not match
+_RAW_CLOCK = re.compile(
+    r"\btime\.(time|perf_counter|monotonic|process_time)\s*\("
+)
+
+_EXEMPT = (
+    os.path.join("utils", "profiling.py"),
+    "obs" + os.sep,
+    "viewer" + os.sep,
+)
+
+
+def test_no_raw_clock_reads_outside_profiling_and_obs():
+    offenders = []
+    for root, _dirs, files in os.walk(_PKG):
+        for name in files:
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(root, name)
+            rel = os.path.relpath(path, _PKG)
+            if any(rel.startswith(e) or rel == e.rstrip(os.sep)
+                   for e in _EXEMPT):
+                continue
+            with open(path, encoding="utf-8") as fh:
+                for lineno, line in enumerate(fh, 1):
+                    if _RAW_CLOCK.search(line):
+                        offenders.append("%s:%d: %s"
+                                         % (rel, lineno, line.strip()))
+    assert not offenders, (
+        "raw clock reads outside utils/profiling.py and obs/ "
+        "(route them through obs.clock or Timer):\n"
+        + "\n".join(offenders)
+    )
