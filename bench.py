@@ -399,6 +399,76 @@ def obs_overhead(rounds=5, sweeps_per_round=3):
     }
 
 
+def fit_step_latency(repeats=10, n_scan=256):
+    """Forward / backward / re-correspondence latency of one scan-fit
+    step on the differentiable point-to-surface loss (doc/differentiable.md).
+
+    All three are timed in compile-free windows (jits warmed first, the
+    engine's plan cache warmed by one throwaway burst —
+    ``engine_compiles_timed`` must be 0, same bar as --dispatch-latency).
+    tests/test_bench_guard.py pins ``backward_over_forward`` < 3: the
+    envelope VJP is gathers and scatter-adds, so a ratio past that means
+    the backward pass started re-running the search.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from mesh_tpu import engine
+    from mesh_tpu.diff.register import _correspond
+    from mesh_tpu.models import synthetic_body_model
+    from mesh_tpu.parallel.fit import scan_to_model_loss
+
+    model = synthetic_body_model(seed=0)
+    rng = np.random.RandomState(0)
+    scan = jnp.asarray(rng.randn(1, n_scan, 3) * 0.3, jnp.float32)
+    betas = jnp.zeros((1, model.num_betas), jnp.float32)
+    pose = jnp.zeros((1, model.num_joints, 3), jnp.float32)
+    trans = jnp.zeros((1, 3), jnp.float32)
+
+    fwd = jax.jit(lambda b, p, t: scan_to_model_loss(model, b, p, t, scan))
+    bwd = jax.jit(jax.value_and_grad(
+        lambda b, p, t: scan_to_model_loss(model, b, p, t, scan),
+        argnums=(0, 1, 2),
+    ))
+    v_np = np.asarray(model.v_template, np.float32)
+    f_np = np.asarray(model.faces, np.int32)
+    scan_np = np.asarray(scan[0])
+
+    def timed(fn, n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            fn()
+        return (time.perf_counter() - t0) / n
+
+    # warm every compile path before any window is timed
+    fwd(betas, pose, trans).block_until_ready()
+    jax.block_until_ready(bwd(betas, pose, trans))
+    engine.reset_stats()
+    _correspond(v_np, f_np, scan_np, chunk=512)     # warms the engine plan
+    warm_misses = engine.stats()["plan_cache"]["misses"]
+    engine.reset_stats()
+
+    fwd_s = timed(lambda: fwd(betas, pose, trans).block_until_ready(),
+                  repeats)
+    bwd_s = timed(lambda: jax.block_until_ready(bwd(betas, pose, trans)),
+                  repeats)
+    rec_s = timed(lambda: _correspond(v_np, f_np, scan_np, chunk=512),
+                  repeats)
+    snap = engine.stats()
+    return {
+        "metric": "fit_step_latency",
+        "value": round(bwd_s * 1e3, 3),
+        "unit": "ms/call",
+        "vs_baseline": None,
+        "forward_ms": round(fwd_s * 1e3, 3),
+        "backward_ms": round(bwd_s * 1e3, 3),
+        "recorrespond_ms": round(rec_s * 1e3, 3),
+        "backward_over_forward": round(bwd_s / fwd_s, 2) if fwd_s else None,
+        "engine_compiles_warm": warm_misses,
+        "engine_compiles_timed": snap["plan_cache"]["misses"],
+    }
+
+
 def wedged_record(reason):
     """The JSON record (and exit code) for a capture attempted while the
     tunnel is wedged.  Two distinct situations, two distinct artifacts:
@@ -465,6 +535,7 @@ def main():
         for flag, metric, unit in (
             ("--dispatch-latency", "dispatch_latency_small_q", "ms/call"),
             ("--obs-overhead", "obs_overhead_small_q", "overhead_frac"),
+            ("--fit-step", "fit_step_latency", "ms/call"),
         ):
             if flag in sys.argv[1:]:
                 print(json.dumps({
@@ -478,7 +549,8 @@ def main():
         print(json.dumps(record))
         sys.exit(rc)
     if ("--dispatch-latency" in sys.argv[1:]
-            or "--obs-overhead" in sys.argv[1:]):
+            or "--obs-overhead" in sys.argv[1:]
+            or "--fit-step" in sys.argv[1:]):
         from mesh_tpu.utils.compilation_cache import (
             enable_persistent_compilation_cache,
         )
@@ -486,6 +558,8 @@ def main():
         enable_persistent_compilation_cache()
         if "--obs-overhead" in sys.argv[1:]:
             print(json.dumps(_with_obs(obs_overhead())))
+        elif "--fit-step" in sys.argv[1:]:
+            print(json.dumps(_with_obs(fit_step_latency())))
         else:
             print(json.dumps(_with_obs(dispatch_latency_small_q())))
         return

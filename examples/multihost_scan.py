@@ -111,7 +111,11 @@ def launch_pair():
     env["PALLAS_AXON_POOL_IPS"] = ""
     env["JAX_PLATFORMS"] = "cpu"
     env["JAX_NUM_CPU_DEVICES"] = str(LOCAL_DEVICES)
-    env.pop("XLA_FLAGS", None)
+    # jax < 0.5 has no jax_num_cpu_devices option; the XLA flag is the
+    # equivalent there and harmless alongside the option on newer jax
+    env["XLA_FLAGS"] = (
+        "--xla_force_host_platform_device_count=%d" % LOCAL_DEVICES
+    )
     # the bind-close-rebind gap can lose the port to another process;
     # retry fresh ports on that signature only (tests/test_multihost.py
     # gates its retry the same way) — a deterministic failure must surface
@@ -126,7 +130,10 @@ def run_host(pid, port):
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", LOCAL_DEVICES)
+    try:
+        jax.config.update("jax_num_cpu_devices", LOCAL_DEVICES)
+    except AttributeError:
+        pass                     # jax < 0.5: XLA_FLAGS set by the parent
 
     import numpy as np
 

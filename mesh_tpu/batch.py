@@ -92,7 +92,8 @@ def _batch_step(vs, fj, pts, use_pallas, use_culled, chunk, with_normals,
         from .query.pallas_culled import closest_point_pallas_culled
 
         res = closest_point_pallas_culled(
-            vs, fj, pts, assume_nondegenerate=nondegen)
+            vs, fj, pts, assume_nondegenerate=nondegen,
+            tile_variant=variant)
     elif use_pallas:
         # vmap lifts the Pallas grid to a batch dimension: one kernel
         # launch for all B meshes (same shape as bench.py's fused step)
@@ -141,13 +142,11 @@ def _strategy(f):
     use_pallas = pallas_default()
     if not use_pallas:
         return False, False
-    from .utils.dispatch import safe_tiles
-
-    if safe_tiles():
-        # the escape hatch pins the sliver-safe BRUTE tile; the culled
-        # kernel has no safe variant, so it is routed around (correctness
-        # over the cull's large-F speed, like the auto facade)
-        return True, False
+    # MESH_TPU_SAFE_TILES no longer changes the brute-vs-culled routing:
+    # the culled kernel runs the sliver-safe tile inside its sphere-culled
+    # grid (pallas_culled tile_variant="safe"), so large-F batches keep
+    # tiling under the escape hatch; the variant itself is threaded via
+    # utils.dispatch.tile_variant at the call sites
     from .query.autotune import crossover_faces
 
     return True, int(f.shape[0]) > crossover_faces()

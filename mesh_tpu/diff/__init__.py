@@ -1,0 +1,34 @@
+"""mesh_tpu.diff: surface queries as first-class autodiff citizens.
+
+The query kernels end in an argmin over faces; this subsystem makes them
+consumable by ``jax.grad``/``jax.jvp`` via envelope-theorem custom VJPs
+(queries.py), composes them into registration energies (energies.py), and
+drives an engine-routed ICP outer loop (register.py).  The training step
+in ``parallel/fit.py`` uses these for its default point-to-surface data
+term.  See doc/differentiable.md.
+"""
+
+from .energies import (  # noqa: F401
+    geman_mcclure,
+    huber,
+    landmark_term,
+    point_to_plane,
+    point_to_point,
+    symmetric_chamfer,
+)
+from .queries import (  # noqa: F401
+    closest_point,
+    closest_point_batched,
+    nearest_normal_weighted,
+    point_to_triangle,
+    surface_normals_frozen,
+)
+from .register import RegisterResult, icp_register, register_vertices  # noqa: F401
+
+__all__ = [
+    "closest_point", "closest_point_batched", "point_to_triangle",
+    "nearest_normal_weighted", "surface_normals_frozen",
+    "huber", "geman_mcclure", "point_to_point", "point_to_plane",
+    "symmetric_chamfer", "landmark_term",
+    "icp_register", "register_vertices", "RegisterResult",
+]

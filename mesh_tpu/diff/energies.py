@@ -1,0 +1,133 @@
+"""Registration energies composed from the differentiable queries.
+
+Every term here is a plain JAX scalar function of ``(v, f, scan)`` (or of
+model parameters through ``parallel/fit.py``'s LBS), built on
+``diff.queries`` so ``jax.grad`` sees the envelope-theorem VJPs instead of
+a non-differentiable argmin.  The robust kernels operate on SQUARED
+residuals (the queries return ``sqdist`` — no wasted sqrt on the happy
+path) and are the two standard scan-registration losses: Huber for heavy
+tails, Geman–McClure for outright outliers.
+
+The packed landmark term is reused from ``parallel/fit.py``
+(``landmark_arrays``/``landmark_loss``) rather than re-implemented — one
+packing convention across the subsystems (lazy import: fit.py imports this
+module for its surface data term).
+"""
+
+import jax.numpy as jnp
+
+from .queries import closest_point, surface_normals_frozen
+
+__all__ = [
+    "huber", "geman_mcclure", "point_to_point", "point_to_plane",
+    "symmetric_chamfer", "landmark_term",
+]
+
+
+def huber(sq, delta=1.0):
+    """Huber penalty on a SQUARED residual: ``sq`` below ``delta**2``,
+    ``2 delta |r| - delta**2`` above — quadratic near zero, linear tails.
+    Smooth at the crossover; safe at sq == 0 (no sqrt of zero under grad:
+    the sqrt branch is clamped away from 0 before jnp.where selects)."""
+    d2 = delta * delta
+    r = jnp.sqrt(jnp.maximum(sq, d2))   # only consumed where sq > d2
+    return jnp.where(sq <= d2, sq, 2.0 * delta * r - d2)
+
+
+def geman_mcclure(sq, sigma=1.0):
+    """Geman–McClure penalty on a SQUARED residual:
+    ``sigma^2 * sq / (sigma^2 + sq)`` — quadratic near zero, saturating to
+    ``sigma^2`` for outliers (their gradient -> 0, so far-off scan points
+    stop pulling the surface)."""
+    s2 = sigma * sigma
+    return s2 * sq / (s2 + sq)
+
+
+def _robustify(sq, robust):
+    """Apply a robust kernel given as None, a callable on squared
+    residuals, or a ("huber"|"geman_mcclure", scale) pair."""
+    if robust is None:
+        return sq
+    if callable(robust):
+        return robust(sq)
+    kind, scale = robust
+    kernel = {"huber": huber, "geman_mcclure": geman_mcclure}[kind]
+    return kernel(sq, scale)
+
+
+def point_to_point(v, f, scan, *, robust=None, mode="frozen", chunk=512,
+                   use_pallas=None):
+    """Mean (robustified) squared scan-to-surface distance.
+
+    The direct differentiable form of the reference's AABB-tree
+    correspondence energy: every scan point is attracted to its closest
+    point on the CURRENT surface, with exact envelope gradients into both
+    the scan and the mesh vertices.
+    """
+    res = closest_point(v, f, scan, mode=mode, chunk=chunk,
+                        use_pallas=use_pallas)
+    return jnp.mean(_robustify(res["sqdist"], robust))
+
+
+def point_to_plane(v, f, scan, *, robust=None, mode="frozen", chunk=512,
+                   use_pallas=None):
+    """Mean (robustified) squared point-to-plane residual
+    ``((p - cp) . n_face)^2`` with the winning face's unit normal frozen
+    (``surface_normals_frozen``) — the standard ICP linearization that
+    lets scan points slide tangentially along the surface.
+
+    Gradients flow through ``p`` and ``cp`` (envelope), never through the
+    normal: freezing it over the inner window keeps the term an exact
+    envelope form and avoids the cross terms that make differentiated
+    normals ill-conditioned on slivers.
+    """
+    res = closest_point(v, f, scan, mode=mode, chunk=chunk,
+                        use_pallas=use_pallas)
+    n = surface_normals_frozen(v, jnp.asarray(f, jnp.int32), res["face"])
+    r = jnp.sum((jnp.asarray(scan, n.dtype) - res["point"]) * n, axis=-1)
+    return jnp.mean(_robustify(r * r, robust))
+
+
+def symmetric_chamfer(v, f, scan, *, robust=None, mode="frozen", chunk=512,
+                      use_pallas=None):
+    """Symmetric surface chamfer: scan->surface through the differentiable
+    closest-point query plus vertex->scan through a dense pairwise min —
+    the completeness term that stops the surface from collapsing onto a
+    partial scan.  The vertex->scan direction is an O(V*S) min over scan
+    points (scan points are a fixed cloud, not a surface), exactly the
+    fused XLA pattern the old fit-loss data term used.
+    """
+    res = closest_point(v, f, scan, mode=mode, chunk=chunk,
+                        use_pallas=use_pallas)
+    fwd_term = jnp.mean(_robustify(res["sqdist"], robust))
+    v = jnp.asarray(v)
+    scan = jnp.asarray(scan, v.dtype)
+    d2 = jnp.sum((v[..., :, None, :] - scan[..., None, :, :]) ** 2, axis=-1)
+    bwd_term = jnp.mean(_robustify(jnp.min(d2, axis=-1), robust))
+    return fwd_term + bwd_term
+
+
+def landmark_term(verts, landmarks, weight=1.0):
+    """The packed landmark energy, delegated to ``parallel.fit``'s
+    ``landmark_loss`` (same ``landmark_arrays`` packing; lazy import
+    breaks the fit.py <-> diff cycle).
+
+    :param landmarks: ``(idx, bary, target_xyz)`` triple from
+        ``parallel.fit.landmark_arrays``.
+    """
+    from ..parallel.fit import landmark_loss
+
+    idx, bary, target_xyz = landmarks
+    return weight * landmark_loss(verts, idx, bary, target_xyz)
+
+
+def energy(name):
+    """Look up a data term by name — the string-keyed form
+    ``diff.register`` and bench sweeps use."""
+    try:
+        return {"point_to_point": point_to_point,
+                "point_to_plane": point_to_plane,
+                "symmetric_chamfer": symmetric_chamfer}[name]
+    except KeyError:
+        raise ValueError("unknown energy %r (want point_to_point, "
+                         "point_to_plane, or symmetric_chamfer)" % (name,))

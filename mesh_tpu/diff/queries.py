@@ -1,0 +1,283 @@
+"""Differentiable surface queries: envelope-theorem custom VJPs.
+
+The closest-point kernels (query/closest_point.py, query/pallas_closest.py)
+end in an argmin over faces — non-differentiable, so the flagship query was
+never consumed by ``jax.grad`` and the training step fell back to a
+min-over-vertices chamfer (VERDICT round 5, gap #1).  This module closes
+that gap the way mesh-based AD systems do (arXiv:2509.00406): differentiate
+the *value function*, not the search.
+
+For a query p against mesh (v, f), the squared surface distance is
+
+    d2(p, v) = min_{face, bary in simplex} |p - sum_k bary_k v[f[face, k]]|^2
+
+The minimizing (face, bary) is a discrete/constrained argmin, but by the
+envelope (Danskin) theorem the gradient of d2 needs NO derivative of the
+argmin: it is the partial gradient at the frozen winner,
+
+    dd2/dp =  2 (p - cp),      dd2/dv[f[face,k]] = -2 bary_k (p - cp),
+
+where cp = sum_k bary_k v[f[face, k]] is the closest point.  The feasible
+set (which face, the barycentric simplex) does not depend on (p, v), so
+this is the exact gradient of the true distance wherever it is
+differentiable (ties excepted).  Each wrapper here is a ``jax.custom_vjp``
+whose forward runs the existing non-differentiable dispatch (Pallas on TPU,
+the chunked XLA scan elsewhere) and whose backward applies exactly those
+closed forms.
+
+Two modes:
+
+- ``mode="frozen"`` (default): the hand-written VJP above.  Cheapest
+  backward (one gather + scatter-add), but first-order reverse only, and
+  cotangents arriving on the ``bary`` output are dropped (the envelope
+  theorem says they contribute nothing to distance-type energies).
+- ``mode="recompute"``: the winning face is found on ``stop_gradient``
+  inputs (AD-opaque — neither jvp nor vjp ever reaches the search), then
+  the barycentrics are RE-DERIVED differentiably from (query, winning
+  triangle) via ``closest_point_barycentric``.  Everything downstream of
+  the search is ordinary composed JAX, so ``jax.jvp``, forward-over-
+  reverse Hessians, and bary cotangents all work.  Same values, same
+  first-order gradients a.e., ~2x the forward flops on the winners.
+
+All wrappers return the same dict: ``point`` [Q, 3], ``sqdist`` [Q]
+(differentiable), ``bary`` [Q, 3] (differentiable only under
+``recompute``), ``face`` [Q] int32 and ``part`` [Q] int32 (never
+differentiable).  ``point``/``sqdist`` are recomposed from (face, bary) so
+output and backward linearize the identical expression.  Batched meshes go
+through ``jax.vmap`` (the custom VJPs batch transparently).
+
+See doc/differentiable.md for where gradients do and do not flow.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..geometry.tri_normals import tri_normals
+from ..query.closest_point import closest_point_dispatch
+from ..query.point_triangle import closest_point_barycentric
+from ..utils.dispatch import pallas_default
+
+__all__ = ["closest_point", "point_to_triangle", "nearest_normal_weighted"]
+
+
+def _compose(points, tri, bary):
+    """cp = sum_k bary_k * corner_k and its squared distance — THE
+    expression the envelope backward linearizes, so forward outputs are
+    recomposed from it (not taken from the search epilogue, which may
+    differ in the last ulp)."""
+    point = jnp.sum(bary[..., :, None] * tri, axis=-2)
+    diff = points - point
+    return point, diff, jnp.sum(diff * diff, axis=-1)
+
+
+def _winner_outputs(v, f, face, points):
+    """The full result dict from a winning-face index: differentiable
+    barycentrics on the frozen winner + recomposed point/sqdist."""
+    corners = f[face]                       # (Q, 3) int32
+    tri = v[corners]                        # (Q, 3, 3)
+    bary, part = closest_point_barycentric(
+        points, tri[..., 0, :], tri[..., 1, :], tri[..., 2, :]
+    )
+    point, _, sqdist = _compose(points, tri, bary)
+    return {"face": face, "part": part, "bary": bary,
+            "point": point, "sqdist": sqdist}
+
+
+def _frozen_from_face(v, f, face, points):
+    """The envelope-theorem custom VJP at a fixed winning face.
+
+    ``face`` is a trace-level constant here (closed over, like ``f``): the
+    search already ran outside.  Only (v, points) are differentiated.
+    """
+
+    v_shape = v.shape  # static: bwd must not close over traced values
+
+    @jax.custom_vjp
+    def cp(v_, points_):
+        return _winner_outputs(v_, f, face, points_)
+
+    def fwd(v_, points_):
+        out = _winner_outputs(v_, f, face, points_)
+        # bwd runs in a different trace context, so everything it needs —
+        # including the winning corner indices — rides in the residuals
+        return out, (points_, out["point"], out["bary"], f[face])
+
+    def bwd(res, cot):
+        points_, point, bary, corners = res
+        # face/part cotangents are float0, bary's is dropped (envelope:
+        # d sqdist / d bary = 0 at the constrained optimum)
+        g_point = cot["point"]
+        g_sqdist = cot["sqdist"]
+        diff = points_ - point
+        # sqdist = |points - cp|^2: d/d cp = -2 diff, d/d points = +2 diff
+        g_cp = g_point - 2.0 * diff * g_sqdist[..., None]
+        g_points = 2.0 * diff * g_sqdist[..., None]
+        # cp = sum_k bary_k v[f[face, k]]: scatter-add the bary-weighted
+        # cotangent into the three winning corners of each query
+        dv = jnp.zeros(v_shape, g_cp.dtype).at[corners].add(
+            bary[..., :, None] * g_cp[..., None, :]
+        )
+        return dv, g_points
+
+    cp.defvjp(fwd, bwd)
+    return cp(v, points)
+
+
+def _search_opaque(search, *args):
+    """Run a correspondence search AD-opaquely: stop_gradient on every
+    input means a jvp tracer lowers to its primal before the search ever
+    traces, so neither forward- nor reverse-mode AD reaches the argmin."""
+    return search(*[jax.lax.stop_gradient(a) for a in args])
+
+
+def _from_face(v, f, face, points, mode):
+    if mode == "frozen":
+        return _frozen_from_face(v, f, face, points)
+    if mode == "recompute":
+        # everything after the (already opaque) search is plain JAX:
+        # closest_point_barycentric is differentiable a.e., so jvp and
+        # second-order transforms compose normally
+        return _winner_outputs(v, f, face, points)
+    raise ValueError("mode must be 'frozen' or 'recompute', got %r"
+                     % (mode,))
+
+
+def closest_point(v, f, points, *, mode="frozen", chunk=512,
+                  use_pallas=None, nondegen=False, variant="fast"):
+    """Differentiable closest-point-on-surface query.
+
+    Forward runs the shared Pallas-vs-XLA dispatch body
+    (query.closest_point.closest_point_dispatch — the same route the
+    batched/sharded facades and the engine's plans compile); backward is
+    the envelope-theorem VJP documented in the module docstring.
+
+    :param v: [V, 3] vertices (differentiable)
+    :param f: [F, 3] int faces (static topology)
+    :param points: [Q, 3] queries (differentiable)
+    :param mode: ``"frozen"`` (hand-written VJP) or ``"recompute"``
+        (differentiable re-derivation; supports jvp/second order)
+    :param use_pallas: force the kernel choice; default = platform policy
+    :param nondegen: ``assume_nondegenerate`` for the Pallas tile
+    :param variant: Pallas tile variant (``MESH_TPU_SAFE_TILES`` callers
+        pass ``"safe"``)
+    :returns: dict with ``point`` [Q, 3], ``sqdist`` [Q], ``bary`` [Q, 3],
+        ``face`` [Q] int32, ``part`` [Q] int32
+    """
+    v = jnp.asarray(v)
+    points = jnp.asarray(points, v.dtype)
+    f = jnp.asarray(f, jnp.int32)
+    if use_pallas is None:
+        use_pallas = pallas_default()
+
+    def search(v_, pts_):
+        res = closest_point_dispatch(v_, f, pts_, chunk, use_pallas,
+                                     nondegen, variant)
+        return res["face"]
+
+    face = _search_opaque(search, v, points)
+    return _from_face(v, f, face, points, mode)
+
+
+def point_to_triangle(p, a, b, c, *, mode="frozen"):
+    """Differentiable point-to-triangle distance (no search — the
+    "winning face" IS the given triangle; only the constrained barycentric
+    argmin is enveloped).
+
+    Elementwise over matching leading axes of ``p``/``a``/``b``/``c``
+    [..., 3].  Returns ``point``/``sqdist``/``bary``/``part`` like
+    ``closest_point`` (no ``face``).
+    """
+    p = jnp.asarray(p)
+    a = jnp.asarray(a, p.dtype)
+    b = jnp.asarray(b, p.dtype)
+    c = jnp.asarray(c, p.dtype)
+
+    if mode == "frozen":
+
+        @jax.custom_vjp
+        def cp(p_, a_, b_, c_):
+            bary, part = closest_point_barycentric(p_, a_, b_, c_)
+            tri = jnp.stack([a_, b_, c_], axis=-2)
+            point, _, sqdist = _compose(p_, tri, bary)
+            return {"part": part, "bary": bary,
+                    "point": point, "sqdist": sqdist}
+
+        def fwd(p_, a_, b_, c_):
+            out = cp(p_, a_, b_, c_)
+            return out, (p_, out["point"], out["bary"])
+
+        def bwd(res, cot):
+            p_, point, bary = res
+            diff = p_ - point
+            g_cp = cot["point"] - 2.0 * diff * cot["sqdist"][..., None]
+            g_p = 2.0 * diff * cot["sqdist"][..., None]
+            return (g_p,
+                    bary[..., 0:1] * g_cp,
+                    bary[..., 1:2] * g_cp,
+                    bary[..., 2:3] * g_cp)
+
+        cp.defvjp(fwd, bwd)
+        return cp(p, a, b, c)
+
+    if mode == "recompute":
+        bary, part = closest_point_barycentric(p, a, b, c)
+        tri = jnp.stack([a, b, c], axis=-2)
+        point, _, sqdist = _compose(p, tri, bary)
+        return {"part": part, "bary": bary, "point": point, "sqdist": sqdist}
+    raise ValueError("mode must be 'frozen' or 'recompute', got %r"
+                     % (mode,))
+
+
+def nearest_normal_weighted(v, f, points, normals, *, eps=0.1,
+                            mode="frozen", chunk=512):
+    """Differentiable normal-weighted nearest query.
+
+    Forward runs query.normal_weighted.nearest_normal_weighted's blended
+    argmin ``|p - q| + eps (1 - n_p . n_tri)`` to pick the face; the
+    differentiable output is the euclidean closest point ON that frozen
+    face (matching the reference AabbNormalsTree contract, which returns
+    the euclidean foot point of the blended winner).  Gradients therefore
+    flow through (v, points) exactly as in ``closest_point``; ``normals``
+    only influence WHICH face wins — a discrete choice — so their gradient
+    is identically zero and they are treated as non-differentiable.
+    """
+    from ..query.normal_weighted import nearest_normal_weighted as nnw
+
+    v = jnp.asarray(v)
+    points = jnp.asarray(points, v.dtype)
+    f = jnp.asarray(f, jnp.int32)
+
+    def search(v_, pts_, nrm_):
+        face, _ = nnw(v_, f, pts_, nrm_, eps=eps, chunk=chunk)
+        return face
+
+    face = _search_opaque(search, v, points, jnp.asarray(normals, v.dtype))
+    return _from_face(v, f, face, points, mode)
+
+
+def surface_normals_frozen(v, f, face):
+    """Unit normals of the winning faces, detached from AD.
+
+    Point-to-plane energies project the residual on the face normal; the
+    standard ICP treatment (and the one that keeps the energy an exact
+    envelope form) freezes the normal over an inner optimization window,
+    so the normal is computed but never differentiated.
+    """
+    n = tri_normals(jax.lax.stop_gradient(jnp.asarray(v)), f)
+    return n[face]
+
+
+def closest_point_batched(v, f, points, **kwargs):
+    """Per-batch-element ``closest_point`` over stacked meshes/queries —
+    the form the fit step consumes ((..., V, 3) x (..., S, 3) with shared
+    topology; any number of leading axes)."""
+    v = jnp.asarray(v)
+    points = jnp.asarray(points, v.dtype)
+
+    def one(vb, pb):
+        return closest_point(vb, f, pb, **kwargs)
+
+    fn = one
+    for _ in range(v.ndim - 2):
+        fn = jax.vmap(fn)
+    return fn(v, points)

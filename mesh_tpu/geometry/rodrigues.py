@@ -18,6 +18,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.jax_compat import enable_x64
+
 _TAYLOR_EPS = 1e-8
 
 
@@ -170,7 +172,7 @@ def rodrigues(r, calculate_jacobian=True):
     r = np.array(r, dtype=np.float64)
     if r.shape in ((3,), (3, 1), (1, 3)):
         rf = r.flatten()
-        with jax.enable_x64(True):
+        with enable_x64(True):
             R = np.asarray(rodrigues2rotmat(jnp.asarray(rf, jnp.float64)))
             if not calculate_jacobian:
                 return R
@@ -185,7 +187,7 @@ def rodrigues(r, calculate_jacobian=True):
         s = np.linalg.norm([rx, ry, rz]) * 0.5
         c = np.clip((np.trace(Rp) - 1.0) * 0.5, -1.0, 1.0)
         theta = np.arccos(c)
-        with jax.enable_x64(True):
+        with enable_x64(True):
             out = np.asarray(rotmat2rodrigues(jnp.asarray(Rp, jnp.float64))).reshape(3, 1)
         if not calculate_jacobian:
             return out

@@ -5,9 +5,18 @@ a body model (BASELINE config 5).  This module provides the full TPU training
 step for that: differentiable LBS forward -> scan-to-surface loss -> adam
 update, batched over bodies (dp) and sharded over scan points (sp) on a
 `jax.sharding.Mesh`.  Gradients flow through the Taylor-guarded Rodrigues map
-and the (min-over-vertices) chamfer distance; XLA inserts the psum/all-gather
-collectives implied by the shardings — there is no hand-written communication
-(SURVEY.md section 2.3).
+and the surface distance; XLA inserts the psum/all-gather collectives implied
+by the shardings — there is no hand-written communication (SURVEY.md 2.3).
+
+The default data term is the TRUE point-to-SURFACE energy: each scan point's
+squared distance to its closest point on the posed mesh surface, through
+``mesh_tpu.diff``'s envelope-theorem VJP (doc/differentiable.md) — the
+flagship closest-point kernel finally consumed by the flagship training
+step.  The pre-diff min-over-VERTICES chamfer (which biases fits toward
+vertex-dense regions and over-estimates distance everywhere a scan point
+faces the middle of a triangle) is kept behind ``MESH_TPU_VERTEX_CHAMFER=1``
+for A/B comparison, read when the step/loss is BUILT (the loss is jitted;
+rebuild after toggling).
 """
 
 import dataclasses
@@ -65,16 +74,67 @@ def landmark_loss(verts, landm_idx, landm_bary, target_xyz):
     return jnp.mean(jnp.sum((regressed - target_xyz) ** 2, axis=-1))
 
 
+def _vertex_chamfer_data(verts, target_points):
+    """The pre-diff data term: mean squared scan-to-nearest-VERTEX
+    distance.  Exact and differentiable (d min / d argmin vertex), O(S*V)
+    pairs fused by XLA — but it over-estimates the surface distance
+    everywhere a scan point faces the interior of a triangle, biasing
+    fits toward vertex-dense regions.  Kept for MESH_TPU_VERTEX_CHAMFER=1
+    A/B runs."""
+    d2 = jnp.sum(
+        (target_points[..., :, None, :] - verts[..., None, :, :]) ** 2, axis=-1
+    )
+    return jnp.mean(jnp.min(d2, axis=-1))
+
+
+def _surface_data(verts, faces, target_points):
+    """The true point-to-SURFACE data term: mean squared distance from
+    each scan point to its closest point on the posed surface, through
+    diff.closest_point's envelope-theorem VJP — the correspondence
+    (winning face + barycentrics) refreshes every loss evaluation and is
+    exact at every step, so this is plain gradient descent on the true
+    surface distance, not frozen-correspondence ICP (diff/register.py is
+    the k-step-frozen variant)."""
+    from ..diff.queries import closest_point_batched
+
+    lead = jnp.broadcast_shapes(verts.shape[:-2], target_points.shape[:-2])
+    verts_b = jnp.broadcast_to(verts, lead + verts.shape[-2:])
+    pts_b = jnp.broadcast_to(
+        jnp.asarray(target_points, verts.dtype),
+        lead + target_points.shape[-2:])
+    res = closest_point_batched(verts_b, faces, pts_b)
+    return jnp.mean(res["sqdist"])
+
+
+def _resolve_data_term(data_term):
+    """``None`` -> env policy (utils.dispatch.vertex_chamfer); else the
+    explicit ``"surface"`` / ``"vertex"`` request.  Called at loss-BUILD
+    (trace) time: the choice is baked into the jitted step."""
+    if data_term is None:
+        from ..utils.dispatch import vertex_chamfer
+
+        return "vertex" if vertex_chamfer() else "surface"
+    if data_term not in ("surface", "vertex"):
+        raise ValueError(
+            "data_term must be None, 'surface' or 'vertex', got %r"
+            % (data_term,))
+    return data_term
+
+
 def scan_to_model_loss(model, betas, pose, trans, target_points,
                        pose_prior_weight=1e-3, beta_prior_weight=1e-3,
                        landmarks=None, landmark_weight=1.0,
-                       precision=jax.lax.Precision.HIGHEST):
-    """Mean squared scan-to-nearest-vertex distance + L2 priors, optionally
+                       precision=jax.lax.Precision.HIGHEST,
+                       data_term=None):
+    """Mean squared scan-to-SURFACE distance + L2 priors, optionally
     anchored by named landmarks.
 
-    target_points: (..., S, 3).  The min-over-vertices is exact and
-    differentiable (d min / d argmin vertex), the standard ICP-style data
-    term; O(S * V) pairs fused by XLA, sharded over S across devices.
+    target_points: (..., S, 3).  The default data term queries each scan
+    point against the posed mesh surface (``model.faces``) through the
+    differentiable closest-point wrapper (mesh_tpu.diff): gradients are
+    the exact envelope-theorem gradients of the true surface distance.
+    ``data_term="vertex"`` (or MESH_TPU_VERTEX_CHAMFER=1 when building
+    the loss) selects the legacy min-over-vertices chamfer instead.
 
     landmarks: optional ``(idx, bary, target_xyz)`` triple (see
     ``landmark_arrays``) adding ``landmark_weight * landmark_loss`` — the
@@ -82,11 +142,10 @@ def scan_to_model_loss(model, betas, pose, trans, target_points,
     reference computes the same regressors host-side, landmarks.py:45-65).
     """
     verts, _ = lbs(model, betas, pose, trans, precision=precision)
-    # (..., S, V) squared distances
-    d2 = jnp.sum(
-        (target_points[..., :, None, :] - verts[..., None, :, :]) ** 2, axis=-1
-    )
-    data = jnp.mean(jnp.min(d2, axis=-1))
+    if _resolve_data_term(data_term) == "surface":
+        data = _surface_data(verts, model.faces, target_points)
+    else:
+        data = _vertex_chamfer_data(verts, target_points)
     prior = pose_prior_weight * jnp.mean(pose ** 2) + beta_prior_weight * jnp.mean(
         betas ** 2
     )
@@ -110,14 +169,17 @@ def init_fit_state(model, batch_size, optimizer=None, dtype=jnp.float32):
 
 def make_fit_step(model, optimizer, mesh=None, dp_axis="dp", sp_axis="sp",
                   landmarks=None, landmark_weight=1.0,
-                  precision=jax.lax.Precision.HIGHEST):
+                  precision=jax.lax.Precision.HIGHEST, data_term=None):
     """Build the jitted training step.
 
     With a device mesh, the batch axis is sharded over `dp_axis` and scan
     points over `sp_axis`; parameters are sharded with the batch.  Without a
     mesh it is an ordinary single-device jit.  ``landmarks`` is an optional
     ``(idx, bary, target_xyz)`` triple (see ``landmark_arrays``).
+    ``data_term`` picks the loss's data term NOW (None -> "surface" unless
+    MESH_TPU_VERTEX_CHAMFER=1): the choice is baked into the jitted step.
     """
+    data_term = _resolve_data_term(data_term)
 
     def step(state, target_points):
         def loss_fn(params):
@@ -125,6 +187,7 @@ def make_fit_step(model, optimizer, mesh=None, dp_axis="dp", sp_axis="sp",
                 model, params["betas"], params["pose"], params["trans"],
                 target_points, landmarks=landmarks,
                 landmark_weight=landmark_weight, precision=precision,
+                data_term=data_term,
             )
 
         params = {"betas": state.betas, "pose": state.pose, "trans": state.trans}
@@ -181,7 +244,7 @@ def make_fit_step(model, optimizer, mesh=None, dp_axis="dp", sp_axis="sp",
 
 def fit_scan(model, target_points, steps=100, batch_size=None, mesh=None,
              optimizer=None, landmarks=None, landmark_weight=1.0,
-             precision=jax.lax.Precision.HIGHEST):
+             precision=jax.lax.Precision.HIGHEST, data_term=None):
     """Convenience driver: fit the model to (B, S, 3) scan batches,
     optionally anchored by ``landmarks=(idx, bary, target_xyz)``
     (see ``landmark_arrays``)."""
@@ -191,7 +254,8 @@ def fit_scan(model, target_points, steps=100, batch_size=None, mesh=None,
     batch_size = batch_size or target_points.shape[0]
     state, optimizer = init_fit_state(model, batch_size, optimizer)
     step = make_fit_step(model, optimizer, mesh=mesh, landmarks=landmarks,
-                         landmark_weight=landmark_weight, precision=precision)
+                         landmark_weight=landmark_weight, precision=precision,
+                         data_term=data_term)
     loss = None
     for _ in range(steps):
         state, loss = step(state, target_points)

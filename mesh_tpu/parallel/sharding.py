@@ -80,6 +80,7 @@ def _pack_local_winner(local, axis, shard_faces):
 from ..query.closest_point import (  # noqa: E402
     closest_point_dispatch as _closest_local,
 )
+from ..utils.jax_compat import shard_map  # noqa: E402
 
 
 @lru_cache(maxsize=32)
@@ -93,7 +94,7 @@ def _closest_shard_fn(mesh, axis, chunk, nondegen=False, variant="fast"):
     use_pallas = mesh_on_tpu(mesh)
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P(), P(), P(axis)),
         out_specs=(P(axis), P(axis)),
@@ -176,7 +177,7 @@ def _closest_fsharded_fn(mesh, axis, chunk, variant="fast"):
     use_pallas = mesh_on_tpu(mesh)
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P(), P(axis), P()),
         out_specs=(P(), P()),
@@ -221,7 +222,7 @@ def _closest_fsharded_ring_fn(mesh, axis, chunk, variant="fast"):
     n_shards = mesh.shape[axis]
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P(), P(axis), P()),
         out_specs=(P(), P()),
@@ -317,7 +318,7 @@ def _visibility_shard_fn(mesh, axis, chunk, min_dist):
     use_pallas = mesh_on_tpu(mesh)
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P(axis), P(axis), P(), P()),
         out_specs=(P(None, axis), P(None, axis)),
@@ -376,7 +377,7 @@ def _batched_visibility_shard_fn(mesh, axis, chunk, min_dist):
     use_pallas = mesh_on_tpu(mesh)
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P(axis), P(), P()),
         out_specs=(P(axis), P(axis)),
@@ -436,7 +437,7 @@ def sharded_batched_visibility(v_batch, f, cams, mesh, axis="dp",
 @lru_cache(maxsize=32)
 def _normals_shard_fn(mesh, axis):
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P(axis), P()),
         out_specs=P(axis),

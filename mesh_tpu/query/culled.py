@@ -178,28 +178,27 @@ def closest_faces_and_points_auto(
         # well as the degenerate tail (mesh_is_nondegenerate already
         # returns False under it): untrusted long-edge sliver meshes keep
         # reference-grade argmin conditioning (_sqdist_tile_safe).  The
-        # culled kernel has no safe variant, so the flag also pins the
-        # brute path at ANY face count — correctness over the cull's
-        # large-F speed is the escape hatch's contract.
-        from ..utils.dispatch import safe_tiles
+        # culled kernel runs the same safe tile inside its sphere-culled
+        # grid (pallas_culled tile_variant="safe"), so the brute-vs-culled
+        # crossover applies under the flag too — the escape hatch no
+        # longer costs large-F meshes their tiling.
+        from ..utils.dispatch import tile_variant
 
-        if safe_tiles():
-            _record_strategy("pallas_safe")
+        variant = tile_variant()
+        if f.shape[0] <= brute_force_max_faces:
+            _record_strategy(
+                "pallas_safe" if variant == "safe" else "pallas_brute")
             res = closest_point_pallas(
                 v32, f.astype(np.int32), pts32,
-                assume_nondegenerate=nondegen, tile_variant="safe",
-            )
-        elif f.shape[0] <= brute_force_max_faces:
-            _record_strategy("pallas_brute")
-            res = closest_point_pallas(
-                v32, f.astype(np.int32), pts32,
-                assume_nondegenerate=nondegen,
+                assume_nondegenerate=nondegen, tile_variant=variant,
             )
         else:
-            _record_strategy("pallas_culled")
+            _record_strategy(
+                "pallas_culled_safe" if variant == "safe"
+                else "pallas_culled")
             res = closest_point_pallas_culled(
                 v32, f.astype(np.int32), pts32,
-                assume_nondegenerate=nondegen,
+                assume_nondegenerate=nondegen, tile_variant=variant,
             )
         return {key: np.asarray(val) for key, val in res.items()}
     if f.shape[0] <= brute_force_max_faces:

@@ -25,6 +25,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .point_triangle import closest_point_on_triangle
+from ..utils.jax_compat import tpu_compiler_params
 
 _BIG = 1e30
 
@@ -295,6 +296,18 @@ def make_fused_argmin_kernel(cost_tile):
     on-chip sweep (tile_sweep.py fused arm) shows a win worth the
     documented tie semantics.  NaN costs pack to large positive keys and
     can never win (unlike jnp.min, which would propagate them).
+
+    Edge case (ADVICE r5, low #4): when NO pair in the whole scan beats
+    the init — every cost is +inf/NaN (e.g. all faces are the _BIG
+    sentinel padding, or every cost NaN-packed) — ``acc_p`` keeps its
+    int32-max init, whose low ``log2(TF)`` bits are all ones, and
+    ``acc_j`` keeps 0; the unpack ``acc_j * tf + (acc_p & (tf - 1))``
+    therefore reports index ``tf - 1`` (last column of the FIRST face
+    tile), where the exact scaffold's untouched ``acc_i`` init reports 0.
+    Both picks are equally arbitrary — no finite winner exists — and the
+    epilogue's exact recompute still reports the true distance of
+    whichever face is named, but comparisons against the exact scaffold
+    must not assume the indices agree in this (never-valid-input) case.
     """
 
     def kernel(*refs):
@@ -574,7 +587,7 @@ def nearest_vertices_pallas(v, points, tile_q=256, tile_v=2048,
             pltpu.VMEM((tile_q, 1), jnp.float32),
             pltpu.VMEM((tile_q, 1), jnp.int32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=DIMSEM_QF),
         interpret=interpret,
     )(*p_cols, *v_rows)
@@ -696,7 +709,7 @@ def closest_point_pallas(v, f, points, tile_q=256, tile_f=2048,
             pltpu.VMEM((tile_q, 1), acc_d_dtype),
             pltpu.VMEM((tile_q, 1), jnp.int32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=DIMSEM_QF),
         interpret=interpret,
     )(*p_cols, *face_rows)
@@ -820,7 +833,7 @@ def closest_point_pallas_mxu(v, f, points, tile_q=256, tile_f=2048,
             pltpu.VMEM((tile_q, 1), jnp.float32),
             pltpu.VMEM((tile_q, 1), jnp.int32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=DIMSEM_QF),
         interpret=interpret,
     )(p, p2, g, *planes)

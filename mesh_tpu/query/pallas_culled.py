@@ -47,9 +47,12 @@ from .pallas_closest import (
     DIMSEM_QF,
     N_FACE_ROWS,
     _sqdist_tile_fast,
+    _sqdist_tile_safe,
     fast_tile_rows,
+    safe_tile_rows,
 )
 from .point_triangle import closest_point_on_triangle
+from ..utils.jax_compat import tpu_compiler_params
 
 _SUB = 128          # sub-tile size for the seed upper bound
 _MARGIN = 1e-3      # relative safety margin on seeds / lower bounds
@@ -133,12 +136,14 @@ def _prologue(vc, f, pts, tile_q, tile_f):
     }
 
 
-def _make_culled_kernel(degenerate_tail):
-    """The culled argmin kernel, with the exact tile's degenerate-face
-    override compile-time optional (pallas_closest._ericson_tail): the
-    tail-free variant is bit-identical when every face clears the
-    relative area cut — the facade gates on mesh_is_nondegenerate, same
-    as the brute kernel."""
+def _make_culled_kernel(tile, degenerate_tail):
+    """The culled argmin kernel over a given sqdist tile
+    (``_sqdist_tile_fast`` or ``_sqdist_tile_safe`` — both consume 19
+    face planes, so the grid/spec plumbing is shared), with the exact
+    tile's degenerate-face override compile-time optional
+    (pallas_closest._ericson_tail): the tail-free variant is bit-identical
+    when every face clears the relative area cut — the facade gates on
+    mesh_is_nondegenerate, same as the brute kernel."""
 
     def kernel(*refs):
         qsph, fsph, seed, px, py, pz = refs[:6]
@@ -166,7 +171,7 @@ def _make_culled_kernel(degenerate_tail):
 
         @pl.when(lb * lb <= worst[0])
         def _exact_tile():
-            d2 = _sqdist_tile_fast(
+            d2 = tile(
                 px[0], py[0], pz[0], *[r[0] for r in face_refs],
                 degenerate_tail=degenerate_tail,
             )  # (TQ, TF)
@@ -187,15 +192,28 @@ def _make_culled_kernel(degenerate_tail):
     return kernel
 
 
-_culled_kernel = _make_culled_kernel(degenerate_tail=True)
-_culled_kernel_nodegen = _make_culled_kernel(degenerate_tail=False)
+_CULLED_TILES = {"fast": _sqdist_tile_fast, "safe": _sqdist_tile_safe}
+_CULLED_ROW_BUILDERS = {"fast": fast_tile_rows, "safe": safe_tile_rows}
+#: (tile_variant, assume_nondegenerate) -> built kernel, built lazily once
+_CULLED_KERNELS = {}
+
+
+def _culled_kernel_for(tile_variant, assume_nondegenerate):
+    key = (tile_variant, bool(assume_nondegenerate))
+    kernel = _CULLED_KERNELS.get(key)
+    if kernel is None:
+        kernel = _CULLED_KERNELS[key] = _make_culled_kernel(
+            _CULLED_TILES[tile_variant],
+            degenerate_tail=not assume_nondegenerate,
+        )
+    return kernel
 
 
 @partial(jax.jit, static_argnames=("tile_q", "tile_f", "interpret",
-                                   "assume_nondegenerate"))
+                                   "assume_nondegenerate", "tile_variant"))
 def closest_point_pallas_culled(
     v, f, points, tile_q=256, tile_f=1024, interpret=False,
-    assume_nondegenerate=False,
+    assume_nondegenerate=False, tile_variant="fast",
 ):
     """Culled closest_faces_and_points on TPU.  Same contract as
     query.closest_faces_and_points; ``v`` [V, 3] or batched [B, V, 3] with
@@ -205,7 +223,21 @@ def closest_point_pallas_culled(
     override (same contract as closest_point_pallas: bit-identical when
     every face clears the relative area cut; the facades derive the flag
     from data via mesh_is_nondegenerate).
+
+    ``tile_variant="safe"`` runs the sliver-safe direct-corner tile
+    (pallas_closest._sqdist_tile_safe) inside the SAME sphere-culled
+    grid, so MESH_TPU_SAFE_TILES keeps large-F tiling instead of falling
+    back to the brute scan.  The cull's certificates are tile-geometry
+    only (sphere centers/radii and seeds) and identical across variants;
+    only the exact per-pair distance changes, and the safe tile's errors
+    are strictly smaller, so every conservative-bound argument in the
+    module docstring carries over unchanged.  Both tiles consume 19 face
+    planes (fast_tile_rows / safe_tile_rows), so the kernel signature is
+    shared.
     """
+    if tile_variant not in ("fast", "safe"):
+        raise ValueError("tile_variant must be 'fast' or 'safe', got %r"
+                         % (tile_variant,))
     v = jnp.asarray(v, jnp.float32)
     points = jnp.asarray(points, jnp.float32)
     batched = v.ndim == 3
@@ -233,12 +265,13 @@ def closest_point_pallas_culled(
     fsph = jnp.concatenate([pro["fc"], pro["fr"][..., None]], axis=-1)
     seed = pro["seed"][..., None]              # (B, Qp, 1)
     p_planes = [pro["pts_s"][..., k:k + 1] for k in range(3)]  # (B, Qp, 1)
-    # the 19 per-face planes of the fast tile, from the shared builder;
-    # tri_s is edge-padded with real duplicated faces, so no sentinel fill
-    # is needed — a padded duplicate that wins a tie maps back to the same
-    # original face id
+    # the 19 per-face planes of the selected tile, from the shared
+    # builders; tri_s is edge-padded with real duplicated faces, so no
+    # sentinel fill is needed — a padded duplicate that wins a tie maps
+    # back to the same original face id
     t_planes = [
-        r.reshape(b_n, 1, f_pad) for r in fast_tile_rows(tri_s)
+        r.reshape(b_n, 1, f_pad)
+        for r in _CULLED_ROW_BUILDERS[tile_variant](tri_s)
     ]
 
     qsph_spec = pl.BlockSpec(
@@ -253,7 +286,7 @@ def closest_point_pallas_culled(
     frow_spec = pl.BlockSpec((1, 1, tile_f), lambda b, i, j: (b, 0, j))
 
     out_i = pl.pallas_call(
-        _culled_kernel_nodegen if assume_nondegenerate else _culled_kernel,
+        _culled_kernel_for(tile_variant, assume_nondegenerate),
         grid=grid,
         in_specs=[
             qsph_spec,
@@ -269,7 +302,7 @@ def closest_point_pallas_culled(
             pltpu.VMEM((tile_q, 1), jnp.int32),
             pltpu.SMEM((1,), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel",) + DIMSEM_QF),
         interpret=interpret,
     )(qsph, fsph, seed, *p_planes, *t_planes)

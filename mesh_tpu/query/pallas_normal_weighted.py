@@ -25,6 +25,7 @@ from .pallas_closest import (
     _sqdist_tile_fast, make_argmin_kernel,
 )
 from .point_triangle import closest_point_on_triangle
+from ..utils.jax_compat import tpu_compiler_params
 
 
 def _nw_cost_tile(eps, degenerate_tail, *planes):
@@ -92,7 +93,7 @@ def nearest_normal_weighted_pallas(v, f, points, normals, eps=0.1,
             pltpu.VMEM((tile_q, 1), jnp.float32),
             pltpu.VMEM((tile_q, 1), jnp.int32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=DIMSEM_QF),
         interpret=interpret,
     )(*p_cols, *n_cols, *face_rows, *tn_rows)

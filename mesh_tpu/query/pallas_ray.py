@@ -43,6 +43,7 @@ from .pallas_closest import (
     make_argmin_kernel,
 )
 from .ray import _BARY_EPS, _EPS
+from ..utils.jax_compat import tpu_compiler_params
 
 
 def _mt_terms(o, d, a, e1, e2):
@@ -181,7 +182,7 @@ def ray_any_hit_pallas(origins, dirs, tri, t_lo=0.0, t_hi=None,
         out_specs=_QCOL(tile_q),
         out_shape=jax.ShapeDtypeStruct((q_pad, 1), jnp.int32),
         scratch_shapes=[pltpu.VMEM((tile_q, 1), jnp.int32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=DIMSEM_QF),
         interpret=interpret,
     )(*qcols, *frows)
@@ -237,7 +238,7 @@ def nearest_alongnormal_pallas(v, f, points, normals, tile_q=256,
             pltpu.VMEM((tile_q, 1), jnp.float32),
             pltpu.VMEM((tile_q, 1), jnp.int32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=DIMSEM_QF),
         interpret=interpret,
     )(*qcols, *frows)
@@ -705,7 +706,7 @@ def self_intersection_count_pallas(v, f, tile_q=256, tile_f=512,
         out_specs=_QCOL(tile_q),
         out_shape=jax.ShapeDtypeStruct((q_pad, 1), jnp.int32),
         scratch_shapes=[pltpu.VMEM((tile_q, 1), jnp.int32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=DIMSEM_QF),
         interpret=interpret,
     )(*qcols, qi, *frows, mi)
@@ -757,7 +758,7 @@ def tri_tri_any_hit_pallas(q_tri, tri, tile_q=256, tile_f=512,
         out_specs=_QCOL(tile_q),
         out_shape=jax.ShapeDtypeStruct((q_pad, 1), jnp.int32),
         scratch_shapes=[pltpu.VMEM((tile_q, 1), jnp.int32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=DIMSEM_QF),
         interpret=interpret,
     )(*qcols, *frows)

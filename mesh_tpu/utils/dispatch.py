@@ -16,7 +16,7 @@ import os
 import jax
 
 __all__ = ["env_flag", "force_xla", "safe_tiles", "tile_variant",
-           "pallas_default", "mesh_on_tpu", "no_engine"]
+           "pallas_default", "mesh_on_tpu", "no_engine", "vertex_chamfer"]
 
 
 def env_flag(name):
@@ -46,6 +46,16 @@ def tile_variant():
     ``"fast"``.  Threaded through the auto, batched, sharded, and
     multi-host facades so the escape hatch reaches every entry point."""
     return "safe" if safe_tiles() else "fast"
+
+
+def vertex_chamfer():
+    """True when MESH_TPU_VERTEX_CHAMFER pins the fit loss's data term to
+    the pre-diff min-over-VERTICES chamfer instead of the default
+    point-to-SURFACE energy (parallel/fit.py) — the A/B hatch for the
+    PR-3 loss rewire.  Read at step-BUILD time (the loss is jitted:
+    toggling mid-run cannot retrace an already-built step, so rebuild the
+    step after changing it)."""
+    return env_flag("MESH_TPU_VERTEX_CHAMFER")
 
 
 def no_engine():

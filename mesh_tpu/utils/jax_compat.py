@@ -1,0 +1,53 @@
+"""Version-compat shims for jax API renames the package straddles.
+
+The package targets the current jax spellings, but the pinned container
+environments (and some user installs) carry jax 0.4.x, where two of the
+APIs we use live under older names:
+
+- ``pallas.tpu.CompilerParams`` was ``TPUCompilerParams`` before the
+  0.5-era rename;
+- ``jax.shard_map`` lived at ``jax.experimental.shard_map.shard_map``,
+  with ``check_vma`` spelled ``check_rep``.
+
+Each shim resolves the modern name first, so on new jax these are
+zero-cost pass-throughs and the deprecated spellings can be dropped by
+deleting this module.
+"""
+
+import jax
+
+__all__ = ["tpu_compiler_params", "shard_map", "enable_x64"]
+
+
+def tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams(**kwargs)`` under whichever name this jax
+    ships (``TPUCompilerParams`` on 0.4.x)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    return cls(**kwargs)
+
+
+def shard_map(f, **kwargs):
+    """``jax.shard_map`` with the pre-0.5 fallback (and its ``check_rep``
+    kwarg spelling).  Same call shape as the modern API, so
+    ``partial(shard_map, mesh=..., in_specs=..., out_specs=...)`` keeps
+    working as a decorator."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm  # noqa: F811
+
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+    return sm(f, **kwargs)
+
+
+def enable_x64(enabled=True):
+    """``jax.enable_x64(...)`` context manager under whichever name this
+    jax ships (``jax.experimental.enable_x64`` on 0.4.x)."""
+    ctx = getattr(jax, "enable_x64", None)
+    if ctx is None:
+        from jax.experimental import enable_x64 as ctx  # noqa: F811
+    return ctx(enabled)

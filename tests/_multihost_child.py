@@ -15,11 +15,15 @@ import sys
 os.environ["PALLAS_AXON_POOL_IPS"] = ""     # disable the axon TPU hook
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["JAX_NUM_CPU_DEVICES"] = "4"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
 
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 4)
+try:
+    jax.config.update("jax_num_cpu_devices", 4)
+except AttributeError:
+    pass                         # jax < 0.5: XLA_FLAGS above covers it
 
 import numpy as np  # noqa: E402
 

@@ -105,7 +105,10 @@ def test_obs_overhead_guard(monkeypatch):
     under 5% of steady-state dispatch latency (ISSUE overhead bound)."""
     monkeypatch.delenv("MESH_TPU_NO_ENGINE", raising=False)
     monkeypatch.delenv("MESH_TPU_OBS", raising=False)
-    rec = bench.obs_overhead(rounds=3, sweeps_per_round=2)
+    # min-of-5 interleaved rounds: on a loaded single-core host the
+    # 3-round min still carries enough scheduler noise to trip the 5%
+    # bound spuriously
+    rec = bench.obs_overhead(rounds=5, sweeps_per_round=2)
     assert rec["metric"] == "obs_overhead_small_q"
     assert rec["unit"] == "overhead_frac"
     assert rec["off_ms_per_call"] > 0
@@ -152,3 +155,36 @@ def test_bench_records_carry_metrics_snapshot(monkeypatch):
     assert "mesh_tpu_engine_plan_hits_total" in rec["obs"]
     assert rec["obs"]["mesh_tpu_engine_dispatch_seconds"]["type"] == (
         "histogram")
+
+
+def test_fit_step_latency_record(monkeypatch):
+    """PR-3 acceptance: the differentiable fit step's backward pass stays
+    under 3x the forward — the envelope VJP is gathers and scatter-adds,
+    so a ratio past that means the backward started re-running the
+    search.  The timed windows must be compile-free, same bar as the
+    dispatch-latency guard."""
+    monkeypatch.delenv("MESH_TPU_NO_ENGINE", raising=False)
+    rec = bench.fit_step_latency(repeats=2, n_scan=128)
+    assert rec["metric"] == "fit_step_latency"
+    assert rec["unit"] == "ms/call"
+    assert rec["forward_ms"] > 0
+    assert rec["backward_ms"] == rec["value"]
+    assert rec["recorrespond_ms"] > 0
+    assert rec["backward_over_forward"] < 3.0
+    assert rec["engine_compiles_warm"] >= 1
+    assert rec["engine_compiles_timed"] == 0
+
+
+def test_fit_step_wedged_is_null(monkeypatch):
+    monkeypatch.setattr(
+        bench, "backend_responsive", lambda *a, **k: (False, "synthetic")
+    )
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--fit-step"])
+    buf = io.StringIO()
+    with redirect_stdout(buf), pytest.raises(SystemExit) as e:
+        bench.main()
+    rec = json.loads(buf.getvalue())
+    assert e.value.code == 1
+    assert rec["metric"] == "fit_step_latency"
+    assert rec["value"] is None and "stale" not in rec
+    assert "synthetic" in rec["error"]

@@ -1,0 +1,293 @@
+"""Gradient correctness of mesh_tpu.diff (CPU, mostly float64).
+
+The envelope-theorem VJPs are checked three ways:
+
+1. against a dense *differentiable* O(Q*F) reference — barycentric
+   closest point on every face, ``jnp.min`` over faces — whose jax.grad
+   is trustworthy because it contains no custom rules;
+2. against central finite differences of the primal (f64, 1e-5);
+3. frozen vs ``mode="recompute"`` must agree exactly away from
+   argmin ties (the modes differ only in how the winning simplex is
+   linearized, not in which simplex wins).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mesh_tpu import diff
+from mesh_tpu.query.point_triangle import closest_point_barycentric
+from tests.fixtures import icosphere, separated_sphere_queries
+
+
+def _dense_min_sqdist(v, f, pts):
+    """Differentiable O(Q*F) reference: min over ALL faces of the exact
+    point-triangle squared distance (no argmin freezing anywhere)."""
+    tri = v[f]  # [F, 3, 3]
+    bary, _ = closest_point_barycentric(
+        pts[:, None, :], tri[None, :, 0], tri[None, :, 1], tri[None, :, 2]
+    )
+    cp = jnp.einsum("qfk,fkd->qfd", bary, tri)
+    sq = jnp.sum((pts[:, None, :] - cp) ** 2, axis=-1)
+    return jnp.min(sq, axis=-1)
+
+
+def _f64_case(subdiv=1, n_q=24, seed=0):
+    v, f = icosphere(subdiv)
+    pts = separated_sphere_queries(n_q, seed)
+    return (
+        jnp.asarray(v, jnp.float64),
+        jnp.asarray(f, jnp.int32),
+        jnp.asarray(pts, jnp.float64),
+    )
+
+
+class TestClosestPointGrad:
+    @pytest.mark.parametrize("mode", ["frozen", "recompute"])
+    def test_matches_dense_reference(self, mode):
+        with jax.experimental.enable_x64():
+            v, f, pts = _f64_case()
+
+            def loss(v_, pts_):
+                res = diff.closest_point(v_, f, pts_, mode=mode)
+                return jnp.sum(res["sqdist"])
+
+            def ref(v_, pts_):
+                return jnp.sum(_dense_min_sqdist(v_, f, pts_))
+
+            gv, gp = jax.grad(loss, argnums=(0, 1))(v, pts)
+            rv, rp = jax.grad(ref, argnums=(0, 1))(v, pts)
+            np.testing.assert_allclose(np.asarray(gv), np.asarray(rv), atol=1e-5)
+            np.testing.assert_allclose(np.asarray(gp), np.asarray(rp), atol=1e-5)
+
+    def test_finite_differences(self):
+        with jax.experimental.enable_x64():
+            v, f, pts = _f64_case(n_q=8, seed=1)
+            rng = np.random.RandomState(2)
+            dv = jnp.asarray(rng.randn(*v.shape), jnp.float64)
+            dp = jnp.asarray(rng.randn(*pts.shape), jnp.float64)
+
+            def loss(v_, pts_):
+                return jnp.sum(diff.closest_point(v_, f, pts_)["sqdist"])
+
+            gv, gp = jax.grad(loss, argnums=(0, 1))(v, pts)
+            analytic = float(jnp.vdot(gv, dv) + jnp.vdot(gp, dp))
+            eps = 1e-6
+            fd = (
+                float(loss(v + eps * dv, pts + eps * dp))
+                - float(loss(v - eps * dv, pts - eps * dp))
+            ) / (2 * eps)
+            assert abs(analytic - fd) <= 1e-5 * max(1.0, abs(fd))
+
+    def test_frozen_vs_recompute_consistent(self):
+        """sqdist gradients must agree exactly between modes: the envelope
+        theorem zeroes the bary-derivative term at the distance minimum,
+        so freezing bary loses nothing.  (The ``point`` output is NOT
+        covered by the theorem — its recompute gradient keeps the
+        tangential motion of the projection, frozen drops it by design —
+        so the comparison is deliberately restricted to sqdist.)"""
+        with jax.experimental.enable_x64():
+            v, f, pts = _f64_case(n_q=16, seed=3)
+            rng = np.random.RandomState(30)
+            w = jnp.asarray(rng.rand(16), jnp.float64)
+
+            def loss(mode):
+                def inner(v_, pts_):
+                    res = diff.closest_point(v_, f, pts_, mode=mode)
+                    return jnp.sum(w * res["sqdist"])
+                return inner
+
+            gf = jax.grad(loss("frozen"), argnums=(0, 1))(v, pts)
+            gr = jax.grad(loss("recompute"), argnums=(0, 1))(v, pts)
+            for a, b in zip(gf, gr):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-8)
+
+    def test_jvp_through_recompute(self):
+        """Forward-mode must work in recompute mode (the frozen custom_vjp
+        deliberately has no JVP rule; recompute re-derives barycentrics
+        differentiably so jax.jvp composes)."""
+        with jax.experimental.enable_x64():
+            v, f, pts = _f64_case(n_q=6, seed=4)
+            rng = np.random.RandomState(5)
+            dv = jnp.asarray(rng.randn(*v.shape), jnp.float64)
+
+            def prim(v_):
+                return diff.closest_point(v_, f, pts, mode="recompute")["sqdist"]
+
+            _, tangent = jax.jvp(prim, (v,), (dv,))
+            gv = jax.grad(lambda v_: jnp.sum(prim(v_)))(v)
+            np.testing.assert_allclose(
+                float(jnp.sum(tangent)), float(jnp.vdot(gv, dv)), rtol=1e-9
+            )
+
+    def test_batched_grad(self):
+        with jax.experimental.enable_x64():
+            v, f, pts = _f64_case(n_q=10, seed=6)
+            vb = jnp.stack([v, v * 1.1])
+            pb = jnp.stack([pts, pts + 0.05])
+
+            def loss(vb_):
+                return jnp.sum(diff.closest_point_batched(vb_, f, pb)["sqdist"])
+
+            g = jax.grad(loss)(vb)
+            assert g.shape == vb.shape
+            assert bool(jnp.all(jnp.isfinite(g)))
+
+
+class TestPointToTriangleGrad:
+    def test_matches_dense_reference(self):
+        with jax.experimental.enable_x64():
+            rng = np.random.RandomState(7)
+            p = jnp.asarray(rng.randn(12, 3), jnp.float64)
+            a = jnp.asarray(rng.randn(12, 3), jnp.float64)
+            b = jnp.asarray(rng.randn(12, 3), jnp.float64)
+            c = jnp.asarray(rng.randn(12, 3), jnp.float64)
+
+            def loss(p_, a_, b_, c_):
+                return jnp.sum(diff.point_to_triangle(p_, a_, b_, c_)["sqdist"])
+
+            def ref(p_, a_, b_, c_):
+                bary, _ = closest_point_barycentric(p_, a_, b_, c_)
+                cp = jnp.einsum(
+                    "qk,qkd->qd", bary, jnp.stack([a_, b_, c_], axis=-2)
+                )
+                return jnp.sum(jnp.sum((p_ - cp) ** 2, axis=-1))
+
+            g = jax.grad(loss, argnums=(0, 1, 2, 3))(p, a, b, c)
+            r = jax.grad(ref, argnums=(0, 1, 2, 3))(p, a, b, c)
+            for gi, ri in zip(g, r):
+                np.testing.assert_allclose(np.asarray(gi), np.asarray(ri), atol=1e-5)
+
+
+class TestEnergies:
+    def test_point_to_plane_grad_finite(self):
+        with jax.experimental.enable_x64():
+            v, f, pts = _f64_case(n_q=12, seed=8)
+            g = jax.grad(lambda v_: diff.point_to_plane(v_, f, pts))(v)
+            assert bool(jnp.all(jnp.isfinite(g)))
+
+    @pytest.mark.parametrize("robust", [None, ("huber", 0.1), ("geman_mcclure", 0.1)])
+    def test_point_to_point_robust_grad_finite(self, robust):
+        with jax.experimental.enable_x64():
+            v, f, pts = _f64_case(n_q=12, seed=9)
+            g = jax.grad(
+                lambda v_: diff.point_to_point(v_, f, pts, robust=robust)
+            )(v)
+            assert bool(jnp.all(jnp.isfinite(g)))
+
+    def test_symmetric_chamfer_grad_finite(self):
+        with jax.experimental.enable_x64():
+            v, f, pts = _f64_case(n_q=12, seed=10)
+            g = jax.grad(lambda v_: diff.symmetric_chamfer(v_, f, pts))(v)
+            assert bool(jnp.all(jnp.isfinite(g)))
+
+    def test_robust_kernels_reduce_to_identity_near_zero(self):
+        sq = jnp.asarray([1e-8, 1e-6], jnp.float32)
+        np.testing.assert_allclose(
+            np.asarray(diff.huber(sq, delta=1.0)), np.asarray(sq), rtol=1e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(diff.geman_mcclure(sq, sigma=1.0)),
+            np.asarray(sq),
+            rtol=1e-4,
+        )
+
+
+class TestFitLossSurface:
+    def test_default_data_term_is_surface(self):
+        from mesh_tpu.parallel.fit import _resolve_data_term
+
+        assert _resolve_data_term(None) == "surface"
+        assert _resolve_data_term("vertex") == "vertex"
+
+    def test_fit_loss_grad_nan_free_on_sliver_mesh(self):
+        """The sliver-heavy synthetic template must not poison the fit
+        gradients: the surface data term's backward touches only the
+        winning simplex via frozen barycentrics, so degenerate faces a
+        query does NOT project onto contribute nothing."""
+        from mesh_tpu.models import synthetic_body_model
+        from mesh_tpu.parallel.fit import scan_to_model_loss
+
+        v, f = icosphere(1)
+        v = np.asarray(v, np.float32)
+        f = np.asarray(f, np.int32)
+        n0 = len(v)
+        # graft a point-triangle and a collinear sliver onto the template
+        v = np.vstack([
+            v,
+            [[0.0, 0.0, 1.5], [-0.5, 0.0, 1.5], [0.5, 0.0, 1.5]],
+        ]).astype(np.float32)
+        f = np.vstack(
+            [f, [[n0, n0, n0], [n0, n0 + 1, n0 + 2]]]
+        ).astype(np.int32)
+        model = synthetic_body_model(seed=0, template=(v, f))
+        rng = np.random.RandomState(11)
+        scan = jnp.asarray(rng.randn(1, 40, 3) * 0.3, jnp.float32)
+        betas = jnp.zeros((1, model.num_betas))
+        pose = jnp.zeros((1, model.num_joints, 3))
+        trans = jnp.zeros((1, 3))
+
+        def loss(betas_, pose_, trans_):
+            return scan_to_model_loss(model, betas_, pose_, trans_, scan)
+
+        grads = jax.grad(loss, argnums=(0, 1, 2))(betas, pose, trans)
+        for g in grads:
+            assert bool(jnp.all(jnp.isfinite(g)))
+
+    def test_vertex_term_still_available(self):
+        from mesh_tpu.models import synthetic_body_model
+        from mesh_tpu.parallel.fit import scan_to_model_loss
+
+        model = synthetic_body_model(seed=0)
+        rng = np.random.RandomState(12)
+        scan = jnp.asarray(rng.randn(1, 30, 3) * 0.3, jnp.float32)
+        z = jnp.zeros((1, model.num_betas))
+        pose = jnp.zeros((1, model.num_joints, 3))
+        t = jnp.zeros((1, 3))
+        a = float(scan_to_model_loss(model, z, pose, t, scan, data_term="surface"))
+        b = float(scan_to_model_loss(model, z, pose, t, scan, data_term="vertex"))
+        assert np.isfinite(a) and np.isfinite(b)
+        # surface distance is a lower bound on vertex distance
+        assert a <= b + 1e-6
+
+
+class TestRegister:
+    def test_icp_descends_and_hits_plan_cache(self):
+        """Acceptance: ICP re-correspondence goes through the engine and
+        the repeated same-shape bursts hit the plan cache (hits > misses
+        after warmup)."""
+        from mesh_tpu.engine import stats
+
+        v, f = icosphere(2)
+        rng = np.random.RandomState(13)
+        scan = (np.asarray(v) * 1.15 + rng.randn(*v.shape) * 0.01).astype(
+            np.float32
+        )[: 120]
+        before = stats()["plan_cache"]
+        res = diff.register_vertices(
+            v.astype(np.float32), f, scan, steps=6, recorrespond_every=2
+        )
+        after = stats()["plan_cache"]
+        assert res.losses[-1] < res.losses[0]
+        assert res.recorrespondences == 3
+        d_hits = after["hits"] - before["hits"]
+        d_misses = after["misses"] - before["misses"]
+        assert d_hits > d_misses
+
+    def test_register_records_obs(self, monkeypatch):
+        monkeypatch.setenv("MESH_TPU_OBS", "1")
+        from mesh_tpu.obs import metrics_snapshot
+
+        v, f = icosphere(1)
+        rng = np.random.RandomState(14)
+        scan = (np.asarray(v) * 1.1 + rng.randn(*v.shape) * 0.01).astype(
+            np.float32
+        )
+        diff.register_vertices(
+            v.astype(np.float32), f, scan, steps=4, recorrespond_every=2
+        )
+        snap = metrics_snapshot()
+        assert "mesh_tpu_diff_recorrespond_total" in snap
+        assert "mesh_tpu_diff_residual_meters" in snap

@@ -4,6 +4,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -38,6 +40,9 @@ def test_batch_pipeline_example():
     assert "amortization" in res.stdout
 
 
+# 8-device CPU simulation end-to-end: minutes-scale, like the sharded
+# tests it drives; tier-1 (-m 'not slow') skips it
+@pytest.mark.slow
 def test_fit_multichip_example(tmp_path):
     res = _run_example(
         "fit_multichip.py", "--steps", "8", "--ckpt", str(tmp_path / "ckpt"),
@@ -63,6 +68,10 @@ def test_hand_body_contact_example(tmp_path):
     assert (tmp_path / "body.ply").exists()
 
 
+@pytest.mark.skipif(
+    __import__("jax").__version_info__ < (0, 5, 0),
+    reason="multi-process CPU collectives need jax >= 0.5",
+)
 def test_multihost_scan_example():
     res = _run_example("multihost_scan.py")
     out = res.stdout

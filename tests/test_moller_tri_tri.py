@@ -15,6 +15,7 @@ from mesh_tpu.query.ray import (
     tri_tri_intersects_moller,
 )
 from mesh_tpu.query.pallas_ray import tri_tri_any_hit_pallas
+from mesh_tpu.utils.jax_compat import enable_x64
 
 
 def _pair(p, q):
@@ -81,7 +82,7 @@ def test_random_battery_matches_segment_oracle_where_robust():
     q = rng.randn(n, 3, 3) * rng.choice([0.3, 1.0, 3.0], (n, 1, 1))
     q[:, :, 2] *= rng.choice([0.05, 1.0], (n, 1))   # some near-planar pairs
 
-    with jax.enable_x64(True):
+    with enable_x64(True):
         pj = jnp.asarray(p)
         qj = jnp.asarray(q)
         assert pj.dtype == jnp.float64

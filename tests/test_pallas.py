@@ -8,6 +8,7 @@ from mesh_tpu.query import closest_faces_and_points
 from mesh_tpu.query.pallas_closest import closest_point_pallas
 
 from .fixtures import box, icosphere
+from mesh_tpu.utils.jax_compat import enable_x64
 
 
 class TestPallasClosestPoint:
@@ -103,7 +104,7 @@ class TestPallasClosestPoint:
         # downcast and the oracle would share the f32 rounding under test
         import jax
 
-        with jax.enable_x64(True):
+        with enable_x64(True):
             ref = closest_faces_and_points(
                 (v + offset).astype(np.float64), f,
                 q_far.astype(np.float64),

@@ -162,3 +162,84 @@ def test_culled_degenerate_faces_never_underreport():
         np.testing.assert_allclose(
             float(np.asarray(res["sqdist"])[qi]), expect, rtol=1e-5
         )
+
+
+@pytest.mark.parametrize("tile_variant", ["fast", "safe"])
+def test_culled_safe_variant_matches_bruteforce(tile_variant):
+    """The safe tile inside the culled grid must meet the same bar the
+    fast tile does: the cull certificates are tile-geometry only, so the
+    variant can only change per-pair distances, never pruning."""
+    v, f = icosphere(3)
+    rng = np.random.RandomState(5)
+    pts = rng.randn(400, 3).astype(np.float32) * 1.5
+    res = closest_point_pallas_culled(
+        v.astype(np.float32), f, pts, tile_q=64, tile_f=256,
+        interpret=True, tile_variant=tile_variant,
+    )
+    ref = closest_faces_and_points(v.astype(np.float32), f, pts)
+    _assert_matches(res, ref, pts)
+
+
+def test_culled_safe_variant_sliver_mesh():
+    """Sliver-heavy mesh: the safe tile's direct-corner fallback must keep
+    the culled kernel exact with assume_nondegenerate=False, the exact
+    regime MESH_TPU_SAFE_TILES exists for."""
+    rng = np.random.RandomState(6)
+    v, f = icosphere(1)
+    v = v.astype(np.float32)
+    f = f.astype(np.int32)
+    extra_v = np.array(
+        [[0.0, 0.0, 10.0],
+         [-1.0, 0.0, 10.0], [1.0, 0.0, 10.0], [3.0, 0.0, 10.0]],
+        np.float32,
+    )
+    n0 = len(v)
+    v = np.vstack([v, extra_v])
+    f = np.vstack([
+        f,
+        [[n0, n0, n0], [n0 + 1, n0 + 2, n0 + 3]],
+    ]).astype(np.int32)
+    pts = np.vstack([
+        (rng.randn(30, 3) * 0.8).astype(np.float32),
+        [[0.0, 0.5, 10.0]],
+        [[0.1, -0.2, 9.0]],
+    ]).astype(np.float32)
+    ref = closest_faces_and_points(v, f, pts)
+    res = closest_point_pallas_culled(
+        v, f, pts, tile_q=8, tile_f=16, interpret=True,
+        assume_nondegenerate=False, tile_variant="safe",
+    )
+    np.testing.assert_allclose(
+        np.asarray(res["sqdist"]), np.asarray(ref["sqdist"]), atol=1e-5
+    )
+
+
+def test_culled_safe_variant_batched():
+    v, f = icosphere(2)
+    rng = np.random.RandomState(7)
+    vs = (
+        v[None] * (1.0 + 0.3 * rng.rand(2, 1, 1))
+        + rng.randn(2, 1, 3) * 0.2
+    ).astype(np.float32)
+    pts = rng.randn(2, 90, 3).astype(np.float32)
+    res = closest_point_pallas_culled(
+        vs, f, pts, tile_q=32, tile_f=64, interpret=True, tile_variant="safe"
+    )
+    for bi in range(2):
+        ref = closest_faces_and_points(vs[bi], f, pts[bi])
+        np.testing.assert_allclose(
+            np.sqrt(np.asarray(res["sqdist"][bi])),
+            np.sqrt(np.asarray(ref["sqdist"])),
+            atol=1e-5,
+            rtol=1e-4,
+        )
+
+
+def test_culled_rejects_unknown_variant():
+    v, f = icosphere(1)
+    pts = np.zeros((4, 3), np.float32)
+    with pytest.raises(ValueError, match="tile_variant"):
+        closest_point_pallas_culled(
+            v.astype(np.float32), f, pts, interpret=True,
+            tile_variant="mystery",
+        )

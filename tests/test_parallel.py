@@ -23,7 +23,13 @@ needs_devices = pytest.mark.skipif(
     len(jax.devices()) < 8, reason="needs 8 virtual devices"
 )
 
+#: the 8-virtual-device shard_map tests take minutes under the CPU
+#: simulator; tier-1 (-m 'not slow') skips them, the full/TPU suite runs
+#: them
+slow_on_cpu_sim = pytest.mark.slow
 
+
+@slow_on_cpu_sim
 @needs_devices
 class TestShardedQueries:
     def test_closest_point_matches_single_device(self):
@@ -216,6 +222,7 @@ class TestShardedQueries:
         np.testing.assert_allclose(ndc_s, ndc_r, atol=1e-5)
 
 
+@slow_on_cpu_sim
 @needs_devices
 class TestDistributedFit:
     def test_fit_step_runs_on_2d_mesh(self):
@@ -275,6 +282,7 @@ class TestGraftEntry:
         out = jax.jit(fn)(*args)
         assert out.shape == (4, 6890, 3)
 
+    @slow_on_cpu_sim
     @needs_devices
     def test_dryrun_multichip(self):
         import importlib
@@ -376,6 +384,7 @@ class TestLandmarkFit:
         assert err.max() < 0.15
 
 
+@slow_on_cpu_sim
 @needs_devices
 class TestShardedVisibility:
     def test_matches_single_device(self):
@@ -417,7 +426,7 @@ class TestCheckpoint:
 
     @pytest.mark.parametrize(
         "use_mesh",
-        [False, pytest.param(True, marks=needs_devices)],
+        [False, pytest.param(True, marks=[needs_devices, slow_on_cpu_sim])],
         ids=["single_device", "sharded_mesh"],
     )
     def test_save_restore_resumes_bit_identically(self, tmp_path, use_mesh):

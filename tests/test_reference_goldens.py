@@ -15,13 +15,14 @@ import numpy as np
 import pytest
 
 from mesh_tpu import Mesh
+from mesh_tpu.utils.jax_compat import enable_x64
 
 
 def x64_mode():
     """Scoped 64-bit JAX types (restores the prior setting on exit)."""
     import jax
 
-    return jax.enable_x64(True)
+    return enable_x64(True)
 
 # 20-vertex random mesh + 5 queries; expected values are CGAL
 # closest_point_and_primitive outputs hardcoded in the reference test

@@ -128,8 +128,9 @@ def test_invalid_options_raise():
 
 def test_safe_tiles_reaches_batched_and_sharded_facades(monkeypatch):
     # the escape hatch must not stop at the single-mesh auto facade
-    # (code-review round-5): the batched strategy routes around the
-    # culled kernel (which has no safe variant), and the sharded/
+    # (code-review round-5): the batched strategy keeps the measured
+    # brute-vs-culled crossover under the flag (the culled kernel runs
+    # the safe tile since PR 3 — tile_variant="safe"), and the sharded/
     # multi-host plumbing threads the variant into its shard bodies
     import inspect
 
@@ -140,8 +141,10 @@ def test_safe_tiles_reaches_batched_and_sharded_facades(monkeypatch):
     monkeypatch.setenv("MESH_TPU_SAFE_TILES", "1")
     assert dispatch.tile_variant() == "safe"
     if dispatch.pallas_default():
+        # a million-face batch must still take the culled kernel: the
+        # safe variant tiles, it no longer routes around the cull
         f_big = np.zeros((10 ** 6, 3), np.int32)
-        assert batch._strategy(f_big) == (True, False)
+        assert batch._strategy(f_big) == (True, True)
     for fn in (sharding._closest_local, sharding._closest_shard_fn,
                sharding._closest_fsharded_fn,
                sharding._closest_fsharded_ring_fn,
