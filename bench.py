@@ -231,16 +231,69 @@ def cpu_baseline(model, betas, pose, queries, n_meshes=4):
     return per_mesh * BATCH
 
 
-def backend_responsive(probe_timeout=150, attempts=3):
+def _inprocess_backend_ok(check_timeout=5):
+    """True when THIS process already initialized the jax backend and it
+    still answers a tiny computation.  Probe-free fast path for
+    backend_responsive(): a live in-process backend makes the subprocess
+    probe pure overhead (~2 s healthy, minutes wedged) — and on the axon
+    tunnel a second backend in a child process is itself a wedge risk.
+
+    Never touches jax unless it is already imported, and runs the check
+    on an abandoned daemon thread so a wedged device cannot hang the
+    caller — a wedge here just means "fast path unavailable", the
+    subprocess probe still decides.
+    """
+    import threading
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return False
+    try:
+        from jax._src import xla_bridge
+
+        if not getattr(xla_bridge, "_backends", None):
+            return False            # imported but never initialized: probe
+    except Exception:
+        return False
+    box = {}
+
+    def _check():
+        try:
+            import jax.numpy as jnp
+
+            box["ok"] = float(jnp.ones((8, 8)).sum()) == 64.0
+        except Exception:
+            box["ok"] = False
+
+    worker = threading.Thread(target=_check, daemon=True,
+                              name="bench-inprocess-probe")
+    worker.start()
+    worker.join(timeout=check_timeout)
+    return bool(box.get("ok"))
+
+
+def backend_responsive(probe_timeout=150, attempts=3, hung_probe_timeout=15):
     """(ok, reason): whether a throwaway subprocess can init the jax backend
     and run a tiny computation.  The axon TPU tunnel can wedge so hard that
     jax.devices() blocks forever *in-process* (observed 2026-07-29 after
     two processes shared the chip); probing in a killable child is the only
-    way to avoid hanging the caller."""
+    way to avoid hanging the caller.
+
+    When this process already has a live, answering backend the probe is
+    skipped entirely (see _inprocess_backend_ok).  After a first hung
+    probe the remaining attempts still run — the wedge is sometimes a
+    transient tunnel stall, not the terminal chip-held state — but at
+    ``hung_probe_timeout`` so three wedged probes cost under a minute
+    instead of three full ``probe_timeout`` waits."""
     import subprocess
 
+    if _inprocess_backend_ok():
+        log("backend probe skipped: in-process backend is live")
+        return True, ""
     reason = "unknown"
+    hung_once = False
     for attempt in range(attempts):
+        timeout = hung_probe_timeout if hung_once else probe_timeout
         proc = subprocess.Popen(
             [sys.executable, "-c",
              "import jax, jax.numpy as jnp;"
@@ -248,15 +301,17 @@ def backend_responsive(probe_timeout=150, attempts=3):
             stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True,
         )
         try:
-            _, err = proc.communicate(timeout=probe_timeout)
+            _, err = proc.communicate(timeout=timeout)
             if proc.returncode == 0:
                 return True, ""
             tail = (err or "").strip().splitlines()
             reason = "probe exited %d: %s" % (
                 proc.returncode, tail[-1] if tail else "no stderr"
             )
+            log("backend probe %d/%d failed: %s"
+                % (attempt + 1, attempts, reason))
         except subprocess.TimeoutExpired:
-            reason = "probe hung > %ds (backend init blocked)" % probe_timeout
+            reason = "probe hung > %ds (backend init blocked)" % timeout
             proc.kill()
             try:
                 # a child stuck in uninterruptible device I/O may not even
@@ -264,14 +319,11 @@ def backend_responsive(probe_timeout=150, attempts=3):
                 proc.communicate(timeout=10)
             except subprocess.TimeoutExpired:
                 pass
-            # a hang means the device session is wedged: more probes can't
-            # help, and starting another backend while the undead child may
-            # still hold the chip is the documented wedge trigger itself
-            log("backend probe %d/%d hung: %s" % (attempt + 1, attempts, reason))
-            break
-        log("backend probe %d/%d failed: %s" % (attempt + 1, attempts, reason))
+            log("backend probe %d/%d hung: %s"
+                % (attempt + 1, attempts, reason))
+            hung_once = True
         if attempt < attempts - 1:
-            time.sleep(20)
+            time.sleep(2 if hung_once else 20)
     return False, reason
 
 
@@ -469,6 +521,73 @@ def fit_step_latency(repeats=10, n_scan=256):
     }
 
 
+def serve_load(rounds=3, clients=4, requests_per_client=24,
+               deadline_s=0.5, queries=256):
+    """Serving-tier latency/goodput under load (--serve-load,
+    doc/serving.md): a QueryService over the engine, hammered by the
+    closed-loop generator (fixed concurrency, arrival adapts — the
+    stable shape), plus one small open-loop burst (fixed arrival — the
+    shape that exposes queueing).  Ladder rungs are warmed first and the
+    closed loop is min-of-rounds on p99, so the record measures serving,
+    not compilation or scheduler noise; tests/test_bench_guard.py pins
+    ``p99_over_p50`` <= 3 under this no-overload config.
+    """
+    from mesh_tpu import Mesh
+    from mesh_tpu.serve import (
+        HealthMonitor, QueryService, run_closed_loop, run_open_loop,
+    )
+    from mesh_tpu.sphere import _icosphere
+
+    rng = np.random.RandomState(0)
+    v, f = _icosphere(2)
+    mesh = Mesh(v=v, f=f)
+    pts = np.asarray(rng.randn(queries, 3) * 0.4, np.float32)
+
+    service = QueryService(
+        workers=2, default_deadline_s=deadline_s,
+        health=HealthMonitor(watchdog=False),
+    )
+    try:
+        warmed = service.warmup(mesh, queries=queries)
+        log("serve-load: warmed rungs %s" % (warmed,))
+        best = None
+        for _ in range(rounds):
+            report = run_closed_loop(
+                service, mesh, pts, clients=clients,
+                requests_per_client=requests_per_client,
+                deadline_s=deadline_s)
+            if best is None or report["p99_ms"] < best["p99_ms"]:
+                best = report
+        open_report = run_open_loop(
+            service, mesh, pts, rate_qps=40.0, duration_s=1.0,
+            deadline_s=deadline_s)
+    finally:
+        service.stop(write_stats=False)
+    p50, p99 = best["p50_ms"], best["p99_ms"]
+    return {
+        "metric": "serve_load_closed_loop",
+        "value": p99,
+        "unit": "p99_ms",
+        "vs_baseline": None,
+        "p50_ms": p50,
+        "p95_ms": best["p95_ms"],
+        "p99_ms": p99,
+        "p99_over_p50": round(p99 / p50, 2) if p50 else None,
+        "goodput_qps": best["goodput_qps"],
+        "shed_rate": best["shed_rate"],
+        "deadline_miss_rate": best["deadline_miss_rate"],
+        "rungs": best["rungs"],
+        "requests": best["requests"],
+        "clients": clients,
+        "deadline_s": deadline_s,
+        "open_loop": {
+            key: open_report[key]
+            for key in ("p50_ms", "p99_ms", "goodput_qps", "shed_rate",
+                        "deadline_miss_rate", "requests", "rate_qps")
+        },
+    }
+
+
 def wedged_record(reason):
     """The JSON record (and exit code) for a capture attempted while the
     tunnel is wedged.  Two distinct situations, two distinct artifacts:
@@ -536,6 +655,7 @@ def main():
             ("--dispatch-latency", "dispatch_latency_small_q", "ms/call"),
             ("--obs-overhead", "obs_overhead_small_q", "overhead_frac"),
             ("--fit-step", "fit_step_latency", "ms/call"),
+            ("--serve-load", "serve_load_closed_loop", "p99_ms"),
         ):
             if flag in sys.argv[1:]:
                 print(json.dumps({
@@ -550,7 +670,8 @@ def main():
         sys.exit(rc)
     if ("--dispatch-latency" in sys.argv[1:]
             or "--obs-overhead" in sys.argv[1:]
-            or "--fit-step" in sys.argv[1:]):
+            or "--fit-step" in sys.argv[1:]
+            or "--serve-load" in sys.argv[1:]):
         from mesh_tpu.utils.compilation_cache import (
             enable_persistent_compilation_cache,
         )
@@ -560,6 +681,8 @@ def main():
             print(json.dumps(_with_obs(obs_overhead())))
         elif "--fit-step" in sys.argv[1:]:
             print(json.dumps(_with_obs(fit_step_latency())))
+        elif "--serve-load" in sys.argv[1:]:
+            print(json.dumps(_with_obs(serve_load())))
         else:
             print(json.dumps(_with_obs(dispatch_latency_small_q())))
         return

@@ -6,6 +6,7 @@ Subcommands:
   snap  — take a snapshot of a running viewer
   stats — run a workload and dump the metrics registry (JSON/Prometheus)
   trace — run a workload with spans on and print the span tree
+  serve-stats — summarize the serving tier's stats sink (no jax init)
 
 Examples:
   meshviewer view body.ply
@@ -14,6 +15,7 @@ Examples:
   meshviewer snap --port 5555 out.png
   mesh-tpu stats --prom
   mesh-tpu trace --mesh body.ply --jsonl /tmp/spans.jsonl
+  mesh-tpu serve-stats
 """
 
 import argparse
@@ -178,6 +180,62 @@ def cmd_trace(args):
     sys.stdout.write("\n")
 
 
+def cmd_serve_stats(args):
+    """Read and summarize the QueryService stats sink.
+
+    Deliberately import-light: json/os only, NO mesh_tpu/jax imports and
+    no backend initialization — safe to run while the axon tunnel is
+    wedged, from cron, or on a box with no accelerator at all.  A
+    missing sink is a normal state (nothing served yet), not an error:
+    clear message, exit 0.
+    """
+    import json
+
+    path = args.path or os.environ.get(
+        "MESH_TPU_SERVE_STATS", "").strip() or os.path.expanduser(
+        os.path.join("~", ".mesh_tpu", "serve_stats.json"))
+    if not os.path.exists(path):
+        print("no serve stats sink at %s (nothing has served yet; "
+              "QueryService.stop() writes it)" % path)
+        return
+    try:
+        with open(path) as fh:
+            sink = json.load(fh)
+    except (OSError, ValueError) as exc:
+        print("serve stats sink at %s is unreadable: %s" % (path, exc),
+              file=sys.stderr)
+        sys.exit(1)
+    if args.json:
+        json.dump(sink, sys.stdout, indent=2, sort_keys=True)
+        sys.stdout.write("\n")
+        return
+    health = sink.get("health", {})
+    print("serve stats (%s)" % path)
+    print("  written_utc: %s" % sink.get("written_utc"))
+    print("  health: %s (inflight=%s trip_streak=%s)"
+          % (health.get("state"), health.get("inflight"),
+             health.get("trip_streak")))
+    queues = sink.get("queues") or {}
+    if queues:
+        print("  queues: %s"
+              % ", ".join("%s=%s" % kv for kv in sorted(queues.items())))
+    metrics = sink.get("metrics") or {}
+    for name in sorted(metrics):
+        metric = metrics[name]
+        print("  %s (%s)" % (name, metric.get("type", "?")))
+        for series in metric.get("series", []):
+            labels = series.get("labels") or {}
+            tag = ",".join("%s=%s" % kv for kv in sorted(labels.items()))
+            if "count" in series:       # histogram series
+                mean_ms = (1e3 * series["sum"] / series["count"]
+                           if series["count"] else 0.0)
+                print("    {%s} count=%d mean=%.3fms max=%.3fms"
+                      % (tag, series["count"], mean_ms,
+                         1e3 * series.get("max", 0.0)))
+            else:
+                print("    {%s} %s" % (tag, series.get("value")))
+
+
 def main():
     parser = argparse.ArgumentParser(prog="meshviewer", description=__doc__)
     sub = parser.add_subparsers(dest="command", required=True)
@@ -241,6 +299,16 @@ def main():
     p_trace.add_argument("--jsonl", default=None,
                          help="also write spans + metrics as JSON lines")
     p_trace.set_defaults(func=cmd_trace)
+
+    p_sstats = sub.add_parser(
+        "serve-stats",
+        help="summarize the serving tier's stats sink (no jax init)")
+    p_sstats.add_argument("--path", default=None,
+                          help="sink path (default: MESH_TPU_SERVE_STATS "
+                               "or ~/.mesh_tpu/serve_stats.json)")
+    p_sstats.add_argument("--json", action="store_true",
+                          help="raw JSON dump instead of the summary")
+    p_sstats.set_defaults(func=cmd_serve_stats)
 
     args = parser.parse_args()
     args.func(args)

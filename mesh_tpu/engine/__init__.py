@@ -20,7 +20,12 @@ direct exact-shape jit path.  See doc/engine.md.
 
 import numpy as np
 
-from .executor import EngineExecutor, get_executor, submit  # noqa: F401
+from .executor import (  # noqa: F401
+    EngineExecutor,
+    EngineShutdown,
+    get_executor,
+    submit,
+)
 from .planner import (  # noqa: F401
     B_LADDER,
     Q_LADDER,
@@ -33,7 +38,7 @@ from .stats import STATS, reset_stats, stats  # noqa: F401
 
 __all__ = [
     "engine_enabled", "stats", "reset_stats", "warmup",
-    "get_planner", "get_executor", "submit",
+    "get_planner", "get_executor", "submit", "EngineShutdown",
     "facade_closest_faces_and_points",
     "Q_LADDER", "B_LADDER", "bucket_size",
 ]

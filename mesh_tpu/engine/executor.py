@@ -33,11 +33,12 @@ from contextlib import contextmanager
 
 import numpy as np
 
+from ..errors import DeadlineExceeded, EngineShutdown
 from ..obs.clock import monotonic as _now
 from ..obs.trace import span as obs_span
 from .stats import STATS
 
-__all__ = ["EngineExecutor", "get_executor", "submit"]
+__all__ = ["EngineExecutor", "EngineShutdown", "get_executor", "submit"]
 
 #: ops the executor understands and the facade result shape it returns
 #: per request (see _complete_request)
@@ -46,9 +47,9 @@ _OPS = ("closest_point", "fused")
 
 class _Request(object):
     __slots__ = ("op", "mesh", "points", "chunk", "future", "key",
-                 "t_submit")
+                 "t_submit", "deadline")
 
-    def __init__(self, op, mesh, points, chunk, key):
+    def __init__(self, op, mesh, points, chunk, key, deadline=None):
         self.op = op
         self.mesh = mesh
         self.points = points
@@ -56,6 +57,7 @@ class _Request(object):
         self.key = key
         self.future = Future()
         self.t_submit = _now()
+        self.deadline = deadline    # absolute obs.clock.monotonic, or None
 
 
 class EngineExecutor(object):
@@ -75,7 +77,7 @@ class EngineExecutor(object):
     # ------------------------------------------------------------------
     # submission API
 
-    def submit(self, op, mesh, points, chunk=512):
+    def submit(self, op, mesh, points, chunk=512, deadline=None):
         """Enqueue one (mesh, query set) request; returns a Future.
 
         Future results match the sequential facade conventions:
@@ -84,6 +86,13 @@ class EngineExecutor(object):
           f64)`` (AabbTree.nearest / Mesh.closest_faces_and_points);
         - ``"fused"`` -> ``(normals [V, 3] f64, faces [1, Q] uint32,
           points [Q, 3] f64)`` (Mesh.normals_and_closest_points).
+
+        ``deadline`` is an absolute ``obs.clock.monotonic`` time: a
+        request still queued when it passes is dropped by the worker with
+        ``DeadlineExceeded`` on its future instead of riding a dispatch
+        whose result nobody will wait for.  ``future.cancel()`` before
+        dispatch likewise skips the request (the serving tier's retry
+        path uses both — doc/serving.md).
         """
         if op not in _OPS:
             raise ValueError("unknown engine op %r (have %s)" % (op, _OPS))
@@ -100,11 +109,15 @@ class EngineExecutor(object):
         # collision costs an error, never a wrong answer
         key = (op, chunk, f.shape, zlib.crc32(
             np.ascontiguousarray(f).tobytes()), np.asarray(mesh.v).shape)
-        req = _Request(op, mesh, pts, chunk, key)
+        req = _Request(op, mesh, pts, chunk, key,
+                       deadline=None if deadline is None else float(deadline))
         with obs_span("engine.enqueue", op=op, q=pts.shape[0]):
             with self._cond:
-                if self._shutdown:
-                    raise RuntimeError("executor is shut down")
+                if self._shutdown or not self._thread.is_alive():
+                    raise EngineShutdown(
+                        "engine executor is shut down; submits would hang "
+                        "on a dead worker loop"
+                    )
                 self._pending.append(req)
                 self._cond.notify_all()
         return req.future
@@ -131,12 +144,17 @@ class EngineExecutor(object):
             self.release()
 
     def drain(self):
-        """Block until every submitted request has completed."""
+        """Block until every submitted request has completed.  Returns
+        immediately after shutdown(): the worker loop is (or is about to
+        be) gone, so there is nothing left to wait on."""
         with self._cond:
-            while self._pending or self._busy:
+            while (self._pending or self._busy) and not self._shutdown:
                 self._cond.wait(timeout=0.1)
 
     def shutdown(self):
+        """Stop the worker (completing anything already queued first).
+        Idempotent; afterwards ``submit`` raises ``EngineShutdown`` and
+        ``drain`` returns immediately."""
         with self._cond:
             self._shutdown = True
             self._cond.notify_all()
@@ -177,11 +195,35 @@ class EngineExecutor(object):
                     if not req.future.done():
                         req.future.set_exception(e)
 
+    def _admit(self, group):
+        """Drop requests that no longer want a dispatch: futures the
+        caller cancelled, and deadlines that passed while queued (those
+        fail with DeadlineExceeded).  Survivors are marked RUNNING so a
+        late ``cancel()`` can no longer race the result."""
+        now = _now()
+        live = []
+        for req in group:
+            if req.deadline is not None and now > req.deadline:
+                STATS.record_deadline_drop()
+                req.future.set_exception(DeadlineExceeded(
+                    "request deadline passed %.3fs before dispatch"
+                    % (now - req.deadline)
+                ))
+                continue
+            if not req.future.set_running_or_notify_cancel():
+                STATS.record_cancelled()
+                continue
+            live.append(req)
+        return live
+
     def _dispatch_group(self, group):
         from ..batch import _batch_nondegen, _strategy, stack_mesh_batch
         from ..utils.dispatch import tile_variant
         from .planner import bucket_size, get_planner
 
+        group = self._admit(group)
+        if not group:
+            return
         op = group[0].op
         with obs_span("engine.coalesce", op=op, requests=len(group)):
             drained = _now()
@@ -253,6 +295,7 @@ def get_executor():
         return _EXECUTOR
 
 
-def submit(op, mesh, points, chunk=512):
+def submit(op, mesh, points, chunk=512, deadline=None):
     """Module-level shortcut: ``engine.submit("closest_point", m, pts)``."""
-    return get_executor().submit(op, mesh, points, chunk=chunk)
+    return get_executor().submit(op, mesh, points, chunk=chunk,
+                                 deadline=deadline)

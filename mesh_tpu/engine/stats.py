@@ -89,6 +89,15 @@ class EngineStats(object):
             "mesh_tpu_engine_queue_wait_seconds",
             "Submit-to-dispatch wait of coalesced executor requests.",
         )
+        self._cancelled = registry.counter(
+            "mesh_tpu_engine_cancelled_total",
+            "Requests whose future was cancelled before dispatch.",
+        )
+        self._deadline_drops = registry.counter(
+            "mesh_tpu_engine_deadline_drop_total",
+            "Queued requests dropped because their deadline passed "
+            "before dispatch.",
+        )
         self.reset()
 
     def reset(self):
@@ -99,6 +108,7 @@ class EngineStats(object):
                 self._dispatched_elements, self._coalesced_dispatches,
                 self._coalesced_requests, self._coalesced_max_batch,
                 self._dispatch_seconds, self._queue_wait_seconds,
+                self._cancelled, self._deadline_drops,
             ):
                 metric.reset()
 
@@ -133,6 +143,16 @@ class EngineStats(object):
         """Executor-only: submit-to-dispatch latency of one request
         (registry series, intentionally NOT in the compat snapshot)."""
         self._queue_wait_seconds.observe(float(seconds))
+
+    def record_cancelled(self):
+        """A future was cancelled before its dispatch (registry series,
+        not in the compat snapshot)."""
+        self._cancelled.inc()
+
+    def record_deadline_drop(self):
+        """A queued request's deadline passed before dispatch (registry
+        series, not in the compat snapshot)."""
+        self._deadline_drops.inc()
 
     # ------------------------------------------------------------------
     # reporting

@@ -24,3 +24,28 @@ class VisibilityError(MeshError):
 
 class TopologyError(MeshError):
     """Raised on topology-op failures (decimation/subdivision)."""
+
+
+class EngineShutdown(MeshError, RuntimeError):
+    """Raised when work is submitted to an engine executor (or serving
+    tier) that has been shut down.  Subclasses RuntimeError so callers of
+    the pre-hardening ``executor.submit`` contract keep working."""
+
+
+class DeadlineExceeded(MeshError, TimeoutError):
+    """A request's deadline expired before (or while) it was served.
+
+    Raised by the engine executor when a queued request's deadline passes
+    before dispatch, and by the serving tier when every degradation rung
+    failed inside the request's hard time budget (doc/serving.md)."""
+
+
+class ServeRejected(MeshError):
+    """Admission control turned a request away (queue full, tenant over
+    budget, or the service is draining).  ``retry_after`` is the server's
+    backpressure hint in seconds."""
+
+    def __init__(self, message, retry_after=0.1, reason="rejected"):
+        super(ServeRejected, self).__init__(message)
+        self.retry_after = float(retry_after)
+        self.reason = reason

@@ -1,0 +1,63 @@
+"""mesh_tpu.serve: async, multi-tenant, deadline-aware query serving.
+
+The engine (mesh_tpu/engine/) makes one stream of queries fast; this
+package makes MANY streams safe to run against it:
+
+- **service** — ``QueryService``: tenant/priority/deadline-tagged
+  admission with bounded per-tenant queues (reject-with-retry-after, no
+  unbounded growth), weighted-fair (deficit round-robin) draining into
+  the engine;
+- **deadline** — ``Deadline`` propagation and the degradation ladder:
+  engine -> XLA culled -> anchored-K, retry with exponential backoff,
+  every answer certified exact or stamped ``approximate=True``, hard
+  2x-deadline budget, wedge-proof attempt threads;
+- **health** — ``HealthMonitor``: a non-blocking dispatch-latency
+  watchdog driving the load-shed state machine
+  healthy -> degraded -> draining (liveness/readiness for probes);
+- **loadgen** — closed- and open-loop load generation reporting
+  p50/p95/p99, goodput, shed rate, deadline-miss rate
+  (bench.py --serve-load, guarded by tests/test_bench_guard.py).
+
+Everything records into the obs registry (``serve.*`` span names,
+``mesh_tpu_serve_*`` series); ``mesh-tpu serve-stats`` reads the JSON
+sink ``QueryService.write_stats()`` leaves behind without initializing
+jax.  See doc/serving.md.
+"""
+
+from ..errors import (  # noqa: F401 — the serve-boundary exception surface
+    DeadlineExceeded,
+    EngineShutdown,
+    ServeRejected,
+)
+from .deadline import (  # noqa: F401
+    Deadline,
+    Rung,
+    ServeResult,
+    call_with_timeout,
+    default_ladder,
+    run_with_ladder,
+)
+from .health import (  # noqa: F401
+    DEGRADED,
+    DRAINING,
+    HEALTHY,
+    STATE_NAMES,
+    HealthMonitor,
+)
+from .loadgen import percentile, run_closed_loop, run_open_loop  # noqa: F401
+from .service import (  # noqa: F401
+    QueryService,
+    ServeResponse,
+    WeightedFairQueue,
+    default_stats_path,
+)
+
+__all__ = [
+    "QueryService", "ServeResponse", "WeightedFairQueue",
+    "default_stats_path",
+    "Deadline", "Rung", "ServeResult", "call_with_timeout",
+    "default_ladder", "run_with_ladder",
+    "HealthMonitor", "HEALTHY", "DEGRADED", "DRAINING", "STATE_NAMES",
+    "percentile", "run_closed_loop", "run_open_loop",
+    "ServeRejected", "DeadlineExceeded", "EngineShutdown",
+]

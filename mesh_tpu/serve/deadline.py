@@ -1,0 +1,357 @@
+"""Deadline propagation and the degradation ladder (doc/serving.md).
+
+A request enters the serving tier with a deadline.  The ladder is the
+set of execution strategies the stack already has, ordered from best to
+cheapest:
+
+1. ``engine``   — the coalescing executor + bucketed plan cache
+                  (engine.submit): exact, amortized, the steady-state
+                  path.  On accelerators this is the Pallas brute/culled
+                  kernel; on CPU the XLA brute force.
+2. ``culled``   — the XLA top-k culled kernel (query/culled.py) called
+                  directly, WITHOUT the exact brute-force re-run of
+                  loose-certificate queries: one bounded dispatch, and
+                  the per-query ``tight`` mask tells us whether the
+                  answer is still provably exact.
+3. ``anchored`` — the vertex-anchored candidate tables
+                  (query/anchored.py) with a small K: O(K) per query,
+                  the cheapest exact-shaped work we can do.
+
+``run_with_ladder`` walks the rungs with retry + exponential backoff:
+each attempt gets a bounded slice of the request's time budget, a
+failed or timed-out rung falls through to the next, and the response is
+stamped with the rung that answered plus ``certified`` /
+``approximate`` flags (a rung whose certificate is not tight for every
+query is approximate — under degradation we trade the re-run for
+latency, we do not hide it).
+
+The hard budget is ``2 x deadline``: the acceptance bar is a
+degraded-but-valid response within twice the deadline, never a hang.
+Every in-process rung runs on a watchdog-bounded helper thread, so even
+a wedged device dispatch (the BENCH_r04/r05 failure mode) cannot block
+the serving worker past its budget — the stuck thread is abandoned
+(daemonic) and the next rung runs.
+"""
+
+import threading
+
+from ..errors import DeadlineExceeded
+from ..obs.clock import monotonic
+from ..obs.trace import span as obs_span
+
+__all__ = [
+    "Deadline", "Rung", "ServeResult", "default_ladder", "run_with_ladder",
+    "call_with_timeout",
+]
+
+#: smallest per-attempt time slice: below this a rung cannot even launch
+_MIN_SLICE_S = 0.01
+
+#: retry backoff: base * 2^attempt, capped (and clipped to the budget)
+_BACKOFF_BASE_S = 0.01
+_BACKOFF_CAP_S = 0.25
+
+
+class Deadline(object):
+    """One request's time budget, fixed at admission.
+
+    ``seconds`` is the caller-facing deadline; ``hard_remaining`` tracks
+    the 2x envelope inside which a degraded response must still land.
+    """
+
+    __slots__ = ("seconds", "t_start", "t_deadline", "t_hard")
+
+    def __init__(self, seconds, hard_factor=2.0):
+        self.seconds = float(seconds)
+        self.t_start = monotonic()
+        self.t_deadline = self.t_start + self.seconds
+        self.t_hard = self.t_start + hard_factor * self.seconds
+
+    def remaining(self):
+        return self.t_deadline - monotonic()
+
+    def hard_remaining(self):
+        return self.t_hard - monotonic()
+
+    def expired(self):
+        return self.remaining() <= 0.0
+
+    def elapsed(self):
+        return monotonic() - self.t_start
+
+
+def call_with_timeout(fn, timeout):
+    """Run ``fn()`` on a daemon helper thread, waiting at most
+    ``timeout`` seconds.  Raises DeadlineExceeded on timeout — the stuck
+    thread is abandoned, not joined, because the whole point is that a
+    wedged device call may never return."""
+    box = {}
+    done = threading.Event()
+
+    def _run():
+        try:
+            box["result"] = fn()
+        except BaseException as e:     # noqa: BLE001 — re-raised below
+            box["error"] = e
+        finally:
+            done.set()
+
+    worker = threading.Thread(target=_run, name="mesh-tpu-serve-attempt",
+                              daemon=True)
+    worker.start()
+    if not done.wait(timeout=max(float(timeout), 0.0)):
+        raise DeadlineExceeded(
+            "rung call still running after %.3fs slice" % timeout)
+    if "error" in box:
+        raise box["error"]
+    return box["result"]
+
+
+class ServeResult(object):
+    """What a rung hands back: facade-convention arrays plus provenance."""
+
+    __slots__ = ("faces", "points", "rung", "certified")
+
+    def __init__(self, faces, points, rung, certified):
+        self.faces = faces              # [1, Q] uint32
+        self.points = points            # [Q, 3] f64
+        self.rung = rung
+        self.certified = bool(certified)
+
+    @property
+    def approximate(self):
+        return not self.certified
+
+
+class Rung(object):
+    """One degradation strategy: a name and a callable
+    ``fn(mesh, points, chunk, timeout) -> ServeResult`` that must respect
+    ``timeout`` (every built-in rung does, via futures or
+    call_with_timeout)."""
+
+    __slots__ = ("name", "fn")
+
+    def __init__(self, name, fn):
+        self.name = name
+        self.fn = fn
+
+    def run(self, mesh, points, chunk, timeout):
+        return self.fn(mesh, points, chunk, timeout)
+
+
+# ---------------------------------------------------------------------------
+# built-in rungs
+
+
+def _facade_arrays(mesh):
+    import numpy as np
+
+    v = np.asarray(mesh.v, np.float32)
+    f = np.asarray(mesh.f, np.int64).astype(np.int32)
+    return v, f
+
+
+def _bucket_queries(points, granule):
+    """Edge-pad the query array to a multiple of ``granule`` OUTSIDE the
+    kernel's jit.  The culled/anchored kernels tile queries internally,
+    but jit traces on the caller-visible shape — without this, every
+    distinct query count recompiles the fallback rung, which is exactly
+    the latency a degraded request cannot afford (the engine rung gets
+    the same protection from the planner's Q-ladder buckets)."""
+    import numpy as np
+
+    pts = np.asarray(points, np.float32).reshape(-1, 3)
+    n_q = pts.shape[0]
+    padded = int(-(-n_q // granule) * granule)
+    if padded != n_q:
+        pts = np.pad(pts, ((0, padded - n_q), (0, 0)), mode="edge")
+    return pts, n_q
+
+
+def _rung_engine(mesh, points, chunk, timeout):
+    """Rung 1: the coalescing executor.  The absolute deadline rides into
+    the queue (the worker drops it if it expires pre-dispatch) and a
+    timed-out wait cancels the future so a wedged dispatch is not also
+    paid for by the next request."""
+    import concurrent.futures
+
+    from .. import engine
+
+    fut = engine.submit("closest_point", mesh, points, chunk=chunk,
+                        deadline=monotonic() + timeout)
+    try:
+        faces, pts = fut.result(timeout=timeout)
+    except concurrent.futures.TimeoutError:
+        fut.cancel()
+        raise DeadlineExceeded(
+            "engine dispatch exceeded its %.3fs slice" % timeout)
+    return ServeResult(faces, pts, "engine", certified=True)
+
+
+def _rung_culled(mesh, points, chunk, timeout, k=64):
+    """Rung 2: one bounded XLA culled dispatch, certificate reported
+    instead of repaired."""
+    import numpy as np
+
+    def _call():
+        from ..query.culled import closest_faces_and_points_culled
+
+        v, f = _facade_arrays(mesh)
+        c = min(int(chunk), 256)
+        pts, n_q = _bucket_queries(points, c)
+        res = closest_faces_and_points_culled(v, f, pts, k=k, chunk=c)
+        return {key: np.asarray(val)[:n_q] for key, val in res.items()}
+
+    out = call_with_timeout(_call, timeout)
+    faces = out["face"].astype("uint32")[None, :]
+    return ServeResult(faces, out["point"].astype("float64"), "culled",
+                       certified=bool(out["tight"].all()))
+
+
+#: anchored-rung table cache: (v crc, f crc, k) -> (table, safe).  Tables
+#: depend on the posed vertices, so the key hashes both arrays; bounded
+#: because degraded traffic should not grow host memory without limit.
+_ANCHOR_TABLES = {}
+_ANCHOR_TABLES_LOCK = threading.Lock()
+_ANCHOR_TABLES_MAX = 8
+
+
+def _anchor_tables(v, f, k):
+    import zlib
+
+    key = (zlib.crc32(v.tobytes()), zlib.crc32(f.tobytes()), v.shape[0],
+           f.shape[0], k)
+    with _ANCHOR_TABLES_LOCK:
+        if key in _ANCHOR_TABLES:
+            return _ANCHOR_TABLES[key]
+    from ..query.anchored import build_anchor_tables
+
+    import numpy as np
+
+    table, safe = build_anchor_tables(v, f, k=k)
+    tables = (np.asarray(table), np.asarray(safe))
+    with _ANCHOR_TABLES_LOCK:
+        if len(_ANCHOR_TABLES) >= _ANCHOR_TABLES_MAX:
+            _ANCHOR_TABLES.pop(next(iter(_ANCHOR_TABLES)))
+        _ANCHOR_TABLES[key] = tables
+    return tables
+
+
+def _rung_anchored(mesh, points, chunk, timeout, k=16):
+    """Rung 3: small-K anchored tables — O(K) per query, no certificate
+    repair.  The cheapest shaped answer the stack can produce."""
+    import numpy as np
+
+    def _call():
+        from ..query.anchored import closest_point_anchored
+
+        v, f = _facade_arrays(mesh)
+        table, safe = _anchor_tables(v, f, min(k, f.shape[0]))
+        c = max(int(chunk), 256)
+        pts, n_q = _bucket_queries(points, c)
+        res = closest_point_anchored(v, f, pts, table, safe, chunk=c)
+        return {key: np.asarray(val)[:n_q] for key, val in res.items()}
+
+    out = call_with_timeout(_call, timeout)
+    faces = out["face"].astype("uint32")[None, :]
+    return ServeResult(faces, out["point"].astype("float64"), "anchored",
+                       certified=bool(out["tight"].all()))
+
+
+def default_ladder():
+    """The standard three-rung ladder, optionally filtered/reordered by
+    ``MESH_TPU_SERVE_LADDER`` (comma-separated rung names)."""
+    import os
+
+    rungs = {
+        "engine": Rung("engine", _rung_engine),
+        "culled": Rung("culled", _rung_culled),
+        "anchored": Rung("anchored", _rung_anchored),
+    }
+    spec = os.environ.get("MESH_TPU_SERVE_LADDER", "").strip()
+    if not spec:
+        return [rungs["engine"], rungs["culled"], rungs["anchored"]]
+    chosen = []
+    for name in spec.split(","):
+        name = name.strip()
+        if not name:
+            continue
+        if name not in rungs:
+            raise ValueError(
+                "MESH_TPU_SERVE_LADDER rung %r unknown (have %s)"
+                % (name, sorted(rungs)))
+        chosen.append(rungs[name])
+    if not chosen:
+        raise ValueError("MESH_TPU_SERVE_LADDER selected no rungs")
+    return chosen
+
+
+# ---------------------------------------------------------------------------
+# the retry loop
+
+
+def _retry_counter():
+    from ..obs.metrics import REGISTRY
+
+    return REGISTRY.counter(
+        "mesh_tpu_serve_retries_total",
+        "Rung attempts that failed or timed out and fell through to the "
+        "next degradation rung.",
+    )
+
+
+def run_with_ladder(mesh, points, deadline, ladder=None, chunk=512,
+                    start_rung=0, health=None):
+    """Walk the degradation ladder under ``deadline``.
+
+    Returns ``(ServeResult, retries)``; raises DeadlineExceeded (carrying
+    the last rung error as ``__cause__``) when the hard 2x budget runs
+    out or every rung failed.
+
+    Slice policy: while the caller deadline is live each attempt may use
+    everything left of it; once past the deadline (degraded territory)
+    the remaining hard budget is split evenly across the remaining rungs
+    so the LAST rung is never starved by an earlier wedge.
+    """
+    import time
+
+    if ladder is None:
+        ladder = default_ladder()
+    rungs = ladder[start_rung:]
+    if not rungs:
+        raise ValueError("start_rung %d leaves an empty ladder" % start_rung)
+    last_error = None
+    retries = 0
+    for i, rung in enumerate(rungs):
+        rungs_left = len(rungs) - i
+        hard_left = deadline.hard_remaining()
+        if hard_left <= _MIN_SLICE_S and last_error is not None:
+            break
+        slice_s = max(deadline.remaining(), hard_left / rungs_left)
+        slice_s = max(min(slice_s, hard_left), _MIN_SLICE_S)
+        token = health.dispatch_began(rung.name) if health else None
+        try:
+            with obs_span("serve.attempt", rung=rung.name,
+                          slice_ms=round(1e3 * slice_s, 1)):
+                result = rung.run(mesh, points, chunk, slice_s)
+            if health:
+                health.dispatch_finished(token, ok=True)
+            return result, retries
+        except Exception as e:      # noqa: BLE001 — every rung failure falls through
+            if health:
+                health.dispatch_finished(token, ok=False)
+            last_error = e
+            retries += 1
+            _retry_counter().inc(rung=rung.name,
+                                 error=type(e).__name__)
+            if i + 1 < len(rungs):
+                backoff = min(_BACKOFF_BASE_S * (2 ** i), _BACKOFF_CAP_S,
+                              max(deadline.hard_remaining(), 0.0) * 0.1)
+                if backoff > 0:
+                    time.sleep(backoff)
+    exc = DeadlineExceeded(
+        "no rung answered within the hard budget (deadline %.3fs, "
+        "elapsed %.3fs, retries %d)"
+        % (deadline.seconds, deadline.elapsed(), retries))
+    exc.__cause__ = last_error
+    raise exc

@@ -1,0 +1,167 @@
+"""Load generation for the serving tier (bench.py --serve-load).
+
+Two generator shapes, because they answer different questions:
+
+- **closed loop** (``run_closed_loop``): N client threads, each issuing
+  the next request the moment the previous one answers.  Concurrency is
+  fixed, arrival rate adapts to service speed — this measures the
+  service's best sustainable latency under a known load, and is the
+  stable shape the bench guard pins.
+- **open loop** (``run_open_loop``): submissions paced at a target rate
+  regardless of completions (async submit, collect at the end).  Arrival
+  rate is fixed, concurrency floats — this exposes queueing collapse and
+  shed behavior that a closed loop structurally cannot (a closed loop
+  slows its own arrivals when the service slows; real traffic does not).
+
+Both return one JSON-able report: latency percentiles over *successful*
+responses, goodput (ok responses per wall second), shed rate (rejected +
+shed / issued), deadline-miss rate, and per-rung answer counts — the
+serving acceptance numbers, straight off the wire.
+"""
+
+import threading
+
+from ..errors import DeadlineExceeded, ServeRejected
+from ..obs.clock import monotonic
+
+__all__ = ["percentile", "run_closed_loop", "run_open_loop"]
+
+
+def percentile(values, q):
+    """Nearest-rank percentile (q in [0, 100]); 0.0 on empty input."""
+    import math
+
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    rank = max(int(math.ceil(q / 100.0 * len(ordered))), 1)
+    return ordered[min(rank, len(ordered)) - 1]
+
+
+class _Tally(object):
+    """Thread-shared outcome accumulator for one load run."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.latencies_s = []           # successful responses only
+        self.ok = 0
+        self.shed = 0                   # ServeRejected at admission
+        self.deadline = 0               # DeadlineExceeded end to end
+        self.errors = 0
+        self.misses = 0                 # ok but past the deadline
+        self.approximate = 0
+        self.retries = 0
+        self.rungs = {}
+
+    def record_response(self, response):
+        with self.lock:
+            self.ok += 1
+            self.latencies_s.append(response.latency_s)
+            self.rungs[response.rung] = self.rungs.get(response.rung, 0) + 1
+            self.retries += response.retries
+            if response.deadline_missed:
+                self.misses += 1
+            if response.approximate:
+                self.approximate += 1
+
+    def record_error(self, error):
+        with self.lock:
+            if isinstance(error, ServeRejected):
+                self.shed += 1
+            elif isinstance(error, DeadlineExceeded):
+                self.deadline += 1
+            else:
+                self.errors += 1
+
+    def report(self, wall_s):
+        with self.lock:
+            issued = (self.ok + self.shed + self.deadline + self.errors)
+            lat = list(self.latencies_s)
+            report = {
+                "requests": issued,
+                "ok": self.ok,
+                "shed": self.shed,
+                "deadline_failures": self.deadline,
+                "errors": self.errors,
+                "wall_s": round(wall_s, 4),
+                "goodput_qps": round(self.ok / wall_s, 2) if wall_s else 0.0,
+                "shed_rate": round(self.shed / issued, 4) if issued else 0.0,
+                "deadline_miss_rate": round(
+                    (self.misses + self.deadline) / issued, 4)
+                if issued else 0.0,
+                "approximate": self.approximate,
+                "retries": self.retries,
+                "rungs": dict(self.rungs),
+                "p50_ms": round(1e3 * percentile(lat, 50), 3),
+                "p95_ms": round(1e3 * percentile(lat, 95), 3),
+                "p99_ms": round(1e3 * percentile(lat, 99), 3),
+            }
+            return report
+
+
+def run_closed_loop(service, mesh, points, clients=4, requests_per_client=32,
+                    tenant_fn=None, deadline_s=None):
+    """``clients`` threads, each issuing ``requests_per_client``
+    back-to-back sync queries.  ``tenant_fn(client_idx)`` names the
+    tenant (default: one tenant per client)."""
+    if tenant_fn is None:
+        def tenant_fn(i):
+            return "client-%d" % i
+    tally = _Tally()
+
+    def _client(idx):
+        tenant = tenant_fn(idx)
+        for _ in range(requests_per_client):
+            try:
+                response = service.query(mesh, points, tenant=tenant,
+                                         deadline_s=deadline_s)
+                tally.record_response(response)
+            except Exception as e:      # noqa: BLE001 — tallied, not raised
+                tally.record_error(e)
+
+    t0 = monotonic()
+    threads = [
+        threading.Thread(target=_client, args=(i,),
+                         name="mesh-tpu-loadgen-%d" % i, daemon=True)
+        for i in range(clients)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    report = tally.report(monotonic() - t0)
+    report["loop"] = "closed"
+    report["clients"] = clients
+    return report
+
+
+def run_open_loop(service, mesh, points, rate_qps=50.0, duration_s=2.0,
+                  tenant="open-loop", deadline_s=None, collect_timeout_s=30.0):
+    """Paced async submissions at ``rate_qps`` for ``duration_s``; futures
+    are collected afterwards so slow service never slows arrivals."""
+    import time
+
+    interval = 1.0 / float(rate_qps)
+    tally = _Tally()
+    futures = []
+    t0 = monotonic()
+    t_next = t0
+    while t_next - t0 < duration_s:
+        wait = t_next - monotonic()
+        if wait > 0:
+            time.sleep(wait)
+        try:
+            futures.append(service.submit(mesh, points, tenant=tenant,
+                                          deadline_s=deadline_s))
+        except Exception as e:          # noqa: BLE001 — tallied, not raised
+            tally.record_error(e)
+        t_next += interval
+    for fut in futures:
+        try:
+            tally.record_response(fut.result(timeout=collect_timeout_s))
+        except Exception as e:          # noqa: BLE001 — tallied, not raised
+            tally.record_error(e)
+    report = tally.report(monotonic() - t0)
+    report["loop"] = "open"
+    report["rate_qps"] = float(rate_qps)
+    return report

@@ -1,0 +1,470 @@
+"""QueryService: the deadline-aware multi-tenant front door (doc/serving.md).
+
+Layering: callers submit (tenant, priority, deadline)-tagged requests;
+admission control keeps per-tenant queues bounded (reject-with-
+retry-after, never unbounded growth); worker threads drain the queues
+weighted-fair (deficit round-robin, so one chatty tenant cannot starve
+the rest) and execute each request down the degradation ladder
+(serve/deadline.py) under the health monitor's load-shed state
+(serve/health.py).
+
+Everything is a ``concurrent.futures.Future`` of a ``ServeResponse``:
+the caller picks sync (``query``) or async (``submit``) and the service
+never blocks an admission on device work.
+
+Instrumentation (always-on registry series, ``serve.*`` span names under
+``MESH_TPU_OBS``): per-tenant request/outcome counters, queue-depth
+gauges, latency histograms, shed/deadline-miss counters — dumped by
+``mesh-tpu serve-stats`` from the JSON sink this service writes
+(``MESH_TPU_SERVE_STATS``).
+
+Knobs (all overridable per-constructor): ``MESH_TPU_SERVE_QUEUE``
+(per-tenant queue bound, default 64), ``MESH_TPU_SERVE_DEADLINE_S``
+(default deadline, 1.0), ``MESH_TPU_SERVE_WORKERS`` (drain threads, 1),
+``MESH_TPU_SERVE_STATS`` (stats sink path).
+"""
+
+import json
+import os
+import threading
+from collections import OrderedDict, deque
+from concurrent.futures import Future
+
+from ..errors import DeadlineExceeded, EngineShutdown, ServeRejected
+from ..obs.clock import monotonic, wall
+from ..obs.trace import span as obs_span
+from .deadline import Deadline, default_ladder, run_with_ladder
+from .health import DEGRADED, DRAINING, HealthMonitor
+
+__all__ = [
+    "QueryService", "ServeResponse", "WeightedFairQueue",
+    "default_stats_path",
+]
+
+
+def _env_int(name, default):
+    raw = os.environ.get(name, "").strip()
+    try:
+        return int(raw) if raw else default
+    except ValueError:
+        return default
+
+
+def _env_float(name, default):
+    raw = os.environ.get(name, "").strip()
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        return default
+
+
+def default_stats_path():
+    """The serve-stats sink: ``MESH_TPU_SERVE_STATS`` or
+    ``~/.mesh_tpu/serve_stats.json``."""
+    return os.environ.get("MESH_TPU_SERVE_STATS", "").strip() or (
+        os.path.expanduser(os.path.join("~", ".mesh_tpu",
+                                        "serve_stats.json")))
+
+
+class WeightedFairQueue(object):
+    """Deficit round-robin over per-tenant FIFO queues.
+
+    Each tenant earns ``weight`` credits when the drain pointer visits
+    it and spends one credit per popped request; a tenant with twice the
+    weight drains twice the requests per cycle.  Pop order is
+    deterministic (tenants in first-push order), which the fairness
+    tests pin."""
+
+    def __init__(self, weights=None, default_weight=1.0):
+        self._weights = dict(weights or {})
+        self._default_weight = float(default_weight)
+        self._queues = OrderedDict()        # tenant -> deque
+        self._credit = 0.0
+        self._current = None
+
+    def weight(self, tenant):
+        return float(self._weights.get(tenant, self._default_weight))
+
+    def push(self, tenant, item):
+        self._queues.setdefault(tenant, deque()).append(item)
+
+    def depth(self, tenant):
+        q = self._queues.get(tenant)
+        return len(q) if q else 0
+
+    def depths(self):
+        return {t: len(q) for t, q in self._queues.items()}
+
+    def __len__(self):
+        return sum(len(q) for q in self._queues.values())
+
+    def _advance(self):
+        """Move the drain pointer to the next non-empty tenant and top
+        its credit up by one quantum (= its weight)."""
+        tenants = [t for t, q in self._queues.items() if q]
+        if not tenants:
+            self._current, self._credit = None, 0.0
+            return
+        if self._current in tenants:
+            start = (tenants.index(self._current) + 1) % len(tenants)
+        else:
+            start = 0
+        self._current = tenants[start]
+        self._credit = self.weight(self._current)
+
+    def pop(self):
+        """Next (tenant, item) under DRR, or None when empty."""
+        if not len(self):
+            self._current, self._credit = None, 0.0
+            return None
+        queue = self._queues.get(self._current)
+        if not queue or self._credit < 1.0:
+            self._advance()
+            queue = self._queues[self._current]
+            # a weight < 1 tenant still makes progress: accumulate quanta
+            # until one credit exists (bounded: weights are > 0)
+            while self._credit < 1.0:
+                self._credit += self.weight(self._current)
+        self._credit -= 1.0
+        return self._current, queue.popleft()
+
+
+class ServeResponse(object):
+    """One answered request: facade-convention arrays + provenance."""
+
+    __slots__ = ("faces", "points", "tenant", "rung", "certified",
+                 "approximate", "retries", "latency_s", "deadline_s",
+                 "deadline_missed")
+
+    def __init__(self, result, tenant, retries, latency_s, deadline):
+        self.faces = result.faces
+        self.points = result.points
+        self.tenant = tenant
+        self.rung = result.rung
+        self.certified = result.certified
+        self.approximate = result.approximate
+        self.retries = retries
+        self.latency_s = latency_s
+        self.deadline_s = deadline.seconds
+        self.deadline_missed = latency_s > deadline.seconds
+
+    def to_dict(self):
+        return {
+            "tenant": self.tenant, "rung": self.rung,
+            "certified": self.certified, "approximate": self.approximate,
+            "retries": self.retries,
+            "latency_ms": round(1e3 * self.latency_s, 3),
+            "deadline_ms": round(1e3 * self.deadline_s, 3),
+            "deadline_missed": self.deadline_missed,
+        }
+
+
+class _ServeRequest(object):
+    __slots__ = ("mesh", "points", "tenant", "priority", "deadline",
+                 "future", "t_admit")
+
+    def __init__(self, mesh, points, tenant, priority, deadline):
+        self.mesh = mesh
+        self.points = points
+        self.tenant = tenant
+        self.priority = int(priority)
+        self.deadline = deadline
+        self.future = Future()
+        self.t_admit = monotonic()
+
+
+class QueryService(object):
+    """Async multi-tenant closest-point service over the engine."""
+
+    def __init__(self, max_queue_per_tenant=None, weights=None, workers=None,
+                 ladder=None, default_deadline_s=None, health=None,
+                 chunk=512, stats_path=None):
+        self.max_queue_per_tenant = (
+            _env_int("MESH_TPU_SERVE_QUEUE", 64)
+            if max_queue_per_tenant is None else int(max_queue_per_tenant))
+        self.default_deadline_s = (
+            _env_float("MESH_TPU_SERVE_DEADLINE_S", 1.0)
+            if default_deadline_s is None else float(default_deadline_s))
+        self.chunk = int(chunk)
+        self.ladder = list(ladder) if ladder is not None else default_ladder()
+        self.health = health if health is not None else HealthMonitor()
+        self.stats_path = stats_path
+        self._wfq = WeightedFairQueue(weights)
+        self._cond = threading.Condition()
+        self._held = 0
+        self._stopping = False
+        self._inflight = 0
+        n_workers = (_env_int("MESH_TPU_SERVE_WORKERS", 1)
+                     if workers is None else int(workers))
+        self._workers = [
+            threading.Thread(target=self._work,
+                             name="mesh-tpu-serve-%d" % i, daemon=True)
+            for i in range(max(n_workers, 1))
+        ]
+        for worker in self._workers:
+            worker.start()
+        self._init_metrics()
+
+    # ------------------------------------------------------------------
+    # metrics
+
+    def _init_metrics(self):
+        from ..obs.metrics import REGISTRY
+
+        self._m_requests = REGISTRY.counter(
+            "mesh_tpu_serve_requests_total",
+            "Requests by tenant and outcome (ok / rejected / shed / "
+            "deadline / error).",
+        )
+        self._m_depth = REGISTRY.gauge(
+            "mesh_tpu_serve_queue_depth",
+            "Admitted-but-undrained requests per tenant.",
+        )
+        self._m_latency = REGISTRY.histogram(
+            "mesh_tpu_serve_latency_seconds",
+            "Admission-to-response latency per tenant.",
+        )
+        self._m_shed = REGISTRY.counter(
+            "mesh_tpu_serve_shed_total",
+            "Load shed by reason (queue_full / draining / low_priority / "
+            "expired_in_queue).",
+        )
+        self._m_miss = REGISTRY.counter(
+            "mesh_tpu_serve_deadline_miss_total",
+            "Responses (or failures) that landed after the deadline.",
+        )
+        self._m_rung = REGISTRY.counter(
+            "mesh_tpu_serve_rung_total",
+            "Answered requests by degradation rung and certification.",
+        )
+
+    def _update_depth_gauges(self):
+        for tenant, depth in self._wfq.depths().items():
+            self._m_depth.set(depth, tenant=tenant)
+
+    # ------------------------------------------------------------------
+    # admission
+
+    def submit(self, mesh, points, tenant="default", priority=0,
+               deadline_s=None):
+        """Admit one closest-point request; returns a Future of
+        ServeResponse.  Raises ServeRejected (with ``retry_after``) when
+        backpressure applies — callers back off, the queue never grows
+        unbounded."""
+        if deadline_s is None:
+            deadline_s = self.default_deadline_s
+        state = self.health.state
+        with self._cond:
+            if self._stopping or state == DRAINING:
+                self._m_requests.inc(tenant=tenant, outcome="rejected")
+                self._m_shed.inc(reason="draining")
+                raise ServeRejected(
+                    "service is draining", retry_after=5.0,
+                    reason="draining")
+            if state == DEGRADED and priority < 0:
+                self._m_requests.inc(tenant=tenant, outcome="rejected")
+                self._m_shed.inc(reason="low_priority")
+                raise ServeRejected(
+                    "degraded: shedding low-priority traffic",
+                    retry_after=1.0, reason="low_priority")
+            depth = self._wfq.depth(tenant)
+            if depth >= self.max_queue_per_tenant:
+                self._m_requests.inc(tenant=tenant, outcome="rejected")
+                self._m_shed.inc(reason="queue_full")
+                # backpressure hint: the queue ahead of the caller at the
+                # deadline pace (coarse, but monotone in depth)
+                raise ServeRejected(
+                    "tenant %r queue full (%d)" % (tenant, depth),
+                    retry_after=min(depth * 0.25 * deadline_s, 10.0),
+                    reason="queue_full")
+            req = _ServeRequest(mesh, points, tenant, priority,
+                                Deadline(deadline_s))
+            self._wfq.push(tenant, req)
+            self._m_depth.set(self._wfq.depth(tenant), tenant=tenant)
+            self._cond.notify()
+        return req.future
+
+    def query(self, mesh, points, tenant="default", priority=0,
+              deadline_s=None):
+        """Synchronous submit: blocks for the response (bounded by the
+        2x-deadline hard budget plus queue wait)."""
+        fut = self.submit(mesh, points, tenant=tenant, priority=priority,
+                          deadline_s=deadline_s)
+        return fut.result()
+
+    # ------------------------------------------------------------------
+    # test/fence hooks (mirrors the executor's hold/release)
+
+    def hold(self):
+        """Fence the drain workers: admitted requests accumulate until
+        release() (deterministic queue states for tests and fairness
+        measurements)."""
+        with self._cond:
+            self._held += 1
+
+    def release(self):
+        with self._cond:
+            self._held = max(0, self._held - 1)
+            self._cond.notify_all()
+
+    # ------------------------------------------------------------------
+    # drain workers
+
+    def _work(self):
+        while True:
+            with self._cond:
+                while ((self._held or not len(self._wfq))
+                        and not self._stopping):
+                    self._cond.wait()
+                if self._stopping and not len(self._wfq):
+                    return
+                popped = self._wfq.pop()
+                if popped is None:
+                    continue
+                tenant, req = popped
+                self._m_depth.set(self._wfq.depth(tenant), tenant=tenant)
+                self._inflight += 1
+            try:
+                self._execute(req)
+            finally:
+                with self._cond:
+                    self._inflight -= 1
+                    self._cond.notify_all()
+
+    def _execute(self, req):
+        if not req.future.set_running_or_notify_cancel():
+            return
+        tenant = req.tenant
+        if req.deadline.expired():
+            # it died waiting in the queue: shed, do not burn device time
+            self._m_shed.inc(reason="expired_in_queue")
+            self._m_miss.inc(tenant=tenant)
+            self._m_requests.inc(tenant=tenant, outcome="deadline")
+            req.future.set_exception(DeadlineExceeded(
+                "deadline (%.3fs) expired after %.3fs in the %r queue"
+                % (req.deadline.seconds, req.deadline.elapsed(), tenant)))
+            return
+        # degraded: the top rung is the one the watchdog saw wedge — skip
+        # it so degraded traffic stops feeding the wedged path
+        start_rung = (
+            1 if (self.health.state == DEGRADED and len(self.ladder) > 1)
+            else 0)
+        with obs_span("serve.request", tenant=tenant,
+                      q=int(req.points.shape[0] if hasattr(
+                          req.points, "shape") else len(req.points)),
+                      priority=req.priority):
+            try:
+                result, retries = run_with_ladder(
+                    req.mesh, req.points, req.deadline, ladder=self.ladder,
+                    chunk=self.chunk, start_rung=start_rung,
+                    health=self.health)
+            except Exception as e:      # noqa: BLE001 — futures carry it
+                latency = req.deadline.elapsed()
+                missed = latency > req.deadline.seconds
+                if missed:
+                    self._m_miss.inc(tenant=tenant)
+                self._m_requests.inc(
+                    tenant=tenant,
+                    outcome=("deadline" if isinstance(e, DeadlineExceeded)
+                             else "error"))
+                self._m_latency.observe(latency, tenant=tenant)
+                req.future.set_exception(e)
+                return
+        latency = req.deadline.elapsed()
+        response = ServeResponse(result, tenant, retries, latency,
+                                 req.deadline)
+        self._m_requests.inc(tenant=tenant, outcome="ok")
+        self._m_latency.observe(latency, tenant=tenant)
+        self._m_rung.inc(rung=response.rung,
+                         certified=str(response.certified).lower())
+        if response.deadline_missed:
+            self._m_miss.inc(tenant=tenant)
+        req.future.set_result(response)
+
+    # ------------------------------------------------------------------
+    # lifecycle
+
+    def warmup(self, mesh, queries=256):
+        """Run every ladder rung once outside any deadline, so first real
+        traffic pays no compiles (engine plans, culled/anchored jits).
+        Returns the rung names warmed."""
+        import numpy as np
+
+        pts = np.zeros((int(queries), 3), np.float32)
+        warmed = []
+        for rung in self.ladder:
+            try:
+                rung.run(mesh, pts, self.chunk, timeout=600.0)
+                warmed.append(rung.name)
+            except Exception:           # noqa: BLE001 — warmup is best-effort
+                pass
+        return warmed
+
+    def drain(self, timeout=None):
+        """Block until the queues are empty and no request is in flight."""
+        t0 = monotonic()
+        with self._cond:
+            while len(self._wfq) or self._inflight:
+                if timeout is not None and monotonic() - t0 > timeout:
+                    return False
+                self._cond.wait(timeout=0.1)
+        return True
+
+    def stop(self, drain=True, write_stats=True):
+        """Graceful shutdown: health enters DRAINING (admission rejects),
+        queued work finishes (when ``drain``), workers exit, and the
+        serve.* series are flushed to the stats sink for
+        ``mesh-tpu serve-stats``."""
+        self.health.begin_drain()
+        with self._cond:
+            self._stopping = True
+            if not drain:
+                while True:
+                    popped = self._wfq.pop()
+                    if popped is None:
+                        break
+                    _tenant, req = popped
+                    if not req.future.cancel():
+                        req.future.set_exception(EngineShutdown(
+                            "serving tier stopped before dispatch"))
+            self._cond.notify_all()
+        for worker in self._workers:
+            worker.join(timeout=10)
+        self.health.stop()
+        if write_stats:
+            try:
+                self.write_stats()
+            except OSError:
+                pass
+
+    # ------------------------------------------------------------------
+    # stats sink (read by `mesh-tpu serve-stats` without touching jax)
+
+    def stats(self):
+        """JSON-able snapshot of every serve.* registry series plus the
+        health state."""
+        from ..obs.metrics import REGISTRY
+
+        series = {
+            name: REGISTRY.get(name).snapshot()
+            for name in REGISTRY.names() if name.startswith("mesh_tpu_serve")
+        }
+        return {
+            "written_utc": wall(),
+            "health": self.health.snapshot(),
+            "queues": self._wfq.depths(),
+            "metrics": series,
+        }
+
+    def write_stats(self, path=None):
+        """Atomically write ``stats()`` to the sink path; returns it."""
+        path = path or self.stats_path or default_stats_path()
+        parent = os.path.dirname(os.path.abspath(path))
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(self.stats(), fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        os.replace(tmp, path)
+        return path

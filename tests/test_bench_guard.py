@@ -188,3 +188,85 @@ def test_fit_step_wedged_is_null(monkeypatch):
     assert rec["metric"] == "fit_step_latency"
     assert rec["value"] is None and "stale" not in rec
     assert "synthetic" in rec["error"]
+
+
+def test_serve_load_record(monkeypatch):
+    """PR-4 acceptance: --serve-load under a NO-load config (one client,
+    back-to-back) must show a flat tail — p99 within 3x p50.  A serving
+    tier whose unloaded p99 blows past that is adding queueing or lock
+    jitter of its own, not measuring the engine."""
+    monkeypatch.delenv("MESH_TPU_NO_ENGINE", raising=False)
+    rec = bench.serve_load(rounds=3, clients=1, requests_per_client=30,
+                           deadline_s=5.0)
+    assert rec["metric"] == "serve_load_closed_loop"
+    assert rec["unit"] == "p99_ms"
+    assert rec["p99_ms"] == rec["value"] > 0
+    assert rec["p50_ms"] > 0
+    assert rec["p50_ms"] <= rec["p95_ms"] <= rec["p99_ms"]
+    assert rec["p99_over_p50"] <= 3.0
+    assert rec["goodput_qps"] > 0
+    assert rec["shed_rate"] == 0.0
+    assert rec["deadline_miss_rate"] == 0.0
+    # unloaded with a generous deadline: everything rides the top rung
+    assert set(rec["rungs"]) == {"engine"}
+    assert rec["requests"] == 30
+    assert rec["open_loop"]["requests"] > 0
+
+
+def test_serve_load_wedged_is_null(monkeypatch):
+    monkeypatch.setattr(
+        bench, "backend_responsive", lambda *a, **k: (False, "synthetic")
+    )
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--serve-load"])
+    buf = io.StringIO()
+    with redirect_stdout(buf), pytest.raises(SystemExit) as e:
+        bench.main()
+    rec = json.loads(buf.getvalue())
+    assert e.value.code == 1
+    assert rec["metric"] == "serve_load_closed_loop"
+    assert rec["value"] is None and "stale" not in rec
+    assert "synthetic" in rec["error"]
+
+
+def test_inprocess_backend_fast_path(monkeypatch):
+    """Satellite a: when this process already initialized the backend and
+    it answers, backend_responsive must skip the subprocess probe."""
+    import jax.numpy as jnp
+
+    float(jnp.zeros(()).sum())          # force backend init in-process
+    import subprocess
+
+    def _no_probe(*a, **k):
+        raise AssertionError("subprocess probe must not run")
+
+    monkeypatch.setattr(subprocess, "Popen", _no_probe)
+    ok, reason = bench.backend_responsive()
+    assert ok and reason == ""
+
+
+def test_hung_probe_retries_with_reduced_timeout(monkeypatch):
+    """Satellite a: after a first hung probe, the remaining attempts run
+    at the reduced hung_probe_timeout instead of full probe_timeout."""
+    import subprocess
+
+    timeouts = []
+
+    class _HungProc(object):
+        returncode = None
+
+        def communicate(self, timeout=None):
+            if timeouts and timeout == 10:
+                return ("", "")         # the post-kill reap succeeds
+            timeouts.append(timeout)
+            raise subprocess.TimeoutExpired(cmd="probe", timeout=timeout)
+
+        def kill(self):
+            pass
+
+    monkeypatch.setattr(bench, "_inprocess_backend_ok", lambda **k: False)
+    monkeypatch.setattr(subprocess, "Popen", lambda *a, **k: _HungProc())
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    ok, reason = bench.backend_responsive(
+        probe_timeout=150, attempts=3, hung_probe_timeout=15)
+    assert not ok and "hung" in reason
+    assert timeouts == [150, 15, 15]

@@ -215,6 +215,86 @@ def test_executor_rejects_bad_requests(mesh):
 
 
 # ---------------------------------------------------------------------------
+# executor lifecycle: shutdown hardening + deadline/cancel hooks
+#
+# These use FRESH EngineExecutor instances: the process-wide
+# get_executor() is shared by every other test in the suite, and a
+# shut-down singleton would poison them all.
+
+
+def test_shutdown_submit_raises(mesh):
+    ex = engine.EngineExecutor()
+    ex.shutdown()
+    with pytest.raises(engine.EngineShutdown):
+        ex.submit("closest_point", mesh, _queries(10))
+
+
+def test_shutdown_completes_queued_work(mesh, monkeypatch):
+    monkeypatch.delenv("MESH_TPU_NO_ENGINE", raising=False)
+    ex = engine.EngineExecutor()
+    ex.hold()
+    fut = ex.submit("closest_point", mesh, _queries(20, seed=21))
+    ex.release()
+    ex.shutdown()
+    faces, points = fut.result(timeout=60)
+    assert faces.shape == (1, 20) and points.shape == (20, 3)
+
+
+def test_drain_after_shutdown_returns_immediately(mesh):
+    from mesh_tpu.obs.clock import monotonic
+
+    ex = engine.EngineExecutor()
+    ex.shutdown()
+    t0 = monotonic()
+    ex.drain()
+    assert monotonic() - t0 < 1.0
+    # idempotent, still fast the second time
+    ex.shutdown()
+    ex.drain()
+
+
+def test_queued_deadline_expiry_drops_request(mesh, monkeypatch):
+    from mesh_tpu.errors import DeadlineExceeded
+    from mesh_tpu.obs.clock import monotonic
+
+    monkeypatch.delenv("MESH_TPU_NO_ENGINE", raising=False)
+    ex = engine.EngineExecutor()
+    try:
+        ex.hold()
+        # already expired when the worker gets to it
+        dead = ex.submit("closest_point", mesh, _queries(15, seed=31),
+                         deadline=monotonic() - 0.001)
+        live = ex.submit("closest_point", mesh, _queries(15, seed=32),
+                         deadline=monotonic() + 60.0)
+        ex.release()
+        with pytest.raises(DeadlineExceeded):
+            dead.result(timeout=60)
+        faces, _ = live.result(timeout=60)
+        assert faces.shape == (1, 15)
+    finally:
+        ex.shutdown()
+
+
+def test_cancel_before_dispatch_skips_request(mesh, monkeypatch):
+    monkeypatch.delenv("MESH_TPU_NO_ENGINE", raising=False)
+    from mesh_tpu.obs.metrics import REGISTRY
+
+    cancelled = REGISTRY.counter("mesh_tpu_engine_cancelled_total")
+    before = cancelled.total()
+    ex = engine.EngineExecutor()
+    try:
+        ex.hold()
+        fut = ex.submit("closest_point", mesh, _queries(15, seed=41))
+        assert fut.cancel()
+        ex.release()
+        ex.drain()
+        assert fut.cancelled()
+        assert cancelled.total() == before + 1
+    finally:
+        ex.shutdown()
+
+
+# ---------------------------------------------------------------------------
 # warmup + stats surface
 
 

@@ -1,0 +1,612 @@
+"""mesh_tpu.serve contract (doc/serving.md).
+
+The serving acceptance bar, pinned fast and TPU-free:
+
+- weighted-fair admission: DRR ordering, bounded queues, reject-with-
+  retry-after backpressure, draining rejection;
+- the degradation ladder under fault injection: a wedged or failing
+  rung falls through to the next within the hard 2x-deadline budget,
+  the response carries rung/approximate metadata, and the serve.*
+  metrics count every retry and shed;
+- the health watchdog's state machine (fake clock, no sleeps);
+- the serve-stats CLI's no-JAX-init fast path.
+
+Fault injection uses custom ladders of plain-python rungs (no jax at
+all) wherever possible; the handful of real-ladder tests ride the same
+CPU engine the rest of the suite uses.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from mesh_tpu.errors import DeadlineExceeded, ServeRejected
+from mesh_tpu.obs.clock import monotonic
+from mesh_tpu.obs.metrics import REGISTRY
+from mesh_tpu.serve import (
+    DEGRADED,
+    DRAINING,
+    HEALTHY,
+    Deadline,
+    HealthMonitor,
+    QueryService,
+    Rung,
+    ServeResult,
+    WeightedFairQueue,
+    call_with_timeout,
+    default_ladder,
+    percentile,
+    run_with_ladder,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# fake rungs: plain python, deterministic, no jax
+
+
+def _answer(rung_name, certified=True):
+    faces = np.zeros((1, 4), np.uint32)
+    points = np.zeros((4, 3), np.float64)
+    return ServeResult(faces, points, rung_name, certified=certified)
+
+
+def _ok_rung(name="ok", certified=True, latency_s=0.0):
+    def fn(mesh, points, chunk, timeout):
+        if latency_s:
+            time.sleep(latency_s)
+        return _answer(name, certified)
+    return Rung(name, fn)
+
+
+def _failing_rung(name="boom", error=RuntimeError):
+    def fn(mesh, points, chunk, timeout):
+        raise error("%s rung failed" % name)
+    return Rung(name, fn)
+
+
+def _wedged_rung(name="wedged", wedge_s=30.0):
+    """Simulates the axon wedge: ignores its timeout and sleeps far past
+    any deadline.  Wrapped in call_with_timeout so the caller's slice
+    still bounds it — exactly how the built-in rungs guard the device."""
+    def fn(mesh, points, chunk, timeout):
+        return call_with_timeout(
+            lambda: time.sleep(wedge_s) or _answer(name), timeout)
+    return Rung(name, fn)
+
+
+def _counter_total(name, **labels):
+    metric = REGISTRY.get(name)
+    if metric is None:
+        return 0
+    return metric.value(**labels) if labels else metric.total()
+
+
+@pytest.fixture
+def quiet_health():
+    return HealthMonitor(watchdog=False)
+
+
+def _service(**kw):
+    kw.setdefault("health", HealthMonitor(watchdog=False))
+    kw.setdefault("workers", 1)
+    kw.setdefault("ladder", [_ok_rung()])
+    return QueryService(**kw)
+
+
+_MESH = object()            # fake ladders never touch the mesh
+_PTS = np.zeros((4, 3), np.float32)
+
+
+# ---------------------------------------------------------------------------
+# WeightedFairQueue: deficit round-robin
+
+
+def test_wfq_fifo_single_tenant():
+    wfq = WeightedFairQueue()
+    for i in range(3):
+        wfq.push("t", i)
+    assert [wfq.pop()[1] for _ in range(3)] == [0, 1, 2]
+    assert wfq.pop() is None
+
+
+def test_wfq_weighted_interleave():
+    wfq = WeightedFairQueue({"a": 2, "b": 1})
+    for i in range(6):
+        wfq.push("a", i)
+    for i in range(3):
+        wfq.push("b", i)
+    order = []
+    while True:
+        popped = wfq.pop()
+        if popped is None:
+            break
+        order.append(popped[0])
+    # tenant a drains twice per cycle, b once — a cannot starve b
+    assert order == ["a", "a", "b"] * 3
+
+
+def test_wfq_fractional_weight_still_progresses():
+    wfq = WeightedFairQueue({"slow": 0.25})
+    wfq.push("slow", "x")
+    assert wfq.pop() == ("slow", "x")
+
+
+def test_wfq_depths():
+    wfq = WeightedFairQueue()
+    wfq.push("a", 1)
+    wfq.push("a", 2)
+    wfq.push("b", 3)
+    assert wfq.depth("a") == 2 and wfq.depth("b") == 1
+    assert wfq.depths() == {"a": 2, "b": 1}
+    assert len(wfq) == 3
+
+
+# ---------------------------------------------------------------------------
+# Deadline + call_with_timeout
+
+
+def test_deadline_accounting():
+    d = Deadline(10.0)
+    assert 9.0 < d.remaining() <= 10.0
+    assert 19.0 < d.hard_remaining() <= 20.0
+    assert not d.expired()
+    assert Deadline(-0.001).expired()
+
+
+def test_call_with_timeout_passes_result_and_errors():
+    assert call_with_timeout(lambda: 42, timeout=5.0) == 42
+    with pytest.raises(KeyError):
+        call_with_timeout(lambda: {}["missing"], timeout=5.0)
+
+
+def test_call_with_timeout_abandons_wedged_call():
+    t0 = monotonic()
+    with pytest.raises(DeadlineExceeded):
+        call_with_timeout(lambda: time.sleep(30), timeout=0.05)
+    assert monotonic() - t0 < 5.0
+
+
+# ---------------------------------------------------------------------------
+# the degradation ladder under fault injection
+
+
+def test_ladder_happy_path_no_retries():
+    result, retries = run_with_ladder(
+        _MESH, _PTS, Deadline(1.0), ladder=[_ok_rung("a"), _ok_rung("b")])
+    assert result.rung == "a" and retries == 0 and result.certified
+
+
+def test_ladder_failing_rung_falls_through():
+    before = _counter_total("mesh_tpu_serve_retries_total",
+                            rung="boom", error="RuntimeError")
+    result, retries = run_with_ladder(
+        _MESH, _PTS, Deadline(1.0),
+        ladder=[_failing_rung("boom"), _ok_rung("backup", certified=False)])
+    assert result.rung == "backup" and retries == 1
+    assert result.approximate and not result.certified
+    assert _counter_total("mesh_tpu_serve_retries_total",
+                          rung="boom", error="RuntimeError") == before + 1
+
+
+def test_ladder_wedged_rung_bounded_by_hard_budget():
+    """The acceptance criterion: a wedged top rung still yields a valid
+    degraded response within 2x the deadline — never a hang."""
+    deadline_s = 0.2
+    before = _counter_total("mesh_tpu_serve_retries_total")
+    t0 = monotonic()
+    result, retries = run_with_ladder(
+        _MESH, _PTS, Deadline(deadline_s),
+        ladder=[_wedged_rung(wedge_s=30.0), _ok_rung("backup")])
+    wall = monotonic() - t0
+    assert result.rung == "backup" and retries == 1
+    assert wall < 2.0 * deadline_s + 0.1
+    assert _counter_total("mesh_tpu_serve_retries_total") > before
+
+
+def test_ladder_all_rungs_fail_raises_with_cause():
+    with pytest.raises(DeadlineExceeded) as err:
+        run_with_ladder(
+            _MESH, _PTS, Deadline(0.2),
+            ladder=[_failing_rung("a"), _failing_rung("b", ValueError)])
+    assert isinstance(err.value.__cause__, ValueError)
+
+
+def test_ladder_last_rung_not_starved_by_wedges():
+    """Two wedged rungs burn most of the budget; the split-evenly slice
+    policy must still leave the final rung a live slice."""
+    result, retries = run_with_ladder(
+        _MESH, _PTS, Deadline(0.3),
+        ladder=[_wedged_rung("w1"), _wedged_rung("w2"), _ok_rung("last")])
+    assert result.rung == "last" and retries == 2
+
+
+def test_ladder_health_hooks_fire():
+    health = HealthMonitor(watchdog=False, wedge_after_s=60.0)
+    run_with_ladder(_MESH, _PTS, Deadline(1.0),
+                    ladder=[_failing_rung(), _ok_rung()], health=health)
+    # the failed attempt tripped the monitor out of HEALTHY
+    assert health.state == DEGRADED
+
+
+def test_default_ladder_env_override(monkeypatch):
+    monkeypatch.setenv("MESH_TPU_SERVE_LADDER", "anchored,engine")
+    assert [r.name for r in default_ladder()] == ["anchored", "engine"]
+    monkeypatch.setenv("MESH_TPU_SERVE_LADDER", "bogus")
+    with pytest.raises(ValueError):
+        default_ladder()
+    monkeypatch.delenv("MESH_TPU_SERVE_LADDER")
+    assert [r.name for r in default_ladder()] == [
+        "engine", "culled", "anchored"]
+
+
+# ---------------------------------------------------------------------------
+# HealthMonitor: state machine on a fake clock
+
+
+class _FakeClock(object):
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def _monitor(**kw):
+    kw.setdefault("watchdog", False)
+    kw.setdefault("wedge_after_s", 1.0)
+    clock = kw.pop("clock", None) or _FakeClock()
+    return HealthMonitor(clock=clock, **kw), clock
+
+
+def test_health_fast_success_stays_healthy():
+    mon, clock = _monitor()
+    token = mon.dispatch_began("engine")
+    clock.t += 0.1
+    mon.dispatch_finished(token)
+    assert mon.state == HEALTHY and mon.ready() and mon.live()
+
+
+def test_health_slow_dispatch_degrades_then_recovers():
+    mon, clock = _monitor(recover_after=2)
+    token = mon.dispatch_began("engine")
+    clock.t += 5.0                      # past the 1 s wedge threshold
+    mon.dispatch_finished(token)
+    assert mon.state == DEGRADED and mon.ready()
+    for _ in range(2):
+        token = mon.dispatch_began("engine")
+        clock.t += 0.1
+        mon.dispatch_finished(token)
+    assert mon.state == HEALTHY
+
+
+def test_health_watchdog_detects_inflight_wedge():
+    """The non-blocking check: an in-flight dispatch past the threshold
+    trips the monitor WITHOUT waiting for it to return (it may never)."""
+    mon, clock = _monitor()
+    token = mon.dispatch_began("engine")
+    assert mon.check_now() == []
+    clock.t += 2.0
+    assert mon.check_now() == [token]
+    assert mon.state == DEGRADED
+    # one stuck dispatch trips once, not once per tick
+    assert mon.check_now() == []
+
+
+def test_health_persistent_trips_escalate_to_draining():
+    mon, _clock = _monitor(drain_after=3)
+    for _ in range(3):
+        mon.trip("dispatch_failed")
+    assert mon.state == DRAINING
+    assert not mon.ready() and mon.live()
+    # terminal until reset
+    mon.dispatch_finished(mon.dispatch_began("engine"))
+    assert mon.state == DRAINING
+    mon.reset()
+    assert mon.state == HEALTHY
+
+
+def test_health_trip_metric_counts():
+    before = _counter_total("mesh_tpu_serve_watchdog_trips_total")
+    mon, _clock = _monitor()
+    mon.trip("dispatch_failed")
+    assert _counter_total("mesh_tpu_serve_watchdog_trips_total") == before + 1
+
+
+# ---------------------------------------------------------------------------
+# QueryService: admission, backpressure, fairness, execution
+
+
+def test_service_answers_with_metadata():
+    svc = _service(default_deadline_s=5.0)
+    try:
+        resp = svc.query(_MESH, _PTS, tenant="t1")
+        assert resp.rung == "ok" and resp.certified
+        assert not resp.approximate and not resp.deadline_missed
+        assert resp.tenant == "t1" and resp.retries == 0
+        assert resp.latency_s < 5.0
+        d = resp.to_dict()
+        assert d["rung"] == "ok" and d["deadline_missed"] is False
+    finally:
+        svc.stop(write_stats=False)
+
+
+def test_service_queue_full_rejects_with_retry_after():
+    svc = _service(max_queue_per_tenant=2)
+    before = _counter_total("mesh_tpu_serve_shed_total", reason="queue_full")
+    try:
+        svc.hold()
+        futs = [svc.submit(_MESH, _PTS) for _ in range(2)]
+        with pytest.raises(ServeRejected) as err:
+            svc.submit(_MESH, _PTS)
+        assert err.value.reason == "queue_full"
+        assert err.value.retry_after > 0
+        # other tenants have their own bound: not rejected
+        other = svc.submit(_MESH, _PTS, tenant="other")
+        svc.release()
+        for fut in futs + [other]:
+            assert fut.result(timeout=30).rung == "ok"
+        assert _counter_total("mesh_tpu_serve_shed_total",
+                              reason="queue_full") == before + 1
+    finally:
+        svc.stop(write_stats=False)
+
+
+def test_service_draining_rejects_admission():
+    svc = _service()
+    try:
+        svc.health.begin_drain()
+        with pytest.raises(ServeRejected) as err:
+            svc.submit(_MESH, _PTS)
+        assert err.value.reason == "draining"
+    finally:
+        svc.stop(write_stats=False)
+
+
+def test_service_degraded_sheds_low_priority():
+    svc = _service(ladder=[_ok_rung("a"), _ok_rung("b")])
+    try:
+        svc.health.trip("dispatch_slow")
+        assert svc.health.state == DEGRADED
+        with pytest.raises(ServeRejected) as err:
+            svc.submit(_MESH, _PTS, priority=-1)
+        assert err.value.reason == "low_priority"
+        # normal priority still served — one rung down (skip the wedged top)
+        resp = svc.query(_MESH, _PTS)
+        assert resp.rung == "b"
+    finally:
+        svc.stop(write_stats=False)
+
+
+def test_service_expired_in_queue_is_shed():
+    svc = _service()
+    before = _counter_total("mesh_tpu_serve_shed_total",
+                            reason="expired_in_queue")
+    try:
+        svc.hold()
+        fut = svc.submit(_MESH, _PTS, deadline_s=0.05)
+        time.sleep(0.2)                 # expires while held in queue
+        svc.release()
+        with pytest.raises(DeadlineExceeded):
+            fut.result(timeout=30)
+        assert _counter_total("mesh_tpu_serve_shed_total",
+                              reason="expired_in_queue") == before + 1
+    finally:
+        svc.stop(write_stats=False)
+
+
+def test_service_wedged_rung_degraded_response_within_budget():
+    """End-to-end acceptance: wedged top rung, the service still answers
+    degraded-but-valid within 2x the deadline, and the serve.* series
+    record the retry."""
+    deadline_s = 0.2
+    svc = _service(ladder=[_wedged_rung(wedge_s=30.0),
+                           _ok_rung("backup", certified=False)],
+                   default_deadline_s=deadline_s)
+    retries_before = _counter_total("mesh_tpu_serve_retries_total")
+    try:
+        t0 = monotonic()
+        resp = svc.query(_MESH, _PTS)
+        wall = monotonic() - t0
+        assert resp.rung == "backup"
+        assert resp.approximate and resp.retries == 1
+        assert wall < 2.0 * deadline_s + 0.2
+        assert _counter_total("mesh_tpu_serve_retries_total") > retries_before
+        assert _counter_total("mesh_tpu_serve_rung_total",
+                              rung="backup", certified="false") > 0
+    finally:
+        svc.stop(write_stats=False)
+
+
+def test_service_outcome_counters():
+    svc = _service(ladder=[_failing_rung("only")])
+    tenant = "errtenant-%d" % os.getpid()
+    before = _counter_total("mesh_tpu_serve_requests_total",
+                            tenant=tenant, outcome="deadline")
+    try:
+        with pytest.raises(DeadlineExceeded):
+            svc.query(_MESH, _PTS, tenant=tenant, deadline_s=0.1)
+        assert _counter_total("mesh_tpu_serve_requests_total",
+                              tenant=tenant,
+                              outcome="deadline") == before + 1
+    finally:
+        svc.stop(write_stats=False)
+
+
+def test_service_stop_without_drain_fails_queued_futures():
+    svc = _service()
+    svc.hold()
+    futs = [svc.submit(_MESH, _PTS) for _ in range(3)]
+    svc.release()           # workers may grab some before stop lands
+    svc.stop(drain=False, write_stats=False)
+    for fut in futs:
+        assert fut.cancelled() or fut.done()
+
+
+def test_service_stats_sink_roundtrip(tmp_path):
+    sink = str(tmp_path / "serve_stats.json")
+    svc = _service(stats_path=sink)
+    try:
+        svc.query(_MESH, _PTS, tenant="sink-test")
+    finally:
+        svc.stop()
+    with open(sink) as fh:
+        data = json.load(fh)
+    assert data["health"]["state"] == "draining"
+    assert "mesh_tpu_serve_requests_total" in data["metrics"]
+    series = data["metrics"]["mesh_tpu_serve_requests_total"]["series"]
+    assert any(s["labels"].get("tenant") == "sink-test" for s in series)
+
+
+# ---------------------------------------------------------------------------
+# real ladder on the CPU engine
+
+
+@pytest.fixture
+def sphere():
+    from mesh_tpu import Mesh
+    from mesh_tpu.sphere import _icosphere
+
+    v, f = _icosphere(2)
+    return Mesh(v=v, f=f)
+
+
+def test_real_ladder_parity_with_facade(sphere):
+    pts = np.asarray(np.random.RandomState(0).randn(48, 3), np.float32)
+    svc = QueryService(workers=1, default_deadline_s=30.0,
+                       health=HealthMonitor(watchdog=False))
+    try:
+        svc.warmup(sphere, queries=48)
+        resp = svc.query(sphere, pts)
+        assert resp.rung == "engine" and resp.certified
+        f_ref, p_ref = sphere.closest_faces_and_points(pts)
+        assert np.array_equal(resp.faces, f_ref)
+        assert np.array_equal(resp.points, p_ref)
+    finally:
+        svc.stop(write_stats=False)
+
+
+def test_real_ladder_engine_failure_falls_to_culled(sphere, monkeypatch):
+    """Monkeypatched engine rung failure: the real culled rung answers,
+    and the response says so."""
+    from mesh_tpu.serve import deadline as deadline_mod
+
+    def _broken(mesh, points, chunk, timeout):
+        raise RuntimeError("injected engine fault")
+
+    ladder = [Rung("engine", _broken),
+              Rung("culled", deadline_mod._rung_culled)]
+    svc = QueryService(workers=1, ladder=ladder, default_deadline_s=30.0,
+                       health=HealthMonitor(watchdog=False))
+    try:
+        svc.warmup(sphere, queries=48)      # compiles culled outside timing
+        pts = np.asarray(np.random.RandomState(1).randn(48, 3), np.float32)
+        resp = svc.query(sphere, pts)
+        assert resp.rung == "culled" and resp.retries == 1
+        # k=64 candidates on a 320-face sphere: certificates may or may
+        # not all be tight, but the answer arrays are facade-shaped
+        assert resp.faces.shape == (1, 48) and resp.points.shape == (48, 3)
+    finally:
+        svc.stop(write_stats=False)
+
+
+# ---------------------------------------------------------------------------
+# loadgen
+
+
+def test_percentile_nearest_rank():
+    vals = list(range(1, 101))
+    assert percentile(vals, 50) == 50
+    assert percentile(vals, 99) == 99
+    assert percentile(vals, 100) == 100
+    assert percentile([], 99) == 0.0
+    assert percentile([7.0], 50) == 7.0
+
+
+def test_closed_loop_report_shape():
+    from mesh_tpu.serve import run_closed_loop
+
+    svc = _service(workers=2, default_deadline_s=5.0)
+    try:
+        report = run_closed_loop(svc, _MESH, _PTS, clients=2,
+                                 requests_per_client=5)
+    finally:
+        svc.stop(write_stats=False)
+    assert report["loop"] == "closed"
+    assert report["requests"] == 10 and report["ok"] == 10
+    assert report["shed_rate"] == 0.0
+    assert report["p50_ms"] <= report["p95_ms"] <= report["p99_ms"]
+    assert report["goodput_qps"] > 0
+    assert report["rungs"] == {"ok": 10}
+
+
+def test_open_loop_report_shape():
+    from mesh_tpu.serve import run_open_loop
+
+    svc = _service(workers=2, default_deadline_s=5.0)
+    try:
+        report = run_open_loop(svc, _MESH, _PTS, rate_qps=50.0,
+                               duration_s=0.3)
+    finally:
+        svc.stop(write_stats=False)
+    assert report["loop"] == "open"
+    assert report["requests"] >= 10
+    assert report["ok"] + report["shed"] + report["errors"] \
+        + report["deadline_failures"] == report["requests"]
+
+
+# ---------------------------------------------------------------------------
+# mesh-tpu serve-stats CLI
+
+
+def _run_cli(*argv, **env_overrides):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", **env_overrides)
+    return subprocess.run(
+        [sys.executable, "-m", "mesh_tpu.cli", "serve-stats"] + list(argv),
+        capture_output=True, text=True, timeout=120, env=env, cwd=_REPO)
+
+
+def test_serve_stats_cli_missing_sink_exits_zero(tmp_path):
+    missing = str(tmp_path / "nope.json")
+    proc = _run_cli("--path", missing)
+    assert proc.returncode == 0
+    assert "no serve stats sink" in proc.stdout
+    assert missing in proc.stdout
+
+
+def test_serve_stats_cli_env_path(tmp_path):
+    missing = str(tmp_path / "env_nope.json")
+    proc = _run_cli(MESH_TPU_SERVE_STATS=missing)
+    assert proc.returncode == 0
+    assert missing in proc.stdout
+
+
+def test_serve_stats_cli_reads_sink(tmp_path):
+    sink = str(tmp_path / "serve_stats.json")
+    svc = _service(stats_path=sink)
+    try:
+        svc.query(_MESH, _PTS, tenant="cli-test")
+    finally:
+        svc.stop()
+    proc = _run_cli("--path", sink)
+    assert proc.returncode == 0
+    assert "mesh_tpu_serve_requests_total" in proc.stdout
+    assert "cli-test" in proc.stdout
+    proc_json = _run_cli("--path", sink, "--json")
+    assert proc_json.returncode == 0
+    data = json.loads(proc_json.stdout)
+    assert data["health"]["state"] == "draining"
+
+
+def test_serve_stats_cli_corrupt_sink_exits_nonzero(tmp_path):
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    proc = _run_cli("--path", str(bad))
+    assert proc.returncode == 1
+    assert "unreadable" in proc.stderr
