@@ -451,6 +451,67 @@ def obs_overhead(rounds=5, sweeps_per_round=3):
     }
 
 
+def recorder_overhead(rounds=5, sweeps_per_round=3):
+    """Always-on cost of the flight recorder on the steady-state
+    dispatch sweep: per-call latency with MESH_TPU_RECORDER=0 (record()
+    returns at the env read) vs the default always-on ring append, obs
+    spans off on both sides.  Same interleaved min-of-rounds shape as
+    --obs-overhead; tests/test_bench_guard.py pins ``overhead_frac``
+    < 0.05 — the bound that makes "always on" an honest claim.
+    """
+    from mesh_tpu import Mesh, obs
+    from mesh_tpu.sphere import _icosphere
+
+    rng = np.random.RandomState(0)
+    v, f = _icosphere(2)
+    mesh = Mesh(v=v, f=f)
+    query_sets = [
+        np.asarray(rng.randn(q, 3) * 0.4, np.float32) for q in _DISPATCH_QS
+    ]
+
+    def sweep():
+        for q in query_sets:
+            mesh.closest_faces_and_points(q)
+
+    def timed():
+        t0 = time.perf_counter()
+        for _ in range(sweeps_per_round):
+            sweep()
+        return (time.perf_counter() - t0) / (
+            sweeps_per_round * len(query_sets))
+
+    prev_rec = os.environ.pop("MESH_TPU_RECORDER", None)
+    prev_obs = os.environ.pop("MESH_TPU_OBS", None)
+    try:
+        sweep()                              # warm-up: compile every plan
+        os.environ["MESH_TPU_RECORDER"] = "0"
+        sweep()                              # warm both code paths
+        off_best, on_best = np.inf, np.inf
+        for _ in range(rounds):
+            os.environ["MESH_TPU_RECORDER"] = "0"
+            off_best = min(off_best, timed())
+            os.environ.pop("MESH_TPU_RECORDER", None)
+            on_best = min(on_best, timed())
+    finally:
+        if prev_rec is None:
+            os.environ.pop("MESH_TPU_RECORDER", None)
+        else:
+            os.environ["MESH_TPU_RECORDER"] = prev_rec
+        if prev_obs is not None:
+            os.environ["MESH_TPU_OBS"] = prev_obs
+    overhead = max(0.0, (on_best - off_best) / off_best) if off_best else None
+    return {
+        "metric": "recorder_overhead_small_q",
+        "value": round(overhead, 4) if overhead is not None else None,
+        "unit": "overhead_frac",
+        "vs_baseline": None,
+        "off_ms_per_call": round(off_best * 1e3, 3),
+        "on_ms_per_call": round(on_best * 1e3, 3),
+        "overhead_frac": round(overhead, 4) if overhead is not None else None,
+        "events_recorded": len(obs.get_recorder().events()),
+    }
+
+
 def fit_step_latency(repeats=10, n_scan=256):
     """Forward / backward / re-correspondence latency of one scan-fit
     step on the differentiable point-to-surface loss (doc/differentiable.md).
@@ -654,6 +715,8 @@ def main():
         for flag, metric, unit in (
             ("--dispatch-latency", "dispatch_latency_small_q", "ms/call"),
             ("--obs-overhead", "obs_overhead_small_q", "overhead_frac"),
+            ("--recorder-overhead", "recorder_overhead_small_q",
+             "overhead_frac"),
             ("--fit-step", "fit_step_latency", "ms/call"),
             ("--serve-load", "serve_load_closed_loop", "p99_ms"),
         ):
@@ -670,6 +733,7 @@ def main():
         sys.exit(rc)
     if ("--dispatch-latency" in sys.argv[1:]
             or "--obs-overhead" in sys.argv[1:]
+            or "--recorder-overhead" in sys.argv[1:]
             or "--fit-step" in sys.argv[1:]
             or "--serve-load" in sys.argv[1:]):
         from mesh_tpu.utils.compilation_cache import (
@@ -679,6 +743,8 @@ def main():
         enable_persistent_compilation_cache()
         if "--obs-overhead" in sys.argv[1:]:
             print(json.dumps(_with_obs(obs_overhead())))
+        elif "--recorder-overhead" in sys.argv[1:]:
+            print(json.dumps(_with_obs(recorder_overhead())))
         elif "--fit-step" in sys.argv[1:]:
             print(json.dumps(_with_obs(fit_step_latency())))
         elif "--serve-load" in sys.argv[1:]:

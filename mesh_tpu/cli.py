@@ -7,6 +7,8 @@ Subcommands:
   stats — run a workload and dump the metrics registry (JSON/Prometheus)
   trace — run a workload with spans on and print the span tree
   serve-stats — summarize the serving tier's stats sink (no jax init)
+  incidents — list/show flight-recorder incident dumps (no jax init)
+  slo — evaluate SLO compliance from the serve-stats sink (no jax init)
 
 Examples:
   meshviewer view body.ply
@@ -16,6 +18,9 @@ Examples:
   mesh-tpu stats --prom
   mesh-tpu trace --mesh body.ply --jsonl /tmp/spans.jsonl
   mesh-tpu serve-stats
+  mesh-tpu incidents
+  mesh-tpu incidents incident-...-watchdog_trip-001.json --json
+  mesh-tpu slo --latency-ms 250 --target 0.99
 """
 
 import argparse
@@ -236,6 +241,140 @@ def cmd_serve_stats(args):
                 print("    {%s} %s" % (tag, series.get("value")))
 
 
+def _incident_dir(args):
+    return (args.dir or os.environ.get(
+        "MESH_TPU_INCIDENT_DIR", "").strip() or os.path.expanduser(
+        os.path.join("~", ".mesh_tpu", "incidents")))
+
+
+def cmd_incidents(args):
+    """List or show flight-recorder incident dumps.
+
+    Same import discipline as serve-stats: json/os only, no mesh_tpu or
+    jax imports, no backend initialization — incidents are exactly what
+    you read while the device is wedged.  An empty/missing directory is
+    a normal state (nothing went wrong yet): message, exit 0.
+    """
+    import json
+
+    directory = _incident_dir(args)
+    try:
+        names = sorted(
+            n for n in os.listdir(directory)
+            if n.startswith("incident-") and n.endswith(".json"))
+    except OSError:
+        names = []
+    if args.name:
+        path = (args.name if os.path.sep in args.name
+                else os.path.join(directory, args.name))
+        try:
+            with open(path) as fh:
+                incident = json.load(fh)
+        except (OSError, ValueError) as exc:
+            print("incident %s is unreadable: %s" % (path, exc),
+                  file=sys.stderr)
+            sys.exit(1)
+        if args.json:
+            json.dump(incident, sys.stdout, indent=2, sort_keys=True)
+            sys.stdout.write("\n")
+            return
+        print("incident %s" % path)
+        print("  reason: %s" % incident.get("reason"))
+        print("  written_utc: %s" % incident.get("written_utc"))
+        print("  schema_version: %s" % incident.get("schema_version"))
+        context = incident.get("context") or {}
+        if context:
+            print("  context: %s"
+                  % ", ".join("%s=%s" % kv for kv in sorted(context.items())))
+        health = incident.get("health")
+        if health:
+            print("  health: %s (trip_streak=%s trips=%s)"
+                  % (health.get("state"), health.get("trip_streak"),
+                     health.get("trips")))
+        ring = incident.get("ring") or []
+        kinds = {}
+        for event in ring:
+            kinds[event.get("kind", "?")] = kinds.get(
+                event.get("kind", "?"), 0) + 1
+        print("  ring: %d events (%s)"
+              % (len(ring),
+                 ", ".join("%s=%d" % kv for kv in sorted(kinds.items()))))
+        for event in ring[-args.tail:]:
+            detail = " ".join(
+                "%s=%s" % (k, v) for k, v in sorted(event.items())
+                if k not in ("kind", "t"))
+            print("    [%.6f] %s %s"
+                  % (event.get("t") or 0.0, event.get("kind", "?"), detail))
+        return
+    if not names:
+        print("no incidents in %s (nothing has tripped yet; see "
+              "doc/observability.md for the trigger matrix)" % directory)
+        return
+    if args.json:
+        json.dump(names, sys.stdout)
+        sys.stdout.write("\n")
+        return
+    print("%d incident(s) in %s" % (len(names), directory))
+    for name in names:
+        line = "  %s" % name
+        try:
+            with open(os.path.join(directory, name)) as fh:
+                incident = json.load(fh)
+            line += "  reason=%s ring=%d" % (
+                incident.get("reason"), len(incident.get("ring") or []))
+        except (OSError, ValueError):
+            line += "  (unreadable)"
+        print(line)
+
+
+def cmd_slo(args):
+    """Evaluate SLO compliance offline from the serve-stats sink.
+
+    Imports only mesh_tpu.obs.slo (stdlib-only) on top of json/os — no
+    jax backend initialization, same operability story as serve-stats.
+    """
+    import json
+
+    from mesh_tpu.obs.slo import SLO, compliance, tenants
+
+    path = args.path or os.environ.get(
+        "MESH_TPU_SERVE_STATS", "").strip() or os.path.expanduser(
+        os.path.join("~", ".mesh_tpu", "serve_stats.json"))
+    if not os.path.exists(path):
+        print("no serve stats sink at %s (nothing has served yet; "
+              "QueryService.stop() writes it)" % path)
+        return
+    try:
+        with open(path) as fh:
+            sink = json.load(fh)
+    except (OSError, ValueError) as exc:
+        print("serve stats sink at %s is unreadable: %s" % (path, exc),
+              file=sys.stderr)
+        sys.exit(1)
+    metrics = sink.get("metrics") or {}
+    objectives = [
+        SLO("latency_p%g" % (100 * args.target), "latency", args.target,
+            threshold_s=args.latency_ms / 1e3),
+        SLO("availability", "availability", args.availability_target),
+    ]
+    rows = [
+        compliance(metrics, slo, tenant)
+        for slo in objectives for tenant in tenants(metrics)
+    ]
+    if args.json:
+        json.dump(rows, sys.stdout, indent=2, sort_keys=True)
+        sys.stdout.write("\n")
+        return
+    print("SLO compliance (%s)" % path)
+    if not rows:
+        print("  no tenants in the sink yet")
+    for row in rows:
+        print("  %-18s tenant=%-12s %d/%d = %.5f (target %.5f) %s"
+              % (row["objective"], row["tenant"], row["good"], row["total"],
+                 row["compliance"], row["target"],
+                 "MET" if row["met"] else "MISSED"))
+
+
 def main():
     parser = argparse.ArgumentParser(prog="meshviewer", description=__doc__)
     sub = parser.add_subparsers(dest="command", required=True)
@@ -309,6 +448,40 @@ def main():
     p_sstats.add_argument("--json", action="store_true",
                           help="raw JSON dump instead of the summary")
     p_sstats.set_defaults(func=cmd_serve_stats)
+
+    p_inc = sub.add_parser(
+        "incidents",
+        help="list/show flight-recorder incident dumps (no jax init)")
+    p_inc.add_argument("name", nargs="?", default=None,
+                       help="incident file (name in the dir, or a path) "
+                            "to show; omit to list")
+    p_inc.add_argument("--dir", default=None,
+                       help="incident directory (default: "
+                            "MESH_TPU_INCIDENT_DIR or "
+                            "~/.mesh_tpu/incidents)")
+    p_inc.add_argument("--tail", type=int, default=10,
+                       help="ring events to print when showing (default 10)")
+    p_inc.add_argument("--json", action="store_true",
+                       help="raw JSON instead of the summary")
+    p_inc.set_defaults(func=cmd_incidents)
+
+    p_slo = sub.add_parser(
+        "slo",
+        help="evaluate SLO compliance from the serve-stats sink "
+             "(no jax init)")
+    p_slo.add_argument("--path", default=None,
+                       help="sink path (default: MESH_TPU_SERVE_STATS "
+                            "or ~/.mesh_tpu/serve_stats.json)")
+    p_slo.add_argument("--latency-ms", type=float, default=250.0,
+                       help="latency objective threshold (default 250)")
+    p_slo.add_argument("--target", type=float, default=0.99,
+                       help="latency objective target fraction "
+                            "(default 0.99)")
+    p_slo.add_argument("--availability-target", type=float, default=0.999,
+                       help="availability objective target (default 0.999)")
+    p_slo.add_argument("--json", action="store_true",
+                       help="raw JSON rows instead of the summary")
+    p_slo.set_defaults(func=cmd_slo)
 
     args = parser.parse_args()
     args.func(args)

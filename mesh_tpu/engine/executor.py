@@ -164,6 +164,23 @@ class EngineExecutor(object):
     # worker
 
     def _loop(self):
+        # a worker death strands every future behind it (submit() then
+        # raises EngineShutdown) — dump the black box before dying
+        try:
+            self._drain_loop()
+        except BaseException as e:      # noqa: BLE001 — forensics, then die
+            from ..obs.recorder import get_recorder
+
+            recorder = get_recorder()
+            recorder.record("engine.worker_crash",
+                            error=type(e).__name__, detail=str(e))
+            recorder.trigger(
+                "executor_exception",
+                context={"error": type(e).__name__, "detail": str(e)},
+                force=True)
+            raise
+
+    def _drain_loop(self):
         while True:
             with self._cond:
                 while (self._held or not self._pending) and not self._shutdown:
@@ -191,6 +208,11 @@ class EngineExecutor(object):
             try:
                 self._dispatch_group(group)
             except BaseException as e:  # noqa: BLE001 — futures carry it
+                from ..obs.recorder import get_recorder
+
+                get_recorder().record(
+                    "engine.error", error=type(e).__name__,
+                    detail=str(e), requests=len(group))
                 for req in group:
                     if not req.future.done():
                         req.future.set_exception(e)

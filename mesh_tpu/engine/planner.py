@@ -31,6 +31,7 @@ from collections import OrderedDict
 import numpy as np
 
 from ..obs.clock import monotonic as _now
+from ..obs.recorder import get_recorder
 from ..obs.trace import span as obs_span
 from ..obs.trace import timed_span
 from .stats import STATS
@@ -207,6 +208,10 @@ class Planner(object):
             STATS.record_padding(
                 n_batch * (n_queries or 1), bb * (qb or 1)
             )
+            get_recorder().record(
+                "engine.dispatch", op=op, b=n_batch, q=n_queries or 0,
+                bucket_b=bb, bucket_q=qb or 0,
+                elapsed_ms=round(1e3 * (disp.elapsed or 0.0), 3))
         if normals is not None:
             normals = normals[:n_batch]
         if res is not None:
@@ -260,6 +265,10 @@ class Planner(object):
                 jax.block_until_ready((vis, ndc))
             STATS.record_dispatch("visibility", disp.elapsed)
             STATS.record_padding(n_batch * n_cams, bb * cb)
+            get_recorder().record(
+                "engine.dispatch", op="visibility", b=n_batch, q=n_cams,
+                bucket_b=bb, bucket_q=cb,
+                elapsed_ms=round(1e3 * (disp.elapsed or 0.0), 3))
         return vis[:n_batch, :n_cams], ndc[:n_batch, :n_cams]
 
 

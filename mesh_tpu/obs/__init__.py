@@ -20,6 +20,15 @@ as a gap to fill, and the engine's value is invisible without it):
 - **jax bridge** (obs/jax_bridge.py) — jax.monitoring events
   (persistent compilation-cache hits/misses, compile durations) folded
   into the same registry.
+- **flight recorder** (obs/recorder.py) — the always-on bounded event
+  ring + triggered JSON incident dumps (``mesh-tpu incidents``),
+  running even with ``MESH_TPU_OBS`` off (kill switch:
+  ``MESH_TPU_RECORDER=0``; cost pinned by ``bench.py
+  --recorder-overhead``).
+- **SLOs** (obs/slo.py) — declarative latency/availability objectives
+  per tenant, evaluated from the registry with multi-window
+  multi-burn-rate alerting; a fast-burn breach dumps an incident and
+  (``MESH_TPU_SLO_DRIVES_HEALTH=1``) trips the serving health machine.
 
 Import cost: stdlib only — jax is touched lazily and never required.
 """
@@ -34,6 +43,23 @@ from .metrics import (  # noqa: F401
     Histogram,
     Registry,
     REGISTRY,
+)
+from .recorder import (  # noqa: F401
+    RECORDER,
+    FlightRecorder,
+    default_incident_dir,
+    get_recorder,
+    list_incidents,
+    recorder_enabled,
+)
+from .slo import (  # noqa: F401
+    SLO,
+    BurnRateRule,
+    SLOMonitor,
+    bind_incident_response,
+    compliance,
+    default_rules,
+    default_slos,
 )
 from .trace import (  # noqa: F401
     TRACER,
@@ -53,6 +79,10 @@ __all__ = [
     "counter", "gauge", "histogram", "metrics_snapshot", "reset",
     "prometheus_text", "render_tree", "write_jsonl", "export_jsonl",
     "install_jax_monitoring_bridge",
+    "RECORDER", "FlightRecorder", "get_recorder", "recorder_enabled",
+    "default_incident_dir", "list_incidents",
+    "SLO", "BurnRateRule", "SLOMonitor", "default_slos", "default_rules",
+    "compliance", "bind_incident_response",
     "monotonic", "wall",
 ]
 
@@ -81,7 +111,9 @@ export_jsonl = write_jsonl
 
 
 def reset():
-    """Zero every metric series and drop buffered spans (tests, and the
-    per-run isolation of the CLI subcommands)."""
+    """Zero every metric series, drop buffered spans, and empty the
+    flight-recorder ring (tests, and the per-run isolation of the CLI
+    subcommands)."""
     REGISTRY.reset()
     TRACER.clear()
+    RECORDER.clear()

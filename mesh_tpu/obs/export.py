@@ -47,7 +47,19 @@ def write_jsonl(path, events=None, registry=None):
 
 
 def _prom_escape(value):
-    return str(value).replace("\\", r"\\").replace('"', r'\"')
+    """Label-value escaping per the text-format spec: backslash, double
+    quote, and line feed (a raw newline would truncate the sample)."""
+    return (
+        str(value)
+        .replace("\\", r"\\")
+        .replace('"', r'\"')
+        .replace("\n", r"\n")
+    )
+
+
+def _prom_escape_help(value):
+    """HELP-line escaping per the spec: backslash and line feed only."""
+    return str(value).replace("\\", r"\\").replace("\n", r"\n")
 
 
 def _prom_labels(labels, extra=None):
@@ -71,7 +83,7 @@ def prometheus_text(registry=None):
     out = []
     for name, snap in registry.snapshot().items():
         if snap["help"]:
-            out.append("# HELP %s %s" % (name, snap["help"]))
+            out.append("# HELP %s %s" % (name, _prom_escape_help(snap["help"])))
         out.append("# TYPE %s %s" % (name, snap["type"]))
         for series in snap["series"]:
             labels = series["labels"]

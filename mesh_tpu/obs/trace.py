@@ -49,6 +49,12 @@ JAX_TRACE_ENV = "MESH_TPU_OBS_JAX_TRACE"
 #: default JSON-lines sink path gate
 JSONL_ENV = "MESH_TPU_OBS_JSONL"
 
+#: size bound (megabytes) on the live sink before rotation (unset = off)
+JSONL_MAX_MB_ENV = "MESH_TPU_OBS_JSONL_MAX_MB"
+
+#: rotated files kept as path.1..path.N (default 3)
+JSONL_KEEP_ENV = "MESH_TPU_OBS_JSONL_KEEP"
+
 _span_ids = itertools.count(1)
 
 
@@ -318,18 +324,54 @@ def traced(name=None, **attrs):
     return lambda fn: decorate(fn, name)
 
 
-def jsonl_sink(path):
+def jsonl_sink(path, max_mb=None, keep=None):
     """A push sink appending one JSON line per finished span to ``path``
     (opened lazily, line-buffered under a lock; errors are swallowed —
-    observability must never take serving down)."""
+    observability must never take serving down).
+
+    Size-bounded: when the file would exceed ``max_mb`` megabytes
+    (default ``MESH_TPU_OBS_JSONL_MAX_MB``, unset = unbounded), it is
+    rotated to ``path.1`` … ``path.<keep>`` (default keep
+    ``MESH_TPU_OBS_JSONL_KEEP`` or 3, oldest dropped) so long serving
+    runs can't grow the live trace sink without limit.
+    """
+    import os
+
+    if max_mb is None:
+        raw = os.environ.get(JSONL_MAX_MB_ENV, "").strip()
+        if raw:
+            try:
+                max_mb = float(raw)
+            except ValueError:
+                max_mb = None
+    if keep is None:
+        try:
+            keep = max(1, int(os.environ.get(JSONL_KEEP_ENV, "3")))
+        except ValueError:
+            keep = 3
+    max_bytes = int(max_mb * 1024 * 1024) if max_mb else None
     lock = threading.Lock()
     state = {"fh": None}
 
+    def rotate_locked():
+        state["fh"].close()
+        state["fh"] = None
+        for i in range(keep - 1, 0, -1):
+            src = "%s.%d" % (path, i)
+            if os.path.exists(src):
+                os.replace(src, "%s.%d" % (path, i + 1))
+        os.replace(path, "%s.1" % path)
+
     def sink(event):
+        line = json.dumps(event) + "\n"
         with lock:
             if state["fh"] is None:
                 state["fh"] = open(path, "a", buffering=1)
-            state["fh"].write(json.dumps(event) + "\n")
+            if (max_bytes is not None and state["fh"].tell()
+                    and state["fh"].tell() + len(line) > max_bytes):
+                rotate_locked()
+                state["fh"] = open(path, "a", buffering=1)
+            state["fh"].write(line)
     return sink
 
 

@@ -37,6 +37,7 @@ import threading
 
 from ..errors import DeadlineExceeded
 from ..obs.clock import monotonic
+from ..obs.recorder import get_recorder
 from ..obs.trace import span as obs_span
 
 __all__ = [
@@ -344,6 +345,8 @@ def run_with_ladder(mesh, points, deadline, ladder=None, chunk=512,
             retries += 1
             _retry_counter().inc(rung=rung.name,
                                  error=type(e).__name__)
+            get_recorder().record("serve.retry", rung=rung.name,
+                                  error=type(e).__name__)
             if i + 1 < len(rungs):
                 backoff = min(_BACKOFF_BASE_S * (2 ** i), _BACKOFF_CAP_S,
                               max(deadline.hard_remaining(), 0.0) * 0.1)

@@ -59,7 +59,7 @@ class HealthMonitor(object):
     """Dispatch-latency watchdog driving the load-shed state machine."""
 
     def __init__(self, wedge_after_s=None, recover_after=2, drain_after=5,
-                 watchdog=True, clock=monotonic):
+                 watchdog=True, clock=monotonic, recorder=None):
         self.wedge_after_s = (
             _wedge_threshold() if wedge_after_s is None
             else float(wedge_after_s))
@@ -72,6 +72,8 @@ class HealthMonitor(object):
         self._tokens = itertools.count(1)
         self._success_streak = 0
         self._trip_streak = 0
+        self._trips_total = 0
+        self._recorder = recorder
         self._stop = threading.Event()
         self._gauge().set(HEALTHY)
         self._watchdog = None
@@ -133,12 +135,23 @@ class HealthMonitor(object):
         with self._lock:
             self._success_streak = 0
             self._trip_streak += 1
-            if self._state == DRAINING:
-                return
-            if self._trip_streak >= self.drain_after:
-                self._set_state_locked(DRAINING)
-            elif self._state == HEALTHY:
-                self._set_state_locked(DEGRADED)
+            self._trips_total += 1
+            if self._state != DRAINING:
+                if self._trip_streak >= self.drain_after:
+                    self._set_state_locked(DRAINING)
+                elif self._state == HEALTHY:
+                    self._set_state_locked(DEGRADED)
+        # forensics OUTSIDE the lock: trigger() calls snapshot(), which
+        # takes it again
+        recorder = self._recorder
+        if recorder is None:
+            from ..obs.recorder import get_recorder
+
+            recorder = get_recorder()
+        recorder.record("health.trip", reason=reason,
+                        state=STATE_NAMES[self.state])
+        recorder.trigger("watchdog_trip", context={"reason": reason},
+                         health=self)
 
     # ------------------------------------------------------------------
     # watchdog
@@ -214,5 +227,6 @@ class HealthMonitor(object):
                 "inflight": len(self._inflight),
                 "success_streak": self._success_streak,
                 "trip_streak": self._trip_streak,
+                "trips": self._trips_total,
                 "wedge_after_s": self.wedge_after_s,
             }

@@ -28,14 +28,23 @@ __all__ = ["percentile", "run_closed_loop", "run_open_loop"]
 
 
 def percentile(values, q):
-    """Nearest-rank percentile (q in [0, 100]); 0.0 on empty input."""
+    """Linearly-interpolated percentile (q in [0, 100]); 0.0 on empty
+    input.  Matches numpy's default ("linear") method: rank
+    (n-1)·q/100 interpolated between the bracketing order statistics —
+    nearest-rank would make p99 of fewer than 100 samples degenerate to
+    the max, overstating tail latency on short load runs."""
     import math
 
     if not values:
         return 0.0
     ordered = sorted(values)
-    rank = max(int(math.ceil(q / 100.0 * len(ordered))), 1)
-    return ordered[min(rank, len(ordered)) - 1]
+    if len(ordered) == 1:
+        return ordered[0]
+    rank = (len(ordered) - 1) * q / 100.0
+    lo = int(math.floor(rank))
+    hi = min(lo + 1, len(ordered) - 1)
+    frac = rank - lo
+    return ordered[lo] + (ordered[hi] - ordered[lo]) * frac
 
 
 class _Tally(object):

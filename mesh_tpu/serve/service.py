@@ -32,6 +32,7 @@ from concurrent.futures import Future
 
 from ..errors import DeadlineExceeded, EngineShutdown, ServeRejected
 from ..obs.clock import monotonic, wall
+from ..obs.recorder import get_recorder
 from ..obs.trace import span as obs_span
 from .deadline import Deadline, default_ladder, run_with_ladder
 from .health import DEGRADED, DRAINING, HealthMonitor
@@ -178,7 +179,7 @@ class QueryService(object):
 
     def __init__(self, max_queue_per_tenant=None, weights=None, workers=None,
                  ladder=None, default_deadline_s=None, health=None,
-                 chunk=512, stats_path=None):
+                 chunk=512, stats_path=None, recorder=None):
         self.max_queue_per_tenant = (
             _env_int("MESH_TPU_SERVE_QUEUE", 64)
             if max_queue_per_tenant is None else int(max_queue_per_tenant))
@@ -189,6 +190,10 @@ class QueryService(object):
         self.ladder = list(ladder) if ladder is not None else default_ladder()
         self.health = health if health is not None else HealthMonitor()
         self.stats_path = stats_path
+        self._recorder = recorder if recorder is not None else get_recorder()
+        # incidents triggered away from the serve layer (executor faults,
+        # SLO breaches) still capture this service's health snapshot
+        self._recorder.attach_health(self.health)
         self._wfq = WeightedFairQueue(weights)
         self._cond = threading.Condition()
         self._held = 0
@@ -237,6 +242,11 @@ class QueryService(object):
             "mesh_tpu_serve_rung_total",
             "Answered requests by degradation rung and certification.",
         )
+        self._m_good = REGISTRY.counter(
+            "mesh_tpu_serve_good_total",
+            "Requests answered ok AND on time, per tenant (the SLO "
+            "availability numerator; see obs/slo.py).",
+        )
 
     def _update_depth_gauges(self):
         for tenant, depth in self._wfq.depths().items():
@@ -258,12 +268,17 @@ class QueryService(object):
             if self._stopping or state == DRAINING:
                 self._m_requests.inc(tenant=tenant, outcome="rejected")
                 self._m_shed.inc(reason="draining")
+                self._recorder.record("serve.reject", tenant=tenant,
+                                      reason="draining")
                 raise ServeRejected(
                     "service is draining", retry_after=5.0,
                     reason="draining")
             if state == DEGRADED and priority < 0:
                 self._m_requests.inc(tenant=tenant, outcome="rejected")
                 self._m_shed.inc(reason="low_priority")
+                self._recorder.record("serve.reject", tenant=tenant,
+                                      reason="low_priority",
+                                      priority=priority)
                 raise ServeRejected(
                     "degraded: shedding low-priority traffic",
                     retry_after=1.0, reason="low_priority")
@@ -271,6 +286,8 @@ class QueryService(object):
             if depth >= self.max_queue_per_tenant:
                 self._m_requests.inc(tenant=tenant, outcome="rejected")
                 self._m_shed.inc(reason="queue_full")
+                self._recorder.record("serve.reject", tenant=tenant,
+                                      reason="queue_full", depth=depth)
                 # backpressure hint: the queue ahead of the caller at the
                 # deadline pace (coarse, but monotone in depth)
                 raise ServeRejected(
@@ -280,7 +297,11 @@ class QueryService(object):
             req = _ServeRequest(mesh, points, tenant, priority,
                                 Deadline(deadline_s))
             self._wfq.push(tenant, req)
-            self._m_depth.set(self._wfq.depth(tenant), tenant=tenant)
+            depth = self._wfq.depth(tenant)
+            self._m_depth.set(depth, tenant=tenant)
+            self._recorder.record("serve.admit", tenant=tenant, depth=depth,
+                                  priority=priority,
+                                  deadline_s=float(deadline_s))
             self._cond.notify()
         return req.future
 
@@ -311,6 +332,21 @@ class QueryService(object):
     # drain workers
 
     def _work(self):
+        # an uncaught exception here means a drain worker silently dying
+        # mid-serve — exactly what the flight recorder exists to capture
+        try:
+            self._drain_loop()
+        except BaseException as e:      # noqa: BLE001 — forensics, then die
+            self._recorder.record("serve.worker_crash",
+                                  error=type(e).__name__, detail=str(e))
+            self._recorder.trigger(
+                "serve_worker_exception",
+                context={"error": type(e).__name__, "detail": str(e),
+                         "thread": threading.current_thread().name},
+                health=self.health, force=True)
+            raise
+
+    def _drain_loop(self):
         while True:
             with self._cond:
                 while ((self._held or not len(self._wfq))
@@ -340,6 +376,9 @@ class QueryService(object):
             self._m_shed.inc(reason="expired_in_queue")
             self._m_miss.inc(tenant=tenant)
             self._m_requests.inc(tenant=tenant, outcome="deadline")
+            self._recorder.record("serve.deadline", tenant=tenant,
+                                  where="expired_in_queue",
+                                  queued_s=round(req.deadline.elapsed(), 6))
             req.future.set_exception(DeadlineExceeded(
                 "deadline (%.3fs) expired after %.3fs in the %r queue"
                 % (req.deadline.seconds, req.deadline.elapsed(), tenant)))
@@ -363,11 +402,14 @@ class QueryService(object):
                 missed = latency > req.deadline.seconds
                 if missed:
                     self._m_miss.inc(tenant=tenant)
-                self._m_requests.inc(
-                    tenant=tenant,
-                    outcome=("deadline" if isinstance(e, DeadlineExceeded)
-                             else "error"))
+                outcome = ("deadline" if isinstance(e, DeadlineExceeded)
+                           else "error")
+                self._m_requests.inc(tenant=tenant, outcome=outcome)
                 self._m_latency.observe(latency, tenant=tenant)
+                self._recorder.record(
+                    "serve.error", tenant=tenant, outcome=outcome,
+                    error=type(e).__name__,
+                    latency_ms=round(1e3 * latency, 3))
                 req.future.set_exception(e)
                 return
         latency = req.deadline.elapsed()
@@ -379,6 +421,12 @@ class QueryService(object):
                          certified=str(response.certified).lower())
         if response.deadline_missed:
             self._m_miss.inc(tenant=tenant)
+        else:
+            self._m_good.inc(tenant=tenant)
+        self._recorder.record(
+            "serve.response", tenant=tenant, rung=response.rung,
+            retries=retries, latency_ms=round(1e3 * latency, 3),
+            deadline_missed=response.deadline_missed)
         req.future.set_result(response)
 
     # ------------------------------------------------------------------

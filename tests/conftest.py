@@ -45,6 +45,12 @@ import tempfile
 
 os.environ.setdefault("MESH_TPU_CACHE", tempfile.mkdtemp(prefix="mesh_tpu_cache_"))
 
+# health trips auto-dump flight-recorder incidents (obs/recorder.py);
+# route them to a throwaway dir so test-injected faults never pollute
+# the operator's ~/.mesh_tpu/incidents
+os.environ.setdefault(
+    "MESH_TPU_INCIDENT_DIR", tempfile.mkdtemp(prefix="mesh_tpu_incidents_"))
+
 # XLA's persistent compilation cache is content-keyed, so unlike the
 # topology cache it is safe (and worth minutes per run) to share across
 # test sessions; the throwaway MESH_TPU_CACHE above would defeat it

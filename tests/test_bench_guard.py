@@ -146,6 +146,44 @@ def test_obs_overhead_wedged_is_null(monkeypatch):
     assert "synthetic" in rec["error"]
 
 
+def test_recorder_overhead_guard(monkeypatch):
+    """PR-5 acceptance: the always-on flight-recorder ring must cost
+    under 5% of steady-state dispatch latency (same bar and interleaved
+    min-of-rounds protocol as the obs gate)."""
+    monkeypatch.delenv("MESH_TPU_NO_ENGINE", raising=False)
+    monkeypatch.delenv("MESH_TPU_RECORDER", raising=False)
+    monkeypatch.delenv("MESH_TPU_OBS", raising=False)
+    rec = bench.recorder_overhead(rounds=5, sweeps_per_round=2)
+    assert rec["metric"] == "recorder_overhead_small_q"
+    assert rec["unit"] == "overhead_frac"
+    assert rec["off_ms_per_call"] > 0
+    assert rec["on_ms_per_call"] > 0
+    assert rec["overhead_frac"] == rec["value"]
+    assert rec["overhead_frac"] < 0.05
+    # the recorder-on windows actually buffered engine.dispatch events —
+    # the comparison measured the ring, not two disabled runs
+    assert rec["events_recorded"] > 0
+    # the kill switch is restored: a guard run must leave the recorder
+    # in its default (on) state and the obs gate untouched
+    assert "MESH_TPU_RECORDER" not in os.environ
+    assert "MESH_TPU_OBS" not in os.environ
+
+
+def test_recorder_overhead_wedged_is_null(monkeypatch):
+    monkeypatch.setattr(
+        bench, "backend_responsive", lambda *a, **k: (False, "synthetic")
+    )
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--recorder-overhead"])
+    buf = io.StringIO()
+    with redirect_stdout(buf), pytest.raises(SystemExit) as e:
+        bench.main()
+    rec = json.loads(buf.getvalue())
+    assert e.value.code == 1
+    assert rec["metric"] == "recorder_overhead_small_q"
+    assert rec["value"] is None and "stale" not in rec
+    assert "synthetic" in rec["error"]
+
+
 def test_bench_records_carry_metrics_snapshot(monkeypatch):
     """Every live bench record carries the final metrics-registry
     snapshot under "obs" (satellite f)."""

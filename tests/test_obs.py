@@ -312,6 +312,71 @@ class TestExporters:
         (line,) = path.read_text().splitlines()
         assert json.loads(line)["name"] == "streamed"
 
+    def test_prometheus_conformance(self):
+        """Text-format spec: label values escape backslash, quote, and
+        newline; HELP escapes backslash and newline; histograms carry
+        the +Inf bucket and _sum/_count with bucket counts cumulative."""
+        obs.counter("conf_total", 'help with \\ and\nnewline').inc(
+            1, path='a\\b', msg='say "hi"\nbye')
+        obs.histogram("conf_lat", "lat", buckets=(0.1, 1.0)).observe(0.05)
+        obs.histogram("conf_lat").observe(0.5)
+        obs.histogram("conf_lat").observe(99.0)
+        text = obs.prometheus_text()
+        assert "# HELP conf_total help with \\\\ and\\nnewline" in text
+        line = next(l for l in text.splitlines()
+                    if l.startswith("conf_total{"))
+        assert '\\\\b' in line and '\\"hi\\"' in line and '\\nbye' in line
+        assert "\n" not in line  # the escaped newline stayed escaped
+        assert 'conf_lat_bucket{le="0.1"} 1' in text
+        assert 'conf_lat_bucket{le="1.0"} 2' in text
+        assert 'conf_lat_bucket{le="+Inf"} 3' in text
+        assert "conf_lat_count 3" in text
+        assert "conf_lat_sum 99.55" in text
+
+    def test_jsonl_sink_rotates_at_size_bound(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("MESH_TPU_OBS", "1")
+        path = tmp_path / "bounded.jsonl"
+        # ~1 KB cap: a handful of spans per file, several rotations
+        sink = obs.jsonl_sink(str(path), max_mb=0.001, keep=2)
+        TRACER.add_sink(sink)
+        try:
+            for i in range(60):
+                with span("rotated", i=i, pad="x" * 120):
+                    pass
+        finally:
+            TRACER.remove_sink(sink)
+        rotated = sorted(p.name for p in tmp_path.iterdir())
+        assert rotated == ["bounded.jsonl", "bounded.jsonl.1",
+                           "bounded.jsonl.2"]
+        # keep-N means older generations were dropped, and every
+        # surviving file is under the bound and valid JSON lines
+        for p in tmp_path.iterdir():
+            assert p.stat().st_size <= 1100
+            for line in p.read_text().splitlines():
+                assert json.loads(line)["name"] == "rotated"
+        # newest events are in the live file, oldest surviving in .2
+        last_live = json.loads(
+            path.read_text().splitlines()[-1])["attrs"]["i"]
+        first_old = json.loads((tmp_path / "bounded.jsonl.2").read_text()
+                               .splitlines()[0])["attrs"]["i"]
+        assert last_live == 59 and first_old < last_live
+
+    def test_jsonl_sink_rotation_env_gate(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("MESH_TPU_OBS", "1")
+        monkeypatch.setenv("MESH_TPU_OBS_JSONL_MAX_MB", "0.001")
+        monkeypatch.setenv("MESH_TPU_OBS_JSONL_KEEP", "1")
+        path = tmp_path / "env.jsonl"
+        sink = obs.jsonl_sink(str(path))
+        TRACER.add_sink(sink)
+        try:
+            for i in range(40):
+                with span("env_rotated", i=i, pad="y" * 120):
+                    pass
+        finally:
+            TRACER.remove_sink(sink)
+        names = sorted(p.name for p in tmp_path.iterdir())
+        assert names == ["env.jsonl", "env.jsonl.1"]
+
 
 # ----------------------------------------------------------------------
 # executor integration

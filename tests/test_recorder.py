@@ -1,0 +1,333 @@
+"""Flight recorder: ring semantics, incident dumps, trigger wiring.
+
+The acceptance chain the ISSUE pins: an injected watchdog trip and an
+injected worker/executor exception each produce a well-formed,
+schema-checked incident file that ``mesh-tpu incidents`` reads in a
+subprocess without initializing a jax backend.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from mesh_tpu import obs
+from mesh_tpu.obs.recorder import (
+    SCHEMA_VERSION,
+    FlightRecorder,
+    get_recorder,
+    list_incidents,
+    recorder_enabled,
+)
+from mesh_tpu.serve import HealthMonitor, QueryService, Rung, ServeResult
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: every key an incident file must carry (doc/observability.md schema)
+_INCIDENT_KEYS = {
+    "schema_version", "kind", "reason", "written_utc", "mono_at_dump",
+    "context", "ring", "metrics", "health", "engine", "env",
+}
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch, tmp_path):
+    monkeypatch.delenv("MESH_TPU_OBS", raising=False)
+    monkeypatch.delenv("MESH_TPU_RECORDER", raising=False)
+    monkeypatch.setenv("MESH_TPU_INCIDENT_DIR", str(tmp_path / "incidents"))
+    obs.reset()
+    yield
+    obs.reset()
+
+
+def _answer(rung_name):
+    return ServeResult(np.zeros((1, 4), np.uint32),
+                       np.zeros((4, 3), np.float64), rung_name)
+
+
+def _ok_rung(name="ok"):
+    return Rung(name, lambda mesh, points, chunk, timeout: _answer(name))
+
+
+def _failing_rung(name="boom"):
+    def fn(mesh, points, chunk, timeout):
+        raise RuntimeError("%s rung failed" % name)
+    return Rung(name, fn)
+
+
+def _service(recorder, **kw):
+    kw.setdefault("health",
+                  HealthMonitor(watchdog=False, recorder=recorder))
+    kw.setdefault("workers", 1)
+    kw.setdefault("ladder", [_ok_rung()])
+    return QueryService(recorder=recorder, **kw)
+
+
+_PTS = np.zeros((4, 3), np.float32)
+
+
+def _check_incident(path, reason):
+    assert path is not None and os.path.exists(path)
+    with open(path) as fh:
+        incident = json.load(fh)
+    assert set(incident) == _INCIDENT_KEYS
+    assert incident["kind"] == "incident"
+    assert incident["schema_version"] == SCHEMA_VERSION
+    assert incident["reason"] == reason
+    assert isinstance(incident["ring"], list)
+    assert isinstance(incident["metrics"], dict)
+    assert all(k.startswith(("MESH_TPU_", "JAX_", "XLA_"))
+               for k in incident["env"])
+    return incident
+
+
+# ---------------------------------------------------------------------------
+# ring semantics
+
+
+def test_ring_is_bounded_and_ordered():
+    rec = FlightRecorder(capacity=16)
+    for i in range(40):
+        rec.record("tick", i=i)
+    events = rec.events()
+    assert len(events) == 16
+    assert [e["i"] for e in events] == list(range(24, 40))
+    assert all(e["kind"] == "tick" and "t" in e for e in events)
+
+
+def test_env_kill_switch(monkeypatch):
+    rec = FlightRecorder(capacity=8)
+    monkeypatch.setenv("MESH_TPU_RECORDER", "0")
+    assert not recorder_enabled()
+    rec.record("dropped")
+    assert rec.trigger("manual") is None
+    assert rec.events() == []
+    monkeypatch.delenv("MESH_TPU_RECORDER")
+    assert recorder_enabled()
+    rec.record("kept")
+    assert [e["kind"] for e in rec.events()] == ["kept"]
+
+
+def test_spans_land_in_global_ring(monkeypatch):
+    monkeypatch.setenv("MESH_TPU_OBS", "1")
+    with obs.span("recorded.region", q=7):
+        pass
+    spans = [e for e in get_recorder().events() if e["kind"] == "span"]
+    assert spans and spans[-1]["name"] == "recorded.region"
+    assert spans[-1]["attrs"]["q"] == 7
+    assert spans[-1]["elapsed_s"] is not None
+
+
+def test_sample_records_metric_deltas():
+    rec = FlightRecorder(capacity=32)
+    requests = obs.counter("mesh_tpu_serve_requests_total")
+    obs.gauge("mesh_tpu_serve_queue_depth").set(3, tenant="a")
+    requests.inc(5, tenant="a", outcome="ok")
+    rec.sample()
+    requests.inc(2, tenant="a", outcome="ok")
+    rec.sample()
+    samples = [e for e in rec.events() if e["kind"] == "metrics.sample"]
+    assert len(samples) == 2
+    assert samples[0]["deltas"]["mesh_tpu_serve_requests_total"] == 5
+    assert samples[1]["deltas"]["mesh_tpu_serve_requests_total"] == 2
+    assert samples[0]["queue_depths"] == {"a": 3}
+
+
+# ---------------------------------------------------------------------------
+# incident dumps
+
+
+def test_trigger_writes_schema_complete_dump():
+    rec = FlightRecorder(capacity=8)
+    rec.record("serve.admit", tenant="a")
+    obs.counter("mesh_tpu_serve_shed_total").inc(reason="queue_full")
+    mon = HealthMonitor(watchdog=False, recorder=rec)
+    path = rec.trigger("manual_test", context={"note": "hello"}, health=mon)
+    incident = _check_incident(path, "manual_test")
+    assert incident["context"] == {"note": "hello"}
+    assert incident["ring"][0]["kind"] == "serve.admit"
+    shed = incident["metrics"]["mesh_tpu_serve_shed_total"]["series"]
+    assert shed[0]["labels"] == {"reason": "queue_full"}
+    assert incident["health"]["state"] == "healthy"
+    assert "trips" in incident["health"]
+    # the dump itself is counted (next incident's metrics carry it)
+    assert obs.REGISTRY.get("mesh_tpu_incident_dumps_total").value(
+        reason="manual_test") == 1
+
+
+def test_trigger_rate_limited_and_force_bypasses():
+    rec = FlightRecorder(capacity=8, min_dump_interval_s=3600.0)
+    first = rec.trigger("storm")
+    assert first is not None
+    assert rec.trigger("storm") is None          # held back
+    forced = rec.trigger("storm", force=True)    # explicit API bypass
+    assert forced is not None and forced != first
+
+
+def test_incident_dir_keeps_newest_n(monkeypatch):
+    monkeypatch.setenv("MESH_TPU_INCIDENT_KEEP", "3")
+    rec = FlightRecorder(capacity=8)
+    paths = [rec.trigger("prune_%d" % i, force=True) for i in range(5)]
+    assert all(paths)
+    kept = list_incidents()
+    assert len(kept) == 3
+    assert kept == sorted(paths[-3:])
+
+
+# ---------------------------------------------------------------------------
+# trigger sources (the ISSUE's trigger matrix)
+
+
+def test_watchdog_trip_dumps_incident():
+    rec = FlightRecorder(capacity=32)
+    mon = HealthMonitor(watchdog=False, recorder=rec)
+    mon.trip("dispatch_wedged")
+    (path,) = list_incidents()
+    incident = _check_incident(path, "watchdog_trip")
+    assert incident["context"] == {"reason": "dispatch_wedged"}
+    assert incident["health"]["state"] == "degraded"
+    assert incident["health"]["trips"] == 1
+    trips = [e for e in incident["ring"] if e["kind"] == "health.trip"]
+    assert trips and trips[0]["reason"] == "dispatch_wedged"
+    # acceptance: the injected-trip dump is readable by `mesh-tpu
+    # incidents` in a subprocess (no jax backend init)
+    proc = _run_cli(os.path.basename(path), "--dir", os.path.dirname(path),
+                    "--json")
+    assert proc.returncode == 0, proc.stderr
+    assert json.loads(proc.stdout)["reason"] == "watchdog_trip"
+
+
+def test_serve_worker_exception_dumps_incident(monkeypatch):
+    rec = FlightRecorder(capacity=32)
+    svc = _service(rec)
+    try:
+        monkeypatch.setattr(
+            QueryService, "_execute",
+            lambda self, req: (_ for _ in ()).throw(
+                RuntimeError("injected worker fault")))
+        svc.submit(object(), _PTS, tenant="a")
+        deadline = time.time() + 10
+        while not list_incidents() and time.time() < deadline:
+            time.sleep(0.05)
+    finally:
+        svc.stop(write_stats=False)
+    paths = [p for p in list_incidents()
+             if "serve_worker_exception" in os.path.basename(p)]
+    assert paths
+    incident = _check_incident(paths[0], "serve_worker_exception")
+    assert incident["context"]["error"] == "RuntimeError"
+    assert "injected worker fault" in incident["context"]["detail"]
+    assert incident["health"] is not None
+
+
+def test_serve_error_and_reject_events_recorded():
+    # the GLOBAL recorder: run_with_ladder's serve.retry goes through
+    # get_recorder(), so this doubles as the end-to-end wiring check
+    rec = get_recorder()
+    svc = _service(rec, ladder=[_failing_rung()], max_queue_per_tenant=1,
+                   default_deadline_s=0.2)
+    try:
+        fut = svc.submit(object(), _PTS, tenant="a")
+        with pytest.raises(Exception):
+            fut.result(timeout=30)
+        svc.hold()
+        try:
+            svc.submit(object(), _PTS, tenant="a")
+            with pytest.raises(Exception):
+                svc.submit(object(), _PTS, tenant="a")  # queue_full
+        finally:
+            svc.release()
+        svc.drain(timeout=10)
+    finally:
+        svc.stop(write_stats=False)
+    kinds = [e["kind"] for e in rec.events()]
+    assert "serve.admit" in kinds
+    assert "serve.retry" in kinds        # ladder rung failure fell through
+    assert "serve.error" in kinds        # request ultimately failed
+    rejects = [e for e in rec.events() if e["kind"] == "serve.reject"]
+    assert any(e["reason"] == "queue_full" for e in rejects)
+
+
+def test_executor_exception_dumps_incident(monkeypatch):
+    import types
+
+    monkeypatch.delenv("MESH_TPU_NO_ENGINE", raising=False)
+    from mesh_tpu.engine import executor as executor_mod
+    from mesh_tpu.errors import EngineShutdown
+
+    mesh = types.SimpleNamespace(
+        v=np.zeros((4, 3), np.float64),
+        f=np.asarray([[0, 1, 2], [0, 1, 3], [0, 2, 3], [1, 2, 3]],
+                     np.uint32))
+    ex = executor_mod.EngineExecutor()
+    monkeypatch.setattr(
+        executor_mod.EngineExecutor, "_process",
+        lambda self, batch: (_ for _ in ()).throw(
+            SystemError("injected executor fault")))
+    ex.submit("closest_point", mesh, _PTS)
+    deadline = time.time() + 10
+    while not list_incidents() and time.time() < deadline:
+        time.sleep(0.05)
+    paths = [p for p in list_incidents()
+             if "executor_exception" in os.path.basename(p)]
+    assert paths
+    incident = _check_incident(paths[0], "executor_exception")
+    assert incident["context"]["error"] == "SystemError"
+    # the worker is dead: late submits fail fast instead of hanging
+    with pytest.raises(EngineShutdown):
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            ex.submit("closest_point", mesh, _PTS)
+            time.sleep(0.05)
+
+
+# ---------------------------------------------------------------------------
+# mesh-tpu incidents CLI (subprocess, no jax backend init)
+
+
+def _run_cli(*argv, **env_overrides):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", **env_overrides)
+    return subprocess.run(
+        [sys.executable, "-m", "mesh_tpu.cli", "incidents"] + list(argv),
+        capture_output=True, text=True, timeout=120, env=env, cwd=_REPO)
+
+
+def test_incidents_cli_empty_dir_exits_zero(tmp_path):
+    proc = _run_cli("--dir", str(tmp_path / "none"))
+    assert proc.returncode == 0
+    assert "no incidents" in proc.stdout
+
+
+def test_incidents_cli_lists_and_shows(tmp_path):
+    rec = FlightRecorder(capacity=8)
+    rec.record("serve.reject", tenant="a", reason="queue_full")
+    mon = HealthMonitor(watchdog=False, recorder=rec)
+    path = rec.trigger("cli_test", context={"k": "v"}, health=mon)
+    directory = os.path.dirname(path)
+
+    listing = _run_cli("--dir", directory)
+    assert listing.returncode == 0
+    assert os.path.basename(path) in listing.stdout
+    assert "reason=cli_test" in listing.stdout
+
+    shown = _run_cli(os.path.basename(path), "--dir", directory)
+    assert shown.returncode == 0
+    assert "reason: cli_test" in shown.stdout
+    assert "serve.reject" in shown.stdout
+
+    raw = _run_cli(os.path.basename(path), "--dir", directory, "--json")
+    incident = json.loads(raw.stdout)
+    assert incident["reason"] == "cli_test"
+    assert incident["context"] == {"k": "v"}
+
+
+def test_incidents_cli_corrupt_file_exits_one(tmp_path):
+    bad = tmp_path / "incident-000-bad-001.json"
+    bad.write_text("{not json")
+    proc = _run_cli(bad.name, "--dir", str(tmp_path))
+    assert proc.returncode == 1
+    assert "unreadable" in proc.stderr

@@ -319,6 +319,54 @@ def test_health_trip_metric_counts():
     assert _counter_total("mesh_tpu_serve_watchdog_trips_total") == before + 1
 
 
+def test_health_concurrent_trip_and_snapshot_consistent():
+    """Hammer trip()/dispatch cycles/snapshot() from many threads: every
+    snapshot must show a consistent (state, streak) pair — HEALTHY with
+    a nonzero trip_streak would mean the state machine and its counters
+    were mutated non-atomically — and no trip may be lost."""
+    import threading
+
+    from mesh_tpu.obs.recorder import FlightRecorder
+
+    # a private recorder so trip-triggered dumps never interact with
+    # other tests' incident expectations (conftest routes the dir to tmp)
+    mon, _clock = _monitor(drain_after=10 ** 9,
+                           recorder=FlightRecorder(capacity=64))
+    trips_per_thread, n_trippers = 200, 4
+    bad, stop = [], threading.Event()
+
+    def tripper():
+        for _ in range(trips_per_thread):
+            mon.trip("hammer")
+
+    def succeeder():
+        while not stop.is_set():
+            token = mon.dispatch_began("engine")
+            mon.dispatch_finished(token)
+
+    def observer():
+        while not stop.is_set():
+            snap = mon.snapshot()
+            if snap["state"] == "healthy" and snap["trip_streak"] != 0:
+                bad.append(snap)
+            if snap["trip_streak"] < 0 or snap["trips"] < 0:
+                bad.append(snap)
+
+    threads = ([threading.Thread(target=tripper)
+                for _ in range(n_trippers)]
+               + [threading.Thread(target=succeeder) for _ in range(2)]
+               + [threading.Thread(target=observer) for _ in range(2)])
+    for t in threads:
+        t.start()
+    for t in threads[:n_trippers]:
+        t.join()
+    stop.set()
+    for t in threads[n_trippers:]:
+        t.join()
+    assert not bad, "inconsistent snapshots observed: %r" % bad[:3]
+    assert mon.snapshot()["trips"] == trips_per_thread * n_trippers
+
+
 # ---------------------------------------------------------------------------
 # QueryService: admission, backpressure, fairness, execution
 
@@ -520,13 +568,23 @@ def test_real_ladder_engine_failure_falls_to_culled(sphere, monkeypatch):
 # loadgen
 
 
-def test_percentile_nearest_rank():
+def test_percentile_interpolates():
+    # numpy-default linear interpolation between order statistics
     vals = list(range(1, 101))
-    assert percentile(vals, 50) == 50
-    assert percentile(vals, 99) == 99
+    assert percentile(vals, 50) == pytest.approx(50.5)
+    assert percentile(vals, 99) == pytest.approx(99.01)
     assert percentile(vals, 100) == 100
+    assert percentile(vals, 0) == 1
     assert percentile([], 99) == 0.0
     assert percentile([7.0], 50) == 7.0
+    # the motivating case: p99 of a tiny sample must NOT degenerate to
+    # the max — one outlier in ten samples shouldn't own the tail number
+    small = [1.0] * 9 + [100.0]
+    assert percentile(small, 99) < 100.0
+    assert percentile(small, 99) == pytest.approx(1.0 + 99.0 * 0.91)
+    # two-point distribution: exact midpoint at p50
+    assert percentile([0.0, 1.0], 50) == pytest.approx(0.5)
+    assert percentile([0.0, 1.0], 25) == pytest.approx(0.25)
 
 
 def test_closed_loop_report_shape():

@@ -1,0 +1,357 @@
+"""SLO engine tests: snapshot readers, hand-computed burn-rate windows
+under a fake clock, edge-triggered breaches, and the detect -> capture ->
+degrade incident response (obs/slo.py + obs/recorder.py wiring).
+
+Burn math is verified against hand-computed window arithmetic, not
+against the implementation: burn = bad_fraction / (1 - target) over the
+samples bracketing each rule window.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import mesh_tpu.obs as obs
+from mesh_tpu.obs.metrics import Registry
+from mesh_tpu.obs.recorder import FlightRecorder, list_incidents
+from mesh_tpu.obs.slo import (
+    SLO,
+    BurnRateRule,
+    SLOMonitor,
+    bind_incident_response,
+    compliance,
+    default_rules,
+    default_slos,
+    good_total,
+    tenants,
+)
+from mesh_tpu.serve.health import DEGRADED, HealthMonitor
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch, tmp_path):
+    monkeypatch.delenv("MESH_TPU_OBS", raising=False)
+    monkeypatch.delenv("MESH_TPU_RECORDER", raising=False)
+    monkeypatch.delenv("MESH_TPU_SLO_DRIVES_HEALTH", raising=False)
+    monkeypatch.setenv("MESH_TPU_INCIDENT_DIR", str(tmp_path / "incidents"))
+    obs.reset()
+    yield
+    obs.reset()
+
+
+class _FakeClock(object):
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _latency_metrics(tenant="web", count=10, buckets=None):
+    """Registry-snapshot-shaped dict with one latency histogram series."""
+    return {
+        "mesh_tpu_serve_latency_seconds": {
+            "type": "histogram",
+            "series": [{
+                "labels": {"tenant": tenant},
+                "count": count,
+                "sum": 1.0,
+                "buckets": buckets or [[0.1, 8], [0.25, 9], ["+Inf", count]],
+            }],
+        },
+    }
+
+
+def _availability_metrics(rows):
+    """rows: {tenant: (good, total)} -> snapshot-shaped counter pair."""
+    return {
+        "mesh_tpu_serve_good_total": {
+            "type": "counter",
+            "series": [{"labels": {"tenant": t}, "value": g}
+                       for t, (g, _) in rows.items()],
+        },
+        "mesh_tpu_serve_requests_total": {
+            "type": "counter",
+            "series": [{"labels": {"tenant": t, "outcome": "ok"}, "value": n}
+                       for t, (_, n) in rows.items()],
+        },
+    }
+
+
+class TestSnapshotReaders:
+    def test_latency_good_total_reads_bucket_at_threshold(self):
+        metrics = _latency_metrics(count=10)
+        slo = SLO("lat", "latency", 0.9, threshold_s=0.25)
+        assert good_total(metrics, slo, "web") == (9, 10)
+        tighter = SLO("lat", "latency", 0.9, threshold_s=0.1)
+        assert good_total(metrics, tighter, "web") == (8, 10)
+        # threshold below every bound -> nothing counts as good
+        micro = SLO("lat", "latency", 0.9, threshold_s=0.01)
+        assert good_total(metrics, micro, "web") == (0, 10)
+
+    def test_availability_compliance_met_and_missed(self):
+        metrics = _availability_metrics({"a": (999, 1000), "b": (90, 100)})
+        slo = SLO("avail", "availability", 0.999)
+        row_a = compliance(metrics, slo, "a")
+        assert row_a["good"] == 999 and row_a["total"] == 1000
+        assert row_a["compliance"] == pytest.approx(0.999)
+        assert row_a["met"]
+        row_b = compliance(metrics, slo, "b")
+        assert row_b["compliance"] == pytest.approx(0.9)
+        assert not row_b["met"]
+
+    def test_no_traffic_is_compliant(self):
+        slo = SLO("avail", "availability", 0.999)
+        row = compliance({}, slo, "ghost")
+        assert row["total"] == 0
+        assert row["compliance"] == 1.0
+        assert row["met"]
+
+    def test_tenants_union_is_sorted(self):
+        metrics = dict(_latency_metrics(tenant="zeta"))
+        metrics.update(_availability_metrics({"alpha": (1, 1)}))
+        assert tenants(metrics) == ["alpha", "zeta"]
+
+    def test_slo_validation(self):
+        with pytest.raises(ValueError):
+            SLO("x", "throughput", 0.9)
+        with pytest.raises(ValueError):
+            SLO("x", "availability", 1.0)
+        with pytest.raises(ValueError):
+            SLO("x", "latency", 0.9)  # no threshold_s
+
+    def test_defaults(self):
+        slos = default_slos()
+        assert [s.kind for s in slos] == ["latency", "availability"]
+        rules = default_rules()
+        assert [r.name for r in rules] == ["fast_burn", "slow_burn"]
+        assert rules[0].factor == pytest.approx(14.4)
+
+
+class TestBurnRate:
+    """Hand-computed windows: target 0.99 (budget 0.01), one rule
+    long=300s / short=60s @ factor 10."""
+
+    def _monitor(self, clock):
+        return SLOMonitor(
+            objectives=[SLO("avail", "availability", 0.99, tenant="web")],
+            registry=Registry(),
+            clock=clock,
+            rules=[BurnRateRule("fast_burn", long_s=300, short_s=60,
+                                factor=10.0)],
+        )
+
+    def test_hand_computed_burn_and_edge_triggered_breach(self):
+        clock = _FakeClock(0.0)
+        mon = self._monitor(clock)
+        mon.tick(_availability_metrics({"web": (0, 0)}))
+        clock.t = 30.0
+        mon.tick(_availability_metrics({"web": (98, 100)}))
+        clock.t = 60.0
+        mon.tick(_availability_metrics({"web": (178, 200)}))
+
+        # Both windows reach back to the t=0 baseline: 22 bad of 200
+        # -> bad_fraction 0.11 -> burn 0.11 / 0.01 = 11 >= factor 10.
+        rows = mon.evaluate()
+        assert len(rows) == 1
+        rule = rows[0]["rules"][0]
+        assert rule["long_burn"] == pytest.approx(11.0)
+        assert rule["short_burn"] == pytest.approx(11.0)
+        assert rule["breaching"] and rule["new"]
+        counter = mon._registry.counter("mesh_tpu_slo_breach_total")
+        assert counter.value(objective="avail", rule="fast_burn") == 1
+        assert ("avail", "web", "fast_burn") in mon.breaching()
+
+        # Still breaching on re-evaluation, but edge-triggered: not new,
+        # counter unchanged.
+        rule = mon.evaluate()[0]["rules"][0]
+        assert rule["breaching"] and not rule["new"]
+        assert counter.value(objective="avail", rule="fast_burn") == 1
+
+        # 200 all-good requests: short window [30, 90] sees 20 bad of
+        # 300 -> burn 6.67 < 10 -> recovery (long window alone is not
+        # enough to keep the rule firing).
+        clock.t = 90.0
+        mon.tick(_availability_metrics({"web": (378, 400)}))
+        rule = mon.evaluate()[0]["rules"][0]
+        assert rule["short_burn"] == pytest.approx((20 / 300) / 0.01)
+        assert not rule["breaching"]
+        assert mon.breaching() == set()
+
+        # 100 all-bad requests re-breach: a NEW edge, counter goes to 2.
+        clock.t = 120.0
+        mon.tick(_availability_metrics({"web": (378, 500)}))
+        rule = mon.evaluate()[0]["rules"][0]
+        # short window [60, 120]: 100 bad of 300 -> burn 33.3
+        assert rule["short_burn"] == pytest.approx((100 / 300) / 0.01)
+        # long window start -180 -> oldest sample: 122 bad of 500
+        assert rule["long_burn"] == pytest.approx((122 / 500) / 0.01)
+        assert rule["breaching"] and rule["new"]
+        assert counter.value(objective="avail", rule="fast_burn") == 2
+
+    def test_no_traffic_burns_nothing(self):
+        clock = _FakeClock(0.0)
+        mon = self._monitor(clock)
+        for t in (0.0, 30.0, 60.0):
+            clock.t = t
+            mon.tick(_availability_metrics({"web": (5, 5)}))
+        rule = mon.evaluate()[0]["rules"][0]
+        assert rule["long_burn"] == 0.0
+        assert rule["short_burn"] == 0.0
+        assert not rule["breaching"]
+
+    def test_burn_gauge_exported(self):
+        clock = _FakeClock(0.0)
+        mon = self._monitor(clock)
+        mon.tick(_availability_metrics({"web": (0, 0)}))
+        clock.t = 60.0
+        mon.tick(_availability_metrics({"web": (50, 100)}))
+        mon.evaluate()
+        gauge = mon._registry.gauge("mesh_tpu_slo_burn_rate")
+        assert gauge.value(objective="avail", tenant="web",
+                           window="300s") == pytest.approx(50.0)
+
+    def test_callback_exception_does_not_break_evaluate(self):
+        clock = _FakeClock(0.0)
+        mon = self._monitor(clock)
+
+        @mon.on_breach
+        def boom(event):
+            raise RuntimeError("alert sink down")
+
+        seen = []
+        mon.on_breach(seen.append)
+        mon.tick(_availability_metrics({"web": (0, 0)}))
+        clock.t = 60.0
+        mon.tick(_availability_metrics({"web": (0, 100)}))
+        rows = mon.evaluate()  # must not raise
+        assert rows[0]["rules"][0]["breaching"]
+        assert len(seen) == 1 and seen[0]["rule"] == "fast_burn"
+
+
+def _drive_fast_burn(mon, clock):
+    mon.tick(_availability_metrics({"web": (0, 0)}))
+    clock.t = 60.0
+    mon.tick(_availability_metrics({"web": (0, 100)}))
+    return mon.evaluate()
+
+
+class TestIncidentResponse:
+    def _monitor(self, clock):
+        return SLOMonitor(
+            objectives=[SLO("avail", "availability", 0.99, tenant="web")],
+            registry=Registry(),
+            clock=clock,
+            rules=[BurnRateRule("fast_burn", long_s=300, short_s=60,
+                                factor=10.0)],
+        )
+
+    def test_fast_burn_breach_dumps_incident(self):
+        clock = _FakeClock(0.0)
+        mon = self._monitor(clock)
+        rec = FlightRecorder(capacity=128)
+        bind_incident_response(mon, recorder=rec)
+        _drive_fast_burn(mon, clock)
+
+        paths = list_incidents()
+        assert len(paths) == 1
+        assert "slo_fast_burn" in os.path.basename(paths[0])
+        with open(paths[0]) as fh:
+            incident = json.load(fh)
+        assert incident["kind"] == "incident"
+        assert incident["reason"] == "slo_fast_burn"
+        assert incident["context"]["objective"] == "avail"
+        assert incident["context"]["tenant"] == "web"
+        assert incident["context"]["rule"] == "fast_burn"
+        assert incident["context"]["long_burn"] == pytest.approx(100.0)
+        kinds = [e["kind"] for e in incident["ring"]]
+        assert "slo.breach" in kinds
+        # acceptance: the fast-burn dump is readable by `mesh-tpu
+        # incidents` in a subprocess (no jax backend init)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, "-m", "mesh_tpu.cli", "incidents",
+             os.path.basename(paths[0]), "--dir", os.path.dirname(paths[0]),
+             "--json"],
+            capture_output=True, text=True, cwd=_REPO, env=env, timeout=120)
+        assert proc.returncode == 0, proc.stderr
+        assert json.loads(proc.stdout)["reason"] == "slo_fast_burn"
+
+    def test_slow_burn_breach_records_but_does_not_dump(self):
+        clock = _FakeClock(0.0)
+        mon = SLOMonitor(
+            objectives=[SLO("avail", "availability", 0.99, tenant="web")],
+            registry=Registry(),
+            clock=clock,
+            rules=[BurnRateRule("slow_burn", long_s=300, short_s=60,
+                                factor=5.0)],
+        )
+        rec = FlightRecorder(capacity=128)
+        bind_incident_response(mon, recorder=rec)
+        _drive_fast_burn(mon, clock)
+        assert "slo.breach" in [e["kind"] for e in rec.events()]
+        assert list_incidents() == []
+
+    def test_breach_drives_health_when_enabled(self, monkeypatch):
+        monkeypatch.setenv("MESH_TPU_SLO_DRIVES_HEALTH", "1")
+        clock = _FakeClock(0.0)
+        mon = self._monitor(clock)
+        rec = FlightRecorder(capacity=128)
+        health = HealthMonitor(watchdog=False, recorder=rec)
+        bind_incident_response(mon, recorder=rec, health=health)
+        _drive_fast_burn(mon, clock)
+        assert health.state == DEGRADED
+        # the slo_fast_burn dump carries the health snapshot it degraded
+        reasons = [os.path.basename(p) for p in list_incidents()]
+        assert any("slo_fast_burn" in r for r in reasons)
+
+    def test_breach_does_not_drive_health_by_default(self):
+        clock = _FakeClock(0.0)
+        mon = self._monitor(clock)
+        rec = FlightRecorder(capacity=128)
+        health = HealthMonitor(watchdog=False, recorder=rec)
+        bind_incident_response(mon, recorder=rec, health=health)
+        _drive_fast_burn(mon, clock)
+        assert health.state != DEGRADED
+
+
+class TestSLOCli:
+    def _run(self, *argv):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        return subprocess.run(
+            [sys.executable, "-m", "mesh_tpu.cli", "slo"] + list(argv),
+            capture_output=True, text=True, cwd=_REPO, env=env, timeout=120)
+
+    def test_cli_evaluates_sink_json(self, tmp_path):
+        sink = tmp_path / "serve_stats.json"
+        metrics = dict(_latency_metrics(tenant="web", count=100,
+                                        buckets=[[0.1, 97], [0.25, 99],
+                                                 ["+Inf", 100]]))
+        metrics.update(_availability_metrics({"web": (995, 1000)}))
+        sink.write_text(json.dumps({"metrics": metrics}))
+        proc = self._run("--path", str(sink), "--json")
+        assert proc.returncode == 0, proc.stderr
+        rows = json.loads(proc.stdout)
+        by_obj = {r["objective"]: r for r in rows}
+        # latency p99 at 250ms: 99/100 -> met at target 0.99
+        assert by_obj["latency_p99"]["good"] == 99
+        assert by_obj["latency_p99"]["met"]
+        # availability 995/1000 = 0.995 < 0.999 default -> missed
+        assert by_obj["availability"]["compliance"] == pytest.approx(0.995)
+        assert not by_obj["availability"]["met"]
+
+    def test_cli_text_mode_and_missing_sink(self, tmp_path):
+        sink = tmp_path / "serve_stats.json"
+        sink.write_text(json.dumps(
+            {"metrics": _availability_metrics({"web": (1, 1)})}))
+        proc = self._run("--path", str(sink))
+        assert proc.returncode == 0, proc.stderr
+        assert "MET" in proc.stdout
+        missing = self._run("--path", str(tmp_path / "nope.json"))
+        assert missing.returncode == 0
+        assert "no serve stats sink" in missing.stdout
