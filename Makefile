@@ -29,6 +29,19 @@ tests: import_tests unit_tests
 bench:
 	@python bench.py
 
+perfcheck:
+	@echo "----- [ ${package_name} ] Chip-free perf gate (staged probe + CPU-interpreter proxy)"
+	@PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+		MESH_TPU_BENCH_PARTIAL=/tmp/mesh_tpu_perfcheck_partial.json \
+		python bench.py --stages probe,pallas_proxy > /tmp/mesh_tpu_perfcheck_bench.json || true
+	@python -m mesh_tpu.cli perfcheck /tmp/mesh_tpu_perfcheck_bench.json
+
+proxy-golden:
+	@echo "----- [ ${package_name} ] Recording the CPU-interpreter proxy golden"
+	@PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+		python bench.py --stage pallas_proxy > benchmarks/proxy_golden.json
+	@cat benchmarks/proxy_golden.json
+
 gates:
 	@bash tools/run_tpu_gates.sh
 
@@ -55,4 +68,4 @@ docs:
 clean:
 	@rm -rf build dist *.egg-info doc/_build
 
-.PHONY: all import_tests unit_tests tpu_tests tests bench gates sweep sdist wheel documentation docs clean
+.PHONY: all import_tests unit_tests tpu_tests tests bench perfcheck proxy-golden gates sweep sdist wheel documentation docs clean

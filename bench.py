@@ -5,6 +5,17 @@ queries, on whatever accelerator jax exposes (one v5e chip under the driver).
 Prints ONE JSON line:
   {"metric": ..., "value": queries/sec, "unit": ..., "vs_baseline": speedup}
 
+Since the staging rework (doc/benchmarking.md) the default run is a
+subprocess-isolated staged pipeline (mesh_tpu/obs/perf.py): probe ->
+warmup -> normals -> closest_point -> dispatch_latency -> fit_step ->
+serve_load -> obs/recorder overhead guards -> pallas_proxy, each stage
+under its own timeout with partial results persisted to
+bench_partial.json, one flight-recorder incident per wedged run, and a
+chip-free CPU-interpreter Pallas proxy metric riding every record.
+``--stage <name>`` runs one stage in-process (the child entry),
+``--stages a,b`` runs a subset pipeline, and the pre-staging mode flags
+(--dispatch-latency and friends) are unchanged.
+
 vs_baseline is the measured speedup over a single-core CPU implementation of
 the same queries (numpy normals + scipy cKDTree nearest-vertex seed with an
 exact local triangle refinement — the same algorithmic class as the
@@ -16,10 +27,16 @@ import json
 import os
 import sys
 import time
+from collections import OrderedDict
 
 import numpy as np
 
 _REPO = os.path.dirname(os.path.abspath(__file__))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+# stdlib-only (obs never imports jax): the staged harness + reap helpers
+from mesh_tpu.obs import perf as obs_perf  # noqa: E402
 
 BATCH = 256
 QUERIES_PER_MESH = 1024
@@ -37,7 +54,7 @@ def _bench_knobs():
     )
 
 
-def tpu_workload():
+def tpu_workload(n_rep=10):
     import jax
     import jax.numpy as jnp
 
@@ -135,7 +152,6 @@ def tpu_workload():
     # warm up (compile)
     out = workload(betas, pose, queries)
     sync(out)
-    n_rep = 10
     t0 = time.perf_counter()
     for _ in range(n_rep):
         out = workload(betas, pose, queries)
@@ -312,15 +328,13 @@ def backend_responsive(probe_timeout=150, attempts=3, hung_probe_timeout=15):
                 % (attempt + 1, attempts, reason))
         except subprocess.TimeoutExpired:
             reason = "probe hung > %ds (backend init blocked)" % timeout
-            proc.kill()
-            try:
-                # a child stuck in uninterruptible device I/O may not even
-                # die on SIGKILL; give up on reaping rather than block here
-                proc.communicate(timeout=10)
-            except subprocess.TimeoutExpired:
-                pass
-            log("backend probe %d/%d hung: %s"
-                % (attempt + 1, attempts, reason))
+            # escalating reap, poll-based end to end: the old
+            # kill(); communicate(timeout=10) teardown could itself block
+            # on a pipe held open by a wedged grandchild, leaking one
+            # stuck subprocess per attempt
+            how = obs_perf.reap_child(proc)
+            log("backend probe %d/%d hung: %s (child %s)"
+                % (attempt + 1, attempts, reason, how))
             hung_once = True
         if attempt < attempts - 1:
             time.sleep(2 if hung_once else 20)
@@ -685,12 +699,29 @@ def wedged_record(reason):
     except (OSError, ValueError):
         last_good = None
     if last_good and last_good.get("value"):
+        stale_age_h = None
+        measured = last_good.get("measured_utc")
+        if measured:
+            try:
+                import calendar
+
+                t_meas = calendar.timegm(
+                    time.strptime(measured, "%Y-%m-%dT%H:%M:%SZ"))
+                stale_age_h = round(
+                    max(0.0, time.time() - t_meas) / 3600.0, 1)
+            except ValueError:
+                stale_age_h = None
         record.update(
             value=last_good["value"],
             unit=last_good.get("unit", "queries/sec"),
-            vs_baseline=last_good.get("vs_baseline"),
+            # top-level vs_baseline stays NULL on a stale record: the
+            # ratio belongs to the archived run, not to this unmeasured
+            # attempt — harvesters must not read a stale republication as
+            # a fresh improvement (it lives in last_good_onchip_run)
+            vs_baseline=None,
             stale=True,
-            measured_utc=last_good.get("measured_utc"),
+            stale_age_hours=stale_age_h,
+            measured_utc=measured,
             last_good_onchip_run=last_good,
         )
         return record, 0
@@ -707,51 +738,92 @@ def _with_obs(record):
     return record
 
 
-def main():
-    ok, reason = backend_responsive()
-    if not ok:
-        # sweep records have no last-good provenance file; null out rather
-        # than borrowing the north-star headline's
-        for flag, metric, unit in (
-            ("--dispatch-latency", "dispatch_latency_small_q", "ms/call"),
-            ("--obs-overhead", "obs_overhead_small_q", "overhead_frac"),
-            ("--recorder-overhead", "recorder_overhead_small_q",
-             "overhead_frac"),
-            ("--fit-step", "fit_step_latency", "ms/call"),
-            ("--serve-load", "serve_load_closed_loop", "p99_ms"),
-        ):
-            if flag in sys.argv[1:]:
-                print(json.dumps({
-                    "metric": metric, "value": None,
-                    "unit": unit, "vs_baseline": None,
-                    "error": "jax backend probe failed, no fresh "
-                             "measurement possible (%s)" % reason,
-                }))
-                sys.exit(1)
-        record, rc = wedged_record(reason)
-        print(json.dumps(record))
-        sys.exit(rc)
-    if ("--dispatch-latency" in sys.argv[1:]
-            or "--obs-overhead" in sys.argv[1:]
-            or "--recorder-overhead" in sys.argv[1:]
-            or "--fit-step" in sys.argv[1:]
-            or "--serve-load" in sys.argv[1:]):
-        from mesh_tpu.utils.compilation_cache import (
-            enable_persistent_compilation_cache,
-        )
+# ---------------------------------------------------------------------------
+# staged pipeline (mesh_tpu/obs/perf.py orchestrates; doc/benchmarking.md)
 
-        enable_persistent_compilation_cache()
-        if "--obs-overhead" in sys.argv[1:]:
-            print(json.dumps(_with_obs(obs_overhead())))
-        elif "--recorder-overhead" in sys.argv[1:]:
-            print(json.dumps(_with_obs(recorder_overhead())))
-        elif "--fit-step" in sys.argv[1:]:
-            print(json.dumps(_with_obs(fit_step_latency())))
-        elif "--serve-load" in sys.argv[1:]:
-            print(json.dumps(_with_obs(serve_load())))
-        else:
-            print(json.dumps(_with_obs(dispatch_latency_small_q())))
-        return
+
+def probe_stage():
+    """Stage ``probe``: init the jax backend IN THIS CHILD and run a tiny
+    computation.  A wedged tunnel wedges this process, not the
+    orchestrator — the stage timeout + reap replace the old in-process
+    150 s wait that could block the whole bench run."""
+    import jax
+    import jax.numpy as jnp
+
+    ok = float(jnp.ones((8, 8)).sum()) == 64.0
+    return {
+        "metric": "backend_probe",
+        "value": 1.0 if ok else 0.0,
+        "unit": "bool",
+        "vs_baseline": None,
+        "backend_ok": ok,
+        "platform": jax.devices()[0].platform,
+        "device": str(jax.devices()[0]),
+    }
+
+
+def warmup_stage():
+    """Stage ``warmup``: compile the headline workload once with the
+    persistent compilation cache on, so the measuring stage's child loads
+    the executable from disk instead of paying the tunneled compile
+    inside its timed budget."""
+    from mesh_tpu.utils.compilation_cache import (
+        enable_persistent_compilation_cache,
+    )
+
+    enable_persistent_compilation_cache()
+    t0 = time.perf_counter()
+    tpu_workload(n_rep=1)
+    elapsed = time.perf_counter() - t0
+    return {
+        "metric": "warmup_compile",
+        "value": round(elapsed, 3),
+        "unit": "seconds",
+        "vs_baseline": None,
+    }
+
+
+def normals_stage(n_rep=10):
+    """Stage ``normals``: posed-batch vertex normals alone — the headline
+    workload's other half, isolated so a query-kernel regression and a
+    normals regression are distinguishable in the per-stage record."""
+    import jax
+    import jax.numpy as jnp
+
+    from mesh_tpu.geometry.vert_normals import vert_normals
+    from mesh_tpu.models import lbs, synthetic_body_model
+    from mesh_tpu.utils.profiling import host_sync as sync
+
+    model = synthetic_body_model(seed=0)
+    f = model.faces
+    rng = np.random.RandomState(0)
+    betas = jnp.asarray(rng.randn(BATCH, model.num_betas) * 0.3, jnp.float32)
+    pose = jnp.asarray(rng.randn(BATCH, model.num_joints, 3) * 0.1,
+                       jnp.float32)
+
+    @jax.jit
+    def normals_only(betas, pose):
+        verts, _ = lbs(model, betas, pose)
+        return jnp.sum(vert_normals(verts, f))
+
+    sync(normals_only(betas, pose))
+    t0 = time.perf_counter()
+    for _ in range(n_rep):
+        out = normals_only(betas, pose)
+    sync(out)
+    elapsed = (time.perf_counter() - t0) / n_rep
+    return {
+        "metric": "batch256_vert_normals",
+        "value": round(BATCH / elapsed, 1),
+        "unit": "meshes/sec",
+        "vs_baseline": None,
+    }
+
+
+def closest_point_stage():
+    """Stage ``closest_point``: the north-star headline measurement —
+    exactly the pre-staging ``python bench.py`` body, including the
+    CPU-baseline ratio, roofline accounting, and last-good persistence."""
     # rerun compiles load from disk instead of paying ~20-40 s each on the
     # tunneled chip (content-keyed, so measurements are unaffected)
     from mesh_tpu.utils.compilation_cache import (
@@ -795,7 +867,6 @@ def main():
             # the CPU fallback path never reads the knobs — labeling the
             # record would claim a variant kernel that did not run
             log("kernel knobs ignored on the CPU fallback path")
-    print(json.dumps(_with_obs(result)))
     if on_accelerator and knobs_default:
         # persist the successful on-chip measurement for the wedged-tunnel
         # record above (committed to the repo: provenance, not a live cache)
@@ -812,6 +883,269 @@ def main():
             os.replace(_LAST_GOOD + ".tmp", _LAST_GOOD)
         except OSError as e:
             log("could not persist last-good record: %s" % e)
+    return result
+
+
+def pallas_proxy_stage(n_rep=3):
+    """Stage ``pallas_proxy``: the chip-free regression proxy.  Runs the
+    sphere-culled Pallas query kernel under the CPU interpreter
+    (``interpret=True``, the Pallas TPU-interpret mode the exactness
+    tests already rely on) over a fixed icosphere workload, so every
+    BENCH record carries a fresh kernel-sensitive pair-tests/sec number
+    even while the chip is wedged — plus the XLA brute path's
+    compiled-HLO cost-model FLOPs, which are deterministic and catch
+    algorithmic regressions with zero timing noise.  The stage env pins
+    JAX_PLATFORMS=cpu so this child never touches the (possibly wedged)
+    accelerator tunnel."""
+    import jax
+    import jax.numpy as jnp
+
+    from mesh_tpu.query.closest_point import closest_faces_and_points
+    from mesh_tpu.query.pallas_culled import closest_point_pallas_culled
+    from mesh_tpu.sphere import _icosphere
+
+    rng = np.random.RandomState(0)
+    v, f = _icosphere(2)
+    v = np.asarray(v, np.float32)
+    f = np.asarray(f, np.int32)
+    n_q = 384
+    pts = np.asarray(rng.randn(n_q, 3) * 0.7, np.float32)
+
+    def run():
+        return closest_point_pallas_culled(
+            v, f, pts, tile_q=64, tile_f=256, interpret=True)
+
+    res = run()                                 # compile + correctness ref
+    checksum = float(jnp.sum(res["sqdist"]) + jnp.sum(res["point"]))
+    best = np.inf
+    for _ in range(max(int(n_rep), 1)):
+        t0 = time.perf_counter()
+        out = run()
+        jax.block_until_ready((out["sqdist"], out["point"]))
+        best = min(best, time.perf_counter() - t0)
+    n_f = int(f.shape[0])
+    pairs = n_q * n_f
+
+    flops = None
+    try:
+        lowered = jax.jit(
+            lambda vv, pp: closest_faces_and_points(vv, f, pp, chunk=128)
+        ).lower(jnp.asarray(v), jnp.asarray(pts))
+        cost = lowered.compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        if cost and cost.get("flops"):
+            flops = float(cost["flops"])
+    except Exception as e:      # noqa: BLE001 — cost model is best-effort
+        log("hlo cost analysis unavailable: %s" % e)
+    return {
+        "metric": "pallas_proxy_pair_tests",
+        "value": round(pairs / best, 1),
+        "unit": "pair_tests/sec",
+        "vs_baseline": None,
+        "interpret": True,
+        "queries": n_q,
+        "faces": n_f,
+        "checksum": round(checksum, 4),
+        "hlo_cost": {"flops": flops},
+    }
+
+
+#: declarative stage table: name -> (fn, default timeout_s,
+#: requires_backend, gate, extra child env).  Budgets bound a WEDGE —
+#: they are not measurements; override one with
+#: MESH_TPU_BENCH_TIMEOUT_<NAME> (doc/benchmarking.md has the table).
+_STAGE_DEFS = OrderedDict((
+    ("probe", (probe_stage, 150.0, False, True, {})),
+    ("warmup", (warmup_stage, 600.0, True, False, {})),
+    ("normals", (normals_stage, 300.0, True, False, {})),
+    ("closest_point", (closest_point_stage, 900.0, True, False, {})),
+    ("dispatch_latency", (dispatch_latency_small_q, 300.0, True, False, {})),
+    ("fit_step", (fit_step_latency, 300.0, True, False, {})),
+    ("serve_load", (serve_load, 300.0, True, False, {})),
+    ("obs_overhead", (obs_overhead, 300.0, True, False, {})),
+    ("recorder_overhead", (recorder_overhead, 300.0, True, False, {})),
+    # PALLAS_AXON_POOL_IPS must ALSO be cleared: the axon hook ignores
+    # JAX_PLATFORMS=cpu alone (same idiom as tests/conftest.py), and a
+    # proxy child that silently lands on the wedged tunnel defeats the
+    # whole chip-free point of the stage
+    ("pallas_proxy", (pallas_proxy_stage, 120.0, False, False,
+                      {"JAX_PLATFORMS": "cpu",
+                       "PALLAS_AXON_POOL_IPS": ""})),
+))
+
+
+def _stage_timeout(name, default):
+    value = os.environ.get(obs_perf.TIMEOUT_ENV_PREFIX + name.upper())
+    if value:
+        try:
+            return float(value)
+        except ValueError:
+            log("ignoring non-numeric %s%s=%r"
+                % (obs_perf.TIMEOUT_ENV_PREFIX, name.upper(), value))
+    return default
+
+
+def build_stage_specs(names=None):
+    """StageSpecs for the requested stage subset (default: all, in table
+    order).  Each spec re-invokes THIS file as ``--stage <name>`` so the
+    stage body runs subprocess-isolated."""
+    if names is None:
+        names = list(_STAGE_DEFS)
+    unknown = [n for n in names if n not in _STAGE_DEFS]
+    if unknown:
+        raise SystemExit("unknown bench stage(s) %s (have %s)"
+                         % (unknown, list(_STAGE_DEFS)))
+    specs = []
+    for name in names:
+        _fn, timeout, requires_backend, gate, env = _STAGE_DEFS[name]
+        specs.append(obs_perf.StageSpec(
+            name,
+            [sys.executable, os.path.abspath(__file__), "--stage", name],
+            _stage_timeout(name, timeout),
+            requires_backend=requires_backend, gate=gate, env=env,
+        ))
+    return specs
+
+
+def _stage_child(name):
+    """Child-process entry for ``python bench.py --stage <name>``: run one
+    stage function and print its record as the final JSON line.  The
+    MESH_TPU_BENCH_FAULT=<stage>:<hang|crash|error> hook wedges/kills
+    this child on purpose so tests can prove the orchestrator survives."""
+    if name not in _STAGE_DEFS:
+        raise SystemExit("unknown bench stage %r (have %s)"
+                         % (name, list(_STAGE_DEFS)))
+    fault = os.environ.get(obs_perf.FAULT_ENV, "")
+    if fault.startswith(name + ":"):
+        mode = fault.split(":", 1)[1]
+        if mode == "hang":
+            log("fault injection: stage %s hanging" % name)
+            while True:
+                time.sleep(3600)
+        elif mode == "crash":
+            log("fault injection: stage %s crashing" % name)
+            sys.exit(41)
+        elif mode == "error":
+            raise RuntimeError("fault injection: stage %s error" % name)
+    record = _STAGE_DEFS[name][0]()
+    print(json.dumps(record))
+    sys.exit(0)
+
+
+def run_staged(names=None):
+    """The default ``python bench.py`` flow: the subprocess-isolated
+    staged pipeline (obs/perf.py) with incremental partial persistence
+    and incident-on-wedge, ending in ONE final JSON line that combines
+    the headline (fresh or stale), the chip-free proxy, and the
+    per-stage outcomes."""
+    partial_path = os.environ.get(obs_perf.PARTIAL_ENV) or os.path.join(
+        _REPO, "bench_partial.json")
+    specs = build_stage_specs(names)
+    results = obs_perf.run_stages(specs, partial_path, log=log)
+
+    failed = [n for n, r in results.items()
+              if r.status in ("hung", "crashed")]
+    probe = results.get("probe")
+    cp = results.get("closest_point")
+    rc = 0
+    if cp is not None and cp.ok:
+        record = dict(cp.record)
+    elif cp is not None:
+        # headline attempted but did not land: same stale/null contract
+        # as the pre-staging wedge guard
+        if probe is not None and not (probe.ok and (probe.record or {}).get(
+                "backend_ok", True)):
+            reason = "probe stage %s (%s)" % (
+                probe.status, probe.error or "backend not ok")
+        else:
+            reason = "closest_point stage %s (%s)" % (cp.status, cp.error)
+        record, rc = wedged_record(reason)
+    else:
+        record = {
+            "metric": "bench_staged_subset",
+            "value": None,
+            "unit": None,
+            "vs_baseline": None,
+        }
+    proxy = results.get("pallas_proxy")
+    if proxy is not None and proxy.ok:
+        record["proxy"] = proxy.record
+    record["stages"] = OrderedDict(
+        (n, r.to_json()) for n, r in results.items())
+    record["bench_partial"] = partial_path
+    print(json.dumps(_with_obs(record), default=str))
+    if failed:
+        # a hung/crashed stage fails the RUN even when a stale headline
+        # exists: the wedge itself must trip the gate, and the partial
+        # file + incident dump carry the forensics
+        rc = 1
+    sys.exit(rc)
+
+
+def main():
+    argv = sys.argv[1:]
+    if "--stage" in argv:
+        idx = argv.index("--stage")
+        if idx + 1 >= len(argv):
+            raise SystemExit("--stage needs a name (have %s)"
+                             % list(_STAGE_DEFS))
+        _stage_child(argv[idx + 1])
+        return
+    if "--stages" in argv:
+        idx = argv.index("--stages")
+        if idx + 1 >= len(argv):
+            raise SystemExit("--stages needs a comma-separated list "
+                             "(have %s)" % list(_STAGE_DEFS))
+        names = [n.strip() for n in argv[idx + 1].split(",") if n.strip()]
+        run_staged(names)
+        return
+    legacy = [flag for flag in (
+        "--dispatch-latency", "--obs-overhead", "--recorder-overhead",
+        "--fit-step", "--serve-load") if flag in argv]
+    if legacy:
+        # pre-staging single-mode flows, kept in-process: their guard
+        # tests monkeypatch backend_responsive and time the sweeps with
+        # the plan cache shared across modes
+        ok, reason = backend_responsive()
+        if not ok:
+            # sweep records have no last-good provenance file; null out
+            # rather than borrowing the north-star headline's
+            for flag, metric, unit in (
+                ("--dispatch-latency", "dispatch_latency_small_q",
+                 "ms/call"),
+                ("--obs-overhead", "obs_overhead_small_q",
+                 "overhead_frac"),
+                ("--recorder-overhead", "recorder_overhead_small_q",
+                 "overhead_frac"),
+                ("--fit-step", "fit_step_latency", "ms/call"),
+                ("--serve-load", "serve_load_closed_loop", "p99_ms"),
+            ):
+                if flag in argv:
+                    print(json.dumps({
+                        "metric": metric, "value": None,
+                        "unit": unit, "vs_baseline": None,
+                        "error": "jax backend probe failed, no fresh "
+                                 "measurement possible (%s)" % reason,
+                    }))
+                    sys.exit(1)
+        from mesh_tpu.utils.compilation_cache import (
+            enable_persistent_compilation_cache,
+        )
+
+        enable_persistent_compilation_cache()
+        if "--obs-overhead" in argv:
+            print(json.dumps(_with_obs(obs_overhead())))
+        elif "--recorder-overhead" in argv:
+            print(json.dumps(_with_obs(recorder_overhead())))
+        elif "--fit-step" in argv:
+            print(json.dumps(_with_obs(fit_step_latency())))
+        elif "--serve-load" in argv:
+            print(json.dumps(_with_obs(serve_load())))
+        else:
+            print(json.dumps(_with_obs(dispatch_latency_small_q())))
+        return
+    run_staged()
 
 
 if __name__ == "__main__":

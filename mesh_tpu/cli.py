@@ -9,6 +9,8 @@ Subcommands:
   serve-stats — summarize the serving tier's stats sink (no jax init)
   incidents — list/show flight-recorder incident dumps (no jax init)
   slo — evaluate SLO compliance from the serve-stats sink (no jax init)
+  perfcheck — compare a saved bench JSON against the last-good record
+    and the CPU-proxy golden with tolerance bands (no jax init)
 
 Examples:
   meshviewer view body.ply
@@ -21,6 +23,7 @@ Examples:
   mesh-tpu incidents
   mesh-tpu incidents incident-...-watchdog_trip-001.json --json
   mesh-tpu slo --latency-ms 250 --target 0.99
+  mesh-tpu perfcheck bench_partial.json
 """
 
 import argparse
@@ -375,6 +378,62 @@ def cmd_slo(args):
                  "MET" if row["met"] else "MISSED"))
 
 
+def cmd_perfcheck(args):
+    """Regression-gate a saved bench JSON (final record or the staged
+    harness's bench_partial.json) against bench_last_good.json and the
+    committed CPU-proxy golden.
+
+    Same import discipline as serve-stats/incidents: json/os plus the
+    stdlib-only mesh_tpu.obs.perf — no jax, no backend initialization.
+    This is the tool you run while the chip is wedged, exactly when the
+    proxy metric is the only fresh number (doc/benchmarking.md runbook).
+    Exits 1 on any regression beyond tolerance.
+    """
+    import json
+
+    from mesh_tpu.obs.perf import perfcheck, read_bench_json
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    try:
+        doc = read_bench_json(args.bench_json)
+    except (OSError, ValueError) as exc:
+        print("bench JSON %s is unreadable: %s" % (args.bench_json, exc),
+              file=sys.stderr)
+        sys.exit(2)
+
+    def _load_optional(path, label):
+        if not path or not os.path.exists(path):
+            return None
+        try:
+            with open(path) as fh:
+                return json.load(fh)
+        except (OSError, ValueError) as exc:
+            print("%s at %s is unreadable: %s" % (label, path, exc),
+                  file=sys.stderr)
+            sys.exit(2)
+
+    baseline = _load_optional(
+        args.baseline or os.path.join(repo_root, "bench_last_good.json"),
+        "baseline")
+    golden = _load_optional(
+        args.proxy_golden or os.path.join(repo_root, "benchmarks",
+                                          "proxy_golden.json"),
+        "proxy golden")
+    rc, lines = perfcheck(doc, baseline=baseline, proxy_golden=golden,
+                          proxy_tol=args.proxy_tol,
+                          headline_tol=args.headline_tol,
+                          flops_tol=args.flops_tol)
+    if args.json:
+        json.dump({"rc": rc, "lines": lines}, sys.stdout, indent=2)
+        sys.stdout.write("\n")
+    else:
+        print("perfcheck %s" % args.bench_json)
+        for line in lines:
+            print("  " + line)
+        print("perfcheck: %s" % ("OK" if rc == 0 else "REGRESSION"))
+    sys.exit(rc)
+
+
 def main():
     parser = argparse.ArgumentParser(prog="meshviewer", description=__doc__)
     sub = parser.add_subparsers(dest="command", required=True)
@@ -482,6 +541,34 @@ def main():
     p_slo.add_argument("--json", action="store_true",
                        help="raw JSON rows instead of the summary")
     p_slo.set_defaults(func=cmd_slo)
+
+    p_perf = sub.add_parser(
+        "perfcheck",
+        help="compare a saved bench JSON against last-good + proxy "
+             "golden with tolerance bands (no jax init)")
+    p_perf.add_argument("bench_json",
+                        help="bench JSON to check: the final record line "
+                             "or a bench_partial.json")
+    p_perf.add_argument("--baseline", default=None,
+                        help="last-good record (default: repo "
+                             "bench_last_good.json)")
+    p_perf.add_argument("--proxy-golden", default=None,
+                        help="proxy golden record (default: repo "
+                             "benchmarks/proxy_golden.json)")
+    p_perf.add_argument("--proxy-tol", type=float, default=0.5,
+                        help="allowed fractional proxy slowdown vs the "
+                             "golden (default 0.5: interpreter timing is "
+                             "noisy; the band only catches collapses)")
+    p_perf.add_argument("--headline-tol", type=float, default=0.2,
+                        help="allowed fractional headline slowdown vs "
+                             "last-good (default 0.2)")
+    p_perf.add_argument("--flops-tol", type=float, default=0.25,
+                        help="allowed fractional HLO cost-model FLOPs "
+                             "growth vs the golden (default 0.25)")
+    p_perf.add_argument("--json", action="store_true",
+                        help="machine-readable {rc, lines} instead of the "
+                             "summary")
+    p_perf.set_defaults(func=cmd_perfcheck)
 
     args = parser.parse_args()
     args.func(args)

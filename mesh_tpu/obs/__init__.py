@@ -25,6 +25,10 @@ as a gap to fill, and the engine's value is invisible without it):
   running even with ``MESH_TPU_OBS`` off (kill switch:
   ``MESH_TPU_RECORDER=0``; cost pinned by ``bench.py
   --recorder-overhead``).
+- **perf harness** (obs/perf.py) — the staged, subprocess-isolated
+  bench pipeline (per-stage timeouts, incremental ``bench_partial.json``
+  persistence, ``bench_stage_hang`` incident dumps) and the jax-free
+  ``mesh-tpu perfcheck`` regression gate (doc/benchmarking.md).
 - **SLOs** (obs/slo.py) — declarative latency/availability objectives
   per tenant, evaluated from the registry with multi-window
   multi-burn-rate alerting; a fast-burn breach dumps an incident and
@@ -43,6 +47,14 @@ from .metrics import (  # noqa: F401
     Histogram,
     Registry,
     REGISTRY,
+)
+from .perf import (  # noqa: F401
+    StageResult,
+    StageSpec,
+    call_with_timeout,
+    perfcheck,
+    reap_child,
+    run_stages,
 )
 from .recorder import (  # noqa: F401
     RECORDER,
@@ -84,6 +96,8 @@ __all__ = [
     "SLO", "BurnRateRule", "SLOMonitor", "default_slos", "default_rules",
     "compliance", "bind_incident_response",
     "monotonic", "wall",
+    "StageSpec", "StageResult", "call_with_timeout", "reap_child",
+    "run_stages", "perfcheck",
 ]
 
 

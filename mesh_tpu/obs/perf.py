@@ -1,0 +1,503 @@
+"""Hermetic perf observability: the staged, watchdogged bench harness
+plus the jax-free ``perfcheck`` regression gate (doc/benchmarking.md).
+
+Why this module exists: the monolithic bench bootstrap could be wedged
+by a single blocked backend init for longer than the whole gate budget
+(BENCH_r02-r05 all read ``backend probe hung > 150s``; the watchdog log
+recorded a >26.5h continuous wedge).  The harness here decomposes a
+bench run into declarative **stages**, each executed in its own
+subprocess under a per-stage timeout, so:
+
+- a hang in stage k can never destroy stages 1..k-1 — every stage's
+  record is persisted incrementally to ``bench_partial.json`` (atomic
+  temp+rename) the moment the stage ends;
+- the orchestrator itself can never wedge: the child wait runs inside
+  ``call_with_timeout`` (the wedge-proof abandoned-attempt-thread
+  pattern extracted from serve/deadline.py) with a grace margin on top
+  of the subprocess timeout, and a timed-out child is reaped by
+  ``reap_child`` (terminate -> poll -> kill -> poll -> abandon — never
+  a blocking pipe read);
+- the first hang/crash auto-dumps ONE flight-recorder incident tagged
+  ``bench_stage_hang`` (stage name, timeout, statuses so far, partial
+  path), and every stage outcome lands in the
+  ``mesh_tpu_bench_stage_{ok,hung,crashed,skipped}_total`` counters and
+  the ``mesh_tpu_bench_stage_seconds`` histogram.
+
+``perfcheck`` is the read side: stdlib-only comparison of a saved bench
+JSON (final record or the partial file) against ``bench_last_good.json``
+and the committed CPU-proxy golden, with tolerance bands, exiting
+nonzero on regression — runnable while the chip is wedged, which is
+exactly when it is needed.
+
+Import cost: stdlib only; jax is never touched (the stages that need it
+run in child processes).
+"""
+
+import json
+import os
+import subprocess
+import threading
+import time
+from collections import OrderedDict
+
+from ..errors import DeadlineExceeded
+from .clock import monotonic, wall
+from .metrics import REGISTRY
+from .recorder import get_recorder
+
+__all__ = [
+    "StageSpec", "StageResult", "call_with_timeout", "reap_child",
+    "run_stages", "write_partial", "read_bench_json", "extract_records",
+    "perfcheck", "PARTIAL_SCHEMA_VERSION", "INCIDENT_REASON",
+    "FAULT_ENV", "PARTIAL_ENV", "TIMEOUT_ENV_PREFIX",
+]
+
+#: incident reason tag for any stage hang/crash (doc/benchmarking.md)
+INCIDENT_REASON = "bench_stage_hang"
+
+#: fault injection: ``<stage>:hang`` / ``<stage>:crash`` / ``<stage>:error``
+#: makes that stage's child wedge / exit nonzero / raise (tests only)
+FAULT_ENV = "MESH_TPU_BENCH_FAULT"
+
+#: relocates the incremental partial-results file
+PARTIAL_ENV = "MESH_TPU_BENCH_PARTIAL"
+
+#: per-stage timeout override: MESH_TPU_BENCH_TIMEOUT_<STAGE> seconds
+TIMEOUT_ENV_PREFIX = "MESH_TPU_BENCH_TIMEOUT_"
+
+#: bench_partial.json schema (bump on breaking shape changes)
+PARTIAL_SCHEMA_VERSION = 1
+
+#: orchestrator-side margin on top of the subprocess timeout: covers
+#: spawn latency plus a full reap escalation before the attempt thread
+#: itself is declared wedged and abandoned
+_ATTEMPT_GRACE_S = 30.0
+
+
+def call_with_timeout(fn, timeout):
+    """Run ``fn()`` on a daemon helper thread, waiting at most
+    ``timeout`` seconds.  Raises DeadlineExceeded on timeout — the stuck
+    thread is abandoned, not joined, because the whole point is that a
+    wedged device call may never return.
+
+    (Extracted from serve/deadline.py, which re-exports it: the serving
+    ladder's rung attempts and the bench harness's stage attempts share
+    this one wedge-proof primitive.)
+    """
+    box = {}
+    done = threading.Event()
+
+    def _run():
+        try:
+            box["result"] = fn()
+        except BaseException as e:     # noqa: BLE001 — re-raised below
+            box["error"] = e
+        finally:
+            done.set()
+
+    worker = threading.Thread(target=_run, name="mesh-tpu-attempt",
+                              daemon=True)
+    worker.start()
+    if not done.wait(timeout=max(float(timeout), 0.0)):
+        raise DeadlineExceeded(
+            "rung call still running after %.3fs slice" % timeout)
+    if "error" in box:
+        raise box["error"]
+    return box["result"]
+
+
+def reap_child(proc, term_grace_s=3.0, kill_grace_s=10.0,
+               clock=monotonic, sleep=time.sleep):
+    """Escalating child teardown that can never block the caller:
+    SIGTERM -> bounded poll -> SIGKILL -> bounded poll -> abandon.
+
+    Every wait is ``poll()`` (WNOHANG — it also reaps the zombie);
+    nothing here reads a pipe, because a pipe held open by a wedged
+    child (or its grandchild) is exactly what made the old
+    ``kill(); communicate(timeout=10)`` teardown block.  Returns
+    ``"terminated"`` / ``"killed"`` / ``"abandoned"`` — abandoned means
+    the child survived SIGKILL (uninterruptible device I/O); the caller
+    moves on and init never blocks on it again.
+    """
+    if proc.poll() is not None:
+        return "terminated"
+    try:
+        proc.terminate()
+    except OSError:
+        pass
+    deadline = clock() + term_grace_s
+    while clock() < deadline:
+        if proc.poll() is not None:
+            return "terminated"
+        sleep(0.05)
+    try:
+        proc.kill()
+    except OSError:
+        pass
+    deadline = clock() + kill_grace_s
+    while clock() < deadline:
+        if proc.poll() is not None:
+            return "killed"
+        sleep(0.05)
+    return "abandoned"
+
+
+class StageSpec(object):
+    """One declarative bench stage.
+
+    :param name: stage name (also the child's ``--stage`` argument and
+        the ``stage=`` metric label).
+    :param argv: child command line; the stage runs subprocess-isolated
+        so a wedge dies with the child, not the orchestrator.
+    :param timeout_s: per-stage budget; past it the child is reaped and
+        the stage is ``hung``.
+    :param requires_backend: stage needs the (possibly wedged)
+        accelerator backend; skipped once the backend is known-bad.
+    :param gate: a non-ok outcome marks the backend bad (the probe).
+    :param env: extra child environment (e.g. the proxy stage's
+        ``JAX_PLATFORMS=cpu``, which keeps it off the wedged tunnel).
+    """
+
+    __slots__ = ("name", "argv", "timeout_s", "requires_backend", "gate",
+                 "env")
+
+    def __init__(self, name, argv, timeout_s, requires_backend=False,
+                 gate=False, env=None):
+        self.name = name
+        self.argv = list(argv)
+        self.timeout_s = float(timeout_s)
+        self.requires_backend = bool(requires_backend)
+        self.gate = bool(gate)
+        self.env = dict(env) if env else {}
+
+
+class StageResult(object):
+    """Outcome of one stage attempt: ``ok`` / ``hung`` / ``crashed`` /
+    ``skipped``, elapsed wall time, the stage's JSON record (ok only),
+    and the error string otherwise."""
+
+    __slots__ = ("name", "status", "elapsed_s", "timeout_s", "record",
+                 "error")
+
+    def __init__(self, name, status, elapsed_s, timeout_s, record=None,
+                 error=None):
+        self.name = name
+        self.status = status
+        self.elapsed_s = elapsed_s
+        self.timeout_s = timeout_s
+        self.record = record
+        self.error = error
+
+    @property
+    def ok(self):
+        return self.status == "ok"
+
+    def to_json(self):
+        out = {
+            "status": self.status,
+            "elapsed_s": round(self.elapsed_s, 3),
+            "timeout_s": self.timeout_s,
+            "record": self.record,
+        }
+        if self.error:
+            out["error"] = self.error
+        return out
+
+
+def write_partial(path, state):
+    """Atomically persist the partial-results state (temp + rename so a
+    crash mid-write — the wedge modes this file exists for — can never
+    clobber the previous good copy)."""
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w") as fh:
+            json.dump(state, fh, indent=1, default=str)
+            fh.write("\n")
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+def _last_json_line(text):
+    for line in reversed((text or "").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+    return None
+
+
+def _stage_counter(status):
+    # one literal name per status so the metrics-doc lint sees them all
+    names = {
+        "ok": "mesh_tpu_bench_stage_ok_total",
+        "hung": "mesh_tpu_bench_stage_hung_total",
+        "crashed": "mesh_tpu_bench_stage_crashed_total",
+        "skipped": "mesh_tpu_bench_stage_skipped_total",
+    }
+    help_text = "bench harness stages by outcome (label: stage)"
+    REGISTRY.counter("mesh_tpu_bench_stage_ok_total", help_text)
+    REGISTRY.counter("mesh_tpu_bench_stage_hung_total", help_text)
+    REGISTRY.counter("mesh_tpu_bench_stage_crashed_total", help_text)
+    REGISTRY.counter("mesh_tpu_bench_stage_skipped_total", help_text)
+    return REGISTRY.get(names[status])
+
+
+def _stage_histogram():
+    return REGISTRY.histogram(
+        "mesh_tpu_bench_stage_seconds",
+        "wall seconds per bench stage attempt (label: stage)")
+
+
+def _run_one(spec, clock, sleep, popen, log):
+    """One subprocess-isolated stage attempt under its timeout, with the
+    call_with_timeout backstop around the whole spawn+wait+reap path."""
+    t0 = clock()
+
+    def attempt():
+        env = dict(os.environ)
+        env.update(spec.env)
+        proc = popen(spec.argv, stdout=subprocess.PIPE,
+                     stderr=subprocess.PIPE, text=True, env=env)
+        try:
+            out, err = proc.communicate(timeout=spec.timeout_s)
+        except subprocess.TimeoutExpired:
+            how = reap_child(proc, clock=clock, sleep=sleep)
+            return ("hung", None,
+                    "stage still running after %.1fs budget (child %s)"
+                    % (spec.timeout_s, how))
+        if proc.returncode != 0:
+            tail = (err or "").strip().splitlines()
+            return ("crashed", None, "stage exited %d: %s" % (
+                proc.returncode, tail[-1] if tail else "no stderr"))
+        record = _last_json_line(out)
+        if record is None:
+            return ("crashed", None, "stage exited 0 without a JSON record")
+        return ("ok", record, None)
+
+    try:
+        status, record, error = call_with_timeout(
+            attempt, spec.timeout_s + _ATTEMPT_GRACE_S)
+    except DeadlineExceeded:
+        # even the reap path wedged; the attempt thread is abandoned
+        status, record, error = "hung", None, (
+            "stage attempt still wedged %.0fs past its %.1fs budget "
+            "(attempt thread abandoned)"
+            % (_ATTEMPT_GRACE_S, spec.timeout_s))
+    except Exception as e:          # noqa: BLE001 — spawn failures etc.
+        status, record, error = "crashed", None, "%s: %s" % (
+            type(e).__name__, e)
+    if error:
+        log("stage %s %s: %s" % (spec.name, status, error))
+    return StageResult(spec.name, status, clock() - t0, spec.timeout_s,
+                       record, error)
+
+
+def run_stages(specs, partial_path, clock=monotonic, sleep=time.sleep,
+               popen=subprocess.Popen, recorder=None, log=None):
+    """Execute ``specs`` in order; returns ``OrderedDict`` name ->
+    StageResult.
+
+    Contract (the measurement floor every perf PR stands on):
+
+    - each stage runs in its own child under its own timeout; the
+      orchestrator never waits unboundedly on anything;
+    - after EVERY stage the partial state lands in ``partial_path`` —
+      a hang in stage k never destroys stages 1..k-1;
+    - a failed ``gate`` stage, or a hung backend stage, marks the
+      backend bad: later ``requires_backend`` stages are skipped
+      (re-touching a wedged tunnel just burns their budgets), while
+      backend-free stages (the CPU-interpreter proxy) still run;
+    - the FIRST hang/crash dumps exactly one ``bench_stage_hang``
+      incident via the flight recorder (later failures only ring-record,
+      so a fully wedged run produces one forensic file, not a pile).
+    """
+    if log is None:
+        log = lambda msg: None      # noqa: E731 — quiet default
+    recorder = recorder or get_recorder()
+    results = OrderedDict()
+    state = {
+        "schema_version": PARTIAL_SCHEMA_VERSION,
+        "kind": "bench_partial",
+        "started_utc": wall(),
+        "order": [s.name for s in specs],
+        "stages": {},
+    }
+    write_partial(partial_path, state)
+    backend_ok = True
+    incident_dumped = False
+    hist = _stage_histogram()
+    for spec in specs:
+        if spec.requires_backend and not backend_ok:
+            res = StageResult(spec.name, "skipped", 0.0, spec.timeout_s,
+                              error="backend unavailable (gate/hang "
+                                    "earlier in the pipeline)")
+        else:
+            log("stage %s (budget %.0fs)..." % (spec.name, spec.timeout_s))
+            res = _run_one(spec, clock, sleep, popen, log)
+            hist.observe(res.elapsed_s, stage=spec.name)
+        results[spec.name] = res
+        _stage_counter(res.status).inc(stage=spec.name)
+        recorder.record("bench.stage", stage=spec.name, status=res.status,
+                        elapsed_s=round(res.elapsed_s, 3),
+                        timeout_s=spec.timeout_s)
+        if spec.gate and (res.status != "ok"
+                          or (res.record or {}).get("backend_ok") is False):
+            backend_ok = False
+        if res.status == "hung" and spec.requires_backend:
+            # a hang INSIDE a backend stage means the tunnel wedged
+            # mid-run; later backend stages would hang the same way
+            backend_ok = False
+        if res.status in ("hung", "crashed") and not incident_dumped:
+            recorder.trigger(INCIDENT_REASON, context={
+                "stage": spec.name,
+                "status": res.status,
+                "timeout_s": spec.timeout_s,
+                "elapsed_s": round(res.elapsed_s, 3),
+                "error": res.error,
+                "completed": [n for n, r in results.items() if r.ok],
+                "partial_path": partial_path,
+            }, force=True)
+            incident_dumped = True
+        state["stages"][spec.name] = res.to_json()
+        write_partial(partial_path, state)
+    return results
+
+
+# ---------------------------------------------------------------------------
+# perfcheck: the jax-free regression gate
+
+
+def read_bench_json(path):
+    """Load a bench JSON file: either the one-line final record
+    ``python bench.py`` prints, or the incremental ``bench_partial.json``
+    the staged harness maintains."""
+    with open(path) as fh:
+        text = fh.read()
+    doc = _last_json_line(text)
+    if doc is None:
+        doc = json.loads(text)
+    return doc
+
+
+def extract_records(doc):
+    """Normalize either bench JSON shape into
+    ``{"headline": rec|None, "proxy": rec|None, "stages": {...}|None}``.
+
+    The headline slot is only filled by a FRESH measurement — a
+    ``stale: true`` envelope (last-good value republished while the
+    tunnel was wedged) is deliberately dropped here, so stale records
+    can neither pass nor fail a regression gate.
+    """
+    headline = None
+    proxy = None
+    stages = None
+    if doc.get("kind") == "bench_partial":
+        stages = doc.get("stages") or {}
+        cp = stages.get("closest_point") or {}
+        if cp.get("status") == "ok":
+            headline = cp.get("record")
+        px = stages.get("pallas_proxy") or {}
+        if px.get("status") == "ok":
+            proxy = px.get("record")
+    else:
+        if doc.get("value") is not None and not doc.get("stale"):
+            headline = doc
+        prox = doc.get("proxy")
+        if isinstance(prox, dict) and prox.get("value") is not None:
+            proxy = prox
+        stages = doc.get("stages")
+    return {"headline": headline, "proxy": proxy, "stages": stages}
+
+
+def perfcheck(doc, baseline=None, proxy_golden=None, proxy_tol=0.5,
+              headline_tol=0.2, flops_tol=0.25):
+    """Compare a bench JSON against the last-good baseline and the
+    committed proxy golden.  Returns ``(rc, lines)`` — rc 0 when nothing
+    regressed beyond its tolerance band, 1 on regression (including a
+    missing proxy metric when a golden exists: the proxy is the number
+    that must survive a wedge).
+
+    Tolerances are one-sided fractions of the baseline: the candidate
+    fails when it is below ``baseline * (1 - tol)`` (faster never
+    fails).  HLO cost-model FLOPs are the exception — deterministic, so
+    they fail in the *upward* direction (``> golden * (1 + flops_tol)``:
+    the compiled algorithm got more expensive).
+    """
+    lines = []
+    rc = 0
+    recs = extract_records(doc)
+
+    golden_rec = None
+    if proxy_golden:
+        golden_rec = (extract_records(proxy_golden)["proxy"]
+                      or (proxy_golden
+                          if proxy_golden.get("value") is not None
+                          else None))
+    cand_proxy = recs["proxy"]
+    if golden_rec is not None:
+        if cand_proxy is None:
+            rc = 1
+            lines.append(
+                "FAIL proxy: candidate carries no pallas_proxy record "
+                "(a golden exists — the chip-free metric must always "
+                "be fresh)")
+        else:
+            floor = golden_rec["value"] * (1.0 - proxy_tol)
+            verdict = "ok" if cand_proxy["value"] >= floor else "FAIL"
+            if verdict == "FAIL":
+                rc = 1
+            lines.append(
+                "%s proxy pair_tests/sec: %.1f vs golden %.1f "
+                "(floor %.1f, tol %.0f%%)"
+                % (verdict, cand_proxy["value"], golden_rec["value"],
+                   floor, 100 * proxy_tol))
+            cand_flops = (cand_proxy.get("hlo_cost") or {}).get("flops")
+            gold_flops = (golden_rec.get("hlo_cost") or {}).get("flops")
+            if cand_flops and gold_flops:
+                ceil = gold_flops * (1.0 + flops_tol)
+                verdict = "ok" if cand_flops <= ceil else "FAIL"
+                if verdict == "FAIL":
+                    rc = 1
+                lines.append(
+                    "%s proxy HLO cost-model flops: %.3g vs golden %.3g "
+                    "(ceiling %.3g, tol %.0f%%)"
+                    % (verdict, cand_flops, gold_flops, ceil,
+                       100 * flops_tol))
+    elif cand_proxy is not None:
+        lines.append("note: proxy present but no golden to compare "
+                     "against (record one: make proxy-golden)")
+
+    base_head = None
+    if baseline and baseline.get("value") is not None \
+            and not baseline.get("stale"):
+        base_head = baseline
+    cand_head = recs["headline"]
+    if cand_head is not None and base_head is not None:
+        floor = base_head["value"] * (1.0 - headline_tol)
+        verdict = "ok" if cand_head["value"] >= floor else "FAIL"
+        if verdict == "FAIL":
+            rc = 1
+        lines.append(
+            "%s headline %s: %.1f vs last-good %.1f (floor %.1f, "
+            "tol %.0f%%)"
+            % (verdict, cand_head.get("unit", "queries/sec"),
+               cand_head["value"], base_head["value"], floor,
+               100 * headline_tol))
+    elif doc.get("stale"):
+        lines.append(
+            "note: headline is a STALE last-good republication "
+            "(age %sh) — skipped, neither an improvement nor a "
+            "regression" % doc.get("stale_age_hours"))
+    elif cand_head is None:
+        lines.append("note: no fresh headline in the candidate "
+                     "(wedged or subset run) — headline not checked")
+    elif base_head is None:
+        lines.append("note: no usable last-good baseline — headline "
+                     "not checked")
+    return rc, lines

@@ -37,6 +37,7 @@ import threading
 
 from ..errors import DeadlineExceeded
 from ..obs.clock import monotonic
+from ..obs.perf import call_with_timeout
 from ..obs.recorder import get_recorder
 from ..obs.trace import span as obs_span
 
@@ -81,31 +82,9 @@ class Deadline(object):
         return monotonic() - self.t_start
 
 
-def call_with_timeout(fn, timeout):
-    """Run ``fn()`` on a daemon helper thread, waiting at most
-    ``timeout`` seconds.  Raises DeadlineExceeded on timeout — the stuck
-    thread is abandoned, not joined, because the whole point is that a
-    wedged device call may never return."""
-    box = {}
-    done = threading.Event()
-
-    def _run():
-        try:
-            box["result"] = fn()
-        except BaseException as e:     # noqa: BLE001 — re-raised below
-            box["error"] = e
-        finally:
-            done.set()
-
-    worker = threading.Thread(target=_run, name="mesh-tpu-serve-attempt",
-                              daemon=True)
-    worker.start()
-    if not done.wait(timeout=max(float(timeout), 0.0)):
-        raise DeadlineExceeded(
-            "rung call still running after %.3fs slice" % timeout)
-    if "error" in box:
-        raise box["error"]
-    return box["result"]
+# ``call_with_timeout`` now lives in obs/perf.py (the bench harness's
+# stage attempts share the same wedge-proof primitive) and is re-exported
+# here unchanged for the serving tier and its tests.
 
 
 class ServeResult(object):

@@ -1,0 +1,397 @@
+"""The staged bench pipeline's wedge contract (PR-6 tentpole).
+
+A hang in ANY stage class — the gate probe, a compile stage, a measure
+stage — must cost at most that stage's budget, preserve every completed
+stage's record in the partial file, dump exactly ONE ``bench_stage_hang``
+incident, and exit nonzero.  The fake-clock/fake-popen tests pin the
+orchestrator logic without real child processes; one end-to-end case
+runs the real ``python bench.py --stages probe,pallas_proxy`` under
+fault injection; ``reap_child`` is proven against a real
+SIGTERM-ignoring child; and ``perfcheck`` + ``tools/rotate_log.sh`` get
+their unit contracts.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from mesh_tpu.obs import perf as obs_perf  # noqa: E402
+
+
+class _FakeRecorder(object):
+    """Captures ring records and incident triggers."""
+
+    def __init__(self):
+        self.records = []
+        self.triggers = []
+
+    def record(self, kind, **fields):
+        self.records.append((kind, fields))
+
+    def trigger(self, reason, context=None, health=None, force=False):
+        self.triggers.append({"reason": reason, "context": context,
+                              "force": force})
+        return "/fake/incident.json"
+
+
+class _FakeProc(object):
+    """One scripted child: ``ok`` prints a JSON record, ``hang`` raises
+    TimeoutExpired from communicate() and dies to the first SIGTERM (so
+    reap_child resolves without waiting out real grace windows),
+    ``crash`` exits nonzero."""
+
+    def __init__(self, mode, record=None):
+        self.mode = mode
+        self.record = record or {}
+        self.returncode = None
+
+    def communicate(self, timeout=None):
+        if self.mode == "hang":
+            raise subprocess.TimeoutExpired(cmd="stage", timeout=timeout)
+        if self.mode == "crash":
+            self.returncode = 41
+            return ("", "boom\n")
+        self.returncode = 0
+        return (json.dumps(self.record) + "\n", "")
+
+    def poll(self):
+        return self.returncode
+
+    def terminate(self):
+        self.returncode = -15
+
+    def kill(self):
+        self.returncode = -9
+
+
+def _fake_popen(script):
+    """popen(argv, ...) -> the scripted _FakeProc for argv's stage name
+    (argv is [python, bench.py, --stage, <name>])."""
+
+    def popen(argv, **kwargs):
+        return script[argv[-1]]()
+
+    return popen
+
+
+def _specs(*rows):
+    return [obs_perf.StageSpec(name, ["py", "bench.py", "--stage", name],
+                               timeout_s, requires_backend=rb, gate=gate)
+            for name, timeout_s, rb, gate in rows]
+
+
+_PIPELINE = (
+    ("probe", 3.0, False, True),
+    ("warmup", 3.0, True, False),
+    ("closest_point", 3.0, True, False),
+    ("pallas_proxy", 3.0, False, False),
+)
+
+
+def _ok_proc(name):
+    rec = {"metric": name, "value": 1.0}
+    if name == "probe":
+        rec["backend_ok"] = True
+    return lambda: _FakeProc("ok", rec)
+
+
+@pytest.mark.parametrize("wedged", ["probe", "warmup", "closest_point"])
+def test_stage_hang_yields_partial_plus_one_incident(tmp_path, wedged):
+    """A hang in each stage class (gate probe / compile / measure) keeps
+    every earlier record, skips later backend stages, still runs the
+    backend-free proxy, dumps ONE incident, and never blocks — the whole
+    fake pipeline must finish in real seconds, far under the
+    stage-budget sum."""
+    script = {name: _ok_proc(name) for name, _, _, _ in _PIPELINE}
+    script[wedged] = lambda: _FakeProc("hang")
+    rec = _FakeRecorder()
+    partial = str(tmp_path / "bench_partial.json")
+
+    t0 = time.monotonic()
+    results = obs_perf.run_stages(
+        _specs(*_PIPELINE), partial, popen=_fake_popen(script),
+        recorder=rec)
+    wall = time.monotonic() - t0
+    assert wall < 10.0                  # fake children: no real waiting
+
+    order = [n for n, _, _, _ in _PIPELINE]
+    statuses = {n: results[n].status for n in order}
+    assert statuses[wedged] == "hung"
+    for name in order[:order.index(wedged)]:
+        assert statuses[name] == "ok"
+    for name in order[order.index(wedged) + 1:]:
+        if name == "pallas_proxy":
+            assert statuses[name] == "ok"       # backend-free: still runs
+        else:
+            assert statuses[name] == "skipped"
+
+    # exactly one incident, correctly tagged and forced
+    assert len(rec.triggers) == 1
+    trig = rec.triggers[0]
+    assert trig["reason"] == obs_perf.INCIDENT_REASON
+    assert trig["force"] is True
+    assert trig["context"]["stage"] == wedged
+    assert trig["context"]["partial_path"] == partial
+
+    # the partial file carries every completed stage's record
+    state = json.load(open(partial))
+    assert state["kind"] == "bench_partial"
+    assert state["order"] == order
+    for name in order:
+        assert state["stages"][name]["status"] == statuses[name]
+    for name in order[:order.index(wedged)]:
+        assert state["stages"][name]["record"]["metric"] == name
+
+
+def test_stage_crash_also_dumps_one_incident(tmp_path):
+    script = {name: _ok_proc(name) for name, _, _, _ in _PIPELINE}
+    script["closest_point"] = lambda: _FakeProc("crash")
+    rec = _FakeRecorder()
+    results = obs_perf.run_stages(
+        _specs(*_PIPELINE), str(tmp_path / "p.json"),
+        popen=_fake_popen(script), recorder=rec)
+    assert results["closest_point"].status == "crashed"
+    assert "exited 41" in results["closest_point"].error
+    # a crash is not a tunnel wedge: the proxy AND nothing else hung
+    assert results["pallas_proxy"].status == "ok"
+    assert len(rec.triggers) == 1
+    assert rec.triggers[0]["context"]["status"] == "crashed"
+
+
+def test_probe_reporting_unhealthy_gates_backend_stages(tmp_path):
+    """A probe that ANSWERS but reports backend_ok=false must gate the
+    backend stages exactly like a hung probe — and a clean gate dumps no
+    incident (nothing hung, nothing crashed)."""
+    script = {name: _ok_proc(name) for name, _, _, _ in _PIPELINE}
+    script["probe"] = lambda: _FakeProc(
+        "ok", {"metric": "probe", "backend_ok": False})
+    rec = _FakeRecorder()
+    results = obs_perf.run_stages(
+        _specs(*_PIPELINE), str(tmp_path / "p.json"),
+        popen=_fake_popen(script), recorder=rec)
+    assert results["probe"].status == "ok"
+    assert results["warmup"].status == "skipped"
+    assert results["closest_point"].status == "skipped"
+    assert results["pallas_proxy"].status == "ok"
+    assert rec.triggers == []
+
+
+def test_reap_child_escalates_past_sigterm_ignorer():
+    """Satellite: a probe child that ignores SIGTERM must still be fully
+    reaped (SIGKILL escalation), never leaked as the old
+    kill(); communicate(timeout=10) teardown could."""
+    proc = subprocess.Popen(
+        [sys.executable, "-u", "-c",
+         "import signal, time\n"
+         "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+         "print('armed', flush=True)\n"
+         "time.sleep(600)\n"],
+        stdout=subprocess.PIPE, text=True)
+    try:
+        assert proc.stdout.readline().strip() == "armed"
+        t0 = time.monotonic()
+        how = obs_perf.reap_child(proc, term_grace_s=0.5, kill_grace_s=10.0)
+        assert how == "killed"
+        assert time.monotonic() - t0 < 10.0
+        assert proc.poll() is not None      # dead AND reaped (no zombie)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.stdout.close()
+
+
+def test_reap_child_cooperative_terminate():
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(600)"])
+    how = obs_perf.reap_child(proc, term_grace_s=5.0, kill_grace_s=5.0)
+    assert how == "terminated"
+    assert proc.poll() is not None
+
+
+def test_staged_run_with_hung_probe_end_to_end(tmp_path):
+    """The ISSUE acceptance drill, real subprocesses end to end: a
+    fault-injected hung probe exits nonzero within the stage budgets,
+    persists partial results, dumps exactly one bench_stage_hang
+    incident, and the chip-free proxy metric is still FRESH."""
+    partial = str(tmp_path / "bench_partial.json")
+    incidents = str(tmp_path / "incidents")
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "MESH_TPU_BENCH_FAULT": "probe:hang",
+        "MESH_TPU_BENCH_TIMEOUT_PROBE": "3",
+        "MESH_TPU_BENCH_PARTIAL": partial,
+        "MESH_TPU_INCIDENT_DIR": incidents,
+    })
+    t0 = time.monotonic()
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py"),
+         "--stages", "probe,pallas_proxy"],
+        capture_output=True, text=True, env=env, timeout=150)
+    wall = time.monotonic() - t0
+    # budget sum: probe 3s (+reap) + proxy 120s; a pre-PR wedge was 150s+
+    # per attempt — the whole faulted run must come in far under that
+    assert wall < 120.0
+    assert out.returncode == 1, out.stderr[-2000:]
+
+    state = json.load(open(partial))
+    assert state["stages"]["probe"]["status"] == "hung"
+    assert state["stages"]["pallas_proxy"]["status"] == "ok"
+    proxy = state["stages"]["pallas_proxy"]["record"]
+    assert proxy["metric"] == "pallas_proxy_pair_tests"
+    assert proxy["value"] > 0
+
+    # the final stdout JSON line carries the fresh proxy despite the wedge
+    final = json.loads(
+        [ln for ln in out.stdout.splitlines()
+         if ln.strip().startswith("{")][-1])
+    assert final["proxy"]["value"] == proxy["value"]
+    assert final["bench_partial"] == partial
+
+    dumps = [f for f in os.listdir(incidents)
+             if "bench_stage_hang" in f and f.endswith(".json")]
+    assert len(dumps) == 1
+    inc = json.load(open(os.path.join(incidents, dumps[0])))
+    assert inc["reason"] == "bench_stage_hang"
+    assert inc["context"]["stage"] == "probe"
+
+
+# ---------------------------------------------------------------------------
+# perfcheck
+
+
+def _proxy_doc(value, flops=1000.0, stale=False, headline=None):
+    doc = {"metric": "batch256_smpl_normals_plus_closest_point",
+           "value": headline, "unit": "queries/sec", "vs_baseline": None,
+           "proxy": {"metric": "pallas_proxy_pair_tests", "value": value,
+                     "unit": "pair_tests/sec",
+                     "hlo_cost": {"flops": flops}}}
+    if stale:
+        doc.update(stale=True, stale_age_hours=12.0)
+    return doc
+
+
+_GOLDEN = {"metric": "pallas_proxy_pair_tests", "value": 1000.0,
+           "unit": "pair_tests/sec", "hlo_cost": {"flops": 1000.0}}
+
+
+def test_perfcheck_ok_within_bands():
+    rc, lines = obs_perf.perfcheck(_proxy_doc(900.0), proxy_golden=_GOLDEN)
+    assert rc == 0
+    assert any(ln.startswith("ok proxy") for ln in lines)
+
+
+def test_perfcheck_proxy_regression_fails():
+    rc, lines = obs_perf.perfcheck(_proxy_doc(400.0), proxy_golden=_GOLDEN)
+    assert rc == 1          # below the 50% floor
+    assert any(ln.startswith("FAIL proxy") for ln in lines)
+
+
+def test_perfcheck_missing_proxy_fails_when_golden_exists():
+    rc, lines = obs_perf.perfcheck(
+        {"metric": "m", "value": None}, proxy_golden=_GOLDEN)
+    assert rc == 1
+    assert any("no pallas_proxy record" in ln for ln in lines)
+
+
+def test_perfcheck_flops_ceiling_is_upward():
+    rc, _ = obs_perf.perfcheck(
+        _proxy_doc(1000.0, flops=500.0), proxy_golden=_GOLDEN)
+    assert rc == 0          # cheaper compile never fails
+    rc, lines = obs_perf.perfcheck(
+        _proxy_doc(1000.0, flops=1500.0), proxy_golden=_GOLDEN)
+    assert rc == 1
+    assert any("FAIL proxy HLO" in ln for ln in lines)
+
+
+def test_perfcheck_stale_headline_is_skipped_not_graded():
+    doc = _proxy_doc(1000.0, stale=True, headline=50.0)
+    rc, lines = obs_perf.perfcheck(
+        doc, baseline={"value": 10000.0}, proxy_golden=_GOLDEN)
+    assert rc == 0          # the stale 50.0 must NOT fail the floor
+    assert any("STALE" in ln for ln in lines)
+
+
+def test_perfcheck_fresh_headline_regression_fails():
+    doc = _proxy_doc(1000.0, headline=50.0)
+    rc, lines = obs_perf.perfcheck(
+        doc, baseline={"value": 10000.0}, proxy_golden=_GOLDEN)
+    assert rc == 1
+    assert any(ln.startswith("FAIL headline") for ln in lines)
+
+
+def test_perfcheck_reads_partial_shape():
+    doc = {"kind": "bench_partial", "schema_version": 1,
+           "stages": {
+               "probe": {"status": "hung"},
+               "pallas_proxy": {"status": "ok",
+                                "record": _GOLDEN.copy()}}}
+    rc, lines = obs_perf.perfcheck(doc, proxy_golden=_GOLDEN)
+    assert rc == 0
+    assert any(ln.startswith("ok proxy") for ln in lines)
+
+
+def test_perfcheck_cli_exit_codes(tmp_path):
+    """The CLI gate: rc 0 in-band, rc 1 on regression, rc 2 unreadable —
+    jax-free, so it must answer even with the platform forced empty."""
+    golden = tmp_path / "golden.json"
+    golden.write_text(json.dumps(_GOLDEN))
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(_proxy_doc(950.0)))
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(_proxy_doc(100.0)))
+
+    def run(path):
+        return subprocess.run(
+            [sys.executable, "-m", "mesh_tpu.cli", "perfcheck", str(path),
+             "--proxy-golden", str(golden)],
+            capture_output=True, text=True, cwd=_REPO)
+
+    ok = run(good)
+    assert ok.returncode == 0 and "perfcheck: OK" in ok.stdout
+    bad_run = run(bad)
+    assert bad_run.returncode == 1
+    assert "REGRESSION" in bad_run.stdout
+    missing = run(tmp_path / "nope.json")
+    assert missing.returncode == 2
+
+
+# ---------------------------------------------------------------------------
+# rotate_log.sh (PR-6 satellite: the watchdog cycle log can't grow forever)
+
+
+def _rotate(path, max_kb, keep):
+    return subprocess.run(
+        ["bash", os.path.join(_REPO, "tools", "rotate_log.sh"),
+         str(path), str(max_kb), str(keep)],
+        capture_output=True, text=True)
+
+
+def test_rotate_log_under_cap_is_untouched(tmp_path):
+    p = tmp_path / "cycle.md"
+    p.write_text("# log\nsmall\n")
+    assert _rotate(p, 1, 3).returncode == 0
+    assert p.read_text() == "# log\nsmall\n"
+    assert not (tmp_path / "cycle.md.1").exists()
+
+
+def test_rotate_log_keep_n_shift_drops_oldest(tmp_path):
+    p = tmp_path / "cycle.md"
+    for gen in ("one", "two", "three"):
+        p.write_text("# log\n" + gen * 800)       # > 1 KB
+        assert _rotate(p, 1, 2).returncode == 0
+    # keep=2: generation "one" fell off the end, "three" is now .1,
+    # and the live file was reseeded with a self-describing header
+    assert "three" in (tmp_path / "cycle.md.1").read_text()
+    assert not (tmp_path / "cycle.md.2").exists() or \
+        "one" not in (tmp_path / "cycle.md.2").read_text()
+    live = p.read_text()
+    assert live.startswith("# cycle.md (rotated ")
+    assert "rotate_log.sh" in live

@@ -104,13 +104,22 @@ def render_table(h):
             # a failed capture must read as a failure, not a null row
             lines.append("gate 2 (bench.py, %s): CAPTURE FAILED — %s" % (
                 b["mtime_utc"], b.get("error", "no value, no error recorded")))
-        else:
-            stale = " [STALE last-good record — tunnel was wedged]" \
-                if b.get("stale") else ""
+        elif b.get("stale"):
+            # a stale record is a republished last-good value, not a fresh
+            # measurement: render it as NOT an improvement so a wedged-run
+            # harvest can never stamp BASELINE.md with a fake new row
+            age = b.get("stale_age_hours")
             lines.append(
-                "gate 2 (bench.py, %s): %s %s  vs_baseline=%s%s" % (
+                "gate 2 (bench.py, %s): STALE last-good record — tunnel "
+                "was wedged; %s %s republished%s, vs_baseline=null — NOT "
+                "an improvement, not comparable with fresh rows" % (
                     b["mtime_utc"], b.get("value"), b.get("unit", ""),
-                    b.get("vs_baseline"), stale))
+                    " (age %sh)" % age if age is not None else ""))
+        else:
+            lines.append(
+                "gate 2 (bench.py, %s): %s %s  vs_baseline=%s" % (
+                    b["mtime_utc"], b.get("value"), b.get("unit", ""),
+                    b.get("vs_baseline")))
     for b in h.get("bench_variants", ()):
         if b.get("value") is None:
             lines.append(

@@ -1,0 +1,47 @@
+#!/bin/bash
+# Size-capped keep-N rotation for append-forever logs.
+#
+#   bash tools/rotate_log.sh <path> [max_kb] [keep]
+#
+# Mirrors the MESH_TPU_OBS_JSONL rotation semantics (jsonl_sink in
+# mesh_tpu/obs/trace.py): shift path.i -> path.(i+1) for i = keep-1..1,
+# then move the live file to path.1, oldest generation dropped.  A file
+# at or under the cap is left untouched, so calling this before every
+# append is cheap and idempotent.
+#
+# Defaults come from WATCHDOG_LOG_MAX_KB (256) and WATCHDOG_LOG_KEEP (3)
+# because the first caller is tools/tpu_watchdog.sh, whose cycle log
+# otherwise grows forever; the path/size/keep arguments keep it generic.
+# A rotated markdown log is reseeded with a short header so the live
+# file stays self-describing.
+
+set -u
+path=${1:?usage: rotate_log.sh <path> [max_kb] [keep]}
+max_kb=${2:-${WATCHDOG_LOG_MAX_KB:-256}}
+keep=${3:-${WATCHDOG_LOG_KEEP:-3}}
+
+[ -f "$path" ] || exit 0
+size_kb=$(( ($(wc -c < "$path") + 1023) / 1024 ))
+[ "$size_kb" -le "$max_kb" ] && exit 0
+
+i=$((keep - 1))
+while [ "$i" -ge 1 ]; do
+    [ -f "$path.$i" ] && mv -f "$path.$i" "$path.$((i + 1))"
+    i=$((i - 1))
+done
+mv -f "$path" "$path.1"
+
+case "$path" in
+    *.md)
+        {
+            echo "# $(basename "$path") (rotated $(date -u +%Y-%m-%dT%H:%M:%SZ))"
+            echo ""
+            echo "Older entries live in $(basename "$path").1 .. .$keep"
+            echo "(size-capped at ${max_kb} KB per generation by"
+            echo "tools/rotate_log.sh; oldest generation dropped)."
+        } > "$path"
+        ;;
+    *)
+        : > "$path"
+        ;;
+esac

@@ -26,7 +26,9 @@
 # failed, default 3600 — a deterministically red gate on a healthy tunnel
 # must not re-run the whole suite and commit every probe interval),
 # LOGDIR (gate logs, default /tmp/tpu_gates), WATCHDOG_ONESHOT=1 (exit
-# after the first completed gate cycle instead of re-arming).
+# after the first completed gate cycle instead of re-arming),
+# WATCHDOG_LOG_MAX_KB / WATCHDOG_LOG_KEEP (cycle-log rotation cap and
+# generations, default 256 KB x 3 — tools/rotate_log.sh).
 
 set -u
 cd "$(dirname "$0")/.."
@@ -69,6 +71,10 @@ run_cycle() {
     note "gate suite finished rc=$rc — harvesting"
     local harvest_rc=0
     python tools/harvest_gates.py --write "$LOGDIR" || harvest_rc=$?
+
+    # size-capped keep-N rotation (mirrors the MESH_TPU_OBS_JSONL sink's
+    # semantics) so an unattended loop can't grow the cycle log forever
+    bash tools/rotate_log.sh "$CYCLE_LOG"
 
     {
         echo ""
