@@ -30,10 +30,10 @@ bench:
 	@python bench.py
 
 perfcheck:
-	@echo "----- [ ${package_name} ] Chip-free perf gate (staged probe + CPU-interpreter proxy)"
+	@echo "----- [ ${package_name} ] Chip-free perf gate (staged probe + CPU proxies)"
 	@PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
 		MESH_TPU_BENCH_PARTIAL=/tmp/mesh_tpu_perfcheck_partial.json \
-		python bench.py --stages probe,pallas_proxy > /tmp/mesh_tpu_perfcheck_bench.json || true
+		python bench.py --stages probe,pallas_proxy,accel_proxy > /tmp/mesh_tpu_perfcheck_bench.json || true
 	@python -m mesh_tpu.cli perfcheck /tmp/mesh_tpu_perfcheck_bench.json
 
 proxy-golden:
@@ -41,6 +41,12 @@ proxy-golden:
 	@PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
 		python bench.py --stage pallas_proxy > benchmarks/proxy_golden.json
 	@cat benchmarks/proxy_golden.json
+
+accel-golden:
+	@echo "----- [ ${package_name} ] Recording the spatial-index CPU golden"
+	@PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+		python bench.py --stage accel_proxy > benchmarks/accel_golden.json
+	@cat benchmarks/accel_golden.json
 
 gates:
 	@bash tools/run_tpu_gates.sh
@@ -68,4 +74,4 @@ docs:
 clean:
 	@rm -rf build dist *.egg-info doc/_build
 
-.PHONY: all import_tests unit_tests tpu_tests tests bench perfcheck proxy-golden gates sweep sdist wheel documentation docs clean
+.PHONY: all import_tests unit_tests tpu_tests tests bench perfcheck proxy-golden accel-golden gates sweep sdist wheel documentation docs clean

@@ -951,6 +951,78 @@ def pallas_proxy_stage(n_rep=3):
     }
 
 
+def accel_proxy_stage(n_rep=1):
+    """Stage ``accel_proxy``: the chip-free spatial-index metric.  Walks
+    the flattened-BVH XLA traversal (mesh_tpu.accel) over a fixed
+    >=200k-face parametric sphere on CPU and reports the pair-tests-
+    skipped ratio ``1 - pair_tests / (Q * F)`` — the sub-linearity the
+    subsystem exists to buy, deterministic because mesh, queries, and
+    traversal are all fixed.  A checksum over the results pins
+    exactness (the traversal must stay bit-identical to the dense
+    reference), and a small interpret-mode run of the Pallas rope
+    kernel proves that code path still compiles and agrees without a
+    chip.  Mesh/query sizes are overridable for local iteration via
+    MESH_TPU_ACCEL_PROXY_FACES / MESH_TPU_ACCEL_PROXY_QUERIES."""
+    import jax
+    import jax.numpy as jnp
+
+    from mesh_tpu.accel.build import build_bvh
+    from mesh_tpu.accel.pallas_bvh import closest_point_pallas_bvh
+    from mesh_tpu.accel.traverse import bvh_closest_point
+    from mesh_tpu.query.autotune import _sphere_mesh
+    from mesh_tpu.sphere import _icosphere
+
+    n_faces = int(os.environ.get("MESH_TPU_ACCEL_PROXY_FACES", 210000))
+    n_q = int(os.environ.get("MESH_TPU_ACCEL_PROXY_QUERIES", 512))
+    v, f = _sphere_mesh(n_faces)
+    rng = np.random.RandomState(0)
+    pts = np.asarray(rng.randn(n_q, 3), np.float32)
+    index = build_bvh(v, f)
+
+    def run():
+        return bvh_closest_point(v, f, pts, index=index)
+
+    res = run()                                 # compile + reference
+    jax.block_until_ready(res["sqdist"])
+    checksum = float(jnp.sum(res["sqdist"]) + jnp.sum(res["point"]))
+    pair_tests = int(np.asarray(res["pair_tests"]).sum())
+    tight_frac = float(np.asarray(res["tight"]).mean())
+    best = np.inf
+    for _ in range(max(int(n_rep), 1)):
+        t0 = time.perf_counter()
+        out = run()
+        jax.block_until_ready((out["sqdist"], out["point"]))
+        best = min(best, time.perf_counter() - t0)
+    n_f = int(f.shape[0])
+    ratio = 1.0 - pair_tests / float(n_q * n_f)
+
+    # interpret-mode Pallas rope kernel on a small mesh: chip-free proof
+    # the TPU path still lowers and returns the same answers
+    vi, fi = _icosphere(2)
+    vi = np.asarray(vi, np.float32)
+    fi = np.asarray(fi, np.int32)
+    pts_i = np.asarray(rng.randn(128, 3) * 0.7, np.float32)
+    pall = closest_point_pallas_bvh(
+        vi, fi, pts_i, tile_q=64, tile_f=256, interpret=True)
+    pallas_checksum = float(
+        jnp.sum(pall["sqdist"]) + jnp.sum(pall["point"]))
+    return {
+        "metric": "accel_proxy_skip_ratio",
+        "value": round(ratio, 4),
+        "unit": "pair_tests_skipped_frac",
+        "vs_baseline": None,
+        "interpret": True,
+        "queries": n_q,
+        "faces": n_f,
+        "pair_tests": pair_tests,
+        "pair_tests_per_query": round(pair_tests / float(n_q), 1),
+        "tight_frac": round(tight_frac, 4),
+        "traverse_seconds": round(best, 3),
+        "checksum": round(checksum, 4),
+        "pallas_interpret_checksum": round(pallas_checksum, 4),
+    }
+
+
 #: declarative stage table: name -> (fn, default timeout_s,
 #: requires_backend, gate, extra child env).  Budgets bound a WEDGE —
 #: they are not measurements; override one with
@@ -972,6 +1044,11 @@ _STAGE_DEFS = OrderedDict((
     ("pallas_proxy", (pallas_proxy_stage, 120.0, False, False,
                       {"JAX_PLATFORMS": "cpu",
                        "PALLAS_AXON_POOL_IPS": ""})),
+    # same chip-free contract as pallas_proxy; the generous budget covers
+    # the ~200k-face XLA traversal under CPU lockstep vmap (~10s/rep)
+    ("accel_proxy", (accel_proxy_stage, 240.0, False, False,
+                     {"JAX_PLATFORMS": "cpu",
+                      "PALLAS_AXON_POOL_IPS": ""})),
 ))
 
 
@@ -1071,6 +1148,9 @@ def run_staged(names=None):
     proxy = results.get("pallas_proxy")
     if proxy is not None and proxy.ok:
         record["proxy"] = proxy.record
+    accel = results.get("accel_proxy")
+    if accel is not None and accel.ok:
+        record["accel"] = accel.record
     record["stages"] = OrderedDict(
         (n, r.to_json()) for n, r in results.items())
     record["bench_partial"] = partial_path

@@ -1,0 +1,50 @@
+"""TPU-resident spatial indexes for sub-linear mesh queries.
+
+The reference package's entire speed story is its CGAL AABB trees
+(spatialsearch / aabb_normals — PAPER.md section 1, L0); this package is
+the TPU-native equivalent: two interchangeable device-resident indexes
+over triangle bounds, built host-side (numpy, jit-free) once per
+topology and traversed with fixed-shape XLA / Pallas kernels.
+
+- ``build.py`` — Morton-ordered flattened LBVH (contiguous int32 node
+  arrays in the child/skip "stackless rope" layout — no pointers) and a
+  uniform grid (cell->face CSR plus a fixed-capacity dense table), each
+  a frozen ``AccelIndex`` pytree keyed by a topology digest so the
+  engine plan cache can treat it as a compile-time constant companion.
+- ``traverse.py`` — XLA (gather + ``lax.while_loop``) stackless rope
+  traversal and the 27-cell grid probe, both carrying the conservative
+  ``tight[q]`` certificate so results stay exact-by-fallback, plus the
+  ``closest_faces_and_points_accel`` host facade auto consults.
+- ``pallas_bvh.py`` — the Pallas kernel that walks the same rope layout
+  per query *tile* (SMEM node metadata, VMEM-resident face planes).
+
+See doc/acceleration.md.
+"""
+
+from .build import (       # noqa: F401  (numpy-only, cheap import)
+    AccelIndex,
+    build_bvh,
+    build_grid,
+    clear_index_cache,
+    get_index,
+    index_cache_info,
+    topology_digest,
+)
+
+__all__ = [
+    "AccelIndex", "build_bvh", "build_grid", "get_index",
+    "clear_index_cache", "index_cache_info", "topology_digest",
+    "closest_faces_and_points_accel", "bvh_closest_point",
+    "grid_closest_point",
+]
+
+
+def __getattr__(name):
+    # traversal imports jax; keep the package importable (and the builder
+    # usable) without touching a backend
+    if name in ("closest_faces_and_points_accel", "bvh_closest_point",
+                "grid_closest_point"):
+        from . import traverse
+
+        return getattr(traverse, name)
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))

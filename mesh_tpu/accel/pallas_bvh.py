@@ -1,0 +1,208 @@
+"""Pallas TPU kernel: stackless rope traversal per query tile.
+
+Per-query pointer chasing is Mosaic-hostile (scalar gathers, divergent
+loops), so the kernel walks the SAME flattened rope layout build.py
+emits, but at *tile* granularity: the BVH is built with
+``leaf_size = tile_f`` so every leaf is one contiguous Morton block of
+``tile_f`` faces, the node metadata (AABB + skip + leaf start — a few
+hundred nodes even at the VMEM face ceiling) lives in SMEM for the
+scalar control flow, and a leaf visit runs the shared 19-plane Ericson
+tile (pallas_closest) on a dynamically sliced ``(tile_q, tile_f)``
+block of the VMEM-resident face planes.
+
+Each query tile carries its running-best accumulator through a
+``lax.while_loop``; a node is pruned when the tile's *closest* query is
+provably farther than the tile's *worst* running best (margin-shrunk,
+so f32 rounding keeps the bound conservative — the same argument as
+pallas_culled, whose seed construction this kernel reuses).  Results
+equal the brute kernel up to distance ties; no certificate/fallback
+pass is needed.
+
+VMEM ceiling: the face planes are fully resident (19 rows x Fp f32),
+so the kernel serves meshes up to ``traverse.PALLAS_BVH_MAX_FACES``;
+above that the facade routes the XLA traversal even on TPU.
+DMA-streamed leaf blocks are future work (doc/acceleration.md).
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .build import get_index
+from ..query.pallas_closest import N_FACE_ROWS, _sqdist_tile_fast, \
+    fast_tile_rows
+from ..query.pallas_culled import _MARGIN, _pad_rows_edge, _tile_spheres
+from ..query.point_triangle import closest_point_on_triangle
+from ..utils.jax_compat import tpu_compiler_params
+
+__all__ = ["closest_point_pallas_bvh"]
+
+_SEED_SUB = 128     # sub-block size for the seed upper bound
+
+
+def _make_rope_kernel(tile_q, tile_f, n_nodes):
+    def kernel(qx, qy, qz, seed, boxes, topo, rows, out_d, out_i, out_lv):
+        px, py, pz = qx[...], qy[...], qz[...]          # (TQ, 1)
+
+        def cond(carry):
+            return carry[0] < n_nodes
+
+        def body(carry):
+            node, acc_d, acc_i, leaves = carry
+            dx = jnp.maximum(
+                jnp.maximum(boxes[node, 0] - px, px - boxes[node, 3]), 0.0)
+            dy = jnp.maximum(
+                jnp.maximum(boxes[node, 1] - py, py - boxes[node, 4]), 0.0)
+            dz = jnp.maximum(
+                jnp.maximum(boxes[node, 2] - pz, pz - boxes[node, 5]), 0.0)
+            lb2 = jnp.min(dx * dx + dy * dy + dz * dz)  # tile lower bound
+            prune = lb2 * (1.0 - _MARGIN) > jnp.max(acc_d)
+            skip_to = topo[node, 0]
+            leaf_start = topo[node, 1]
+            is_leaf = leaf_start >= 0
+            take = jnp.logical_and(is_leaf, jnp.logical_not(prune))
+
+            def visit(args):
+                ad, ai = args
+                planes = [
+                    pl.load(rows, (pl.ds(k, 1), pl.ds(leaf_start, tile_f)))
+                    for k in range(N_FACE_ROWS)
+                ]
+                d2 = _sqdist_tile_fast(px, py, pz, *planes)  # (TQ, TF)
+                tile_min = jnp.min(d2, axis=1, keepdims=True)
+                tile_arg = (jnp.argmin(d2, axis=1).astype(jnp.int32)[:, None]
+                            + leaf_start)
+                better = tile_min < ad
+                return (jnp.where(better, tile_min, ad),
+                        jnp.where(better, tile_arg, ai))
+
+            acc_d, acc_i = jax.lax.cond(
+                take, visit, lambda args: args, (acc_d, acc_i))
+            leaves = leaves + jnp.where(take, 1, 0)
+            node = jnp.where(jnp.logical_or(prune, is_leaf),
+                             skip_to, node + 1)
+            return node, acc_d, acc_i, leaves
+
+        _node, acc_d, acc_i, leaves = jax.lax.while_loop(
+            cond, body,
+            (jnp.int32(0), seed[...],
+             jnp.zeros((tile_q, 1), jnp.int32), jnp.int32(0)))
+        out_d[...] = acc_d
+        out_i[...] = acc_i
+        out_lv[0, 0] = leaves
+
+    return kernel
+
+
+@partial(jax.jit, static_argnames=("tile_q", "tile_f", "interpret"))
+def _pallas_bvh_run(v32, f, pts32, order_p, node_lo, node_hi, node_skip,
+                    node_leaf, center_b, tile_q, tile_f, interpret):
+    vc = v32 - center_b                        # bitwise the builder's frame
+    pts = pts32 - center_b
+    n_q = pts.shape[0]
+    tri_s = vc[f][order_p]                     # (Fp, 3, 3), Morton order
+    f_pad = tri_s.shape[0]
+    n_nodes = node_skip.shape[0]
+
+    # query Morton sort for tile compactness + the sub-block sphere seed
+    # (both straight from pallas_culled's prologue recipe)
+    from ..query.pallas_culled import _morton_codes
+
+    qorder = jnp.argsort(_morton_codes(pts))
+    pts_s = _pad_rows_edge(pts[qorder], tile_q)
+    q_pad = pts_s.shape[0]
+    corners = tri_s.reshape(-1, 3)
+    sub = _SEED_SUB if f_pad % _SEED_SUB == 0 else tile_f
+    sc, sr = _tile_spheres(corners, sub * 3)
+    seed = (jnp.min(
+        jnp.sqrt(jnp.sum((pts_s[:, None, :] - sc[None]) ** 2, axis=-1))
+        + sr[None], axis=1) ** 2 * (1.0 + _MARGIN) + 1e-12)[:, None]
+
+    boxes = jnp.concatenate([node_lo, node_hi], axis=1)       # (N, 6)
+    topo = jnp.stack(
+        [node_skip,
+         jnp.where(node_leaf >= 0, node_leaf * tile_f, -1)],
+        axis=1).astype(jnp.int32)                             # (N, 2)
+    rows = jnp.stack(fast_tile_rows(tri_s), axis=0)           # (19, Fp)
+
+    n_tiles = q_pad // tile_q
+    qcol = pl.BlockSpec((tile_q, 1), lambda i: (i, 0))
+    full = lambda shape: pl.BlockSpec(shape, lambda i: (0, 0))  # noqa: E731
+    smem_full = lambda shape: pl.BlockSpec(                     # noqa: E731
+        shape, lambda i: (0, 0), memory_space=pltpu.SMEM)
+
+    out_d, out_i, out_lv = pl.pallas_call(
+        _make_rope_kernel(tile_q, tile_f, n_nodes),
+        grid=(n_tiles,),
+        in_specs=[
+            qcol, qcol, qcol, qcol,
+            smem_full(boxes.shape),
+            smem_full(topo.shape),
+            full(rows.shape),
+        ],
+        out_specs=[
+            pl.BlockSpec((tile_q, 1), lambda i: (i, 0)),
+            pl.BlockSpec((tile_q, 1), lambda i: (i, 0)),
+            pl.BlockSpec((1, 1), lambda i: (i, 0),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((q_pad, 1), jnp.float32),
+            jax.ShapeDtypeStruct((q_pad, 1), jnp.int32),
+            jax.ShapeDtypeStruct((n_tiles, 1), jnp.int32),
+        ],
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(pts_s[:, 0:1], pts_s[:, 1:2], pts_s[:, 2:3], seed, boxes, topo, rows)
+
+    # sorted-face position -> original face id, sorted-query order ->
+    # caller order, exact recompute on the winner (pallas_culled epilogue)
+    inv = jnp.argsort(qorder)
+    best = order_p[out_i[:, 0]][inv][:n_q]
+    tri = vc[f]
+    a, b, c = tri[:, 0], tri[:, 1], tri[:, 2]
+    point, sqd, part = closest_point_on_triangle(
+        pts[:n_q], a[best], b[best], c[best])
+    # per-query pair-test count at tile granularity: each leaf visit of a
+    # query's tile ran tile_f exact tests for every query in the tile
+    pairs = jnp.repeat(out_lv[:, 0] * tile_f, tile_q)[inv][:n_q]
+    return {
+        "face": best.astype(jnp.int32),
+        "part": part,
+        "point": point + center_b,
+        "sqdist": sqd,
+        "tight": jnp.ones((n_q,), bool),
+        "pair_tests": pairs.astype(jnp.int32),
+    }
+
+
+def closest_point_pallas_bvh(v, f, points, tile_q=128, tile_f=256,
+                             interpret=False, index=None):
+    """Closest point via the Pallas rope kernel.  Same result contract
+    as ``closest_point_pallas_culled`` (exact up to distance ties) plus
+    the accel keys ``tight`` (all True — the bounds are conservative by
+    construction) and ``pair_tests``.
+
+    The coarse BVH (``leaf_size = tile_f``) comes from the same
+    digest-keyed ``get_index`` cache as the XLA traversal, so repeated
+    queries against one topology pay the host build once.
+    """
+    v32 = np.asarray(v, np.float32)
+    f32 = np.asarray(f, np.int32)
+    pts32 = np.asarray(points, np.float32).reshape(-1, 3)
+    if index is None:
+        index = get_index(v32, f32, kind="bvh", leaf_size=int(tile_f))
+    elif int(index.meta["leaf_size"]) != int(tile_f):
+        raise ValueError(
+            "pallas rope kernel needs leaf_size == tile_f (index has %s, "
+            "tile_f=%s)" % (index.meta["leaf_size"], tile_f))
+    arr = index.arrays
+    return _pallas_bvh_run(
+        v32, f32, pts32, arr["order"], arr["node_lo"], arr["node_hi"],
+        arr["node_skip"], arr["node_leaf"], arr["center"],
+        tile_q=int(tile_q), tile_f=int(tile_f), interpret=bool(interpret))

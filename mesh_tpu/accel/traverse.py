@@ -1,0 +1,360 @@
+"""XLA traversal of the flattened indexes (gather + ``lax.while_loop``).
+
+``_bvh_query`` walks the stackless rope per query: descending into a
+surviving node is ``node + 1``, pruning (or finishing a leaf) is
+``node = skip[node]``, and the loop carries the running best squared
+distance so deeper subtrees are pruned against an ever-tightening
+bound.  ``_grid_query`` probes the 3x3x3 cell neighborhood of each
+query through the fixed-capacity dense table.
+
+Exactness is the same two-layer contract the culled path established
+(query/culled.py):
+
+1. Bounds are *conservative*: box/block lower bounds are shrunk by the
+   index's scene-relative ``prune_slack`` before comparison, so float32
+   rounding can never prune a subtree (or trust a block) holding a true
+   winner or an exact tie.  Inside the searched set, per-pair distances
+   and the winner recompute use the identical arithmetic — same
+   centering, same ``closest_point_barycentric`` composition, same
+   lowest-face-id tie resolution as the dense argmin — so a tight query
+   returns the dense reference's answer bit for bit.
+2. Every query carries a certificate: ``tight[q]`` is False when the
+   result could not be proven optimal (grid: the best distance reaches
+   the searched-block boundary, or a touched cell overflowed its
+   capacity; BVH: the step-cap safety valve tripped).  The facade
+   re-runs loose queries through the exact dense path and counts them
+   in ``mesh_tpu_query_certificate_fallback_total`` — exact-by-fallback,
+   like ``closest_faces_and_points_auto``.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .build import get_index
+from ..query.closest_point import _pad_to_multiple, closest_faces_and_points
+from ..query.point_triangle import (
+    closest_point_barycentric,
+    closest_point_on_triangle,
+)
+
+__all__ = [
+    "bvh_closest_point", "grid_closest_point", "bvh_search_faces",
+    "closest_faces_and_points_accel", "PALLAS_BVH_MAX_FACES",
+]
+
+#: above this face count the Pallas rope kernel's fully VMEM-resident
+#: face planes stop fitting (19 rows x Fp f32 ~ 76 B/face against ~16 MB
+#: of VMEM with headroom for accumulators); larger meshes take the XLA
+#: traversal even on TPU.  DMA-streamed leaves are future work
+#: (doc/acceleration.md).
+PALLAS_BVH_MAX_FACES = 65536
+
+_INT_MAX = np.int32(np.iinfo(np.int32).max)
+
+_PAIR_COUNTER = None
+
+
+def _record_pair_tests(n, kind):
+    """Count exact point-triangle pair tests the accel path actually ran
+    (``mesh_tpu_accel_pair_tests_total{kind=}``) — the number whose
+    sub-linearity vs brute Q*F is the whole point of the subsystem."""
+    global _PAIR_COUNTER
+    if _PAIR_COUNTER is None:
+        from ..obs.metrics import REGISTRY
+
+        _PAIR_COUNTER = REGISTRY.counter(
+            "mesh_tpu_accel_pair_tests_total",
+            "exact pair tests run by the accel traversal (label: kind)")
+    _PAIR_COUNTER.inc(int(n), kind=kind)
+
+
+def _dense_frame(v, f, points):
+    """The dense reference's exact conditioning (closest_point.py):
+    caller dtype, mesh-centered.  Reproduced operation-for-operation so
+    in-frame arithmetic matches the brute path bit for bit."""
+    v = jnp.asarray(v)
+    points = jnp.asarray(points, dtype=v.dtype)
+    center = jnp.mean(v, axis=0)
+    vc = v - center
+    pts = points - center
+    tri = vc[f]
+    return vc, pts, center, tri[:, 0], tri[:, 1], tri[:, 2]
+
+
+def _pair_sq(p, ag, bg, cg):
+    """Composed barycentric squared distance for one query against a
+    gathered face set — elementwise-identical to the dense one_tile
+    selection arithmetic (same ops in the same order per pair)."""
+    bary, _ = closest_point_barycentric(p[None, :], ag, bg, cg)
+    cp = (bary[..., 0:1] * ag + bary[..., 1:2] * bg + bary[..., 2:3] * cg)
+    diff = p[None, :] - cp
+    return jnp.sum(diff * diff, axis=-1)
+
+
+@partial(jax.jit, static_argnames=("leaf_size",))
+def _bvh_query(v, f, points, order_p, node_lo, node_hi, node_skip,
+               node_leaf, center_b, slack, leaf_size):
+    """Stackless rope traversal, vmapped over queries.
+
+    Pruning runs in the index's build frame (f32, ``center_b``); exact
+    leaf tests and the winner recompute run in the dense frame, with
+    ties resolved to the lowest original face id — the same winner the
+    dense argmin's first-minimum picks.
+    """
+    vc, pts, center, a, b, c = _dense_frame(v, f, points)
+    q32 = jnp.asarray(points, jnp.float32) - center_b
+    n_nodes = node_skip.shape[0]
+    inf = jnp.array(jnp.inf, dtype=pts.dtype)
+    big = jnp.asarray(_INT_MAX)
+
+    def one(p, pb):
+        def cond(state):
+            node, _bs, _bf, steps, _pairs = state
+            return (node < n_nodes) & (steps <= n_nodes)
+
+        def body(state):
+            node, best_sq, best_fid, steps, pairs = state
+            gap = jnp.maximum(
+                jnp.maximum(node_lo[node] - pb, pb - node_hi[node]), 0.0)
+            dbox = jnp.sqrt(jnp.sum(gap * gap))
+            lb2 = jnp.maximum(dbox - slack, 0.0) ** 2
+            prune = lb2.astype(best_sq.dtype) > best_sq
+            leaf = node_leaf[node]
+            is_leaf = leaf >= 0
+
+            def visit(args):
+                bs, bf = args
+                ids = jax.lax.dynamic_slice(
+                    order_p, (leaf * leaf_size,), (leaf_size,))
+                sq = _pair_sq(p, a[ids], b[ids], c[ids])
+                dmin = jnp.min(sq)
+                fmin = jnp.min(jnp.where(sq == dmin, ids, big))
+                better = (dmin < bs) | ((dmin == bs) & (fmin < bf))
+                return (jnp.where(better, dmin, bs),
+                        jnp.where(better, fmin, bf))
+
+            test = is_leaf & ~prune
+            best_sq, best_fid = jax.lax.cond(
+                test, visit, lambda args: args, (best_sq, best_fid))
+            pairs = pairs + jnp.where(test, np.int32(leaf_size), 0)
+            node = jnp.where(prune | is_leaf, node_skip[node], node + 1)
+            return node, best_sq, best_fid, steps + 1, pairs
+
+        node, _best_sq, best_fid, steps, pairs = jax.lax.while_loop(
+            cond, body,
+            (jnp.int32(0), inf, big, jnp.int32(0), jnp.int32(0)))
+        # the rope visits each node at most once, so the walk always
+        # reaches the sentinel within n_nodes steps; the cap is a safety
+        # valve against a corrupted index, surfaced as a loose certificate
+        return best_fid, steps, pairs, node >= n_nodes
+
+    best, steps, pairs, tight = jax.vmap(one)(pts, q32)
+    best = jnp.where(best == big, 0, best).astype(jnp.int32)
+    return {"face": best, "tight": tight, "pair_tests": pairs,
+            "steps": steps}
+
+
+@partial(jax.jit, static_argnames=("res", "cap", "chunk"))
+def _grid_query(v, f, points, cell_table, cell_count, grid_lo, width,
+                center_b, slack, res, cap, chunk=256):
+    """27-cell neighborhood probe through the dense capacity table.
+
+    ``tight[q]`` iff a candidate was found, no touched cell overflowed
+    its capacity, and the best distance stays ``slack`` short of the
+    searched-block boundary (block sides on the grid hull count as open:
+    no face lies beyond the hull by construction).
+    """
+    vc, pts, center, a, b, c = _dense_frame(v, f, points)
+    q32 = jnp.asarray(points, jnp.float32) - center_b
+    big = jnp.asarray(_INT_MAX)
+    offs = jnp.asarray(
+        [[i, j, k] for i in (-1, 0, 1) for j in (-1, 0, 1)
+         for k in (-1, 0, 1)], dtype=jnp.int32)
+
+    def one(p, pb):
+        cell = jnp.clip(
+            jnp.floor((pb - grid_lo) / width).astype(jnp.int32), 0, res - 1)
+        cells = cell[None, :] + offs                       # (27, 3)
+        valid = jnp.all((cells >= 0) & (cells < res), axis=1)
+        cl = jnp.clip(cells, 0, res - 1)
+        cid = (cl[:, 0] * res + cl[:, 1]) * res + cl[:, 2]
+        ids = jnp.where(
+            valid[:, None], cell_table[cid], -1).reshape(-1)  # (27 * cap,)
+        found_mask = ids >= 0
+        safe_ids = jnp.where(found_mask, ids, 0)
+        sq = _pair_sq(p, a[safe_ids], b[safe_ids], c[safe_ids])
+        sq = jnp.where(found_mask, sq, jnp.inf)
+        dmin = jnp.min(sq)
+        found = jnp.isfinite(dmin)
+        fmin = jnp.min(jnp.where(sq == dmin, ids, big))
+        overflow = jnp.any(valid & (cell_count[cid] > cap))
+        # searched-block boundary distance (build frame)
+        blo = grid_lo + jnp.maximum(cell - 1, 0).astype(width.dtype) * width
+        bhi = grid_lo + (jnp.minimum(cell + 1, res - 1) + 1).astype(
+            width.dtype) * width
+        gap_lo = jnp.where(cell - 1 <= 0, jnp.inf, pb - blo)
+        gap_hi = jnp.where(cell + 1 >= res - 1, jnp.inf, bhi - pb)
+        bdist = jnp.minimum(jnp.min(gap_lo), jnp.min(gap_hi))
+        tight = found & ~overflow & (
+            jnp.sqrt(dmin).astype(jnp.float32) <= bdist - slack)
+        best = jnp.where(found & (fmin != big), fmin, 0)
+        return best, tight, jnp.sum(found_mask.astype(jnp.int32))
+
+    padded, n_q = _pad_to_multiple(pts, chunk, axis=0)
+    padded32, _ = _pad_to_multiple(q32, chunk, axis=0)
+    best, tight, pairs = jax.lax.map(
+        lambda tp: jax.vmap(one)(tp[0], tp[1]),
+        (padded.reshape(-1, chunk, 3), padded32.reshape(-1, chunk, 3)))
+    best = best.reshape(-1)[:n_q].astype(jnp.int32)
+    tight = tight.reshape(-1)[:n_q]
+    pairs = pairs.reshape(-1)[:n_q]
+    return {"face": best, "tight": tight, "pair_tests": pairs}
+
+
+@jax.jit
+def _winner_eval(p_c, ag, bg, cg, center):
+    """Winner recompute in ITS OWN jit.  Fused into the traversal jit,
+    XLA's FMA-contraction choices differ from the dense reference's
+    compiled recompute by the last ulp of ``point``; compiled standalone
+    over the gathered winners it reproduces the dense outputs bit for
+    bit (tests/test_accel.py pins this)."""
+    pt, sq, part = closest_point_on_triangle(p_c, ag, bg, cg)
+    return pt + center, sq, part
+
+
+def _core_search(index, v, f, points):
+    """Run the jitted traversal core -> face/tight/pair_tests dict."""
+    arr, meta = index.arrays, index.meta
+    slack = jnp.float32(meta["prune_slack"])
+    if index.kind == "bvh":
+        return _bvh_query(
+            v, jnp.asarray(f, jnp.int32), points, arr["order"],
+            arr["node_lo"], arr["node_hi"], arr["node_skip"],
+            arr["node_leaf"], arr["center"], slack,
+            leaf_size=int(meta["leaf_size"]))
+    return _grid_query(
+        v, jnp.asarray(f, jnp.int32), points, arr["cell_table"],
+        arr["cell_count"], arr["grid_lo"], arr["width"], arr["center"],
+        slack, res=int(meta["res"]), cap=int(meta["cap"]))
+
+
+def _run_index(index, v, f, points):
+    """Traversal core + dense-grade winner evaluation (full dict)."""
+    out = dict(_core_search(index, v, f, points))
+    vc, pts, center, a, b, c = _dense_frame(v, f, points)
+    best = out["face"]
+    pt, sqd, part = _winner_eval(pts, a[best], b[best], c[best], center)
+    out.update(point=pt, sqdist=sqd, part=part)
+    return out
+
+
+def bvh_closest_point(v, f, points, index=None, leaf_size=None):
+    """BVH traversal against (an optionally prebuilt) index.  Returns
+    the full result dict INCLUDING ``tight`` / ``pair_tests`` — callers
+    that need the exact-by-fallback contract use the facade below."""
+    if index is None:
+        params = {} if leaf_size is None else {"leaf_size": int(leaf_size)}
+        index = get_index(v, f, kind="bvh", **params)
+    return _run_index(index, v, f, points)
+
+
+def grid_closest_point(v, f, points, index=None):
+    """Uniform-grid probe; same contract as :func:`bvh_closest_point`."""
+    if index is None:
+        index = get_index(v, f, kind="grid")
+    return _run_index(index, v, f, points)
+
+
+def bvh_search_faces(index, v, f, points):
+    """Winning-face-only BVH search, jit-compatible end to end (the
+    index arrays are ordinary pytree inputs, the build happened on the
+    host beforehand).  This is the hook diff/queries.py routes its
+    AD-opaque correspondence search through: the envelope VJPs only
+    consume the argmin ``face``, so the certificate stays an interior
+    detail — the walk is exact whenever it completes, and the step-cap
+    valve never trips on a well-formed index (doc/acceleration.md,
+    differentiability caveats)."""
+    if index.kind != "bvh":
+        raise ValueError(
+            "bvh_search_faces wants a 'bvh' index, got %r" % index.kind)
+    return _core_search(index, v, f, points)["face"]
+
+
+def closest_faces_and_points_accel(v, f, points, kind=None, index=None,
+                                   with_stats=False):
+    """Index-accelerated exact closest point — the ``accel`` strategy of
+    ``closest_faces_and_points_auto``.  Host-boundary function (numpy
+    out), exact-by-fallback: loose-certificate queries re-run through
+    the dense brute path, so results match it bit for bit.
+
+    On TPU a BVH small enough for VMEM-resident face planes runs the
+    Pallas rope kernel (pallas_bvh.py, exact up to distance ties like
+    the other Pallas paths); everything else — and every CPU run —
+    takes the XLA ``lax.while_loop`` traversal.
+
+    :param kind: ``"bvh"`` / ``"grid"``; default $MESH_TPU_ACCEL_KIND
+        else bvh.
+    :param index: a prebuilt :class:`AccelIndex` (skips the digest-cache
+        lookup entirely).
+    :param with_stats: also return ``{"pair_tests", "fallback",
+        "tight_frac", "kind", "backend"}``.
+    """
+    from ..obs.trace import span as obs_span
+    from ..utils.dispatch import accel_kind, no_engine, pallas_default
+
+    if kind is None:
+        kind = index.kind if index is not None else accel_kind()
+    f_np = np.asarray(f)
+    n_faces = int(f_np.shape[0])
+    n_queries = int(np.asarray(points).reshape(-1, 3).shape[0])
+    if index is None:
+        if no_engine():
+            index = get_index(v, f_np, kind=kind)
+        else:
+            from ..engine.planner import get_planner
+
+            index = get_planner().accel_companion(v, f_np, kind=kind)
+    backend = "xla"
+    with obs_span("accel.traverse", kind=kind, faces=n_faces,
+                  queries=n_queries) as sp:
+        if (kind == "bvh" and pallas_default()
+                and n_faces <= PALLAS_BVH_MAX_FACES):
+            from .pallas_bvh import closest_point_pallas_bvh
+
+            backend = "pallas"
+            res = closest_point_pallas_bvh(
+                np.asarray(v, np.float32), f_np.astype(np.int32),
+                np.asarray(points, np.float32).reshape(-1, 3))
+        else:
+            res = _run_index(index, v, f_np, points)
+        out = {key: np.asarray(val) for key, val in res.items()}
+        tight = out.pop("tight")
+        pairs = int(out.pop("pair_tests").sum())
+        out.pop("steps", None)
+        loose = np.nonzero(~tight)[0]
+        sp.set(backend=backend, pair_tests=pairs, fallback=int(loose.size))
+    _record_pair_tests(pairs, kind)
+    if loose.size:
+        from ..query.culled import _record_fallback
+
+        _record_fallback(loose.size)
+        fix = closest_faces_and_points(
+            v, f_np, np.asarray(points).reshape(-1, 3)[loose])
+        for key in ("face", "part", "sqdist"):
+            out[key] = out[key].copy()
+            out[key][loose] = np.asarray(fix[key])
+        out["point"] = out["point"].copy()
+        out["point"][loose] = np.asarray(fix["point"])
+    if with_stats:
+        stats = {
+            "pair_tests": pairs,
+            "fallback": int(loose.size),
+            "tight_frac": float(tight.mean()) if tight.size else 1.0,
+            "kind": kind,
+            "backend": backend,
+        }
+        return out, stats
+    return out

@@ -419,10 +419,16 @@ def cmd_perfcheck(args):
         args.proxy_golden or os.path.join(repo_root, "benchmarks",
                                           "proxy_golden.json"),
         "proxy golden")
+    accel_golden = _load_optional(
+        args.accel_golden or os.path.join(repo_root, "benchmarks",
+                                          "accel_golden.json"),
+        "accel golden")
     rc, lines = perfcheck(doc, baseline=baseline, proxy_golden=golden,
                           proxy_tol=args.proxy_tol,
                           headline_tol=args.headline_tol,
-                          flops_tol=args.flops_tol)
+                          flops_tol=args.flops_tol,
+                          accel_golden=accel_golden,
+                          accel_tol=args.accel_tol)
     if args.json:
         json.dump({"rc": rc, "lines": lines}, sys.stdout, indent=2)
         sys.stdout.write("\n")
@@ -565,6 +571,13 @@ def main():
     p_perf.add_argument("--flops-tol", type=float, default=0.25,
                         help="allowed fractional HLO cost-model FLOPs "
                              "growth vs the golden (default 0.25)")
+    p_perf.add_argument("--accel-golden", default=None,
+                        help="accel-proxy golden record (default: repo "
+                             "benchmarks/accel_golden.json)")
+    p_perf.add_argument("--accel-tol", type=float, default=0.05,
+                        help="allowed fractional drop of the accel "
+                             "pair-tests-skipped ratio vs the golden "
+                             "(default 0.05: the ratio is deterministic)")
     p_perf.add_argument("--json", action="store_true",
                         help="machine-readable {rc, lines} instead of the "
                              "summary")

@@ -143,7 +143,8 @@ def _from_face(v, f, face, points, mode):
 
 
 def closest_point(v, f, points, *, mode="frozen", chunk=512,
-                  use_pallas=None, nondegen=False, variant="fast"):
+                  use_pallas=None, nondegen=False, variant="fast",
+                  accel_index=None):
     """Differentiable closest-point-on-surface query.
 
     Forward runs the shared Pallas-vs-XLA dispatch body
@@ -160,6 +161,14 @@ def closest_point(v, f, points, *, mode="frozen", chunk=512,
     :param nondegen: ``assume_nondegenerate`` for the Pallas tile
     :param variant: Pallas tile variant (``MESH_TPU_SAFE_TILES`` callers
         pass ``"safe"``)
+    :param accel_index: a prebuilt BVH :class:`~mesh_tpu.accel.AccelIndex`
+        (``mesh_tpu.accel.get_index(v, f, "bvh")`` — topology must match
+        ``f``): the AD-opaque search walks the index instead of scanning
+        all F faces, sub-linear for large meshes.  The VJPs only consume
+        the winning face, so gradients are unchanged.  BVH only — a grid
+        index is rejected (its loose-certificate fallback is a host-side
+        re-run, which a jit-compatible search cannot perform;
+        doc/acceleration.md, differentiability caveats).
     :returns: dict with ``point`` [Q, 3], ``sqdist`` [Q], ``bary`` [Q, 3],
         ``face`` [Q] int32, ``part`` [Q] int32
     """
@@ -169,10 +178,16 @@ def closest_point(v, f, points, *, mode="frozen", chunk=512,
     if use_pallas is None:
         use_pallas = pallas_default()
 
-    def search(v_, pts_):
-        res = closest_point_dispatch(v_, f, pts_, chunk, use_pallas,
-                                     nondegen, variant)
-        return res["face"]
+    if accel_index is not None:
+        from ..accel.traverse import bvh_search_faces
+
+        def search(v_, pts_):
+            return bvh_search_faces(accel_index, v_, f, pts_)
+    else:
+        def search(v_, pts_):
+            res = closest_point_dispatch(v_, f, pts_, chunk, use_pallas,
+                                         nondegen, variant)
+            return res["face"]
 
     face = _search_opaque(search, v, points)
     return _from_face(v, f, face, points, mode)

@@ -271,6 +271,25 @@ class Planner(object):
                 elapsed_ms=round(1e3 * (disp.elapsed or 0.0), 3))
         return vis[:n_batch, :n_cams], ndc[:n_batch, :n_cams]
 
+    # ------------------------------------------------------------------
+    # spatial-index companions (mesh_tpu.accel)
+
+    def accel_companion(self, v, f, kind="bvh", **params):
+        """The spatial index for this topology — the plan cache's
+        compile-time-constant companion.
+
+        The index is NOT an executable, so it does not live in the plan
+        LRU: mesh_tpu.accel keeps its own digest-keyed cache (same
+        build-once-inside-the-lock discipline as ``_get_or_compile``),
+        and this method is the engine-routed door to it so accel lookups
+        show up under engine spans like every other dispatch."""
+        from ..accel.build import get_index
+
+        with obs_span("engine.accel_index", kind=str(kind)) as sp:
+            index = get_index(v, f, kind=kind, **params)
+            sp.set(digest=index.digest, faces=int(index.meta["n_faces"]))
+        return index
+
 
 _PLANNER = None
 _PLANNER_LOCK = threading.Lock()

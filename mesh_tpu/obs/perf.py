@@ -386,8 +386,8 @@ def read_bench_json(path):
 
 
 def extract_records(doc):
-    """Normalize either bench JSON shape into
-    ``{"headline": rec|None, "proxy": rec|None, "stages": {...}|None}``.
+    """Normalize either bench JSON shape into ``{"headline": rec|None,
+    "proxy": rec|None, "accel": rec|None, "stages": {...}|None}``.
 
     The headline slot is only filled by a FRESH measurement — a
     ``stale: true`` envelope (last-good value republished while the
@@ -396,6 +396,7 @@ def extract_records(doc):
     """
     headline = None
     proxy = None
+    accel = None
     stages = None
     if doc.get("kind") == "bench_partial":
         stages = doc.get("stages") or {}
@@ -405,18 +406,26 @@ def extract_records(doc):
         px = stages.get("pallas_proxy") or {}
         if px.get("status") == "ok":
             proxy = px.get("record")
+        ax = stages.get("accel_proxy") or {}
+        if ax.get("status") == "ok":
+            accel = ax.get("record")
     else:
         if doc.get("value") is not None and not doc.get("stale"):
             headline = doc
         prox = doc.get("proxy")
         if isinstance(prox, dict) and prox.get("value") is not None:
             proxy = prox
+        acc = doc.get("accel")
+        if isinstance(acc, dict) and acc.get("value") is not None:
+            accel = acc
         stages = doc.get("stages")
-    return {"headline": headline, "proxy": proxy, "stages": stages}
+    return {"headline": headline, "proxy": proxy, "accel": accel,
+            "stages": stages}
 
 
 def perfcheck(doc, baseline=None, proxy_golden=None, proxy_tol=0.5,
-              headline_tol=0.2, flops_tol=0.25):
+              headline_tol=0.2, flops_tol=0.25, accel_golden=None,
+              accel_tol=0.05):
     """Compare a bench JSON against the last-good baseline and the
     committed proxy golden.  Returns ``(rc, lines)`` — rc 0 when nothing
     regressed beyond its tolerance band, 1 on regression (including a
@@ -428,10 +437,55 @@ def perfcheck(doc, baseline=None, proxy_golden=None, proxy_tol=0.5,
     fails).  HLO cost-model FLOPs are the exception — deterministic, so
     they fail in the *upward* direction (``> golden * (1 + flops_tol)``:
     the compiled algorithm got more expensive).
+
+    ``accel_golden`` grades the accel_proxy stage's pair-tests-skipped
+    ratio the same one-sided way.  The ratio is deterministic (fixed
+    mesh, fixed queries, exact traversal), so its band is tight
+    (``accel_tol`` default 5%) and a checksum drift is a hard FAIL —
+    a changed checksum means the index returned different answers,
+    which no tolerance can excuse.
     """
     lines = []
     rc = 0
     recs = extract_records(doc)
+
+    accel_gold = None
+    if accel_golden:
+        accel_gold = (extract_records(accel_golden)["accel"]
+                      or (accel_golden
+                          if accel_golden.get("value") is not None
+                          else None))
+    cand_accel = recs["accel"]
+    if accel_gold is not None:
+        if cand_accel is None:
+            rc = 1
+            lines.append(
+                "FAIL accel: candidate carries no accel_proxy record "
+                "(a golden exists — the chip-free index metric must "
+                "always be fresh)")
+        else:
+            floor = accel_gold["value"] * (1.0 - accel_tol)
+            verdict = ("ok" if cand_accel["value"] >= floor else "FAIL")
+            if verdict == "FAIL":
+                rc = 1
+            lines.append(
+                "%s accel pair-tests-skipped ratio: %.4f vs golden %.4f "
+                "(floor %.4f, tol %.0f%%)"
+                % (verdict, cand_accel["value"], accel_gold["value"],
+                   floor, 100 * accel_tol))
+            cand_sum = cand_accel.get("checksum")
+            gold_sum = accel_gold.get("checksum")
+            if cand_sum is not None and gold_sum is not None:
+                same = abs(cand_sum - gold_sum) <= 1e-6 * max(
+                    1.0, abs(gold_sum))
+                if not same:
+                    rc = 1
+                lines.append(
+                    "%s accel checksum: %.6f vs golden %.6f (exact)"
+                    % ("ok" if same else "FAIL", cand_sum, gold_sum))
+    elif cand_accel is not None:
+        lines.append("note: accel record present but no golden to "
+                     "compare against (record one: make accel-golden)")
 
     golden_rec = None
     if proxy_golden:

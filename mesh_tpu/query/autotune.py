@@ -30,10 +30,18 @@ log = logging.getLogger(__name__)
 
 DEFAULT_CROSSOVER = 32768
 
+# face count above which the spatial-index path (mesh_tpu.accel) takes
+# over from the culled strategies.  Conservative default: below this the
+# culled kernels' O(Q*F) cheap-bound pass still fits the latency budget
+# everywhere measured, and the index's host build + traversal overhead
+# isn't guaranteed to pay for itself.
+ACCEL_DEFAULT_CROSSOVER = 131072
+
 # in-process resolution cache (covers the cache-file miss too, so hot query
 # loops don't pay a filesystem probe per call; a calibration persisted by
 # ANOTHER process mid-run is picked up on the next interpreter start)
 _measured = None
+_accel_measured = None
 
 
 def _cache_path():
@@ -75,6 +83,40 @@ def crossover_faces():
     except (OSError, ValueError, KeyError, TypeError):
         _measured = DEFAULT_CROSSOVER
     return _measured
+
+
+def _accel_cache_path():
+    return _cache_path().replace("crossover_", "accel_crossover_")
+
+
+def accel_crossover_faces():
+    """The face count at which auto switches to the spatial-index path
+    (env override > cached measurement > default).  auto routes to accel
+    iff ``F >= accel_crossover_faces()`` and MESH_TPU_NO_ACCEL is unset."""
+    env = os.environ.get("MESH_TPU_ACCEL_MIN_FACES")
+    if env:
+        try:
+            return int(env)
+        except ValueError:
+            log.warning(
+                "ignoring malformed MESH_TPU_ACCEL_MIN_FACES=%r "
+                "(want an integer face count)", env,
+            )
+    global _accel_measured
+    if _accel_measured is not None:
+        return _accel_measured
+    try:
+        with open(_accel_cache_path()) as fh:
+            value = int(json.load(fh)["accel_min_faces"])
+        if value <= 0:
+            raise ValueError(value)
+        log.info("using measured accel crossover %d from %s (delete the "
+                 "file or re-run calibrate_accel_crossover() to "
+                 "re-measure)", value, _accel_cache_path())
+        _accel_measured = value
+    except (OSError, ValueError, KeyError, TypeError):
+        _accel_measured = ACCEL_DEFAULT_CROSSOVER
+    return _accel_measured
 
 
 def _sphere_mesh(n_faces, seed=0):
@@ -186,6 +228,94 @@ def calibrate_crossover(ladder=(8192, 16384, 32768, 65536, 131072),
                     "ladder": [
                         {"faces": n, "t_brute": tb, "t_culled": tc}
                         for n, tb, tc in wins
+                    ],
+                    "n_queries": n_queries,
+                    "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                }, fh, indent=1)
+        except OSError:
+            pass
+    return crossover
+
+
+def calibrate_accel_crossover(ladder=(32768, 65536, 131072, 262144),
+                              n_queries=1024, reps=3, save=True):
+    """Measure where the spatial-index path starts beating the ladder's
+    incumbent large-F strategy (culled) on the live backend.
+
+    Mirrors ``calibrate_crossover``: returns the smallest ladder F where
+    accel wins and keeps winning (auto routes to accel iff F >= value),
+    or 2x past the ladder when the incumbent always won.  The index
+    build is paid OUTSIDE the timed region — the steady-state regime the
+    per-topology cache puts every real caller in — and persisted to the
+    cache dir unless ``save=False`` or the timings look unstable.
+    """
+    from ..accel.build import get_index
+    from ..accel.traverse import closest_faces_and_points_accel
+    from ..utils.dispatch import accel_kind, pallas_default
+    from .culled import closest_faces_and_points_auto
+
+    kind = accel_kind()
+    rng = np.random.RandomState(0)
+    pts = rng.randn(n_queries, 3).astype(np.float32)
+    # time the incumbent through the auto facade with accel disabled, so
+    # it exercises exactly the routing (pallas or xla, brute or culled)
+    # that accel would displace at each F
+    incumbent_env = {"MESH_TPU_NO_ACCEL": "1"}
+    wins = []
+    for n_f in ladder:
+        v, f = _sphere_mesh(n_f)
+        get_index(v, f, kind=kind)   # warm the per-topology index cache
+        old = {k: os.environ.get(k) for k in incumbent_env}
+        os.environ.update(incumbent_env)
+        try:
+            t_inc = _time_best(
+                lambda: closest_faces_and_points_auto(v, f, pts), reps)
+        finally:
+            for k, val in old.items():
+                os.environ.pop(k, None) if val is None \
+                    else os.environ.__setitem__(k, val)
+        t_accel = _time_best(
+            lambda: closest_faces_and_points_accel(v, f, pts, kind=kind),
+            reps)
+        wins.append((f.shape[0], t_inc, t_accel))
+    check_f, check_t, _ = wins[len(wins) // 2]
+    v, f = _sphere_mesh(check_f)
+    old = {k: os.environ.get(k) for k in incumbent_env}
+    os.environ.update(incumbent_env)
+    try:
+        recheck = _time_best(
+            lambda: closest_faces_and_points_auto(v, f, pts), reps)
+    finally:
+        for k, val in old.items():
+            os.environ.pop(k, None) if val is None \
+                else os.environ.__setitem__(k, val)
+    stable = max(check_t, recheck) <= 2.0 * min(check_t, recheck)
+    crossover = None
+    for i, (n_f, t_i, t_a) in enumerate(wins):
+        if t_a < t_i and all(ta < ti for _, ti, ta in wins[i:]):
+            crossover = n_f
+            break
+    if crossover is None:
+        crossover = 2 * wins[-1][0]
+    global _accel_measured
+    _accel_measured = crossover
+    if not stable:
+        log.warning(
+            "calibrate_accel_crossover: backend timings unstable (%.3fs vs "
+            "%.3fs at F=%d) — not persisting; using %d for this process "
+            "only", check_t, recheck, check_f, crossover,
+        )
+        save = False
+    if save:
+        try:
+            with open(_accel_cache_path(), "w") as fh:
+                json.dump({
+                    "accel_min_faces": crossover,
+                    "kind": kind,
+                    "pallas": bool(pallas_default()),
+                    "ladder": [
+                        {"faces": n, "t_incumbent": ti, "t_accel": ta}
+                        for n, ti, ta in wins
                     ],
                     "n_queries": n_queries,
                     "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),

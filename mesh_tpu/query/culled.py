@@ -152,16 +152,41 @@ def closest_faces_and_points_auto(
     else a cached `calibrate_crossover()` run, else 32768); pass
     ``brute_force_max_faces`` to pin it explicitly.
 
-    On TPU both branches run their Pallas kernels: the VMEM-tiled
-    brute-force scan, and the tile-sphere-culled kernel, which is exact by
-    construction (its bounds are conservative — no certificate/fallback
-    pass is needed, pallas_culled.py).
+    Above a second, larger crossover (autotune.accel_crossover_faces —
+    $MESH_TPU_ACCEL_MIN_FACES override, else a cached calibration, else
+    131072) the spatial-index path (mesh_tpu.accel) takes over: the
+    per-topology flattened BVH / uniform grid makes pair tests sub-linear
+    in F, and its own certificate/fallback pass keeps results exact.
+    ``MESH_TPU_NO_ACCEL=1`` is the kill switch back to this ladder.
+
+    On TPU both non-accel branches run their Pallas kernels: the
+    VMEM-tiled brute-force scan, and the tile-sphere-culled kernel, which
+    is exact by construction (its bounds are conservative — no
+    certificate/fallback pass is needed, pallas_culled.py).
+
+    The chosen strategy is recorded in
+    ``mesh_tpu_query_strategy_total{path=}`` exactly once per call — a
+    certificate-miss fallback re-run counts under
+    ``mesh_tpu_query_certificate_fallback_total``, never as a second
+    strategy decision (doc/observability.md lists every label).
     """
     if brute_force_max_faces is None:
         from .autotune import crossover_faces
 
         brute_force_max_faces = crossover_faces()
     f = np.asarray(f)
+    from ..utils.dispatch import accel_kind, no_accel
+
+    if not no_accel():
+        from .autotune import accel_crossover_faces
+
+        if f.shape[0] >= accel_crossover_faces():
+            kind = accel_kind()
+            _record_strategy("accel_%s" % kind)
+            from ..accel.traverse import closest_faces_and_points_accel
+
+            return closest_faces_and_points_accel(
+                v, f, points, kind=kind)
     if pallas_default():
         from .pallas_closest import closest_point_pallas, mesh_is_nondegenerate
         from .pallas_culled import closest_point_pallas_culled
@@ -205,6 +230,9 @@ def closest_faces_and_points_auto(
         _record_strategy("xla_brute")
         res = closest_faces_and_points(v, f, points)
         return {key: np.asarray(val) for key, val in res.items()}
+    # strategy recorded BEFORE the certificate check: a loose-certificate
+    # re-run below is part of this same xla_culled call, counted only in
+    # the fallback series — it must not look like a second routing decision
     _record_strategy("xla_culled")
     res = closest_faces_and_points_culled(v, f, points, k=k, chunk=chunk)
     out = {key: np.asarray(val) for key, val in res.items()}

@@ -238,15 +238,42 @@ def _rung_anchored(mesh, points, chunk, timeout, k=16):
                        certified=bool(out["tight"].all()))
 
 
+def _rung_accel(mesh, points, chunk, timeout):
+    """Opt-in rung: one bounded spatial-index dispatch (mesh_tpu.accel),
+    exact-by-fallback like the engine's full path — pair tests sub-linear
+    in F, so it's the rung of choice for scan-scale target meshes.  Not
+    in the default ladder (the first request against a new topology pays
+    the host-side index build inside its time slice); select it with
+    MESH_TPU_SERVE_LADDER, e.g. ``accel,culled,anchored``."""
+    import numpy as np
+
+    def _call():
+        from ..accel.traverse import closest_faces_and_points_accel
+
+        v, f = _facade_arrays(mesh)
+        pts, n_q = _bucket_queries(points, 256)
+        res = closest_faces_and_points_accel(v, f, pts)
+        return {key: np.asarray(val)[:n_q] for key, val in res.items()}
+
+    out = call_with_timeout(_call, timeout)
+    faces = out["face"].astype("uint32")[None, :]
+    # the facade already repaired loose queries through the dense path,
+    # so the answer is exact regardless of how many certificates missed
+    return ServeResult(faces, out["point"].astype("float64"), "accel",
+                       certified=True)
+
+
 def default_ladder():
     """The standard three-rung ladder, optionally filtered/reordered by
-    ``MESH_TPU_SERVE_LADDER`` (comma-separated rung names)."""
+    ``MESH_TPU_SERVE_LADDER`` (comma-separated rung names; the opt-in
+    ``accel`` rung is selectable here too)."""
     import os
 
     rungs = {
         "engine": Rung("engine", _rung_engine),
         "culled": Rung("culled", _rung_culled),
         "anchored": Rung("anchored", _rung_anchored),
+        "accel": Rung("accel", _rung_accel),
     }
     spec = os.environ.get("MESH_TPU_SERVE_LADDER", "").strip()
     if not spec:

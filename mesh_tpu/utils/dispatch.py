@@ -16,7 +16,8 @@ import os
 import jax
 
 __all__ = ["env_flag", "force_xla", "safe_tiles", "tile_variant",
-           "pallas_default", "mesh_on_tpu", "no_engine", "vertex_chamfer"]
+           "pallas_default", "mesh_on_tpu", "no_engine", "vertex_chamfer",
+           "no_accel", "accel_kind"]
 
 
 def env_flag(name):
@@ -56,6 +57,22 @@ def vertex_chamfer():
     toggling mid-run cannot retrace an already-built step, so rebuild the
     step after changing it)."""
     return env_flag("MESH_TPU_VERTEX_CHAMFER")
+
+
+def no_accel():
+    """True when MESH_TPU_NO_ACCEL disables the spatial-index query paths
+    (mesh_tpu.accel): auto never routes to the index and the facades'
+    callers fall back to brute/culled.  The kill switch for a bad index
+    build or traversal kernel — read per call like the other hatches."""
+    return env_flag("MESH_TPU_NO_ACCEL")
+
+
+def accel_kind():
+    """Which spatial index the accel facade builds by default: ``"bvh"``
+    (flattened rope LBVH) unless MESH_TPU_ACCEL_KIND=grid selects the
+    uniform grid.  Unknown values fall back to bvh."""
+    value = os.environ.get("MESH_TPU_ACCEL_KIND", "").strip().lower()
+    return "grid" if value == "grid" else "bvh"
 
 
 def no_engine():

@@ -349,9 +349,13 @@ def test_perfcheck_cli_exit_codes(tmp_path):
     bad.write_text(json.dumps(_proxy_doc(100.0)))
 
     def run(path):
+        # --accel-golden at a nonexistent path keeps the repo's committed
+        # accel golden from grading these proxy-only docs (the accel band
+        # has its own CLI-observable coverage in tests/test_accel.py)
         return subprocess.run(
             [sys.executable, "-m", "mesh_tpu.cli", "perfcheck", str(path),
-             "--proxy-golden", str(golden)],
+             "--proxy-golden", str(golden),
+             "--accel-golden", str(tmp_path / "no_accel_golden.json")],
             capture_output=True, text=True, cwd=_REPO)
 
     ok = run(good)

@@ -120,6 +120,28 @@ def render_table(h):
                 "gate 2 (bench.py, %s): %s %s  vs_baseline=%s" % (
                     b["mtime_utc"], b.get("value"), b.get("unit", ""),
                     b.get("vs_baseline")))
+        # accel sub-linearity gate: the spatial index only counts as an
+        # improvement when its exact pair tests per query stay strictly
+        # below brute-force F at the largest bench mesh
+        acc = b.get("accel")
+        if isinstance(acc, dict):
+            ppq = acc.get("pair_tests_per_query")
+            faces = acc.get("faces")
+            if ppq is None or faces is None:
+                lines.append(
+                    "gate 2 accel: NOT AN IMPROVEMENT — accel record "
+                    "carries no pair_tests_per_query/faces to prove "
+                    "sub-linearity")
+            elif ppq < faces:
+                lines.append(
+                    "gate 2 accel: sub-linear OK — %.1f pair tests/query "
+                    "vs brute F=%d (skip ratio %s)" % (
+                        ppq, faces, acc.get("value")))
+            else:
+                lines.append(
+                    "gate 2 accel: NOT AN IMPROVEMENT — %.1f pair "
+                    "tests/query >= brute F=%d (index does not prune)" % (
+                        ppq, faces))
     for b in h.get("bench_variants", ()):
         if b.get("value") is None:
             lines.append(
