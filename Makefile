@@ -26,6 +26,10 @@ tpu_tests:
 
 tests: import_tests unit_tests
 
+lint:
+	@echo "----- [ ${package_name} ] meshlint static analysis (no jax init)"
+	@PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m mesh_tpu.cli lint
+
 bench:
 	@python bench.py
 
@@ -74,4 +78,4 @@ docs:
 clean:
 	@rm -rf build dist *.egg-info doc/_build
 
-.PHONY: all import_tests unit_tests tpu_tests tests bench perfcheck proxy-golden accel-golden gates sweep sdist wheel documentation docs clean
+.PHONY: all import_tests unit_tests tpu_tests tests lint bench perfcheck proxy-golden accel-golden gates sweep sdist wheel documentation docs clean

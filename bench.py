@@ -37,6 +37,7 @@ if _REPO not in sys.path:
 
 # stdlib-only (obs never imports jax): the staged harness + reap helpers
 from mesh_tpu.obs import perf as obs_perf  # noqa: E402
+from mesh_tpu.utils import knobs  # noqa: E402
 
 BATCH = 256
 QUERIES_PER_MESH = 1024
@@ -49,8 +50,8 @@ def log(*args):
 def _bench_knobs():
     """(tile_variant, reduction) for the accelerator query kernel."""
     return (
-        os.environ.get("MESH_TPU_BENCH_VARIANT", "fast"),
-        os.environ.get("MESH_TPU_BENCH_REDUCTION", "exact"),
+        knobs.get_str("MESH_TPU_BENCH_VARIANT") or "fast",
+        knobs.get_str("MESH_TPU_BENCH_REDUCTION") or "exact",
     )
 
 
@@ -972,8 +973,8 @@ def accel_proxy_stage(n_rep=1):
     from mesh_tpu.query.autotune import _sphere_mesh
     from mesh_tpu.sphere import _icosphere
 
-    n_faces = int(os.environ.get("MESH_TPU_ACCEL_PROXY_FACES", 210000))
-    n_q = int(os.environ.get("MESH_TPU_ACCEL_PROXY_QUERIES", 512))
+    n_faces = knobs.get_int("MESH_TPU_ACCEL_PROXY_FACES", 210000)
+    n_q = knobs.get_int("MESH_TPU_ACCEL_PROXY_QUERIES", 512)
     v, f = _sphere_mesh(n_faces)
     rng = np.random.RandomState(0)
     pts = np.asarray(rng.randn(n_q, 3), np.float32)
@@ -1053,7 +1054,7 @@ _STAGE_DEFS = OrderedDict((
 
 
 def _stage_timeout(name, default):
-    value = os.environ.get(obs_perf.TIMEOUT_ENV_PREFIX + name.upper())
+    value = knobs.raw(obs_perf.TIMEOUT_ENV_PREFIX + name.upper())
     if value:
         try:
             return float(value)
@@ -1093,7 +1094,7 @@ def _stage_child(name):
     if name not in _STAGE_DEFS:
         raise SystemExit("unknown bench stage %r (have %s)"
                          % (name, list(_STAGE_DEFS)))
-    fault = os.environ.get(obs_perf.FAULT_ENV, "")
+    fault = knobs.raw(obs_perf.FAULT_ENV) or ""
     if fault.startswith(name + ":"):
         mode = fault.split(":", 1)[1]
         if mode == "hang":
@@ -1116,7 +1117,7 @@ def run_staged(names=None):
     and incident-on-wedge, ending in ONE final JSON line that combines
     the headline (fresh or stale), the chip-free proxy, and the
     per-stage outcomes."""
-    partial_path = os.environ.get(obs_perf.PARTIAL_ENV) or os.path.join(
+    partial_path = knobs.raw(obs_perf.PARTIAL_ENV) or os.path.join(
         _REPO, "bench_partial.json")
     specs = build_stage_specs(names)
     results = obs_perf.run_stages(specs, partial_path, log=log)

@@ -10,6 +10,7 @@ $PSBODY_MESH_CACHE idea).
 
 import os
 
+from .utils import knobs
 from .core import MeshArrays  # noqa: F401
 from .mesh import Mesh  # noqa: F401
 from .batch import (  # noqa: F401
@@ -25,12 +26,9 @@ texture_path = os.path.abspath(
     os.path.join(os.path.dirname(__file__), "ressources", "textures")
 )
 
-mesh_package_cache_folder = os.environ.get(
-    "MESH_TPU_CACHE",
-    os.environ.get(
-        "PSBODY_MESH_CACHE",
-        os.path.expanduser(os.path.join("~", ".mesh_tpu", "cache")),
-    ),
+mesh_package_cache_folder = knobs.get_str("MESH_TPU_CACHE", None) or (
+    os.environ.get("PSBODY_MESH_CACHE")
+    or os.path.expanduser(os.path.join("~", ".mesh_tpu", "cache"))
 )
 if not os.path.exists(mesh_package_cache_folder):
     os.makedirs(mesh_package_cache_folder, exist_ok=True)

@@ -1,0 +1,31 @@
+"""meshlint: AST-based static analysis for the framework's own hazards.
+
+Generic linters know nothing about the failure modes that actually bite
+this codebase: a ``float()`` on a tracer inside a jitted function (host
+sync in the hot path), a ``jax.jit`` constructed per loop iteration
+(recompile storm), a Pallas BlockSpec whose tile footprint blows the
+16 MiB VMEM budget, a module-level cache mutated outside the lock that
+guards it elsewhere, an env knob read around the central registry, or a
+metric series the docs never heard of.  ``mesh_tpu.analysis`` is the
+in-repo engine that encodes them as first-class rules.
+
+The package is deliberately stdlib-only (``ast`` + friends): the
+``mesh-tpu lint`` subcommand and the gate-0 pre-chip check in
+tools/run_tpu_gates.sh must run on a box with a wedged axon tunnel or
+no accelerator at all.  See doc/static_analysis.md for the rule
+catalog and the baseline-suppression workflow
+(tools/meshlint_baseline.json).
+"""
+
+from .engine import (     # noqa: F401
+    Finding,
+    FileContext,
+    Project,
+    Report,
+    Rule,
+    SEVERITIES,
+    build_project,
+    check_source,
+    load_baseline,
+    run_lint,
+)

@@ -1,0 +1,367 @@
+"""meshlint engine: file contexts, the Rule protocol, baselines, output.
+
+Pipeline: discover ``.py`` files under the package root, parse each one
+once into a :class:`FileContext` (AST + parent map + line table), run
+every rule's per-file ``check(ctx)`` hook, then every rule's
+project-level ``finalize(project)`` hook (for cross-file facts like
+"is this metric series documented").  Findings carry ``file:line``, a
+rule id, a severity, and a fix hint; each has a stable fingerprint —
+``sha1(rule|path|message)[:12]``, deliberately line-free so findings
+survive unrelated edits above them — which is what the committed
+baseline file (tools/meshlint_baseline.json) suppresses by.
+
+Exit-code contract (pinned by tests/test_analysis.py):
+
+- clean tree ............................ rc 0
+- findings, all fingerprints baselined .. rc 0 (suppressed, listed on -v)
+- any NEW warning- or error-severity .... rc 1
+- notes ................................. never block
+
+Stale baseline entries (fingerprint no longer produced — the hazard was
+fixed) are reported so the file can be re-generated with
+``--write-baseline``; they do not affect the exit code.
+
+Stdlib-only by design: ``mesh-tpu lint`` and the gate-0 check must run
+without jax, numpy, or a backend.
+"""
+
+import ast
+import hashlib
+import json
+import os
+import time
+
+__all__ = [
+    "SEVERITIES", "Finding", "FileContext", "Project", "Rule", "Report",
+    "build_project", "check_source", "load_baseline", "save_baseline",
+    "run_lint", "default_baseline_path",
+]
+
+#: severity order; rc goes 1 only for NEW findings at warning or above
+SEVERITIES = ("note", "warning", "error")
+
+_SEVERITY_RANK = {name: i for i, name in enumerate(SEVERITIES)}
+
+#: JSON schema version of both the report and the baseline file
+SCHEMA_VERSION = 1
+
+
+class Finding(object):
+    """One diagnostic: rule id, severity, location, message, fix hint."""
+
+    __slots__ = ("rule", "severity", "path", "line", "message", "hint")
+
+    def __init__(self, rule, severity, path, line, message, hint=None):
+        assert severity in SEVERITIES, severity
+        self.rule = rule
+        self.severity = severity
+        self.path = path            # repo-relative, posix separators
+        self.line = int(line or 0)
+        self.message = message
+        self.hint = hint
+
+    @property
+    def fingerprint(self):
+        """Stable suppression key: line numbers excluded on purpose so a
+        baselined finding survives edits elsewhere in the file."""
+        key = "%s|%s|%s" % (self.rule, self.path, self.message)
+        return hashlib.sha1(key.encode("utf-8")).hexdigest()[:12]
+
+    def to_dict(self):
+        out = {
+            "rule": self.rule,
+            "severity": self.severity,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+            "fingerprint": self.fingerprint,
+        }
+        if self.hint:
+            out["hint"] = self.hint
+        return out
+
+    def render(self):
+        text = "%s:%d: %s %s %s" % (
+            self.path, self.line, self.severity, self.rule, self.message)
+        if self.hint:
+            text += "  [fix: %s]" % self.hint
+        return text
+
+    def __repr__(self):
+        return "Finding(%s)" % self.render()
+
+
+class FileContext(object):
+    """One parsed source file: path, source, AST, lazy parent map."""
+
+    def __init__(self, path, relpath, source, tree):
+        self.path = path            # absolute
+        self.relpath = relpath      # repo-relative, posix separators
+        self.source = source
+        self.tree = tree
+        self._lines = None
+        self._parents = None
+
+    def line(self, lineno):
+        """1-based source line (stripped), for messages."""
+        if self._lines is None:
+            self._lines = self.source.splitlines()
+        if 1 <= lineno <= len(self._lines):
+            return self._lines[lineno - 1].strip()
+        return ""
+
+    def parents(self):
+        """{node: parent} over the whole tree, built once per file."""
+        if self._parents is None:
+            parents = {}
+            for node in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(node):
+                    parents[child] = node
+            self._parents = parents
+        return self._parents
+
+    def finding(self, rule, severity, node, message, hint=None):
+        """Convenience constructor anchored at an AST node."""
+        return Finding(rule, severity, self.relpath,
+                       getattr(node, "lineno", 0), message, hint)
+
+
+class Project(object):
+    """The whole lint run's view: repo root + every parsed file."""
+
+    def __init__(self, root, contexts):
+        self.root = root
+        self.contexts = list(contexts)
+        self.by_relpath = {ctx.relpath: ctx for ctx in self.contexts}
+
+    def doc_text(self, *relparts):
+        """Text of a repo file (docs live outside the scanned package),
+        or None when absent."""
+        path = os.path.join(self.root, *relparts)
+        try:
+            with open(path, encoding="utf-8") as fh:
+                return fh.read()
+        except OSError:
+            return None
+
+
+class Rule(object):
+    """Base rule: subclass, set ``id``/``name``, override one hook.
+
+    ``check(ctx)`` yields findings for one file; ``finalize(project)``
+    yields findings that need cross-file facts (doc coverage, registry
+    completeness).  Both default to nothing so rules implement only
+    what they need.
+    """
+
+    id = "XXX"
+    name = "unnamed rule"
+
+    def check(self, ctx):
+        return ()
+
+    def finalize(self, project):
+        return ()
+
+
+# -- discovery ---------------------------------------------------------
+
+def _iter_py_files(path):
+    if os.path.isfile(path):
+        yield path
+        return
+    for dirpath, dirnames, filenames in os.walk(path):
+        dirnames[:] = sorted(
+            d for d in dirnames
+            if d not in ("__pycache__", ".git", "_build"))
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def build_project(root, paths=None):
+    """Parse every target file into a Project.
+
+    :param root: repo root (fingerprint paths are relative to it).
+    :param paths: explicit files/dirs to scan; default ``<root>/mesh_tpu``.
+    :returns: (project, parse_failures) — parse failures become
+        PARSE-rule error findings rather than crashing the run.
+    """
+    root = os.path.abspath(root)
+    if not paths:
+        paths = [os.path.join(root, "mesh_tpu")]
+    contexts, failures = [], []
+    for target in paths:
+        target = os.path.abspath(target)
+        for path in _iter_py_files(target):
+            relpath = os.path.relpath(path, root).replace(os.sep, "/")
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    source = fh.read()
+                tree = ast.parse(source, filename=path)
+            except (OSError, SyntaxError, ValueError) as exc:
+                failures.append(Finding(
+                    "PARSE", "error", relpath,
+                    getattr(exc, "lineno", 0) or 0,
+                    "cannot parse: %s" % exc))
+                continue
+            contexts.append(FileContext(path, relpath, source, tree))
+    return Project(root, contexts), failures
+
+
+def check_source(rule, source, relpath="snippet.py", root="/nonexistent"):
+    """Run one rule over one in-memory snippet — the fixture-test entry
+    point (positive and negative fixtures per rule id)."""
+    tree = ast.parse(source)
+    ctx = FileContext(os.path.join(root, relpath), relpath, source, tree)
+    findings = list(rule.check(ctx))
+    findings.extend(rule.finalize(Project(root, [ctx])))
+    return findings
+
+
+# -- baseline ----------------------------------------------------------
+
+def default_baseline_path(root):
+    return os.path.join(root, "tools", "meshlint_baseline.json")
+
+
+def load_baseline(path):
+    """{fingerprint: entry-dict}; a missing file is an empty baseline."""
+    try:
+        with open(path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except OSError:
+        return {}
+    entries = doc.get("entries", {})
+    if isinstance(entries, list):    # tolerate the list form
+        entries = {e["fingerprint"]: e for e in entries}
+    return dict(entries)
+
+
+def save_baseline(path, findings, old_entries=None, default_reason=None):
+    """Write the baseline for the given findings, carrying forward the
+    human-written ``reason`` of any fingerprint already baselined."""
+    old_entries = old_entries or {}
+    entries = {}
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        prev = old_entries.get(f.fingerprint, {})
+        entries[f.fingerprint] = {
+            "rule": f.rule,
+            "path": f.path,
+            "line": f.line,        # informational; not part of the match
+            "severity": f.severity,
+            "message": f.message,
+            "reason": prev.get("reason")
+            or default_reason
+            or "TODO: justify this suppression",
+        }
+    doc = {
+        "version": SCHEMA_VERSION,
+        "note": ("meshlint baseline: known findings suppressed by "
+                 "fingerprint (sha1(rule|path|message)[:12]). Regenerate "
+                 "with `mesh-tpu lint --write-baseline`; every entry "
+                 "needs a one-line reason."),
+        "entries": entries,
+    }
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    return path
+
+
+# -- the run -----------------------------------------------------------
+
+class Report(object):
+    """One lint run's outcome: findings split against the baseline."""
+
+    def __init__(self, findings, baseline, elapsed_s, files_scanned):
+        self.findings = sorted(
+            findings, key=lambda f: (f.path, f.line, f.rule, f.message))
+        self.baseline = baseline
+        self.elapsed_s = elapsed_s
+        self.files_scanned = files_scanned
+        produced = {f.fingerprint for f in self.findings}
+        self.new = [f for f in self.findings
+                    if f.fingerprint not in baseline]
+        self.suppressed = [f for f in self.findings
+                           if f.fingerprint in baseline]
+        self.stale = {fp: entry for fp, entry in baseline.items()
+                      if fp not in produced}
+
+    @property
+    def rc(self):
+        """1 only for NEW findings at warning severity or above."""
+        blocking = [f for f in self.new
+                    if _SEVERITY_RANK[f.severity] >= 1]
+        return 1 if blocking else 0
+
+    def to_dict(self):
+        return {
+            "schema_version": SCHEMA_VERSION,
+            "rc": self.rc,
+            "files_scanned": self.files_scanned,
+            "elapsed_s": round(self.elapsed_s, 4),
+            "counts": {
+                "total": len(self.findings),
+                "new": len(self.new),
+                "suppressed": len(self.suppressed),
+                "stale_baseline": len(self.stale),
+            },
+            "findings": [f.to_dict() for f in self.new],
+            "suppressed": [f.to_dict() for f in self.suppressed],
+            "stale_baseline": [
+                dict(entry, fingerprint=fp)
+                for fp, entry in sorted(self.stale.items())
+            ],
+        }
+
+    def render_human(self, verbose=False):
+        lines = []
+        for f in self.new:
+            lines.append(f.render())
+        if verbose:
+            for f in self.suppressed:
+                lines.append("(baselined) " + f.render())
+        for fp, entry in sorted(self.stale.items()):
+            lines.append(
+                "stale baseline entry %s (%s %s — fixed? regenerate with "
+                "--write-baseline)" % (fp, entry.get("rule", "?"),
+                                       entry.get("path", "?")))
+        lines.append(
+            "meshlint: %d file(s), %d finding(s) (%d new, %d baselined, "
+            "%d stale baseline entr%s) in %.2fs -> %s" % (
+                self.files_scanned, len(self.findings), len(self.new),
+                len(self.suppressed), len(self.stale),
+                "y" if len(self.stale) == 1 else "ies",
+                self.elapsed_s, "FAIL" if self.rc else "OK"))
+        return "\n".join(lines)
+
+
+def run_lint(root, paths=None, rules=None, baseline_path=None,
+             use_baseline=True):
+    """Parse, run every rule, split against the baseline.
+
+    :param rules: rule instances; default the full registry
+        (mesh_tpu.analysis.rules.all_rules()).
+    :param baseline_path: explicit path; default
+        tools/meshlint_baseline.json under ``root``.
+    :param use_baseline: False disables suppression (every finding is
+        "new") — the CI mode for fixture tests.
+    """
+    t0 = time.monotonic()
+    if rules is None:
+        from .rules import all_rules
+
+        rules = all_rules()
+    project, findings = build_project(root, paths)
+    for ctx in project.contexts:
+        for rule in rules:
+            findings.extend(rule.check(ctx))
+    for rule in rules:
+        findings.extend(rule.finalize(project))
+    if baseline_path is None:
+        baseline_path = default_baseline_path(project.root)
+    baseline = load_baseline(baseline_path) if use_baseline else {}
+    return Report(findings, baseline, time.monotonic() - t0,
+                  len(project.contexts))

@@ -1,0 +1,31 @@
+"""The meshlint rule packs (one module per rule-id family).
+
+``all_rules()`` is the registry the engine and CLI default to; the
+``--rules TRC,VMEM`` CLI filter matches on each rule's ``id`` prefix.
+See doc/static_analysis.md for the catalog.
+"""
+
+from .trc import TracerLeakRule
+from .rcp import RecompileHazardRule
+from .vmem import VmemBudgetRule
+from .lck import LockDisciplineRule
+from .knb import KnobRegistryRule
+from .obs import ObservabilityHygieneRule
+
+__all__ = [
+    "TracerLeakRule", "RecompileHazardRule", "VmemBudgetRule",
+    "LockDisciplineRule", "KnobRegistryRule", "ObservabilityHygieneRule",
+    "all_rules",
+]
+
+
+def all_rules():
+    """Fresh instances of every registered rule, in catalog order."""
+    return [
+        TracerLeakRule(),
+        RecompileHazardRule(),
+        VmemBudgetRule(),
+        LockDisciplineRule(),
+        KnobRegistryRule(),
+        ObservabilityHygieneRule(),
+    ]

@@ -1,0 +1,142 @@
+"""Shared AST helpers for the meshlint rule packs (stdlib-only)."""
+
+import ast
+
+__all__ = [
+    "qualname", "decorator_names", "enclosing_function", "in_loop",
+    "module_constants", "ConstEnv",
+]
+
+
+def qualname(node):
+    """Dotted name of a Name/Attribute chain (``jax.jit``, ``self.x``),
+    or None for anything not a plain chain."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def decorator_names(funcdef):
+    """Flattened decorator name list; ``functools.partial(jax.jit, ...)``
+    and decorator-factory calls (``jax.jit(static_argnums=...)``)
+    contribute their callee's name too."""
+    names = []
+    for deco in funcdef.decorator_list:
+        if isinstance(deco, ast.Call):
+            base = qualname(deco.func)
+            if base:
+                names.append(base)
+            if base and base.rsplit(".", 1)[-1] == "partial":
+                for arg in deco.args[:1]:
+                    inner = qualname(arg)
+                    if inner:
+                        names.append(inner)
+        else:
+            name = qualname(deco)
+            if name:
+                names.append(name)
+    return names
+
+
+def enclosing_function(parents, node):
+    """Nearest enclosing FunctionDef/AsyncFunctionDef, or None."""
+    node = parents.get(node)
+    while node is not None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return node
+        node = parents.get(node)
+    return None
+
+
+def in_loop(parents, node):
+    """True when ``node`` sits under a For/While/comprehension without a
+    function boundary in between (i.e. the loop re-executes it)."""
+    node = parents.get(node)
+    while node is not None:
+        if isinstance(node, (ast.For, ast.While, ast.comprehension,
+                             ast.ListComp, ast.SetComp, ast.DictComp,
+                             ast.GeneratorExp)):
+            return True
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return False
+        node = parents.get(node)
+    return False
+
+
+def module_constants(tree):
+    """{name: constant-node} for simple module-level ``NAME = literal``
+    assignments (the ``FOO_ENV = "MESH_TPU_FOO"`` idiom)."""
+    out = {}
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+            target = stmt.targets[0]
+            if isinstance(target, ast.Name):
+                out[target.id] = stmt.value
+    return out
+
+
+class ConstEnv(object):
+    """Best-effort integer/float resolver for tile-shape expressions:
+    literals, module-level constants, the enclosing function's keyword
+    defaults, and +,-,*,//,/ over those.  ``resolve`` returns None for
+    anything it cannot prove."""
+
+    def __init__(self, tree, func=None):
+        self._values = {}
+        for name, node in module_constants(tree).items():
+            if isinstance(node, ast.Constant) and isinstance(
+                    node.value, (int, float)) and not isinstance(
+                    node.value, bool):
+                self._values[name] = node.value
+        if func is not None:
+            args = func.args
+            positional = args.posonlyargs + args.args
+            defaults = args.defaults
+            for arg, default in zip(positional[len(positional)
+                                               - len(defaults):], defaults):
+                self._maybe_bind(arg.arg, default)
+            for arg, default in zip(args.kwonlyargs, args.kw_defaults):
+                if default is not None:
+                    self._maybe_bind(arg.arg, default)
+
+    def _maybe_bind(self, name, node):
+        if isinstance(node, ast.Constant) and isinstance(
+                node.value, (int, float)) and not isinstance(
+                node.value, bool):
+            self._values[name] = node.value
+
+    def resolve(self, node):
+        if isinstance(node, ast.Constant):
+            value = node.value
+            if isinstance(value, (int, float)) and not isinstance(
+                    value, bool):
+                return value
+            return None
+        if isinstance(node, ast.Name):
+            return self._values.get(node.id)
+        if isinstance(node, ast.UnaryOp) and isinstance(
+                node.op, ast.USub):
+            value = self.resolve(node.operand)
+            return None if value is None else -value
+        if isinstance(node, ast.BinOp):
+            left = self.resolve(node.left)
+            right = self.resolve(node.right)
+            if left is None or right is None:
+                return None
+            if isinstance(node.op, ast.Add):
+                return left + right
+            if isinstance(node.op, ast.Sub):
+                return left - right
+            if isinstance(node.op, ast.Mult):
+                return left * right
+            if isinstance(node.op, ast.FloorDiv) and right:
+                return left // right
+            if isinstance(node.op, ast.Div) and right:
+                return left / right
+        return None

@@ -1,0 +1,138 @@
+"""KNB: env-knob registry enforcement.
+
+Every ``MESH_TPU_*`` environment variable is declared once in
+``mesh_tpu/utils/knobs.py`` — the registry gives each knob a type, a
+default, one documented truthiness (``flag``), and a generated table in
+doc/configuration.md.  A raw ``os.environ`` read anywhere else
+reintroduces exactly the drift the registry removed: undocumented
+knobs, per-site truthiness, silently diverging defaults.
+
+Writes are deliberately exempt: ``os.environ["MESH_TPU_OBS"] = "1"``
+(the CLI trace subcommand forcing the gate on) and the test-fixture
+save/restore idiom configure the environment rather than read it.
+
+Codes:
+
+- KNB001 (error): a ``MESH_TPU_*`` key is read via ``os.environ.get``
+  / ``os.getenv`` / ``os.environ[...]`` / ``setdefault`` outside
+  utils/knobs.py (keys are resolved through module-level constants,
+  so ``os.environ.get(RECORDER_ENV)`` is caught too).
+- KNB002 (error): a knob declared in the registry is missing from
+  doc/configuration.md — the generated table is stale; rerun
+  ``make docs`` / tools/build_docs.py.
+"""
+
+import ast
+
+from .common import module_constants, qualname
+from ..engine import Finding, Rule
+
+_REGISTRY_RELPATH = "mesh_tpu/utils/knobs.py"
+_PREFIX = "MESH_TPU_"
+
+_READ_FUNCS = {"os.environ.get", "environ.get", "os.getenv", "getenv",
+               "os.environ.setdefault", "environ.setdefault"}
+
+
+def _resolve_key(node, consts):
+    """Best-effort string key of an environ access."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name):
+        const = consts.get(node.id)
+        if isinstance(const, ast.Constant) and isinstance(
+                const.value, str):
+            return const.value
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        left = _resolve_key(node.left, consts)
+        if left:
+            return left + "*"
+    if isinstance(node, ast.JoinedStr) and node.values:
+        first = node.values[0]
+        if isinstance(first, ast.Constant) and isinstance(
+                first.value, str):
+            return first.value + "*"
+    return None
+
+
+def _is_store_context(parents, node):
+    """True when the Subscript is an assignment/deletion target."""
+    parent = parents.get(node)
+    if isinstance(parent, ast.Assign) and node in parent.targets:
+        return True
+    if isinstance(parent, (ast.AugAssign, ast.AnnAssign)):
+        return parent.target is node
+    if isinstance(parent, ast.Delete):
+        return node in parent.targets
+    return False
+
+
+class KnobRegistryRule(Rule):
+
+    id = "KNB"
+    name = "central env-knob registry enforcement"
+
+    def check(self, ctx):
+        if ctx.relpath.replace("\\", "/").endswith("utils/knobs.py"):
+            return []
+        findings = []
+        parents = ctx.parents()
+        consts = module_constants(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            key_node = None
+            if isinstance(node, ast.Call):
+                name = qualname(node.func)
+                if name in _READ_FUNCS and node.args:
+                    key_node = node.args[0]
+            elif isinstance(node, ast.Subscript):
+                base = qualname(node.value)
+                if (base in ("os.environ", "environ")
+                        and not _is_store_context(parents, node)):
+                    key_node = node.slice
+            if key_node is None:
+                continue
+            key = _resolve_key(key_node, consts)
+            if key and key.startswith(_PREFIX):
+                findings.append(ctx.finding(
+                    "KNB001", "error", node,
+                    "raw environment read of %s outside the knob "
+                    "registry" % key,
+                    hint="declare it in mesh_tpu/utils/knobs.py and "
+                         "read it via knobs.flag/get_int/get_float/"
+                         "get_str/raw"))
+        return findings
+
+    def finalize(self, project):
+        registry = project.by_relpath.get(_REGISTRY_RELPATH)
+        if registry is None:
+            return []
+        declared = []      # (name, lineno)
+        for node in ast.walk(registry.tree):
+            if (isinstance(node, ast.Call)
+                    and qualname(node.func) == "_declare"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                declared.append((node.args[0].value, node.lineno))
+        if not declared:
+            return []
+        doc = project.doc_text("doc", "configuration.md")
+        if doc is None:
+            return [Finding(
+                "KNB002", "error", _REGISTRY_RELPATH, 0,
+                "doc/configuration.md is missing: the knob table is "
+                "generated from the registry",
+                hint="run tools/build_docs.py (make docs) and commit "
+                     "doc/configuration.md")]
+        findings = []
+        for name, lineno in declared:
+            if name not in doc:
+                findings.append(Finding(
+                    "KNB002", "error", _REGISTRY_RELPATH, lineno,
+                    "knob %s is declared but missing from "
+                    "doc/configuration.md (stale generated table)"
+                    % name,
+                    hint="regenerate: make docs (tools/build_docs.py "
+                         "rewrites the table from knobs.render_"
+                         "markdown()) and commit the result"))
+        return findings

@@ -1,0 +1,159 @@
+"""LCK: lock discipline over module-level mutable state.
+
+The serving tier made the framework multi-threaded: worker pools,
+watchdogs, and SLO loops all touch module-level caches and registries.
+The repo's convention is a module-level ``threading.Lock`` next to the
+state it guards, mutations under ``with <lock>:``, and ``*_locked``
+helper functions for code that requires the caller to hold it.
+
+This rule checks that discipline per module.  It only activates in
+files that define a module-level lock (a module with no lock is
+assumed single-threaded by design), and module-top-level statements are
+exempt (import-time init runs before any thread exists).
+
+Codes:
+
+- LCK001 (error): a module-level container is mutated *outside* any
+  lock in a function, while the SAME container is mutated under a lock
+  elsewhere in the module — mixed discipline, i.e. a real race.
+- LCK002 (warning): a module-level container is only ever mutated
+  without a lock in functions, in a module that defines one.
+"""
+
+import ast
+
+from .common import enclosing_function, qualname
+from ..engine import Rule
+
+_LOCK_FACTORY_PARTS = {"Lock", "RLock", "Condition", "Semaphore",
+                       "BoundedSemaphore"}
+
+_CONTAINER_FACTORIES = {"dict", "list", "set", "deque", "OrderedDict",
+                        "defaultdict", "Counter"}
+
+_MUTATOR_ATTRS = {
+    "append", "extend", "insert", "remove", "pop", "popitem", "clear",
+    "add", "discard", "update", "setdefault", "appendleft", "popleft",
+    "sort", "reverse",
+}
+
+
+def _module_level_names(tree, predicate):
+    out = {}
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+            target = stmt.targets[0]
+            if isinstance(target, ast.Name) and predicate(stmt.value):
+                out[target.id] = stmt
+    return out
+
+
+def _is_lock_factory(node):
+    if not isinstance(node, ast.Call):
+        return False
+    name = qualname(node.func)
+    return bool(name) and name.rsplit(".", 1)[-1] in _LOCK_FACTORY_PARTS
+
+
+def _is_container_literal(node):
+    if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        name = qualname(node.func)
+        return bool(name) and (name.rsplit(".", 1)[-1]
+                               in _CONTAINER_FACTORIES)
+    return False
+
+
+def _under_lock(parents, node, lock_names):
+    """True if an ancestor ``with`` statement's context mentions a lock
+    (by declared name, or any name containing "lock"), or the enclosing
+    function is a ``*_locked`` caller-holds-it helper."""
+    current = parents.get(node)
+    while current is not None:
+        if isinstance(current, (ast.With, ast.AsyncWith)):
+            for item in current.items:
+                name = qualname(item.context_expr) or ""
+                if isinstance(item.context_expr, ast.Call):
+                    name = qualname(item.context_expr.func) or ""
+                last = name.rsplit(".", 1)[-1]
+                if last in lock_names or "lock" in last.lower():
+                    return True
+        if isinstance(current, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if current.name.endswith("_locked"):
+                return True
+        current = parents.get(current)
+    return False
+
+
+def _mutated_name(node):
+    """The bare container name a statement/call mutates, or None."""
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+        if (node.func.attr in _MUTATOR_ATTRS
+                and isinstance(node.func.value, ast.Name)):
+            return node.func.value.id
+    if isinstance(node, (ast.Assign, ast.AugAssign)):
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for target in targets:
+            if (isinstance(target, ast.Subscript)
+                    and isinstance(target.value, ast.Name)):
+                return target.value.id
+    if isinstance(node, ast.Delete):
+        for target in node.targets:
+            if (isinstance(target, ast.Subscript)
+                    and isinstance(target.value, ast.Name)):
+                return target.value.id
+    return None
+
+
+class LockDisciplineRule(Rule):
+
+    id = "LCK"
+    name = "lock discipline on module-level mutable state"
+
+    def check(self, ctx):
+        tree = ctx.tree
+        locks = _module_level_names(tree, _is_lock_factory)
+        if not locks:
+            return []
+        containers = _module_level_names(tree, _is_container_literal)
+        if not containers:
+            return []
+        parents = ctx.parents()
+        lock_names = set(locks)
+        # name -> [(node, guarded)]
+        sites = {}
+        for node in ast.walk(tree):
+            name = _mutated_name(node)
+            if name not in containers:
+                continue
+            if enclosing_function(parents, node) is None:
+                continue            # import-time init: single-threaded
+            guarded = _under_lock(parents, node, lock_names)
+            sites.setdefault(name, []).append((node, guarded))
+        findings = []
+        for name, entries in sorted(sites.items()):
+            any_guarded = any(guarded for _, guarded in entries)
+            for node, guarded in entries:
+                if guarded:
+                    continue
+                if any_guarded:
+                    findings.append(ctx.finding(
+                        "LCK001", "error", node,
+                        "module-level '%s' mutated outside the lock "
+                        "that guards it elsewhere in this module — a "
+                        "race under the serving tier's threads" % name,
+                        hint="wrap the mutation in `with %s:` (or move "
+                             "it into a *_locked helper)"
+                             % sorted(lock_names)[0]))
+                else:
+                    findings.append(ctx.finding(
+                        "LCK002", "warning", node,
+                        "module-level '%s' mutated in a function "
+                        "without holding any lock (this module defines "
+                        "%s)" % (name, ", ".join(sorted(lock_names))),
+                        hint="guard the mutation or document why it is "
+                             "single-threaded"))
+        return findings

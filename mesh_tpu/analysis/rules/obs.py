@@ -1,0 +1,194 @@
+"""OBS: metric/span hygiene.
+
+The observability contract has three legs: every series the code can
+create is documented in doc/observability.md's name table, series names
+are static literals (so the doc lint CAN see them), and nothing in the
+hot path reads raw clocks around the span tracer's sync-aware
+measurement.  The first two used to live in tests/test_metrics_doc_lint
+as regexes and the third in tests/test_timing_lint; both tests are now
+thin wrappers over this rule pack (same test names, same coverage).
+
+Codes:
+
+- OBS001 (error): a metric series / serve-tier span name created in
+  code is absent from doc/observability.md (the ``{a,b}`` brace
+  shorthand in the doc table is expanded before comparison).
+- OBS002 (warning): ``counter``/``gauge``/``histogram`` called with a
+  non-literal name — a dynamic series name is invisible to OBS001 and
+  unbounded in cardinality (the registry implementation itself,
+  obs/metrics.py, is exempt: its methods forward a name parameter).
+- OBS003 (warning): a metric mutator (``inc``/``set``/``observe``...)
+  called with ``**kwargs`` whose keys are not statically visible —
+  dynamic label NAMES are an unbounded-cardinality hazard (dynamic
+  label values are fine).
+- OBS004 (warning): a raw ``time.time()``-family clock call outside
+  utils/profiling.py, obs/, viewer/, and analysis/ — hot-path timing
+  must go through obs.clock / Timer / timed_span so the sync-aware
+  accounting and the overhead gate stay honest.
+"""
+
+import ast
+import re
+
+from .common import qualname
+from ..engine import Finding, Rule
+
+_SERIES_FUNCS = {"counter", "gauge", "histogram"}
+_SPAN_FUNCS = {"span", "timed_span", "obs_span"}
+_LABEL_MUTATORS = {"inc", "dec", "set", "set_max", "observe"}
+_CLOCK_FUNCS = {"time.time", "time.perf_counter", "time.monotonic",
+                "time.process_time"}
+
+#: files allowed to read raw clocks: the profiling primitives, the obs
+#: subsystem that aliases them, the (non-hot-path) viewer, and this
+#: offline analysis tooling itself
+_CLOCK_EXEMPT = ("mesh_tpu/utils/profiling.py", "mesh_tpu/obs/",
+                 "mesh_tpu/viewer/", "mesh_tpu/analysis/")
+
+#: the registry implementation and its package facade forward name
+#: parameters by design (they ARE the API the literal names flow into)
+_SERIES_EXEMPT = ("mesh_tpu/obs/metrics.py", "mesh_tpu/obs/__init__.py")
+
+#: jax_bridge registers series through helper indirection — every
+#: literal that LOOKS like a series name counts as created (the old
+#: regex lint's _BRIDGE_RE, kept bug-for-bug compatible)
+_BRIDGE_BASENAME = "jax_bridge.py"
+_BRIDGE_NAME_RE = re.compile(r"^mesh_tpu_[a-z0-9_]+$")
+
+#: doc-side names, allowing the {a,b,c} brace shorthand the table uses
+_DOC_NAME_RE = re.compile(
+    r"(?:mesh_tpu|serve\.)(?:[a-z0-9_.]|\{[a-z0-9_,]+\})+")
+
+
+def expand_braces(token):
+    """``a_{x,y}_b`` -> {a_x_b, a_y_b} (recursive, one level is all the
+    doc uses)."""
+    match = re.search(r"\{([a-z0-9_,]+)\}", token)
+    if not match:
+        return {token}
+    out = set()
+    for alt in match.group(1).split(","):
+        out |= expand_braces(
+            token[:match.start()] + alt + token[match.end():])
+    return out
+
+
+def documented_names(doc_text):
+    """Every series/span name doc/observability.md mentions, braces
+    expanded."""
+    names = set()
+    for token in _DOC_NAME_RE.findall(doc_text):
+        names |= expand_braces(token.rstrip("."))
+    return names
+
+
+def _created_names(ctx):
+    """[(name, node)] of series/span names this file can create."""
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        func_name = qualname(node.func)
+        last = func_name.rsplit(".", 1)[-1] if func_name else None
+        first = node.args[0]
+        if not (isinstance(first, ast.Constant)
+                and isinstance(first.value, str)):
+            continue
+        if last in _SERIES_FUNCS and first.value.startswith("mesh_tpu_"):
+            out.append((first.value, node))
+        elif last in _SPAN_FUNCS and first.value.startswith("serve."):
+            out.append((first.value, node))
+    if ctx.relpath.rsplit("/", 1)[-1] == _BRIDGE_BASENAME:
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)
+                    and _BRIDGE_NAME_RE.match(node.value)):
+                out.append((node.value, node))
+    return out
+
+
+def collect_code_names(project):
+    """{name: (relpath, line)} of every creatable series/span name —
+    the first creation site wins (also the wrapper test's entry point)."""
+    names = {}
+    for ctx in project.contexts:
+        for name, node in _created_names(ctx):
+            names.setdefault(
+                name, (ctx.relpath, getattr(node, "lineno", 0)))
+    return names
+
+
+class ObservabilityHygieneRule(Rule):
+
+    id = "OBS"
+    name = "metric/span hygiene"
+
+    def check(self, ctx):
+        findings = []
+        relpath = ctx.relpath.replace("\\", "/")
+        series_exempt = any(relpath.endswith(e) for e in _SERIES_EXEMPT)
+        clock_exempt = any(e in relpath if e.endswith("/")
+                           else relpath.endswith(e)
+                           for e in _CLOCK_EXEMPT)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func_name = qualname(node.func)
+            last = func_name.rsplit(".", 1)[-1] if func_name else None
+            if (not series_exempt and last in _SERIES_FUNCS
+                    and isinstance(node.func, ast.Attribute)
+                    and node.args
+                    and not (isinstance(node.args[0], ast.Constant)
+                             and isinstance(node.args[0].value, str))):
+                findings.append(ctx.finding(
+                    "OBS002", "warning", node,
+                    "dynamic series name in %s(...): invisible to the "
+                    "doc-coverage lint and unbounded in cardinality"
+                    % last,
+                    hint="use a literal name (put variation in labels, "
+                         "not the series name)"))
+            if (last in _LABEL_MUTATORS
+                    and isinstance(node.func, ast.Attribute)):
+                for kw in node.keywords:
+                    if kw.arg is None and not _static_label_keys(kw.value):
+                        findings.append(ctx.finding(
+                            "OBS003", "warning", node,
+                            "**kwargs label expansion in .%s(): dynamic "
+                            "label NAMES make series cardinality "
+                            "unbounded" % last,
+                            hint="spell the label names out "
+                                 "(.%s(tenant=t) is fine — values may "
+                                 "vary, names must not)" % last))
+            if (not clock_exempt and func_name in _CLOCK_FUNCS):
+                findings.append(ctx.finding(
+                    "OBS004", "warning", node,
+                    "raw clock read %s() outside utils/profiling.py "
+                    "and obs/" % func_name,
+                    hint="route it through obs.clock (monotonic/wall), "
+                         "utils.profiling.Timer, or timed_span"))
+        return findings
+
+    def finalize(self, project):
+        doc = project.doc_text("doc", "observability.md")
+        if doc is None:
+            return []
+        documented = documented_names(doc)
+        findings = []
+        for name, (relpath, line) in sorted(
+                collect_code_names(project).items()):
+            if name not in documented:
+                findings.append(Finding(
+                    "OBS001", "error", relpath, line,
+                    "series '%s' is created in code but absent from "
+                    "doc/observability.md" % name,
+                    hint="add it to the series table in "
+                         "doc/observability.md (the {a,b} brace "
+                         "shorthand is expanded)"))
+        return findings
+
+
+def _static_label_keys(node):
+    """True when a ``**expr`` expansion provably has constant keys."""
+    return (isinstance(node, ast.Dict)
+            and all(isinstance(k, ast.Constant)
+                    and isinstance(k.value, str) for k in node.keys))

@@ -1,0 +1,88 @@
+"""RCP: jit recompile hazards.
+
+``jax.jit`` keys its compile cache on the *function object* plus static
+arguments.  Construct the jit inside a loop, hand it a fresh lambda per
+call, or feed ``static_argnums`` something non-hashable and every call
+compiles from scratch — tens of seconds per compile on the tunneled
+chip, which is how a "fast" path quietly becomes a recompile storm.
+
+Codes (all warning severity — each is a real hazard but occasionally
+deliberate, e.g. a build-once helper; baseline those with a reason):
+
+- RCP001: ``jax.jit(...)`` constructed under a loop or comprehension.
+- RCP002: a lambda passed to ``jax.jit`` inside a function body (a new
+  function identity per call defeats the cache; module-level lambdas
+  run once and are exempt).
+- RCP003: ``static_argnums=``/``static_argnames=`` bound to something
+  that is not a literal (or module-level-constant) int/str/tuple —
+  unhashable or varying values defeat or poison the cache key.
+"""
+
+import ast
+
+from .common import enclosing_function, in_loop, module_constants, qualname
+from ..engine import Rule
+
+_JIT_LAST_PARTS = {"jit", "pjit"}
+_STATIC_KWARGS = {"static_argnums", "static_argnames", "donate_argnums"}
+
+
+def _is_jit_call(node):
+    if not isinstance(node, ast.Call):
+        return False
+    name = qualname(node.func)
+    return bool(name) and name.rsplit(".", 1)[-1] in _JIT_LAST_PARTS
+
+
+def _is_constant_static_spec(node, consts):
+    """Literal int/str, or a tuple/list of those, possibly via one
+    module-level constant indirection."""
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, (int, str, bool, type(None)))
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(_is_constant_static_spec(e, consts) for e in node.elts)
+    if isinstance(node, ast.Name) and node.id in consts:
+        return _is_constant_static_spec(consts[node.id], {})
+    return False
+
+
+class RecompileHazardRule(Rule):
+
+    id = "RCP"
+    name = "jit recompile hazard"
+
+    def check(self, ctx):
+        findings = []
+        parents = ctx.parents()
+        consts = module_constants(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not _is_jit_call(node):
+                continue
+            jit_name = qualname(node.func)
+            if in_loop(parents, node):
+                findings.append(ctx.finding(
+                    "RCP001", "warning", node,
+                    "%s(...) constructed inside a loop: every iteration "
+                    "builds a fresh callable and recompiles" % jit_name,
+                    hint="hoist the jit out of the loop (or cache the "
+                         "jitted callable, e.g. functools.lru_cache)"))
+            if (any(isinstance(arg, ast.Lambda) for arg in node.args)
+                    and enclosing_function(parents, node) is not None):
+                findings.append(ctx.finding(
+                    "RCP002", "warning", node,
+                    "lambda passed to %s inside a function body: a new "
+                    "function identity per call defeats the compile "
+                    "cache" % jit_name,
+                    hint="jit a named module-level function (or cache "
+                         "the wrapped callable once)"))
+            for kw in node.keywords:
+                if (kw.arg in _STATIC_KWARGS
+                        and not _is_constant_static_spec(kw.value, consts)):
+                    findings.append(ctx.finding(
+                        "RCP003", "warning", node,
+                        "%s=%s is not a literal constant: a varying or "
+                        "unhashable spec poisons the jit cache key"
+                        % (kw.arg, ast.unparse(kw.value)[:60]),
+                        hint="use a literal tuple of ints/names (hoist "
+                             "it to a module-level constant)"))
+        return findings

@@ -1,0 +1,289 @@
+"""TRC: tracer-leak / host-sync detection inside traced code.
+
+A jitted (or custom_vjp / pallas_call-reachable) function that calls
+``.item()``, ``.block_until_ready()``, ``np.asarray`` or ``float()`` on
+a traced value either fails at trace time or — worse — silently forces
+a device->host round trip on every call, which is exactly the class of
+hot-path stall the span tracer's sync accounting exists to surface.
+
+Reachability is a name-level call graph per module: roots are functions
+decorated with jit/pjit/custom_vjp/custom_jvp (including via
+``functools.partial``), functions wrapped by an explicit
+``jax.jit(f)`` / ``pl.pallas_call(kernel, ...)`` call, and
+``defvjp``/``defjvp`` registrations; everything a root (transitively)
+calls by simple name in the same module is treated as traced.
+
+Codes:
+
+- TRC001 (error): ``.item()`` / ``.tolist()`` in traced code.
+- TRC002 (error): ``.block_until_ready()`` in traced code.
+- TRC003 (warning): numpy materialization (``np.asarray``/``np.array``)
+  in traced code.
+- TRC004 (warning): ``float()``/``int()``/``bool()`` on a value derived
+  from a traced function's arguments (``x.shape[0]``-style static
+  expressions are fine and not flagged).
+"""
+
+import ast
+
+from .common import decorator_names, qualname
+from ..engine import Rule
+
+#: decorator name components that mark a function as traced
+_TRACED_DECORATOR_PARTS = {
+    "jit", "pjit", "custom_vjp", "custom_jvp", "checkpoint", "remat",
+}
+
+#: call wrappers whose function argument becomes traced
+_WRAPPER_LAST_PARTS = {"jit", "pjit", "pallas_call", "checkpoint", "remat"}
+
+#: registration methods whose arguments become traced
+_REGISTER_ATTRS = {"defvjp", "defjvp"}
+
+_NUMPY_ROOTS = {"np", "onp", "numpy", "jnp"}
+_NUMPY_SYNC_ATTRS = {"asarray", "array"}
+
+#: attribute accesses that yield static (host) values even on tracers
+_STATIC_ATTRS = {"shape", "ndim", "size", "dtype", "sharding"}
+
+#: call roots that produce traced values (so float() on them syncs)
+_DEVICE_CALL_ROOTS = {"jnp", "jax", "lax", "pl", "pltpu"}
+
+
+def _last_part(name):
+    return name.rsplit(".", 1)[-1] if name else None
+
+
+class _OneDecorator(object):
+    """Minimal funcdef stand-in so decorator_names() can inspect one
+    decorator at a time (its static_argnames ride on the same Call)."""
+
+    def __init__(self, decorator_list):
+        self.decorator_list = decorator_list
+
+
+def _collect_function_defs(tree):
+    """Every def in the module keyed by bare name (nested and methods
+    included; last definition wins, which is fine for lint purposes)."""
+    defs = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs[node.name] = node
+    return defs
+
+
+def _called_names(funcdef):
+    """Bare names this function calls or references (a function passed
+    to jax.jit / pallas_call inside the body counts as reachable)."""
+    out = set()
+    for node in ast.walk(funcdef):
+        if isinstance(node, ast.Call):
+            name = qualname(node.func)
+            if name and "." not in name:
+                out.add(name)
+    return out
+
+
+def _static_spec(keywords):
+    """(names, nums) declared static via static_argnames/static_argnums
+    keyword literals."""
+    names, nums = set(), set()
+    for kw in keywords:
+        if kw.arg not in ("static_argnames", "static_argnums"):
+            continue
+        values = (kw.value.elts
+                  if isinstance(kw.value, (ast.Tuple, ast.List))
+                  else [kw.value])
+        for value in values:
+            if isinstance(value, ast.Constant):
+                if isinstance(value.value, str):
+                    names.add(value.value)
+                elif isinstance(value.value, int):
+                    nums.add(value.value)
+    return names, nums
+
+
+def _traced_roots(tree):
+    """{name: (static_names, static_nums)} of functions that directly
+    enter tracing in this module."""
+    roots = {}
+
+    def add(name, keywords=()):
+        names, nums = _static_spec(keywords)
+        prev = roots.get(name)
+        if prev:
+            names |= prev[0]
+            nums |= prev[1]
+        roots[name] = (names, nums)
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                deco_names = decorator_names(
+                    _OneDecorator([deco]))
+                if any(_last_part(d) in _TRACED_DECORATOR_PARTS
+                       for d in deco_names):
+                    keywords = (deco.keywords
+                                if isinstance(deco, ast.Call) else ())
+                    add(node.name, keywords)
+                    break
+        elif isinstance(node, ast.Call):
+            last = _last_part(qualname(node.func))
+            if last in _WRAPPER_LAST_PARTS:
+                for arg in node.args[:1]:
+                    inner = qualname(arg)
+                    if inner and "." not in inner:
+                        add(inner, node.keywords)
+                    elif isinstance(arg, ast.Call):
+                        # functools.partial(kernel, ...) as the target
+                        pfunc = qualname(arg.func)
+                        if _last_part(pfunc) == "partial" and arg.args:
+                            inner = qualname(arg.args[0])
+                            if inner and "." not in inner:
+                                add(inner, node.keywords)
+            elif (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _REGISTER_ATTRS):
+                for arg in node.args:
+                    inner = qualname(arg)
+                    if inner and "." not in inner:
+                        add(inner)
+    return roots
+
+
+def _traced_functions(tree):
+    """[(funcdef, direct_root_spec_or_None)] reachable from the traced
+    roots by name; the spec is (static_names, static_nums) for direct
+    roots and None for transitively reached helpers."""
+    defs = _collect_function_defs(tree)
+    roots = _traced_roots(tree)
+    seen = set()
+    frontier = [name for name in roots if name in defs]
+    while frontier:
+        name = frontier.pop()
+        if name in seen:
+            continue
+        seen.add(name)
+        for callee in _called_names(defs[name]):
+            if callee in defs and callee not in seen:
+                frontier.append(callee)
+    return [(defs[name], roots.get(name)) for name in sorted(seen)]
+
+
+def _param_names(funcdef, spec):
+    """Parameters treated as traced values.
+
+    For a direct root (``spec`` is its (static_names, static_nums)),
+    that is every parameter except the statically-declared ones; for a
+    transitively reached helper (``spec`` is None) it is empty — those
+    run at trace-build time on static config (tile variants, eps
+    literals), and flagging ``float()`` on their bare parameters is
+    pure noise.  Device-derived expressions (``float(jnp.sum(x))``)
+    are still flagged everywhere via the call heuristic.
+    """
+    if spec is None:
+        return set()
+    static_names, static_nums = spec
+    args = funcdef.args
+    ordered = [a.arg for a in args.posonlyargs + args.args]
+    names = set(ordered) | {a.arg for a in args.kwonlyargs}
+    if args.vararg:
+        names.add(args.vararg.arg)
+    if args.kwarg:
+        names.add(args.kwarg.arg)
+    names -= static_names
+    names -= {ordered[i] for i in static_nums if i < len(ordered)}
+    names.discard("self")
+    names.discard("cls")
+    return names
+
+
+def _is_dynamic(node, params):
+    """Conservatively: does this expression derive from traced inputs?
+
+    Static things (never flagged): literals, ``.shape``-family
+    attributes, ``len(...)``, names that are not parameters of the
+    enclosing traced function.
+    """
+    if isinstance(node, ast.Constant):
+        return False
+    if isinstance(node, ast.Name):
+        return node.id in params
+    if isinstance(node, ast.Attribute):
+        if node.attr in _STATIC_ATTRS:
+            return False
+        return _is_dynamic(node.value, params)
+    if isinstance(node, ast.Subscript):
+        return _is_dynamic(node.value, params)
+    if isinstance(node, ast.BinOp):
+        return (_is_dynamic(node.left, params)
+                or _is_dynamic(node.right, params))
+    if isinstance(node, ast.UnaryOp):
+        return _is_dynamic(node.operand, params)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return any(_is_dynamic(e, params) for e in node.elts)
+    if isinstance(node, ast.IfExp):
+        return any(_is_dynamic(e, params)
+                   for e in (node.test, node.body, node.orelse))
+    if isinstance(node, ast.Call):
+        root = qualname(node.func)
+        if root and root.split(".", 1)[0] in _DEVICE_CALL_ROOTS:
+            return True
+        return False
+    return False
+
+
+class TracerLeakRule(Rule):
+
+    id = "TRC"
+    name = "tracer leak / host sync in traced code"
+
+    def check(self, ctx):
+        findings = []
+        for funcdef, spec in _traced_functions(ctx.tree):
+            params = _param_names(funcdef, spec)
+            for node in ast.walk(funcdef):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                if isinstance(func, ast.Attribute):
+                    if func.attr in ("item", "tolist") and not node.args:
+                        findings.append(ctx.finding(
+                            "TRC001", "error", node,
+                            ".%s() inside traced '%s' forces a "
+                            "device->host sync (or fails at trace time)"
+                            % (func.attr, funcdef.name),
+                            hint="return the array and convert it "
+                                 "outside the traced function"))
+                    elif func.attr == "block_until_ready":
+                        findings.append(ctx.finding(
+                            "TRC002", "error", node,
+                            ".block_until_ready() inside traced '%s' "
+                            "is a host sync in the hot path"
+                            % funcdef.name,
+                            hint="sync at the caller (utils.profiling."
+                                 "host_sync) or via Span.watch()"))
+                    else:
+                        root = qualname(func)
+                        if (root
+                                and root.split(".", 1)[0] in _NUMPY_ROOTS
+                                and root.split(".", 1)[0] != "jnp"
+                                and func.attr in _NUMPY_SYNC_ATTRS):
+                            findings.append(ctx.finding(
+                                "TRC003", "warning", node,
+                                "%s() inside traced '%s' materializes "
+                                "the tracer on host"
+                                % (root, funcdef.name),
+                                hint="use jnp inside traced code; "
+                                     "convert with numpy at the caller"))
+                elif (isinstance(func, ast.Name)
+                        and func.id in ("float", "int", "bool")
+                        and len(node.args) == 1
+                        and _is_dynamic(node.args[0], params)):
+                    findings.append(ctx.finding(
+                        "TRC004", "warning", node,
+                        "%s() on a traced value inside '%s' breaks "
+                        "tracing (ConcretizationTypeError or a silent "
+                        "host sync)" % (func.id, funcdef.name),
+                        hint="keep it as a jnp array, or hoist the "
+                             "conversion out of the traced function"))
+        return findings

@@ -1,0 +1,154 @@
+"""VMEM: Pallas tile-budget estimation from BlockSpec shapes.
+
+A Pallas TPU kernel's working set — every ``in_specs``/``out_specs``
+block plus every ``pltpu.VMEM`` scratch buffer — must fit the core's
+~16 MiB of VMEM, and Mosaic physically lays f32 tiles out as (8, 128)
+(sublane, lane): a lane dimension that is not a multiple of 128 is
+padded up, silently multiplying the real footprint and the DMA traffic.
+Both failure modes surface only on the real chip (interpret mode does
+not model VMEM), so this rule budgets them statically at lint time.
+
+Tile dimensions are resolved best-effort from literals, the enclosing
+function's keyword defaults (the ``tile_q=256`` idiom every
+query/pallas_*.py builder uses), module-level constants, and simple
+arithmetic over those; unresolvable specs are skipped, and the budget
+message says how many specs it could price.
+
+Codes:
+
+- VMEM001 (error): priced blocks for one ``pallas_call`` exceed the
+  16 MiB VMEM ceiling (assuming f32 where the dtype is not visible).
+- VMEM002 (warning): a block's lane (last) dimension > 1 is not a
+  multiple of 128 — Mosaic pads it to 128.
+- VMEM003 (note): a block's sublane (second-to-last) dimension > 1 is
+  not a multiple of 8 — padded to the next multiple of 8.
+"""
+
+import ast
+
+from .common import ConstEnv, enclosing_function, qualname
+from ..engine import Rule
+
+#: VMEM ceiling per TensorCore (v4/v5 class); the budget is advisory so
+#: a few hundred KiB of Mosaic overhead does not need modelling
+VMEM_BUDGET_BYTES = 16 * 1024 * 1024
+
+#: assumed element size when the dtype is not statically visible
+_DEFAULT_ITEMSIZE = 4
+
+_DTYPE_SIZES = {
+    "float32": 4, "f32": 4, "int32": 4, "i32": 4, "uint32": 4,
+    "float16": 2, "bfloat16": 2, "int16": 2, "uint16": 2,
+    "int8": 1, "uint8": 1, "bool_": 1,
+    "float64": 8, "int64": 8,
+}
+
+
+def _last_part(name):
+    return name.rsplit(".", 1)[-1] if name else None
+
+
+def _dtype_itemsize(node):
+    """Element size of a dtype expression (``jnp.float32``), default f32."""
+    name = _last_part(qualname(node))
+    return _DTYPE_SIZES.get(name, _DEFAULT_ITEMSIZE)
+
+
+def _block_shape(call):
+    """The shape tuple node of a BlockSpec/VMEM call, or None."""
+    if call.args:
+        node = call.args[0]
+    else:
+        node = next((kw.value for kw in call.keywords
+                     if kw.arg == "block_shape"), None)
+    return node if isinstance(node, (ast.Tuple, ast.List)) else None
+
+
+def _spec_calls(container, attr_name):
+    """Calls named ``attr_name`` anywhere under one keyword value."""
+    if container is None:
+        return []
+    return [node for node in ast.walk(container)
+            if isinstance(node, ast.Call)
+            and _last_part(qualname(node.func)) == attr_name]
+
+
+class VmemBudgetRule(Rule):
+
+    id = "VMEM"
+    name = "Pallas VMEM budget / tiling alignment"
+
+    def check(self, ctx):
+        findings = []
+        parents = ctx.parents()
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and _last_part(qualname(node.func)) == "pallas_call"):
+                continue
+            env = ConstEnv(ctx.tree, enclosing_function(parents, node))
+            kwargs = {kw.arg: kw.value for kw in node.keywords if kw.arg}
+            total, priced, unpriced = 0, 0, 0
+            blocks = []
+            for spec_kw in ("in_specs", "out_specs"):
+                for spec in _spec_calls(kwargs.get(spec_kw), "BlockSpec"):
+                    blocks.append((spec, _DEFAULT_ITEMSIZE))
+            for scratch in _spec_calls(kwargs.get("scratch_shapes"),
+                                       "VMEM"):
+                itemsize = (_dtype_itemsize(scratch.args[1])
+                            if len(scratch.args) > 1 else _DEFAULT_ITEMSIZE)
+                blocks.append((scratch, itemsize))
+            for spec, itemsize in blocks:
+                shape = _block_shape(spec)
+                if shape is None:
+                    unpriced += 1
+                    continue
+                dims = [env.resolve(d) for d in shape.elts]
+                findings.extend(self._tiling_findings(ctx, spec, dims))
+                if dims and all(isinstance(d, (int, float)) and d > 0
+                                for d in dims):
+                    priced += 1
+                    size = itemsize
+                    for d in dims:
+                        size *= int(d)
+                    total += size
+                else:
+                    unpriced += 1
+            if total > VMEM_BUDGET_BYTES:
+                findings.append(ctx.finding(
+                    "VMEM001", "error", node,
+                    "pallas_call blocks total ~%.2f MiB (%d spec(s) "
+                    "priced%s, f32 assumed) — over the %d MiB VMEM "
+                    "ceiling; Mosaic will fail or spill on the real "
+                    "chip" % (
+                        total / 2 ** 20, priced,
+                        ", %d unpriced" % unpriced if unpriced else "",
+                        VMEM_BUDGET_BYTES // 2 ** 20),
+                    hint="shrink the tile dims (the autotuner sweep in "
+                         "benchmarks/tile_sweep.py maps the viable "
+                         "range) or move blocks to HBM with explicit "
+                         "DMA"))
+        return findings
+
+    @staticmethod
+    def _tiling_findings(ctx, spec, dims):
+        out = []
+        if not dims:
+            return out
+        lane = dims[-1]
+        if isinstance(lane, int) and lane > 1 and lane % 128:
+            out.append(ctx.finding(
+                "VMEM002", "warning", spec,
+                "block lane dimension %d is not a multiple of 128: "
+                "Mosaic pads each (8, 128) f32 tile, wasting VMEM and "
+                "DMA bandwidth" % lane,
+                hint="pad the lane dim to 128 (mask the tail) or fold "
+                     "the small axis into the sublane dim"))
+        if len(dims) >= 2:
+            sublane = dims[-2]
+            if isinstance(sublane, int) and sublane > 1 and sublane % 8:
+                out.append(ctx.finding(
+                    "VMEM003", "note", spec,
+                    "block sublane dimension %d is not a multiple of 8 "
+                    "(padded to the next (8, 128) f32 tile row)"
+                    % sublane))
+        return out

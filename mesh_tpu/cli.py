@@ -11,6 +11,8 @@ Subcommands:
   slo — evaluate SLO compliance from the serve-stats sink (no jax init)
   perfcheck — compare a saved bench JSON against the last-good record
     and the CPU-proxy golden with tolerance bands (no jax init)
+  lint — run the meshlint static analyzer over the package (no jax
+    init; gate 0 of tools/run_tpu_gates.sh)
 
 Examples:
   meshviewer view body.ply
@@ -24,6 +26,8 @@ Examples:
   mesh-tpu incidents incident-...-watchdog_trip-001.json --json
   mesh-tpu slo --latency-ms 250 --target 0.99
   mesh-tpu perfcheck bench_partial.json
+  mesh-tpu lint --json
+  mesh-tpu lint --rules VMEM,TRC mesh_tpu/query
 """
 
 import argparse
@@ -199,9 +203,7 @@ def cmd_serve_stats(args):
     """
     import json
 
-    path = args.path or os.environ.get(
-        "MESH_TPU_SERVE_STATS", "").strip() or os.path.expanduser(
-        os.path.join("~", ".mesh_tpu", "serve_stats.json"))
+    path = args.path or _serve_stats_path()
     if not os.path.exists(path):
         print("no serve stats sink at %s (nothing has served yet; "
               "QueryService.stop() writes it)" % path)
@@ -244,10 +246,19 @@ def cmd_serve_stats(args):
                 print("    {%s} %s" % (tag, series.get("value")))
 
 
+def _serve_stats_path():
+    from mesh_tpu.utils import knobs
+
+    return knobs.get_str("MESH_TPU_SERVE_STATS", None) or os.path.expanduser(
+        os.path.join("~", ".mesh_tpu", "serve_stats.json"))
+
+
 def _incident_dir(args):
-    return (args.dir or os.environ.get(
-        "MESH_TPU_INCIDENT_DIR", "").strip() or os.path.expanduser(
-        os.path.join("~", ".mesh_tpu", "incidents")))
+    from mesh_tpu.utils import knobs
+
+    return (args.dir or knobs.get_str("MESH_TPU_INCIDENT_DIR", None)
+            or os.path.expanduser(
+                os.path.join("~", ".mesh_tpu", "incidents")))
 
 
 def cmd_incidents(args):
@@ -340,9 +351,7 @@ def cmd_slo(args):
 
     from mesh_tpu.obs.slo import SLO, compliance, tenants
 
-    path = args.path or os.environ.get(
-        "MESH_TPU_SERVE_STATS", "").strip() or os.path.expanduser(
-        os.path.join("~", ".mesh_tpu", "serve_stats.json"))
+    path = args.path or _serve_stats_path()
     if not os.path.exists(path):
         print("no serve stats sink at %s (nothing has served yet; "
               "QueryService.stop() writes it)" % path)
@@ -438,6 +447,54 @@ def cmd_perfcheck(args):
             print("  " + line)
         print("perfcheck: %s" % ("OK" if rc == 0 else "REGRESSION"))
     sys.exit(rc)
+
+
+def cmd_lint(args):
+    """Run meshlint (mesh_tpu.analysis) over the package.
+
+    Stdlib-only engine, no jax backend initialization — this is gate 0
+    of tools/run_tpu_gates.sh and must work while the chip is wedged.
+    Exit codes: 0 clean (or baseline-suppressed only), 1 new findings
+    at warning severity or above, 2 usage errors.
+    """
+    import json
+
+    from mesh_tpu.analysis import engine
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rules = None
+    if args.rules:
+        from mesh_tpu.analysis.rules import all_rules
+
+        wanted = {r.strip().upper()
+                  for r in args.rules.split(",") if r.strip()}
+        rules = [r for r in all_rules() if r.id in wanted]
+        unknown = wanted - {r.id for r in rules}
+        if unknown:
+            print("mesh-tpu lint: unknown rule id(s): %s (have %s)"
+                  % (", ".join(sorted(unknown)),
+                     ", ".join(r.id for r in all_rules())),
+                  file=sys.stderr)
+            sys.exit(2)
+    baseline_path = args.baseline or engine.default_baseline_path(repo_root)
+    report = engine.run_lint(
+        repo_root, paths=args.paths or None, rules=rules,
+        baseline_path=baseline_path,
+        use_baseline=not args.no_baseline)
+    if args.write_baseline:
+        old = engine.load_baseline(baseline_path)
+        engine.save_baseline(baseline_path, report.findings, old)
+        print("wrote %d entr%s to %s (new entries need a reason)"
+              % (len(report.findings),
+                 "y" if len(report.findings) == 1 else "ies",
+                 baseline_path))
+        return
+    if args.json:
+        json.dump(report.to_dict(), sys.stdout, indent=2, sort_keys=True)
+        sys.stdout.write("\n")
+    else:
+        print(report.render_human(verbose=args.verbose))
+    sys.exit(report.rc)
 
 
 def main():
@@ -582,6 +639,32 @@ def main():
                         help="machine-readable {rc, lines} instead of the "
                              "summary")
     p_perf.set_defaults(func=cmd_perfcheck)
+
+    p_lint = sub.add_parser(
+        "lint",
+        help="run the meshlint static analyzer (no jax init)")
+    p_lint.add_argument("paths", nargs="*",
+                        help="files/dirs to lint (default: the mesh_tpu "
+                             "package)")
+    p_lint.add_argument("--rules", default=None,
+                        help="comma-separated rule-id filter "
+                             "(TRC,RCP,VMEM,LCK,KNB,OBS)")
+    p_lint.add_argument("--baseline", default=None,
+                        help="baseline file (default: "
+                             "tools/meshlint_baseline.json)")
+    p_lint.add_argument("--no-baseline", action="store_true",
+                        help="ignore the baseline: report every finding "
+                             "as new")
+    p_lint.add_argument("--write-baseline", action="store_true",
+                        help="write the current findings to the "
+                             "baseline (keeps existing reasons) and "
+                             "exit 0")
+    p_lint.add_argument("--json", action="store_true",
+                        help="machine-readable report (the perf-gate "
+                             "harvester consumes this)")
+    p_lint.add_argument("-v", "--verbose", action="store_true",
+                        help="also list baseline-suppressed findings")
+    p_lint.set_defaults(func=cmd_lint)
 
     args = parser.parse_args()
     args.func(args)

@@ -14,8 +14,9 @@ are no-ops — the overhead bound is pinned by tests/test_bench_guard.py
 via ``bench.py --obs-overhead``.
 """
 
-import os
 import time
+
+from ..utils.knobs import flag as _knob_flag
 
 __all__ = ["monotonic", "wall", "enabled", "env_flag", "OBS_ENV"]
 
@@ -31,10 +32,10 @@ wall = time.time
 
 
 def env_flag(name):
-    """Shared truthiness with utils/dispatch.env_flag (duplicated here so
-    the obs primitives never import jax transitively)."""
-    value = os.environ.get(name, "").strip().lower()
-    return value not in ("", "0", "false", "no", "off")
+    """Shared truthiness with utils/dispatch.env_flag — both now delegate
+    to the central knob registry (utils/knobs.py, stdlib-only, so the obs
+    primitives still never import jax transitively)."""
+    return _knob_flag(name)
 
 
 def enabled():

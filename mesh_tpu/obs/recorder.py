@@ -33,6 +33,7 @@ import sys
 import threading
 from collections import deque
 
+from ..utils import knobs
 from .clock import monotonic, wall
 from .metrics import REGISTRY
 from .trace import TRACER
@@ -75,33 +76,25 @@ _SAMPLED_TOTALS = (
 
 def recorder_enabled():
     """True unless MESH_TPU_RECORDER explicitly turns recording off
-    (unset means ON — the recorder is the always-on black box)."""
-    value = os.environ.get(RECORDER_ENV)
-    if value is None:
-        return True
-    return value.strip().lower() not in ("", "0", "false", "no", "off")
+    (unset means ON — the recorder is the always-on black box; the knob
+    is declared with default=on)."""
+    return knobs.flag(RECORDER_ENV)
 
 
 def default_incident_dir():
     """MESH_TPU_INCIDENT_DIR, or ~/.mesh_tpu/incidents."""
-    path = os.environ.get(INCIDENT_DIR_ENV, "").strip()
+    path = knobs.get_str(INCIDENT_DIR_ENV, None)
     if path:
         return path
     return os.path.join(os.path.expanduser("~"), ".mesh_tpu", "incidents")
 
 
 def _keep_limit():
-    try:
-        return max(1, int(os.environ.get(KEEP_ENV, "32")))
-    except ValueError:
-        return 32
+    return max(1, knobs.get_int(KEEP_ENV))
 
 
 def _ring_capacity():
-    try:
-        return max(16, int(os.environ.get(EVENTS_ENV, "2048")))
-    except ValueError:
-        return 2048
+    return max(16, knobs.get_int(EVENTS_ENV))
 
 
 def list_incidents(directory=None):

@@ -254,9 +254,9 @@ class Tracer(object):
                 pass
 
     def _install_env_sink_locked(self):
-        import os
+        from ..utils import knobs
 
-        path = os.environ.get(JSONL_ENV, "").strip()
+        path = knobs.get_str(JSONL_ENV, None)
         if path:
             self._sinks.append(jsonl_sink(path))
 
@@ -337,18 +337,12 @@ def jsonl_sink(path, max_mb=None, keep=None):
     """
     import os
 
+    from ..utils import knobs
+
     if max_mb is None:
-        raw = os.environ.get(JSONL_MAX_MB_ENV, "").strip()
-        if raw:
-            try:
-                max_mb = float(raw)
-            except ValueError:
-                max_mb = None
+        max_mb = knobs.get_float(JSONL_MAX_MB_ENV)
     if keep is None:
-        try:
-            keep = max(1, int(os.environ.get(JSONL_KEEP_ENV, "3")))
-        except ValueError:
-            keep = 3
+        keep = max(1, knobs.get_int(JSONL_KEEP_ENV))
     max_bytes = int(max_mb * 1024 * 1024) if max_mb else None
     lock = threading.Lock()
     state = {"fh": None}

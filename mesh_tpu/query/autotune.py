@@ -26,6 +26,8 @@ import time
 
 import numpy as np
 
+from ..utils import knobs
+
 log = logging.getLogger(__name__)
 
 DEFAULT_CROSSOVER = 32768
@@ -59,15 +61,15 @@ def _cache_path():
 def crossover_faces():
     """The face count up to which auto uses brute force (env override >
     cached measurement > default); above it the culled strategy runs."""
-    env = os.environ.get("MESH_TPU_BRUTE_MAX_FACES")
+    env = knobs.raw("MESH_TPU_BRUTE_MAX_FACES")
     if env:
-        try:
-            return int(env)
-        except ValueError:
-            log.warning(
-                "ignoring malformed MESH_TPU_BRUTE_MAX_FACES=%r "
-                "(want an integer face count)", env,
-            )
+        value = knobs.get_int("MESH_TPU_BRUTE_MAX_FACES")
+        if value is not None:
+            return value
+        log.warning(
+            "ignoring malformed MESH_TPU_BRUTE_MAX_FACES=%r "
+            "(want an integer face count)", env,
+        )
     global _measured
     if _measured is not None:
         return _measured
@@ -93,15 +95,15 @@ def accel_crossover_faces():
     """The face count at which auto switches to the spatial-index path
     (env override > cached measurement > default).  auto routes to accel
     iff ``F >= accel_crossover_faces()`` and MESH_TPU_NO_ACCEL is unset."""
-    env = os.environ.get("MESH_TPU_ACCEL_MIN_FACES")
+    env = knobs.raw("MESH_TPU_ACCEL_MIN_FACES")
     if env:
-        try:
-            return int(env)
-        except ValueError:
-            log.warning(
-                "ignoring malformed MESH_TPU_ACCEL_MIN_FACES=%r "
-                "(want an integer face count)", env,
-            )
+        value = knobs.get_int("MESH_TPU_ACCEL_MIN_FACES")
+        if value is not None:
+            return value
+        log.warning(
+            "ignoring malformed MESH_TPU_ACCEL_MIN_FACES=%r "
+            "(want an integer face count)", env,
+        )
     global _accel_measured
     if _accel_measured is not None:
         return _accel_measured

@@ -267,7 +267,7 @@ def default_ladder():
     """The standard three-rung ladder, optionally filtered/reordered by
     ``MESH_TPU_SERVE_LADDER`` (comma-separated rung names; the opt-in
     ``accel`` rung is selectable here too)."""
-    import os
+    from ..utils import knobs
 
     rungs = {
         "engine": Rung("engine", _rung_engine),
@@ -275,7 +275,7 @@ def default_ladder():
         "anchored": Rung("anchored", _rung_anchored),
         "accel": Rung("accel", _rung_accel),
     }
-    spec = os.environ.get("MESH_TPU_SERVE_LADDER", "").strip()
+    spec = knobs.get_str("MESH_TPU_SERVE_LADDER", None) or ""
     if not spec:
         return [rungs["engine"], rungs["culled"], rungs["anchored"]]
     chosen = []

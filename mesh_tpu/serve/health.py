@@ -31,10 +31,10 @@ counts as wedged, default 5.0) — see doc/serving.md.
 """
 
 import itertools
-import os
 import threading
 
 from ..obs.clock import monotonic
+from ..utils import knobs
 
 __all__ = ["HEALTHY", "DEGRADED", "DRAINING", "STATE_NAMES", "HealthMonitor"]
 
@@ -46,13 +46,7 @@ _DEFAULT_WEDGE_S = 5.0
 
 
 def _wedge_threshold():
-    raw = os.environ.get("MESH_TPU_SERVE_WEDGE_S", "").strip()
-    if not raw:
-        return _DEFAULT_WEDGE_S
-    try:
-        return float(raw)
-    except ValueError:
-        return _DEFAULT_WEDGE_S
+    return knobs.get_float("MESH_TPU_SERVE_WEDGE_S", _DEFAULT_WEDGE_S)
 
 
 class HealthMonitor(object):

@@ -31,6 +31,7 @@ from collections import OrderedDict, deque
 from concurrent.futures import Future
 
 from ..errors import DeadlineExceeded, EngineShutdown, ServeRejected
+from ..utils import knobs
 from ..obs.clock import monotonic, wall
 from ..obs.recorder import get_recorder
 from ..obs.trace import span as obs_span
@@ -43,26 +44,10 @@ __all__ = [
 ]
 
 
-def _env_int(name, default):
-    raw = os.environ.get(name, "").strip()
-    try:
-        return int(raw) if raw else default
-    except ValueError:
-        return default
-
-
-def _env_float(name, default):
-    raw = os.environ.get(name, "").strip()
-    try:
-        return float(raw) if raw else default
-    except ValueError:
-        return default
-
-
 def default_stats_path():
     """The serve-stats sink: ``MESH_TPU_SERVE_STATS`` or
     ``~/.mesh_tpu/serve_stats.json``."""
-    return os.environ.get("MESH_TPU_SERVE_STATS", "").strip() or (
+    return knobs.get_str("MESH_TPU_SERVE_STATS", None) or (
         os.path.expanduser(os.path.join("~", ".mesh_tpu",
                                         "serve_stats.json")))
 
@@ -181,10 +166,10 @@ class QueryService(object):
                  ladder=None, default_deadline_s=None, health=None,
                  chunk=512, stats_path=None, recorder=None):
         self.max_queue_per_tenant = (
-            _env_int("MESH_TPU_SERVE_QUEUE", 64)
+            knobs.get_int("MESH_TPU_SERVE_QUEUE")
             if max_queue_per_tenant is None else int(max_queue_per_tenant))
         self.default_deadline_s = (
-            _env_float("MESH_TPU_SERVE_DEADLINE_S", 1.0)
+            knobs.get_float("MESH_TPU_SERVE_DEADLINE_S")
             if default_deadline_s is None else float(default_deadline_s))
         self.chunk = int(chunk)
         self.ladder = list(ladder) if ladder is not None else default_ladder()
@@ -199,7 +184,7 @@ class QueryService(object):
         self._held = 0
         self._stopping = False
         self._inflight = 0
-        n_workers = (_env_int("MESH_TPU_SERVE_WORKERS", 1)
+        n_workers = (knobs.get_int("MESH_TPU_SERVE_WORKERS")
                      if workers is None else int(workers))
         self._workers = [
             threading.Thread(target=self._work,

@@ -1,2 +1,23 @@
-from .arrays import row, col, sparse, asarray_f32, asarray_i32  # noqa: F401
-from .profiling import Timer, host_sync, time_fn, trace  # noqa: F401
+"""Shared utilities.  Lazy re-exports (PEP 562) so the stdlib-only
+submodule (``knobs``) and the jax-free obs/ primitives that import it
+never pay the numpy/scipy/jax import of ``arrays``/``profiling``."""
+
+_LAZY = {
+    "row": "arrays", "col": "arrays", "sparse": "arrays",
+    "asarray_f32": "arrays", "asarray_i32": "arrays",
+    "Timer": "profiling", "host_sync": "profiling",
+    "time_fn": "profiling", "trace": "profiling",
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        value = getattr(
+            importlib.import_module("." + _LAZY[name], __name__), name)
+        globals()[name] = value     # cache: __getattr__ runs once per name
+        return value
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))

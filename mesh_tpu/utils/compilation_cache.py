@@ -18,6 +18,8 @@ the compilation cache unless MESH_TPU_XLA_CACHE pins it elsewhere).
 import logging
 import os
 
+from . import knobs
+
 _log = logging.getLogger(__name__)
 
 
@@ -34,10 +36,10 @@ def enable_persistent_compilation_cache(path=None, min_compile_secs=1.0):
         (tiny programs aren't worth the disk round trip).
     :returns: the cache directory in use, or ``None`` when disabled/failed.
     """
-    if os.environ.get("MESH_TPU_NO_XLA_CACHE"):
+    if knobs.flag("MESH_TPU_NO_XLA_CACHE"):
         return None
     if path is None:
-        path = os.environ.get("MESH_TPU_XLA_CACHE")
+        path = knobs.get_str("MESH_TPU_XLA_CACHE")
     if path is None:
         from .. import mesh_package_cache_folder
 

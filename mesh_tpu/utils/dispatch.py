@@ -11,20 +11,15 @@ without downgrading or patching (advisor round-2 finding).
 The env var is read per call (not cached) so tests can toggle it.
 """
 
-import os
-
 import jax
+
+from .knobs import flag as env_flag    # noqa: F401  (re-export: the
+# escape-hatch truthiness now lives in the central knob registry)
+from . import knobs
 
 __all__ = ["env_flag", "force_xla", "safe_tiles", "tile_variant",
            "pallas_default", "mesh_on_tpu", "no_engine", "vertex_chamfer",
            "no_accel", "accel_kind"]
-
-
-def env_flag(name):
-    """Shared truthiness for the escape-hatch env vars: unset, '', '0',
-    'false', 'no', 'off' are all OFF (so '=0' disables, not enables)."""
-    value = os.environ.get(name, "").strip().lower()
-    return value not in ("", "0", "false", "no", "off")
 
 
 def force_xla():
@@ -71,7 +66,7 @@ def accel_kind():
     """Which spatial index the accel facade builds by default: ``"bvh"``
     (flattened rope LBVH) unless MESH_TPU_ACCEL_KIND=grid selects the
     uniform grid.  Unknown values fall back to bvh."""
-    value = os.environ.get("MESH_TPU_ACCEL_KIND", "").strip().lower()
+    value = (knobs.get_str("MESH_TPU_ACCEL_KIND") or "").lower()
     return "grid" if value == "grid" else "bvh"
 
 

@@ -1,0 +1,345 @@
+"""Central ``MESH_TPU_*`` environment-knob registry.
+
+Every environment variable the framework reads is declared HERE — name,
+type, default, and a one-line doc string — and read through the accessors
+below.  Three things hang off that single table:
+
+- ``doc/configuration.md`` is generated from it (tools/build_docs.py), so
+  the knob reference cannot rot;
+- the meshlint ``KNB`` rule (mesh_tpu/analysis/rules/knb.py) fails the
+  build on any raw ``os.environ`` read of a ``MESH_TPU_*`` key outside
+  this module, and on any declared knob missing from the generated doc;
+- ``raw()`` raises ``KeyError`` on an undeclared name, so a typo'd or
+  undeclared knob can never be read silently.
+
+Stdlib-only (no jax, no numpy): the obs/ primitives and the jax-free CLI
+subcommands (serve-stats, incidents, slo, perfcheck, lint) all sit on top
+of it.  Accessors re-read ``os.environ`` per call — same contract as the
+utils/dispatch escape hatches — so tests can toggle knobs at runtime.
+"""
+
+import os
+
+__all__ = [
+    "Knob", "declared", "lookup", "raw", "flag", "get_int", "get_float",
+    "get_str", "render_markdown", "OFF_VALUES",
+]
+
+#: shared flag truthiness: a knob explicitly set to one of these is OFF
+#: (so ``=0`` disables rather than enables)
+OFF_VALUES = ("", "0", "false", "no", "off")
+
+
+class Knob(object):
+    """One declared environment knob."""
+
+    __slots__ = ("name", "kind", "default", "doc", "section", "prefix")
+
+    def __init__(self, name, kind, default, doc, section, prefix=False):
+        self.name = name
+        self.kind = kind          # "flag" | "int" | "float" | "str" | "path"
+        self.default = default
+        self.doc = doc
+        self.section = section
+        self.prefix = prefix      # True: name is a prefix (MESH_TPU_X_<SUFFIX>)
+
+
+#: declaration order is doc order
+_REGISTRY = {}
+
+
+def _declare(name, kind, default, doc, section, prefix=False):
+    _REGISTRY[name] = Knob(name, kind, default, doc, section, prefix=prefix)
+    return name
+
+
+# -- core ------------------------------------------------------------------
+
+CACHE = _declare(
+    "MESH_TPU_CACHE", "path", "~/.mesh_tpu/cache",
+    "Topology/calibration cache folder (the reference's "
+    "$PSBODY_MESH_CACHE idea); the test harness points it at a throwaway "
+    "tmpdir.", "Core")
+TEST_TPU = _declare(
+    "MESH_TPU_TEST_TPU", "flag", False,
+    "Compiled-kernel test mode: keep the default (real-chip) backend "
+    "instead of the virtual 8-device CPU platform "
+    "(`make tpu_tests`, tests/conftest.py).", "Core")
+
+# -- dispatch escape hatches ----------------------------------------------
+
+FORCE_XLA = _declare(
+    "MESH_TPU_FORCE_XLA", "flag", False,
+    "Force the pure-XLA kernel paths even on TPU (escape hatch for a "
+    "Pallas kernel that misbehaves only when Mosaic-compiled).",
+    "Dispatch")
+SAFE_TILES = _declare(
+    "MESH_TPU_SAFE_TILES", "flag", False,
+    "Pin every Pallas kernel to its sliver-safe tile variant and force "
+    "the data-derived nondegeneracy check off.", "Dispatch")
+NO_ENGINE = _declare(
+    "MESH_TPU_NO_ENGINE", "flag", False,
+    "Bypass the shape-bucketed plan-cache engine: facades fall back to "
+    "the direct exact-shape jit-per-call path.", "Dispatch")
+VERTEX_CHAMFER = _declare(
+    "MESH_TPU_VERTEX_CHAMFER", "flag", False,
+    "Pin parallel/fit.py's data term to the legacy min-over-vertices "
+    "chamfer instead of the point-to-surface energy (read at step-build "
+    "time).", "Dispatch")
+NO_ACCEL = _declare(
+    "MESH_TPU_NO_ACCEL", "flag", False,
+    "Disable the spatial-index query paths (mesh_tpu.accel): auto never "
+    "routes to the index; callers fall back to brute/culled.", "Dispatch")
+ACCEL_KIND = _declare(
+    "MESH_TPU_ACCEL_KIND", "str", "bvh",
+    "Which spatial index the accel facade builds: `bvh` (flattened rope "
+    "LBVH, default) or `grid` (uniform grid); unknown values fall back "
+    "to bvh.", "Dispatch")
+BRUTE_MAX_FACES = _declare(
+    "MESH_TPU_BRUTE_MAX_FACES", "int", None,
+    "Face count up to which the auto strategy uses brute force "
+    "(overrides the calibrated crossover; query/autotune.py).",
+    "Dispatch")
+ACCEL_MIN_FACES = _declare(
+    "MESH_TPU_ACCEL_MIN_FACES", "int", None,
+    "Face count at which the auto strategy switches to the spatial "
+    "index (overrides the calibrated accel crossover).", "Dispatch")
+NO_XLA_CACHE = _declare(
+    "MESH_TPU_NO_XLA_CACHE", "flag", False,
+    "Opt out of the persistent XLA compilation cache "
+    "(utils/compilation_cache.py).", "Dispatch")
+XLA_CACHE = _declare(
+    "MESH_TPU_XLA_CACHE", "path", None,
+    "Relocate the persistent XLA compilation cache (default "
+    "`<MESH_TPU_CACHE>/xla`).", "Dispatch")
+
+# -- observability ---------------------------------------------------------
+
+OBS = _declare(
+    "MESH_TPU_OBS", "flag", False,
+    "Master gate for span tracing (metrics counters stay always-on); "
+    "off means spans are no-ops with <5% overhead pinned by the bench "
+    "guard.", "Observability")
+OBS_JSONL = _declare(
+    "MESH_TPU_OBS_JSONL", "path", None,
+    "Live span/metric JSON-lines sink path (obs/trace.py installs it on "
+    "first span).", "Observability")
+OBS_JSONL_MAX_MB = _declare(
+    "MESH_TPU_OBS_JSONL_MAX_MB", "float", None,
+    "Size cap (MiB) that rotates the JSONL sink; unset = unbounded.",
+    "Observability")
+OBS_JSONL_KEEP = _declare(
+    "MESH_TPU_OBS_JSONL_KEEP", "int", 3,
+    "Rotated JSONL generations to keep (oldest dropped).",
+    "Observability")
+OBS_JAX_TRACE = _declare(
+    "MESH_TPU_OBS_JAX_TRACE", "flag", False,
+    "Also emit spans as jax.profiler TraceAnnotations onto the device "
+    "timeline (opt-in on top of MESH_TPU_OBS).", "Observability")
+RECORDER = _declare(
+    "MESH_TPU_RECORDER", "flag", True,
+    "Always-on flight recorder kill switch: unset means ON; set to "
+    "0/false/off to disable recording entirely.", "Observability")
+RECORDER_EVENTS = _declare(
+    "MESH_TPU_RECORDER_EVENTS", "int", 2048,
+    "Flight-recorder ring capacity in events (min 16).", "Observability")
+INCIDENT_DIR = _declare(
+    "MESH_TPU_INCIDENT_DIR", "path", "~/.mesh_tpu/incidents",
+    "Directory for flight-recorder incident dumps.", "Observability")
+INCIDENT_KEEP = _declare(
+    "MESH_TPU_INCIDENT_KEEP", "int", 32,
+    "Incident dumps to keep before pruning the oldest (min 1).",
+    "Observability")
+SLO_DRIVES_HEALTH = _declare(
+    "MESH_TPU_SLO_DRIVES_HEALTH", "flag", False,
+    "Opt-in: a confirmed SLO fast-burn breach trips the serving "
+    "HealthMonitor to degraded (closes the detect->capture->degrade "
+    "loop).", "Observability")
+
+# -- serving ---------------------------------------------------------------
+
+SERVE_STATS = _declare(
+    "MESH_TPU_SERVE_STATS", "path", "~/.mesh_tpu/serve_stats.json",
+    "QueryService stats sink path (written on stop(); read by "
+    "`mesh-tpu serve-stats` / `slo`).", "Serving")
+SERVE_QUEUE = _declare(
+    "MESH_TPU_SERVE_QUEUE", "int", 64,
+    "Per-tenant admission queue bound (overridable per constructor).",
+    "Serving")
+SERVE_DEADLINE_S = _declare(
+    "MESH_TPU_SERVE_DEADLINE_S", "float", 1.0,
+    "Default request deadline in seconds.", "Serving")
+SERVE_WORKERS = _declare(
+    "MESH_TPU_SERVE_WORKERS", "int", 1,
+    "Queue-drain worker threads.", "Serving")
+SERVE_LADDER = _declare(
+    "MESH_TPU_SERVE_LADDER", "str", None,
+    "Comma-separated degradation-ladder rung names "
+    "(engine,culled,anchored,accel) to filter/reorder the default "
+    "engine->culled->anchored ladder.", "Serving")
+SERVE_WEDGE_S = _declare(
+    "MESH_TPU_SERVE_WEDGE_S", "float", 5.0,
+    "In-flight seconds before the health watchdog counts a dispatch as "
+    "wedged.", "Serving")
+
+# -- bench harness ---------------------------------------------------------
+
+BENCH_FAULT = _declare(
+    "MESH_TPU_BENCH_FAULT", "str", None,
+    "Fault injection for the staged bench pipeline: "
+    "`<stage>:hang|crash|error` (tests only).", "Bench harness")
+BENCH_PARTIAL = _declare(
+    "MESH_TPU_BENCH_PARTIAL", "path", None,
+    "Relocate the incremental bench_partial.json written after every "
+    "stage.", "Bench harness")
+BENCH_TIMEOUT_ = _declare(
+    "MESH_TPU_BENCH_TIMEOUT_", "float", None,
+    "Per-stage child timeout override in seconds "
+    "(`MESH_TPU_BENCH_TIMEOUT_<STAGE>`, e.g. ..._PALLAS_PROXY).",
+    "Bench harness", prefix=True)
+BENCH_REDUCTION = _declare(
+    "MESH_TPU_BENCH_REDUCTION", "str", None,
+    "bench.py kernel-knob A/B: reduction variant for gate 2b "
+    "(`fused`); non-default knobs never overwrite the last-good record.",
+    "Bench harness")
+BENCH_VARIANT = _declare(
+    "MESH_TPU_BENCH_VARIANT", "str", None,
+    "bench.py kernel-knob A/B: tile variant override (read by bench.py, "
+    "not the package).", "Bench harness")
+ACCEL_PROXY_FACES = _declare(
+    "MESH_TPU_ACCEL_PROXY_FACES", "int", None,
+    "accel_proxy bench stage: override the proxy mesh face count "
+    "(read by bench.py).", "Bench harness")
+ACCEL_PROXY_QUERIES = _declare(
+    "MESH_TPU_ACCEL_PROXY_QUERIES", "int", None,
+    "accel_proxy bench stage: override the proxy query count (read by "
+    "bench.py).", "Bench harness")
+
+
+# -- accessors -------------------------------------------------------------
+
+_UNSET = object()
+
+
+def declared():
+    """All declared knobs, in declaration (= doc) order."""
+    return list(_REGISTRY.values())
+
+
+def lookup(name):
+    """The :class:`Knob` for ``name`` (exact, or a declared prefix knob).
+
+    Raises ``KeyError`` for undeclared names — reading an undeclared
+    MESH_TPU knob is a bug the KNB lint rule catches statically and this
+    raise catches dynamically.
+    """
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        for knob in _REGISTRY.values():
+            if knob.prefix and name.startswith(knob.name):
+                return knob
+        raise KeyError("undeclared knob %r (declare it in "
+                       "mesh_tpu/utils/knobs.py)" % (name,))
+
+
+def raw(name):
+    """The raw environment value (or None).  The ONE place in the
+    package that reads ``os.environ`` with a MESH_TPU key."""
+    lookup(name)
+    return os.environ.get(name)
+
+
+def flag(name):
+    """Flag truthiness shared by every escape hatch: unset means the
+    declared default; explicitly set to ''/'0'/'false'/'no'/'off' means
+    OFF; anything else means ON."""
+    knob = lookup(name)
+    value = raw(name)
+    if value is None:
+        return bool(knob.default)
+    return value.strip().lower() not in OFF_VALUES
+
+
+def get_int(name, default=_UNSET):
+    """Integer knob; unset/blank/malformed falls back to ``default``
+    (the declared default unless overridden)."""
+    if default is _UNSET:
+        default = lookup(name).default
+    value = raw(name)
+    if value is None or not value.strip():
+        return default
+    try:
+        return int(value.strip())
+    except ValueError:
+        return default
+
+
+def get_float(name, default=_UNSET):
+    """Float knob; unset/blank/malformed falls back to ``default``."""
+    if default is _UNSET:
+        default = lookup(name).default
+    value = raw(name)
+    if value is None or not value.strip():
+        return default
+    try:
+        return float(value.strip())
+    except ValueError:
+        return default
+
+
+def get_str(name, default=_UNSET):
+    """String/path knob, stripped; unset or blank falls back to
+    ``default`` (paths are NOT expanded — callers expanduser)."""
+    if default is _UNSET:
+        default = lookup(name).default
+    value = raw(name)
+    if value is None or not value.strip():
+        return default
+    return value.strip()
+
+
+# -- doc generation --------------------------------------------------------
+
+def render_markdown():
+    """The doc/configuration.md body (tools/build_docs.py writes it; the
+    KNB rule checks every declared knob appears there)."""
+    lines = [
+        "# Configuration knobs",
+        "",
+        "Every `MESH_TPU_*` environment variable the framework reads, "
+        "generated",
+        "from the declaration table in `mesh_tpu/utils/knobs.py` by",
+        "`tools/build_docs.py` — edit the table, not this file.  Flags "
+        "share one",
+        "truthiness: explicitly set to ``''``/``0``/``false``/``no``/"
+        "``off`` means",
+        "OFF, anything else means ON, unset means the default below.  "
+        "All knobs",
+        "are re-read per call unless their doc says otherwise.",
+        "",
+        "The meshlint `KNB` rule ([static_analysis.md]"
+        "(static_analysis.md)) enforces",
+        "that no module outside `utils/knobs.py` reads these keys raw "
+        "and that",
+        "this page stays complete.",
+        "",
+    ]
+    sections = []
+    for knob in declared():
+        if knob.section not in sections:
+            sections.append(knob.section)
+    for section in sections:
+        lines += ["## %s" % section, "",
+                  "| knob | type | default | effect |", "|---|---|---|---|"]
+        for knob in declared():
+            if knob.section != section:
+                continue
+            name = (knob.name + "<STAGE>") if knob.prefix else knob.name
+            default = ("on" if knob.default else "off") \
+                if knob.kind == "flag" else (
+                "unset" if knob.default is None else "`%s`" % (knob.default,))
+            lines.append("| `%s` | %s | %s | %s |"
+                         % (name, knob.kind, default, knob.doc))
+        lines.append("")
+    return "\n".join(lines)

@@ -1,0 +1,684 @@
+"""meshlint engine + rule-pack tests (ISSUE PR-8 tentpole).
+
+Three layers:
+
+- engine mechanics: fingerprints (line-free), baseline add/expire with
+  reason preservation, the rc contract (clean=0 / new warning+=1 /
+  baseline-only=0 / notes-never-block), the JSON report schema;
+- per-rule fixtures: one positive and one negative snippet per rule id
+  through ``engine.check_source`` (the fixture entry point), plus
+  project-level fixtures (tmp trees) for the cross-file codes
+  (KNB002, OBS001);
+- the shipped tree: ``python -m mesh_tpu.cli lint --json`` in a
+  subprocess must exit 0 with zero new findings in under 10 seconds —
+  the gate-0 contract.
+
+All of this is jax-free by design (the analyzer is stdlib-only).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+from mesh_tpu.analysis import engine
+from mesh_tpu.analysis.engine import (
+    Finding, Report, build_project, check_source, load_baseline,
+    save_baseline,
+)
+from mesh_tpu.analysis.rules import all_rules
+from mesh_tpu.analysis.rules.knb import KnobRegistryRule
+from mesh_tpu.analysis.rules.lck import LockDisciplineRule
+from mesh_tpu.analysis.rules.obs import ObservabilityHygieneRule
+from mesh_tpu.analysis.rules.rcp import RecompileHazardRule
+from mesh_tpu.analysis.rules.trc import TracerLeakRule
+from mesh_tpu.analysis.rules.vmem import VmemBudgetRule
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _codes(findings):
+    return [f.rule for f in findings]
+
+
+def _run(rule, source):
+    return check_source(rule, textwrap.dedent(source))
+
+
+# -- engine mechanics --------------------------------------------------
+
+def test_fingerprint_is_line_free_and_message_sensitive():
+    a = Finding("TRC001", "error", "mesh_tpu/x.py", 10, "msg")
+    b = Finding("TRC001", "error", "mesh_tpu/x.py", 999, "msg")
+    c = Finding("TRC001", "error", "mesh_tpu/x.py", 10, "other msg")
+    assert a.fingerprint == b.fingerprint      # survives edits above it
+    assert a.fingerprint != c.fingerprint
+    assert len(a.fingerprint) == 12
+
+
+def test_rc_matrix():
+    warn = Finding("RCP001", "warning", "a.py", 1, "w")
+    note = Finding("VMEM003", "note", "a.py", 2, "n")
+    err = Finding("TRC001", "error", "a.py", 3, "e")
+    # clean tree -> 0
+    assert Report([], {}, 0.0, 1).rc == 0
+    # new warning -> 1; new error -> 1
+    assert Report([warn], {}, 0.0, 1).rc == 1
+    assert Report([err], {}, 0.0, 1).rc == 1
+    # notes never block
+    assert Report([note], {}, 0.0, 1).rc == 0
+    # everything baselined -> 0, listed as suppressed
+    baseline = {warn.fingerprint: {"rule": "RCP001"},
+                err.fingerprint: {"rule": "TRC001"}}
+    report = Report([warn, err], baseline, 0.0, 1)
+    assert report.rc == 0
+    assert len(report.suppressed) == 2 and not report.new
+    # a stale entry (fixed finding) is reported but does not block
+    stale = dict(baseline, deadbeef0000={"rule": "LCK001", "path": "b.py"})
+    report = Report([warn, err], stale, 0.0, 1)
+    assert report.rc == 0
+    assert set(report.stale) == {"deadbeef0000"}
+
+
+def test_report_json_schema():
+    warn = Finding("RCP001", "warning", "a.py", 1, "w", hint="h")
+    doc = Report([warn], {}, 0.123, 7).to_dict()
+    assert doc["schema_version"] == engine.SCHEMA_VERSION
+    assert doc["rc"] == 1
+    assert doc["files_scanned"] == 7
+    assert doc["counts"] == {"total": 1, "new": 1, "suppressed": 0,
+                             "stale_baseline": 0}
+    (entry,) = doc["findings"]
+    assert entry["rule"] == "RCP001" and entry["severity"] == "warning"
+    assert entry["path"] == "a.py" and entry["line"] == 1
+    assert entry["hint"] == "h"
+    assert entry["fingerprint"] == warn.fingerprint
+    assert doc["suppressed"] == [] and doc["stale_baseline"] == []
+
+
+def test_baseline_add_expire_and_reason_preservation(tmp_path):
+    path = str(tmp_path / "baseline.json")
+    warn = Finding("RCP001", "warning", "a.py", 1, "w")
+    err = Finding("TRC001", "error", "b.py", 2, "e")
+    save_baseline(path, [warn, err])
+    entries = load_baseline(path)
+    assert set(entries) == {warn.fingerprint, err.fingerprint}
+    assert entries[warn.fingerprint]["reason"].startswith("TODO")
+    # a human writes a reason; re-saving (finding fixed -> expires,
+    # finding kept -> reason carried forward) must preserve it
+    entries[warn.fingerprint]["reason"] = "deliberate, measured"
+    save_baseline(path, [warn], old_entries=entries)
+    entries = load_baseline(path)
+    assert set(entries) == {warn.fingerprint}          # err expired
+    assert entries[warn.fingerprint]["reason"] == "deliberate, measured"
+    # missing file is an empty baseline, not an error
+    assert load_baseline(str(tmp_path / "nope.json")) == {}
+
+
+def test_run_lint_end_to_end_rc_cycle(tmp_path):
+    pkg = tmp_path / "mesh_tpu"
+    pkg.mkdir()
+    bad = pkg / "bad.py"
+    bad.write_text('import os\n\n'
+                   'def f():\n'
+                   '    return os.environ.get("MESH_TPU_DEMO")\n')
+    baseline = str(tmp_path / "tools" / "meshlint_baseline.json")
+    rules = lambda: [KnobRegistryRule()]
+    # new error -> rc 1
+    report = engine.run_lint(str(tmp_path), rules=rules(),
+                             baseline_path=baseline)
+    assert report.rc == 1 and _codes(report.new) == ["KNB001"]
+    # baseline it -> rc 0, suppressed
+    save_baseline(baseline, report.new)
+    report = engine.run_lint(str(tmp_path), rules=rules(),
+                             baseline_path=baseline)
+    assert report.rc == 0 and not report.new and len(report.suppressed) == 1
+    # fix the file -> rc 0 with a stale baseline entry
+    bad.write_text("def f():\n    return None\n")
+    report = engine.run_lint(str(tmp_path), rules=rules(),
+                             baseline_path=baseline)
+    assert report.rc == 0 and not report.findings and len(report.stale) == 1
+    assert "stale baseline entry" in report.render_human()
+
+
+def test_parse_failure_is_a_finding_not_a_crash(tmp_path):
+    pkg = tmp_path / "mesh_tpu"
+    pkg.mkdir()
+    (pkg / "broken.py").write_text("def f(:\n")
+    project, failures = build_project(str(tmp_path))
+    assert _codes(failures) == ["PARSE"]
+    assert failures[0].severity == "error"
+    assert project.by_relpath == {}
+
+
+def test_all_rules_registry():
+    rules = all_rules()
+    assert [r.id for r in rules] == ["TRC", "RCP", "VMEM", "LCK", "KNB",
+                                     "OBS"]
+    assert all_rules()[0] is not rules[0]      # fresh instances each call
+
+
+# -- TRC fixtures ------------------------------------------------------
+
+def test_trc001_item_in_traced_code():
+    findings = _run(TracerLeakRule(), """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x.item()
+        """)
+    assert _codes(findings) == ["TRC001"]
+    assert findings[0].severity == "error"
+    # negative: host-side code may call .item() freely
+    assert not _run(TracerLeakRule(), """
+        def host(x):
+            return x.item()
+        """)
+
+
+def test_trc001_reaches_transitive_helpers_and_tolist():
+    findings = _run(TracerLeakRule(), """
+        import jax
+
+        def helper(x):
+            return x.tolist()
+
+        @jax.jit
+        def f(x):
+            return helper(x)
+        """)
+    assert _codes(findings) == ["TRC001"]
+
+
+def test_trc002_block_until_ready():
+    findings = _run(TracerLeakRule(), """
+        import jax
+
+        def kernel(x):
+            x.block_until_ready()
+            return x
+
+        g = jax.jit(kernel)
+        """)
+    assert _codes(findings) == ["TRC002"]
+    assert not _run(TracerLeakRule(), """
+        def warmup(x):
+            x.block_until_ready()
+            return x
+        """)
+
+
+def test_trc003_numpy_materialization():
+    findings = _run(TracerLeakRule(), """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            return np.asarray(x)
+        """)
+    assert _codes(findings) == ["TRC003"]
+    # negative: jnp inside traced code is the fix, not a finding
+    assert not _run(TracerLeakRule(), """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            return jnp.asarray(x)
+        """)
+
+
+def test_trc004_float_on_traced_value():
+    findings = _run(TracerLeakRule(), """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return float(x) * 2.0
+        """)
+    assert _codes(findings) == ["TRC004"]
+    # negative 1: static_argnames-declared params are host values
+    assert not _run(TracerLeakRule(), """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("eps",))
+        def f(x, eps):
+            return x * float(eps)
+        """)
+    # negative 2: shape-derived expressions are static even on tracers
+    assert not _run(TracerLeakRule(), """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x * float(x.shape[0])
+        """)
+    # negative 3: a transitively-reached builder's bare params are
+    # trace-build-time config, not tracers...
+    assert not _run(TracerLeakRule(), """
+        import jax
+
+        def build(flag):
+            return bool(flag)
+
+        @jax.jit
+        def f(x):
+            build(True)
+            return x
+        """)
+    # ...but provably device-derived expressions still flag anywhere
+    findings = _run(TracerLeakRule(), """
+        import jax
+        import jax.numpy as jnp
+
+        def helper(x):
+            return float(jnp.sum(x))
+
+        @jax.jit
+        def f(x):
+            return helper(x)
+        """)
+    assert _codes(findings) == ["TRC004"]
+
+
+# -- RCP fixtures ------------------------------------------------------
+
+def test_rcp001_jit_in_loop():
+    findings = _run(RecompileHazardRule(), """
+        import jax
+
+        def run(fns, xs):
+            out = []
+            for fn in fns:
+                out.append(jax.jit(fn)(xs))
+            return out
+        """)
+    assert _codes(findings) == ["RCP001"]
+    assert not _run(RecompileHazardRule(), """
+        import jax
+
+        def run(fn, xs):
+            jitted = jax.jit(fn)
+            return [jitted(x) for x in xs]
+        """)
+
+
+def test_rcp002_lambda_in_function_body():
+    findings = _run(RecompileHazardRule(), """
+        import jax
+
+        def make(scale):
+            return jax.jit(lambda x: x * scale)
+        """)
+    assert _codes(findings) == ["RCP002"]
+    # negative: a module-level jit(lambda) runs once and is fine
+    assert not _run(RecompileHazardRule(), """
+        import jax
+
+        double = jax.jit(lambda x: x * 2)
+        """)
+
+
+def test_rcp003_non_literal_static_spec():
+    findings = _run(RecompileHazardRule(), """
+        import jax
+
+        def make(fn, spec):
+            return jax.jit(fn, static_argnums=spec)
+        """)
+    assert _codes(findings) == ["RCP003"]
+    # negatives: literals, and one module-constant indirection
+    assert not _run(RecompileHazardRule(), """
+        import jax
+
+        _STATIC = (0, 1)
+
+        def make(fn):
+            a = jax.jit(fn, static_argnums=(0,))
+            b = jax.jit(fn, static_argnames=("tile", "eps"))
+            c = jax.jit(fn, static_argnums=_STATIC)
+            return a, b, c
+        """)
+
+
+# -- VMEM fixtures -----------------------------------------------------
+
+def test_vmem001_budget_overrun():
+    findings = _run(VmemBudgetRule(), """
+        import jax.experimental.pallas as pl
+
+        def build(kernel, tile=4096):
+            return pl.pallas_call(
+                kernel,
+                in_specs=[pl.BlockSpec((tile, 4096))],
+                out_specs=pl.BlockSpec((tile, 4096)),
+            )
+        """)
+    # 2 * 4096*4096*4B = 128 MiB >> 16 MiB
+    assert _codes(findings) == ["VMEM001"]
+    assert findings[0].severity == "error"
+    assert "2 spec(s) priced" in findings[0].message
+    # negative: comfortable tiles
+    assert not _run(VmemBudgetRule(), """
+        import jax.experimental.pallas as pl
+
+        def build(kernel, tile=256):
+            return pl.pallas_call(
+                kernel,
+                in_specs=[pl.BlockSpec((tile, 128))],
+                out_specs=pl.BlockSpec((tile, 128)),
+            )
+        """)
+
+
+def test_vmem001_prices_scratch_dtypes():
+    # 2048*2048 f32 scratch = 16 MiB exactly, plus a (8,128) spec -> over
+    findings = _run(VmemBudgetRule(), """
+        import jax.experimental.pallas as pl
+        import jax.experimental.pallas.tpu as pltpu
+        import jax.numpy as jnp
+
+        def build(kernel):
+            return pl.pallas_call(
+                kernel,
+                in_specs=[pl.BlockSpec((8, 128))],
+                scratch_shapes=[pltpu.VMEM((2048, 2048), jnp.float32)],
+            )
+        """)
+    assert _codes(findings) == ["VMEM001"]
+    # bfloat16 halves it -> fits
+    assert not _run(VmemBudgetRule(), """
+        import jax.experimental.pallas as pl
+        import jax.experimental.pallas.tpu as pltpu
+        import jax.numpy as jnp
+
+        def build(kernel):
+            return pl.pallas_call(
+                kernel,
+                in_specs=[pl.BlockSpec((8, 128))],
+                scratch_shapes=[pltpu.VMEM((2048, 2048), jnp.bfloat16)],
+            )
+        """)
+
+
+def test_vmem002_lane_alignment():
+    findings = _run(VmemBudgetRule(), """
+        import jax.experimental.pallas as pl
+
+        def build(kernel):
+            return pl.pallas_call(
+                kernel, in_specs=[pl.BlockSpec((8, 96))])
+        """)
+    assert _codes(findings) == ["VMEM002"]
+    # negatives: multiples of 128, and lane == 1 (scalar column) exempt
+    assert not _run(VmemBudgetRule(), """
+        import jax.experimental.pallas as pl
+
+        def build(kernel):
+            return pl.pallas_call(
+                kernel,
+                in_specs=[pl.BlockSpec((8, 256)), pl.BlockSpec((8, 1))])
+        """)
+
+
+def test_vmem003_sublane_alignment_is_a_note():
+    findings = _run(VmemBudgetRule(), """
+        import jax.experimental.pallas as pl
+
+        def build(kernel):
+            return pl.pallas_call(
+                kernel, in_specs=[pl.BlockSpec((3, 128))])
+        """)
+    assert _codes(findings) == ["VMEM003"]
+    assert findings[0].severity == "note"
+    assert not _run(VmemBudgetRule(), """
+        import jax.experimental.pallas as pl
+
+        def build(kernel):
+            return pl.pallas_call(
+                kernel, in_specs=[pl.BlockSpec((16, 128))])
+        """)
+
+
+# -- LCK fixtures ------------------------------------------------------
+
+def test_lck001_mixed_discipline_is_an_error():
+    findings = _run(LockDisciplineRule(), """
+        import threading
+
+        _LOCK = threading.Lock()
+        _CACHE = {}
+
+        def put(k, v):
+            with _LOCK:
+                _CACHE[k] = v
+
+        def racy(k, v):
+            _CACHE[k] = v
+        """)
+    assert _codes(findings) == ["LCK001"]
+    assert findings[0].severity == "error"
+    # negative: consistently guarded (incl. a *_locked helper)
+    assert not _run(LockDisciplineRule(), """
+        import threading
+
+        _LOCK = threading.Lock()
+        _CACHE = {}
+
+        def put(k, v):
+            with _LOCK:
+                _CACHE[k] = v
+
+        def _evict_locked(k):
+            _CACHE.pop(k, None)
+        """)
+
+
+def test_lck002_never_guarded_is_a_warning():
+    findings = _run(LockDisciplineRule(), """
+        import threading
+
+        _LOCK = threading.Lock()
+        _ITEMS = []
+
+        def add(x):
+            _ITEMS.append(x)
+        """)
+    assert _codes(findings) == ["LCK002"]
+    assert findings[0].severity == "warning"
+    # negative 1: no module-level lock -> single-threaded by design
+    assert not _run(LockDisciplineRule(), """
+        _ITEMS = []
+
+        def add(x):
+            _ITEMS.append(x)
+        """)
+    # negative 2: import-time init precedes all threads
+    assert not _run(LockDisciplineRule(), """
+        import threading
+
+        _LOCK = threading.Lock()
+        _ITEMS = []
+        _ITEMS.append("seed")
+        """)
+
+
+# -- KNB fixtures ------------------------------------------------------
+
+def test_knb001_raw_env_reads():
+    rule = KnobRegistryRule()
+    findings = _run(rule, """
+        import os
+
+        _ENV = "MESH_TPU_RECORDER"
+
+        def f():
+            a = os.environ.get("MESH_TPU_DEMO")
+            b = os.getenv(_ENV)
+            c = os.environ["MESH_TPU_CACHE"]
+            return a, b, c
+        """)
+    assert _codes(findings) == ["KNB001"] * 3
+    # negatives: writes/pops, non-prefix keys, and the registry itself
+    assert not _run(rule, """
+        import os
+
+        def f():
+            os.environ["MESH_TPU_OBS"] = "1"
+            del os.environ["MESH_TPU_OBS"]
+            return os.environ.get("HOME")
+        """)
+    assert not check_source(
+        rule,
+        'import os\nV = os.environ.get("MESH_TPU_DEMO")\n',
+        relpath="mesh_tpu/utils/knobs.py")
+
+
+def test_knb002_doc_table_coverage(tmp_path):
+    pkg = tmp_path / "mesh_tpu" / "utils"
+    pkg.mkdir(parents=True)
+    (pkg / "knobs.py").write_text(
+        "def _declare(name, **kw):\n    pass\n\n"
+        '_declare("MESH_TPU_ALPHA")\n'
+        '_declare("MESH_TPU_BETA")\n')
+    rule = KnobRegistryRule()
+
+    def run():
+        project, failures = build_project(str(tmp_path))
+        assert not failures
+        return list(rule.finalize(project))
+
+    # no doc at all -> one error pointing at the generator
+    findings = run()
+    assert _codes(findings) == ["KNB002"]
+    assert "missing" in findings[0].message
+    # doc covering one knob -> the other is flagged at its declaration
+    doc = tmp_path / "doc"
+    doc.mkdir()
+    (doc / "configuration.md").write_text("| `MESH_TPU_ALPHA` | ... |\n")
+    findings = run()
+    assert _codes(findings) == ["KNB002"]
+    assert "MESH_TPU_BETA" in findings[0].message
+    assert findings[0].line == 5
+    # doc covering both -> clean
+    (doc / "configuration.md").write_text(
+        "| `MESH_TPU_ALPHA` |\n| `MESH_TPU_BETA` |\n")
+    assert not run()
+
+
+# -- OBS fixtures ------------------------------------------------------
+
+def test_obs001_undocumented_series(tmp_path):
+    pkg = tmp_path / "mesh_tpu"
+    pkg.mkdir()
+    (pkg / "instrumented.py").write_text(
+        'from mesh_tpu.obs import counter\n\n\n'
+        'def hit():\n'
+        '    counter("mesh_tpu_fixture_hits_total").inc()\n')
+    doc = tmp_path / "doc"
+    doc.mkdir()
+    rule = ObservabilityHygieneRule()
+
+    def run():
+        project, failures = build_project(str(tmp_path))
+        assert not failures
+        return list(rule.finalize(project))
+
+    (doc / "observability.md").write_text("| `mesh_tpu_other_total` |\n")
+    findings = run()
+    assert _codes(findings) == ["OBS001"]
+    assert findings[0].severity == "error"
+    assert "mesh_tpu_fixture_hits_total" in findings[0].message
+    assert findings[0].path == "mesh_tpu/instrumented.py"
+    # brace shorthand on the doc side documents it -> clean
+    (doc / "observability.md").write_text(
+        "| `mesh_tpu_fixture_{hits,misses}_total` |\n")
+    assert not run()
+
+
+def test_obs002_dynamic_series_name():
+    rule = ObservabilityHygieneRule()
+    findings = _run(rule, """
+        def record(registry, name):
+            registry.counter(name).inc()
+        """)
+    assert _codes(findings) == ["OBS002"]
+    # negatives: a literal name, and the registry implementation itself
+    assert not _run(rule, """
+        def record(registry):
+            registry.counter("mesh_tpu_fixture_total").inc()
+        """)
+    assert not check_source(
+        rule,
+        "def record(registry, name):\n"
+        "    registry.counter(name).inc()\n",
+        relpath="mesh_tpu/obs/metrics.py")
+
+
+def test_obs003_dynamic_label_names():
+    rule = ObservabilityHygieneRule()
+    findings = _run(rule, """
+        def record(c, labels):
+            c.inc(**labels)
+        """)
+    assert _codes(findings) == ["OBS003"]
+    # negatives: named labels (dynamic VALUES are fine), and a **dict
+    # literal whose keys are statically visible
+    assert not _run(rule, """
+        def record(c, tenant):
+            c.inc(tenant=tenant)
+            c.observe(0.5, **{"tier": "gold"})
+        """)
+
+
+def test_obs004_raw_clock_reads():
+    rule = ObservabilityHygieneRule()
+    findings = _run(rule, """
+        import time
+
+        def f():
+            return time.perf_counter()
+        """)
+    assert _codes(findings) == ["OBS004"]
+    # negatives: aliasing without calling (the obs.clock idiom), and
+    # the exempt subtrees
+    assert not _run(rule, """
+        import time
+
+        monotonic = time.perf_counter
+        """)
+    assert not check_source(
+        rule,
+        "import time\n\n\ndef f():\n    return time.time()\n",
+        relpath="mesh_tpu/obs/clock_impl.py")
+
+
+# -- the shipped tree (the gate-0 contract) ----------------------------
+
+def test_shipped_tree_lints_clean_and_fast():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    proc = subprocess.run(
+        [sys.executable, "-m", "mesh_tpu.cli", "lint", "--json"],
+        cwd=_REPO, env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["schema_version"] == engine.SCHEMA_VERSION
+    assert doc["rc"] == 0
+    assert doc["counts"]["new"] == 0
+    assert doc["files_scanned"] > 50
+    # the gate-0 budget: chip-free and fast enough to run before
+    # every chip cycle (the acceptance threshold is 10s)
+    assert doc["elapsed_s"] < 10.0
+    # every baselined suppression must carry a human-written reason
+    baseline = load_baseline(engine.default_baseline_path(_REPO))
+    assert baseline, "shipped baseline should not be empty"
+    for fingerprint, entry in baseline.items():
+        reason = entry.get("reason") or ""
+        assert reason and not reason.startswith("TODO"), (
+            "baseline entry %s lacks a justification" % fingerprint)

@@ -1,49 +1,35 @@
 """Timing discipline lint (ISSUE PR-2 satellite e).
 
-Raw clock reads scattered through the hot path are how timing code rots:
-they bypass the span tracer's sync-aware measurement and the overhead
-gate.  Every wall-clock read in ``mesh_tpu/`` must go through
-``utils/profiling.py`` (Timer / time_fn) or ``obs/`` (obs.clock
-re-exports the clocks; spans build on them).  ``viewer/`` is exempt —
-its deadlines and UI latencies are not hot-path measurements.
+Thin wrapper over the meshlint OBS004 rule (``mesh_tpu.analysis``):
+raw clock reads scattered through the hot path bypass the span tracer's
+sync-aware measurement and the overhead gate, so every wall-clock read
+in ``mesh_tpu/`` must go through ``utils/profiling.py`` (Timer /
+time_fn) or ``obs/`` (obs.clock re-exports the clocks; spans build on
+them).  ``viewer/`` is exempt (UI latencies are not hot-path
+measurements), and so is ``analysis/`` itself (offline lint tooling —
+its own elapsed-time stamp is not a measurement of anything on-device).
+The exemption list lives with the rule; this test runs it over the
+real tree so `pytest` and `mesh-tpu lint` can never disagree.
 """
 
 import os
-import re
 
-_PKG = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "mesh_tpu"
-)
+from mesh_tpu.analysis import build_project
+from mesh_tpu.analysis.rules.obs import ObservabilityHygieneRule
 
-#: a raw clock CALL — `monotonic = time.perf_counter` aliasing (obs.clock)
-#: deliberately does not match
-_RAW_CLOCK = re.compile(
-    r"\btime\.(time|perf_counter|monotonic|process_time)\s*\("
-)
-
-_EXEMPT = (
-    os.path.join("utils", "profiling.py"),
-    "obs" + os.sep,
-    "viewer" + os.sep,
-)
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def test_no_raw_clock_reads_outside_profiling_and_obs():
+    project, failures = build_project(_REPO)
+    assert not failures, [f.render() for f in failures]
+    rule = ObservabilityHygieneRule()
     offenders = []
-    for root, _dirs, files in os.walk(_PKG):
-        for name in files:
-            if not name.endswith(".py"):
-                continue
-            path = os.path.join(root, name)
-            rel = os.path.relpath(path, _PKG)
-            if any(rel.startswith(e) or rel == e.rstrip(os.sep)
-                   for e in _EXEMPT):
-                continue
-            with open(path, encoding="utf-8") as fh:
-                for lineno, line in enumerate(fh, 1):
-                    if _RAW_CLOCK.search(line):
-                        offenders.append("%s:%d: %s"
-                                         % (rel, lineno, line.strip()))
+    for ctx in project.contexts:
+        for finding in rule.check(ctx):
+            if finding.rule == "OBS004":
+                offenders.append("%s:%d: %s" % (
+                    finding.path, finding.line, ctx.line(finding.line)))
     assert not offenders, (
         "raw clock reads outside utils/profiling.py and obs/ "
         "(route them through obs.clock or Timer):\n"

@@ -53,8 +53,17 @@ def _mtime_utc(path):
 
 def harvest(logdir):
     """Collect every gate's result into a structured dict."""
-    out = {"logdir": logdir, "gate1": None, "bench": None,
+    out = {"logdir": logdir, "lint": None, "gate1": None, "bench": None,
            "configs": [], "sweeps": []}
+
+    g0 = os.path.join(logdir, "gate0.log")
+    if os.path.exists(g0):
+        try:
+            with open(g0) as fh:
+                rec = json.load(fh)   # `mesh-tpu lint --json` is one doc
+        except (OSError, ValueError):
+            rec = None
+        out["lint"] = {"rec": rec, "mtime_utc": _mtime_utc(g0)}
 
     g1 = os.path.join(logdir, "gate1.log")
     if os.path.exists(g1):
@@ -95,6 +104,30 @@ def harvest(logdir):
 def render_table(h):
     """The human-readable summary (also what lands in BASELINE.md)."""
     lines = []
+    if h.get("lint"):
+        rec = h["lint"]["rec"]
+        counts = (rec or {}).get("counts", {})
+        if rec is None:
+            # hard gate: an unreadable lint record reads as a failure,
+            # never as a silent pass
+            lines.append(
+                "gate 0 (meshlint, %s): NOT AN IMPROVEMENT — lint "
+                "record unreadable (rerun `mesh-tpu lint --json`)"
+                % h["lint"]["mtime_utc"])
+        elif rec.get("rc") or counts.get("new"):
+            lines.append(
+                "gate 0 (meshlint, %s): NOT AN IMPROVEMENT — %s new "
+                "static-analysis finding(s); fix or baseline them "
+                "(tools/meshlint_baseline.json) before quoting numbers"
+                % (h["lint"]["mtime_utc"], counts.get("new", "?")))
+        else:
+            lines.append(
+                "gate 0 (meshlint, %s): OK — 0 new findings over %s "
+                "file(s) (%s baselined, %s stale)" % (
+                    h["lint"]["mtime_utc"],
+                    rec.get("files_scanned", "?"),
+                    counts.get("suppressed", 0),
+                    counts.get("stale_baseline", 0)))
     if h["gate1"]:
         lines.append("gate 1 (compiled kernels, %s): %s" % (
             h["gate1"]["mtime_utc"], h["gate1"]["summary"]))
@@ -266,7 +299,7 @@ def main():
     h = harvest(logdir)
     print(render_table(h))
     if not (h["gate1"] or h["bench"] or h["configs"] or h["sweeps"]
-            or h["bench_variants"]):
+            or h["bench_variants"] or h["lint"]):
         print("nothing harvested from %s" % logdir)
         return 1
     if write:

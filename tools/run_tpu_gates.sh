@@ -28,6 +28,30 @@ rm -f "$LOGDIR"/*.log
 rm -rf "$LOGDIR/trace"
 fail=0
 
+echo "=== gate 0: meshlint static analysis (chip-free) ==="
+# the analyzer is stdlib-only and must never touch the chip: force the
+# CPU backend exactly like the other chip-free tools
+if PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m mesh_tpu.cli lint \
+        --json > "$LOGDIR/gate0.log" 2>"$LOGDIR/gate0.err"; then
+    echo "gate 0 OK ($(python -c 'import json,sys; d=json.load(open(sys.argv[1])); print("%d files, %d baselined" % (d["files_scanned"], d["counts"]["suppressed"]))' "$LOGDIR/gate0.log"))"
+else
+    cat "$LOGDIR/gate0.err" >&2 || true
+    python - "$LOGDIR/gate0.log" <<'PYEOF' || true
+import json, sys
+try:
+    doc = json.load(open(sys.argv[1]))
+except Exception:
+    sys.exit(0)
+for f in doc.get("findings", []):
+    print("  %s:%s: %s %s %s" % (f["path"], f["line"], f["severity"],
+                                 f["rule"], f["message"]))
+PYEOF
+    echo "gate 0 FAILED — stopping: new static-analysis findings must be"
+    echo "fixed (or baselined with a reason in tools/meshlint_baseline.json)"
+    echo "before any chip time is spent."
+    exit 1
+fi
+
 echo "=== gate 1: compiled-kernel tests on the real chip ==="
 if MESH_TPU_TEST_TPU=1 python -m pytest tests/test_tpu_compiled.py -m tpu -q \
         2>&1 | tee "$LOGDIR/gate1.log"; then
