@@ -1024,6 +1024,101 @@ def accel_proxy_stage(n_rep=1):
     }
 
 
+def accel_stream_proxy_stage(n_rep=1):
+    """Stage ``accel_stream_proxy``: the chip-free STREAMED-kernel
+    metric.  Runs the double-buffered-DMA Pallas rope kernel
+    (mesh_tpu.accel.pallas_stream) in interpret mode over the same
+    >=200k-face parametric sphere the accel_proxy stage walks — a mesh
+    ~3x past the resident kernel's default VMEM budget, so this is the
+    regime the streamed variant exists for.  Deterministic (fixed mesh,
+    fixed queries, exact traversal): the checksum pins exactness and the
+    pair-tests-skipped ratio pins the sub-linearity, graded by
+    ``mesh-tpu perfcheck`` against benchmarks/accel_stream_golden.json.
+    A small resident-vs-streamed run must agree bit for bit — the
+    stage fails outright on any mismatch.  Sizes are overridable via
+    MESH_TPU_STREAM_PROXY_FACES / MESH_TPU_STREAM_PROXY_QUERIES.
+
+    Queries are SURFACE-PROXIMAL (unit directions pushed a few percent
+    off the sphere) — the scan-registration workload the rope kernels
+    serve.  Tile-granular pruning compares the min-over-tile box bound
+    with the max-over-tile running distance, so it only fires when a
+    Morton tile of queries is a spatially compact patch with a tight
+    worst case; volume-filling ``randn`` queries on a closed surface are
+    its adversarial case (every tile spans the interior and keeps every
+    leaf reachable, skip ratio ~0) and would pin nothing but that."""
+    import jax
+    import jax.numpy as jnp
+
+    from mesh_tpu.accel.build import build_bvh
+    from mesh_tpu.accel.pallas_bvh import closest_point_pallas_bvh
+    from mesh_tpu.accel.pallas_stream import closest_point_pallas_bvh_stream
+    from mesh_tpu.query.autotune import _sphere_mesh
+    from mesh_tpu.sphere import _icosphere
+
+    tile_q, tile_f, n_buffers = 128, 256, 2
+    n_faces = knobs.get_int("MESH_TPU_STREAM_PROXY_FACES", 210000)
+    n_q = knobs.get_int("MESH_TPU_STREAM_PROXY_QUERIES", 4096)
+    v, f = _sphere_mesh(n_faces)
+    rng = np.random.RandomState(0)
+    pts = rng.randn(n_q, 3)
+    pts /= np.linalg.norm(pts, axis=1, keepdims=True)
+    pts *= 1.0 + 0.05 * rng.randn(n_q, 1)
+    pts = np.asarray(pts, np.float32)
+    index = build_bvh(v, f, leaf_size=tile_f)
+
+    def run():
+        return closest_point_pallas_bvh_stream(
+            v, f, pts, tile_q=tile_q, tile_f=tile_f, n_buffers=n_buffers,
+            interpret=True, index=index)
+
+    res = run()                                 # compile + reference
+    jax.block_until_ready(res["sqdist"])
+    checksum = float(jnp.sum(res["sqdist"]) + jnp.sum(res["point"]))
+    pair_tests = int(np.asarray(res["pair_tests"]).sum())
+    best = np.inf
+    for _ in range(max(int(n_rep), 1)):
+        t0 = time.perf_counter()
+        out = run()
+        jax.block_until_ready((out["sqdist"], out["point"]))
+        best = min(best, time.perf_counter() - t0)
+    n_f = int(f.shape[0])
+    ratio = 1.0 - pair_tests / float(n_q * n_f)
+
+    # resident-vs-streamed agreement on a small mesh: the bit-identity
+    # contract, enforced every bench run without a chip
+    vi, fi = _icosphere(3)
+    vi = np.asarray(vi, np.float32)
+    fi = np.asarray(fi, np.int32)
+    pts_i = np.asarray(rng.randn(128, 3) * 0.7, np.float32)
+    resident = closest_point_pallas_bvh(
+        vi, fi, pts_i, tile_q=64, tile_f=256, interpret=True)
+    streamed = closest_point_pallas_bvh_stream(
+        vi, fi, pts_i, tile_q=64, tile_f=256, interpret=True)
+    for key in ("face", "point", "sqdist", "part"):
+        if not np.array_equal(np.asarray(resident[key]),
+                              np.asarray(streamed[key])):
+            raise RuntimeError(
+                "streamed rope kernel diverged from the resident kernel "
+                "on %r — the bit-identity contract is broken" % key)
+    return {
+        "metric": "accel_stream_proxy_skip_ratio",
+        "value": round(ratio, 4),
+        "unit": "pair_tests_skipped_frac",
+        "vs_baseline": None,
+        "interpret": True,
+        "queries": n_q,
+        "faces": n_f,
+        "tile_q": tile_q,
+        "tile_f": tile_f,
+        "n_buffers": n_buffers,
+        "pair_tests": pair_tests,
+        "pair_tests_per_query": round(pair_tests / float(n_q), 1),
+        "traverse_seconds": round(best, 3),
+        "checksum": round(checksum, 4),
+        "resident_match": True,
+    }
+
+
 #: declarative stage table: name -> (fn, default timeout_s,
 #: requires_backend, gate, extra child env).  Budgets bound a WEDGE —
 #: they are not measurements; override one with
@@ -1050,6 +1145,12 @@ _STAGE_DEFS = OrderedDict((
     ("accel_proxy", (accel_proxy_stage, 240.0, False, False,
                      {"JAX_PLATFORMS": "cpu",
                       "PALLAS_AXON_POOL_IPS": ""})),
+    # the streamed rope kernel's chip-free twin of accel_proxy: the
+    # interpret-mode DMA emulation walks leaf-by-leaf, so the budget is
+    # generous for the same reason
+    ("accel_stream_proxy", (accel_stream_proxy_stage, 300.0, False, False,
+                            {"JAX_PLATFORMS": "cpu",
+                             "PALLAS_AXON_POOL_IPS": ""})),
 ))
 
 
@@ -1152,6 +1253,9 @@ def run_staged(names=None):
     accel = results.get("accel_proxy")
     if accel is not None and accel.ok:
         record["accel"] = accel.record
+    stream = results.get("accel_stream_proxy")
+    if stream is not None and stream.ok:
+        record["stream"] = stream.record
     record["stages"] = OrderedDict(
         (n, r.to_json()) for n, r in results.items())
     record["bench_partial"] = partial_path

@@ -18,10 +18,18 @@ pallas_culled, whose seed construction this kernel reuses).  Results
 equal the brute kernel up to distance ties; no certificate/fallback
 pass is needed.
 
-VMEM ceiling: the face planes are fully resident (19 rows x Fp f32),
-so the kernel serves meshes up to ``traverse.PALLAS_BVH_MAX_FACES``;
-above that the facade routes the XLA traversal even on TPU.
-DMA-streamed leaf blocks are future work (doc/acceleration.md).
+This RESIDENT variant keeps the face planes fully in VMEM (19 rows x
+Fp f32), so it serves meshes up to ``traverse.pallas_bvh_max_faces()``;
+above that the facade routes the STREAMED variant (pallas_stream.py),
+which keeps the planes in HBM and double-buffers leaf blocks into a
+small VMEM ring via async DMA — million-face meshes stay on the Pallas
+fast path instead of falling back to the XLA traversal
+(doc/acceleration.md).
+
+The prologue (Morton query sort, sphere seed, SMEM metadata packing)
+and epilogue (order unmapping, exact winner recompute) are shared with
+the streamed variant — bit-identity between the two is by construction
+everywhere outside the leaf fetch.
 """
 
 from functools import partial
@@ -42,6 +50,87 @@ from ..utils.jax_compat import tpu_compiler_params
 __all__ = ["closest_point_pallas_bvh"]
 
 _SEED_SUB = 128     # sub-block size for the seed upper bound
+
+
+def _coarse_index(v32, f32, tile_f, index, rebuild_mismatched):
+    """The coarse (``leaf_size == tile_f``) BVH the rope kernels walk.
+
+    ``index=None`` fetches/builds through the digest cache.  A passed
+    index whose ``leaf_size`` disagrees with ``tile_f`` is rebuilt at
+    the requested granularity (still digest-cached, so the rebuild is
+    paid once per topology) when ``rebuild_mismatched`` — the facade's
+    cached plan companions are built at the XLA traversal's fine
+    ``leaf_size`` and must not poison the Pallas route.  An EXPLICITLY
+    passed mismatched index (``rebuild_mismatched=False``, the default
+    for direct callers) still raises: silently ignoring an index the
+    caller constructed on purpose would hide a real bug."""
+    if index is None:
+        return get_index(v32, f32, kind="bvh", leaf_size=int(tile_f))
+    if int(index.meta["leaf_size"]) != int(tile_f):
+        if rebuild_mismatched:
+            return get_index(v32, f32, kind="bvh", leaf_size=int(tile_f))
+        raise ValueError(
+            "pallas rope kernel needs leaf_size == tile_f (index has %s, "
+            "tile_f=%s)" % (index.meta["leaf_size"], tile_f))
+    return index
+
+
+def _rope_operands(v32, f, pts32, order_p, center_b, node_lo, node_hi,
+                   node_skip, node_leaf, tile_q, tile_f):
+    """Shared prologue of the resident and streamed rope kernels:
+    centered frames, query Morton sort, sub-block sphere seed, SMEM
+    node metadata, and the (19, Fp) face-plane rows.  Bit-identity
+    between the two kernel variants rests on this being literally the
+    same computation (tests/test_accel_stream.py pins it)."""
+    vc = v32 - center_b                        # bitwise the builder's frame
+    pts = pts32 - center_b
+    tri_s = vc[f][order_p]                     # (Fp, 3, 3), Morton order
+    f_pad = tri_s.shape[0]
+
+    # query Morton sort for tile compactness + the sub-block sphere seed
+    # (both straight from pallas_culled's prologue recipe)
+    from ..query.pallas_culled import _morton_codes
+
+    qorder = jnp.argsort(_morton_codes(pts))
+    pts_s = _pad_rows_edge(pts[qorder], tile_q)
+    corners = tri_s.reshape(-1, 3)
+    sub = _SEED_SUB if f_pad % _SEED_SUB == 0 else tile_f
+    sc, sr = _tile_spheres(corners, sub * 3)
+    seed = (jnp.min(
+        jnp.sqrt(jnp.sum((pts_s[:, None, :] - sc[None]) ** 2, axis=-1))
+        + sr[None], axis=1) ** 2 * (1.0 + _MARGIN) + 1e-12)[:, None]
+
+    boxes = jnp.concatenate([node_lo, node_hi], axis=1)       # (N, 6)
+    topo = jnp.stack(
+        [node_skip,
+         jnp.where(node_leaf >= 0, node_leaf * tile_f, -1)],
+        axis=1).astype(jnp.int32)                             # (N, 2)
+    rows = jnp.stack(fast_tile_rows(tri_s), axis=0)           # (19, Fp)
+    return vc, pts, qorder, pts_s, seed, boxes, topo, rows
+
+
+def _rope_epilogue(out_i, out_lv, order_p, qorder, vc, f, pts, center_b,
+                   n_q, tile_q, tile_f):
+    """Shared epilogue: sorted-face position -> original face id,
+    sorted-query order -> caller order, exact recompute on the winner
+    (pallas_culled epilogue), tile-granular pair-test accounting."""
+    inv = jnp.argsort(qorder)
+    best = order_p[out_i[:, 0]][inv][:n_q]
+    tri = vc[f]
+    a, b, c = tri[:, 0], tri[:, 1], tri[:, 2]
+    point, sqd, part = closest_point_on_triangle(
+        pts[:n_q], a[best], b[best], c[best])
+    # per-query pair-test count at tile granularity: each leaf visit of a
+    # query's tile ran tile_f exact tests for every query in the tile
+    pairs = jnp.repeat(out_lv[:, 0] * tile_f, tile_q)[inv][:n_q]
+    return {
+        "face": best.astype(jnp.int32),
+        "part": part,
+        "point": point + center_b,
+        "sqdist": sqd,
+        "tight": jnp.ones((n_q,), bool),
+        "pair_tests": pairs.astype(jnp.int32),
+    }
 
 
 def _make_rope_kernel(tile_q, tile_f, n_nodes):
@@ -101,33 +190,12 @@ def _make_rope_kernel(tile_q, tile_f, n_nodes):
 @partial(jax.jit, static_argnames=("tile_q", "tile_f", "interpret"))
 def _pallas_bvh_run(v32, f, pts32, order_p, node_lo, node_hi, node_skip,
                     node_leaf, center_b, tile_q, tile_f, interpret):
-    vc = v32 - center_b                        # bitwise the builder's frame
-    pts = pts32 - center_b
-    n_q = pts.shape[0]
-    tri_s = vc[f][order_p]                     # (Fp, 3, 3), Morton order
-    f_pad = tri_s.shape[0]
-    n_nodes = node_skip.shape[0]
-
-    # query Morton sort for tile compactness + the sub-block sphere seed
-    # (both straight from pallas_culled's prologue recipe)
-    from ..query.pallas_culled import _morton_codes
-
-    qorder = jnp.argsort(_morton_codes(pts))
-    pts_s = _pad_rows_edge(pts[qorder], tile_q)
+    n_q = pts32.shape[0]
+    vc, pts, qorder, pts_s, seed, boxes, topo, rows = _rope_operands(
+        v32, f, pts32, order_p, center_b, node_lo, node_hi, node_skip,
+        node_leaf, tile_q, tile_f)
     q_pad = pts_s.shape[0]
-    corners = tri_s.reshape(-1, 3)
-    sub = _SEED_SUB if f_pad % _SEED_SUB == 0 else tile_f
-    sc, sr = _tile_spheres(corners, sub * 3)
-    seed = (jnp.min(
-        jnp.sqrt(jnp.sum((pts_s[:, None, :] - sc[None]) ** 2, axis=-1))
-        + sr[None], axis=1) ** 2 * (1.0 + _MARGIN) + 1e-12)[:, None]
-
-    boxes = jnp.concatenate([node_lo, node_hi], axis=1)       # (N, 6)
-    topo = jnp.stack(
-        [node_skip,
-         jnp.where(node_leaf >= 0, node_leaf * tile_f, -1)],
-        axis=1).astype(jnp.int32)                             # (N, 2)
-    rows = jnp.stack(fast_tile_rows(tri_s), axis=0)           # (19, Fp)
+    n_nodes = node_skip.shape[0]
 
     n_tiles = q_pad // tile_q
     qcol = pl.BlockSpec((tile_q, 1), lambda i: (i, 0))
@@ -160,47 +228,29 @@ def _pallas_bvh_run(v32, f, pts32, order_p, node_lo, node_hi, node_skip,
         interpret=interpret,
     )(pts_s[:, 0:1], pts_s[:, 1:2], pts_s[:, 2:3], seed, boxes, topo, rows)
 
-    # sorted-face position -> original face id, sorted-query order ->
-    # caller order, exact recompute on the winner (pallas_culled epilogue)
-    inv = jnp.argsort(qorder)
-    best = order_p[out_i[:, 0]][inv][:n_q]
-    tri = vc[f]
-    a, b, c = tri[:, 0], tri[:, 1], tri[:, 2]
-    point, sqd, part = closest_point_on_triangle(
-        pts[:n_q], a[best], b[best], c[best])
-    # per-query pair-test count at tile granularity: each leaf visit of a
-    # query's tile ran tile_f exact tests for every query in the tile
-    pairs = jnp.repeat(out_lv[:, 0] * tile_f, tile_q)[inv][:n_q]
-    return {
-        "face": best.astype(jnp.int32),
-        "part": part,
-        "point": point + center_b,
-        "sqdist": sqd,
-        "tight": jnp.ones((n_q,), bool),
-        "pair_tests": pairs.astype(jnp.int32),
-    }
+    return _rope_epilogue(out_i, out_lv, order_p, qorder, vc, f, pts,
+                          center_b, n_q, tile_q, tile_f)
 
 
 def closest_point_pallas_bvh(v, f, points, tile_q=128, tile_f=256,
-                             interpret=False, index=None):
-    """Closest point via the Pallas rope kernel.  Same result contract
-    as ``closest_point_pallas_culled`` (exact up to distance ties) plus
-    the accel keys ``tight`` (all True — the bounds are conservative by
-    construction) and ``pair_tests``.
+                             interpret=False, index=None,
+                             rebuild_mismatched=False):
+    """Closest point via the resident Pallas rope kernel.  Same result
+    contract as ``closest_point_pallas_culled`` (exact up to distance
+    ties) plus the accel keys ``tight`` (all True — the bounds are
+    conservative by construction) and ``pair_tests``.
 
     The coarse BVH (``leaf_size = tile_f``) comes from the same
     digest-keyed ``get_index`` cache as the XLA traversal, so repeated
-    queries against one topology pay the host build once.
+    queries against one topology pay the host build once.  A passed
+    ``index`` built at a different ``leaf_size`` raises unless
+    ``rebuild_mismatched=True`` asks for a (digest-cached) coarse
+    rebuild — the mode the facade uses for its plan-companion indexes.
     """
     v32 = np.asarray(v, np.float32)
     f32 = np.asarray(f, np.int32)
     pts32 = np.asarray(points, np.float32).reshape(-1, 3)
-    if index is None:
-        index = get_index(v32, f32, kind="bvh", leaf_size=int(tile_f))
-    elif int(index.meta["leaf_size"]) != int(tile_f):
-        raise ValueError(
-            "pallas rope kernel needs leaf_size == tile_f (index has %s, "
-            "tile_f=%s)" % (index.meta["leaf_size"], tile_f))
+    index = _coarse_index(v32, f32, tile_f, index, rebuild_mismatched)
     arr = index.arrays
     return _pallas_bvh_run(
         v32, f32, pts32, arr["order"], arr["node_lo"], arr["node_hi"],

@@ -1,0 +1,253 @@
+"""Streamed Pallas TPU rope kernel: double-buffered leaf DMA from HBM.
+
+The resident rope kernel (pallas_bvh.py) keeps all 19 face-plane rows
+VMEM-resident, which caps it at roughly 64k faces per core.  This
+variant keeps the ``(19, Fp)`` rows array in HBM
+(``memory_space=pltpu.ANY``) and holds only
+
+- the node metadata (AABBs + rope topology, SMEM — scalar control flow),
+- a ring of ``n_buffers`` leaf blocks of shape ``(19, tile_f)`` in VMEM
+  scratch, and
+- the per-query accumulators,
+
+on chip, so VMEM use is O(tile_q + n_buffers * tile_f) — independent of
+mesh size.  Million-face meshes stay on the Pallas fast path.
+
+Prefetch queue
+--------------
+Each query tile runs two interleaved loops:
+
+- ``refill`` walks the rope from the current node with the running-best
+  bound *frozen at call time*, and for every unpruned leaf it meets,
+  writes the leaf's row offset into an SMEM ring slot and starts the
+  HBM->VMEM copy for that slot (``pltpu.make_async_copy``), until the
+  ring is full or the walk exhausts the tree.
+- the main loop pops the ring head, *waits* its DMA, runs the shared
+  19-plane Ericson tile on the landed block, merges with a strict ``<``
+  (ties keep the lowest face id), then calls ``refill`` again with the
+  tightened bound.
+
+With ``n_buffers >= 2`` the head block's compute overlaps the next
+block's DMA — classic double buffering; leaves are contiguous Morton
+blocks so each fetch is one dense row slice, no gather.
+
+Exactness (bit-identity with the resident kernel)
+-------------------------------------------------
+``refill`` prunes with a bound that may be stale by the (at most
+``n_buffers - 1``) leaves still in flight.  A stale bound is *looser*,
+so the streamed kernel prunes a subset of what the resident kernel
+prunes and visits a superset of its leaves, in the same preorder.  Any
+leaf containing some query's true minimum can never be pruned by either
+kernel (its lower bound is <= the minimum, which is <= every running
+bound — the conservative ``_MARGIN`` argument), so both kernels visit
+exactly the same winner leaves in the same order; extra streamed-only
+visits can only be overridden by the later strict improvement at the
+winner leaf.  With identical merge arithmetic on identical DMA'd bytes,
+the final ``(face, point, sqdist)`` are bit-identical — only
+``pair_tests`` may differ (streamed >= resident).  A popped leaf is
+deliberately NOT re-checked against the fresh bound: the recheck saves
+only the 19-plane tile on already-fetched data and costs a divergent
+branch per visit.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .pallas_bvh import _coarse_index, _rope_epilogue, _rope_operands
+from ..query.pallas_closest import N_FACE_ROWS, _sqdist_tile_fast
+from ..query.pallas_culled import _MARGIN
+from ..utils.jax_compat import tpu_compiler_params
+
+__all__ = ["closest_point_pallas_bvh_stream", "stream_vmem_bytes"]
+
+#: f32 rows per leaf block (== pallas_closest.N_FACE_ROWS; restated as a
+#: literal so the static VMEM lint rule can price the scratch ring)
+STREAM_ROWS = 19
+
+#: ring slots carry the 19 rows padded to the next (8, 128) f32 sublane
+#: quantum — Mosaic would pad the physical layout to 24 rows anyway, so
+#: allocating them explicitly keeps the lint-priced footprint honest
+STREAM_ROW_PAD = 24
+
+assert STREAM_ROWS == N_FACE_ROWS
+
+
+def stream_vmem_bytes(tile_q, tile_f, n_buffers):
+    """Static VMEM footprint of one streamed-kernel grid step in bytes:
+    the (sublane-padded) leaf ring plus the per-tile query/accumulator
+    columns.  Used by the traverse routing to check a candidate config
+    against the ``MESH_TPU_BVH_STREAM_VMEM_MB`` budget."""
+    ring = n_buffers * STREAM_ROW_PAD * tile_f * 4
+    cols = 6 * tile_q * 4          # qx/qy/qz/seed in + out_d/out_i
+    return ring + cols
+
+
+def _make_stream_kernel(tile_q, tile_f, n_nodes, n_buffers):
+    def kernel(qx, qy, qz, seed, boxes, topo, rows_hbm,
+               out_d, out_i, out_lv, buf, ring, sem):
+        px, py, pz = qx[...], qy[...], qz[...]          # (TQ, 1)
+
+        def leaf_dma(slot, leaf_start):
+            return pltpu.make_async_copy(
+                rows_hbm.at[:, pl.ds(leaf_start, tile_f)],
+                buf.at[slot, pl.ds(0, STREAM_ROWS)], sem.at[slot])
+
+        def refill(node, head, count, bound):
+            """Walk the rope from ``node``, enqueueing + DMA-starting
+            every unpruned leaf until the ring holds ``n_buffers``
+            in-flight blocks or the walk hits the exit sentinel.
+            ``bound`` is frozen for the whole walk — stale by at most
+            the in-flight leaves, i.e. looser than the live bound, so
+            every prune here is one the resident kernel also takes."""
+
+            def cond(carry):
+                nd, cnt = carry
+                return jnp.logical_and(nd < n_nodes, cnt < n_buffers)
+
+            def body(carry):
+                nd, cnt = carry
+                dx = jnp.maximum(
+                    jnp.maximum(boxes[nd, 0] - px, px - boxes[nd, 3]), 0.0)
+                dy = jnp.maximum(
+                    jnp.maximum(boxes[nd, 1] - py, py - boxes[nd, 4]), 0.0)
+                dz = jnp.maximum(
+                    jnp.maximum(boxes[nd, 2] - pz, pz - boxes[nd, 5]), 0.0)
+                lb2 = jnp.min(dx * dx + dy * dy + dz * dz)
+                prune = lb2 * (1.0 - _MARGIN) > bound
+                skip_to = topo[nd, 0]
+                leaf_start = topo[nd, 1]
+                is_leaf = leaf_start >= 0
+                take = jnp.logical_and(is_leaf, jnp.logical_not(prune))
+
+                @pl.when(take)
+                def _enqueue():
+                    slot = jax.lax.rem(head + cnt, n_buffers)
+                    ring[slot] = leaf_start
+                    leaf_dma(slot, leaf_start).start()
+
+                nd = jnp.where(jnp.logical_or(prune, is_leaf),
+                               skip_to, nd + 1)
+                return nd, cnt + jnp.where(take, 1, 0)
+
+            return jax.lax.while_loop(cond, body, (node, count))
+
+        seed0 = seed[...]
+        node0, count0 = refill(jnp.int32(0), jnp.int32(0), jnp.int32(0),
+                               jnp.max(seed0))
+
+        def cond(carry):
+            return carry[5] > 0                 # leaves still in flight
+
+        def body(carry):
+            node, acc_d, acc_i, leaves, head, count = carry
+            leaf_start = ring[head]
+            leaf_dma(head, leaf_start).wait()
+            block = buf[head]                   # (24, tile_f), 19 landed
+            planes = [block[k:k + 1, :] for k in range(STREAM_ROWS)]
+            d2 = _sqdist_tile_fast(px, py, pz, *planes)  # (TQ, TF)
+            tile_min = jnp.min(d2, axis=1, keepdims=True)
+            tile_arg = (jnp.argmin(d2, axis=1).astype(jnp.int32)[:, None]
+                        + leaf_start)
+            better = tile_min < acc_d
+            acc_d = jnp.where(better, tile_min, acc_d)
+            acc_i = jnp.where(better, tile_arg, acc_i)
+            leaves = leaves + 1
+            head = jax.lax.rem(head + 1, n_buffers)
+            node, count = refill(node, head, count - 1, jnp.max(acc_d))
+            return node, acc_d, acc_i, leaves, head, count
+
+        _nd, acc_d, acc_i, leaves, _h, _c = jax.lax.while_loop(
+            cond, body,
+            (node0, seed0, jnp.zeros((tile_q, 1), jnp.int32),
+             jnp.int32(0), jnp.int32(0), count0))
+        out_d[...] = acc_d
+        out_i[...] = acc_i
+        out_lv[0, 0] = leaves
+
+    return kernel
+
+
+@partial(jax.jit,
+         static_argnames=("tile_q", "tile_f", "n_buffers", "interpret"))
+def _pallas_stream_run(v32, f, pts32, order_p, node_lo, node_hi, node_skip,
+                       node_leaf, center_b, tile_q=128, tile_f=256,
+                       n_buffers=2, interpret=False):
+    n_q = pts32.shape[0]
+    vc, pts, qorder, pts_s, seed, boxes, topo, rows = _rope_operands(
+        v32, f, pts32, order_p, center_b, node_lo, node_hi, node_skip,
+        node_leaf, tile_q, tile_f)
+    q_pad = pts_s.shape[0]
+    n_nodes = node_skip.shape[0]
+
+    n_tiles = q_pad // tile_q
+    qcol = pl.BlockSpec((tile_q, 1), lambda i: (i, 0))
+    smem_full = lambda shape: pl.BlockSpec(                     # noqa: E731
+        shape, lambda i: (0, 0), memory_space=pltpu.SMEM)
+
+    out_d, out_i, out_lv = pl.pallas_call(
+        _make_stream_kernel(tile_q, tile_f, n_nodes, n_buffers),
+        grid=(n_tiles,),
+        in_specs=[
+            qcol, qcol, qcol, qcol,
+            smem_full(boxes.shape),
+            smem_full(topo.shape),
+            pl.BlockSpec(memory_space=pltpu.ANY),   # rows stay in HBM
+        ],
+        out_specs=[
+            pl.BlockSpec((tile_q, 1), lambda i: (i, 0)),
+            pl.BlockSpec((tile_q, 1), lambda i: (i, 0)),
+            pl.BlockSpec((1, 1), lambda i: (i, 0),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((q_pad, 1), jnp.float32),
+            jax.ShapeDtypeStruct((q_pad, 1), jnp.int32),
+            jax.ShapeDtypeStruct((n_tiles, 1), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((n_buffers, STREAM_ROW_PAD, tile_f), jnp.float32),
+            pltpu.SMEM((n_buffers,), jnp.int32),
+            pltpu.SemaphoreType.DMA((n_buffers,)),
+        ],
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(pts_s[:, 0:1], pts_s[:, 1:2], pts_s[:, 2:3], seed, boxes, topo, rows)
+
+    return _rope_epilogue(out_i, out_lv, order_p, qorder, vc, f, pts,
+                          center_b, n_q, tile_q, tile_f)
+
+
+def closest_point_pallas_bvh_stream(v, f, points, tile_q=128, tile_f=256,
+                                    n_buffers=2, interpret=False,
+                                    index=None, rebuild_mismatched=False):
+    """Closest point via the streamed (HBM leaves, double-buffered DMA)
+    Pallas rope kernel.  Bit-identical results to
+    ``closest_point_pallas_bvh`` (see module docstring) with no VMEM
+    face ceiling; only ``pair_tests`` may be >= the resident kernel's.
+
+    ``tile_f`` must be a multiple of 128 (the DMA slices the rows array
+    at lane offsets ``leaf * tile_f``) and ``n_buffers >= 2`` (a single
+    buffer would serialise every fetch against its own compute).
+    """
+    if int(tile_f) % 128:
+        raise ValueError("streamed kernel needs tile_f %% 128 == 0 "
+                         "(got %d)" % tile_f)
+    if int(n_buffers) < 2:
+        raise ValueError("streamed kernel needs n_buffers >= 2 "
+                         "(got %d)" % n_buffers)
+    v32 = np.asarray(v, np.float32)
+    f32 = np.asarray(f, np.int32)
+    pts32 = np.asarray(points, np.float32).reshape(-1, 3)
+    index = _coarse_index(v32, f32, tile_f, index, rebuild_mismatched)
+    arr = index.arrays
+    return _pallas_stream_run(
+        v32, f32, pts32, arr["order"], arr["node_lo"], arr["node_hi"],
+        arr["node_skip"], arr["node_leaf"], arr["center"],
+        tile_q=int(tile_q), tile_f=int(tile_f),
+        n_buffers=int(n_buffers), interpret=bool(interpret))

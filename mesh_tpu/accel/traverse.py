@@ -43,14 +43,68 @@ from ..query.point_triangle import (
 __all__ = [
     "bvh_closest_point", "grid_closest_point", "bvh_search_faces",
     "closest_faces_and_points_accel", "PALLAS_BVH_MAX_FACES",
+    "pallas_bvh_max_faces", "pallas_bvh_variant", "resident_rows_bytes",
 ]
 
-#: above this face count the Pallas rope kernel's fully VMEM-resident
-#: face planes stop fitting (19 rows x Fp f32 ~ 76 B/face against ~16 MB
-#: of VMEM with headroom for accumulators); larger meshes take the XLA
-#: traversal even on TPU.  DMA-streamed leaves are future work
-#: (doc/acceleration.md).
+#: LEGACY resident-kernel face ceiling, used only when the streamed
+#: variant is killed (MESH_TPU_BVH_STREAM=0): above it the facade falls
+#: back to the XLA traversal even on TPU, the pre-streaming behavior.
+#: With streaming on, routing is by measured VMEM budget instead —
+#: see :func:`pallas_bvh_variant`.
 PALLAS_BVH_MAX_FACES = 65536
+
+
+def _rope_fp(n_faces, tile_f):
+    """Padded face count of the coarse rope index: ``tile_f`` times the
+    next power-of-two leaf count (build_bvh's complete-tree padding)."""
+    n_leaves = max(1, -(-int(n_faces) // int(tile_f)))
+    depth = int(np.ceil(np.log2(n_leaves))) if n_leaves > 1 else 0
+    return (1 << depth) * int(tile_f)
+
+
+def resident_rows_bytes(n_faces, tile_f=256):
+    """VMEM footprint (bytes) of the RESIDENT rope kernel's face-plane
+    rows for ``n_faces``: 19 f32 rows over the padded face count."""
+    from ..query.pallas_closest import N_FACE_ROWS
+
+    return N_FACE_ROWS * _rope_fp(n_faces, tile_f) * 4
+
+
+def pallas_bvh_variant(n_faces, tile_f=256):
+    """Which Pallas rope variant serves ``n_faces``: ``"resident"`` when
+    the full face-plane rows fit the MESH_TPU_BVH_STREAM_VMEM_MB budget,
+    ``"stream"`` otherwise (double-buffered leaf DMA, no face ceiling).
+    MESH_TPU_BVH_STREAM_FORCE pins ``"stream"``; with streaming killed
+    (MESH_TPU_BVH_STREAM=0) the legacy ceiling applies and ``None``
+    above it means "take the XLA traversal"."""
+    from ..utils.dispatch import (
+        bvh_stream_enabled, bvh_stream_force, bvh_stream_vmem_budget)
+
+    if not bvh_stream_enabled():
+        return "resident" if n_faces <= PALLAS_BVH_MAX_FACES else None
+    if bvh_stream_force():
+        return "stream"
+    if resident_rows_bytes(n_faces, tile_f) <= bvh_stream_vmem_budget():
+        return "resident"
+    return "stream"
+
+
+def pallas_bvh_max_faces(tile_f=256):
+    """Largest face count the RESIDENT rope kernel serves under the
+    current VMEM budget (the padded row footprint is quantised to
+    power-of-two leaf counts, so this is a power of two times
+    ``tile_f``).  Informational — routing itself goes through
+    :func:`pallas_bvh_variant`."""
+    from ..query.pallas_closest import N_FACE_ROWS
+    from ..utils.dispatch import bvh_stream_vmem_budget
+
+    n_leaves = bvh_stream_vmem_budget() // (N_FACE_ROWS * 4 * int(tile_f))
+    if n_leaves < 1:
+        return 0
+    pow2 = 1
+    while pow2 * 2 <= n_leaves:
+        pow2 *= 2
+    return pow2 * int(tile_f)
 
 _INT_MAX = np.int32(np.iinfo(np.int32).max)
 
@@ -290,17 +344,23 @@ def closest_faces_and_points_accel(v, f, points, kind=None, index=None,
     out), exact-by-fallback: loose-certificate queries re-run through
     the dense brute path, so results match it bit for bit.
 
-    On TPU a BVH small enough for VMEM-resident face planes runs the
-    Pallas rope kernel (pallas_bvh.py, exact up to distance ties like
-    the other Pallas paths); everything else — and every CPU run —
-    takes the XLA ``lax.while_loop`` traversal.
+    On TPU a BVH runs a Pallas rope kernel (exact up to distance ties
+    like the other Pallas paths): the RESIDENT variant (pallas_bvh.py)
+    when the face planes fit the measured VMEM budget, the STREAMED
+    double-buffered-DMA variant (pallas_stream.py) above that — there is
+    no face ceiling on the fast path any more.  Grid indexes and every
+    CPU run take the XLA ``lax.while_loop`` traversal, as does a BVH
+    above the legacy ceiling when MESH_TPU_BVH_STREAM=0 kills streaming.
 
     :param kind: ``"bvh"`` / ``"grid"``; default $MESH_TPU_ACCEL_KIND
         else bvh.
     :param index: a prebuilt :class:`AccelIndex` (skips the digest-cache
-        lookup entirely).
+        lookup entirely; the Pallas routes rebuild a coarse
+        tile-granular twin through the digest cache when its leaf size
+        disagrees).
     :param with_stats: also return ``{"pair_tests", "fallback",
-        "tight_frac", "kind", "backend"}``.
+        "tight_frac", "kind", "backend"}`` — ``backend`` is ``"xla"``,
+        ``"pallas"`` (resident) or ``"pallas_stream"``.
     """
     from ..obs.trace import span as obs_span
     from ..utils.dispatch import accel_kind, no_engine, pallas_default
@@ -310,24 +370,51 @@ def closest_faces_and_points_accel(v, f, points, kind=None, index=None,
     f_np = np.asarray(f)
     n_faces = int(f_np.shape[0])
     n_queries = int(np.asarray(points).reshape(-1, 3).shape[0])
+    backend = "xla"
+    variant = (pallas_bvh_variant(n_faces)
+               if kind == "bvh" and pallas_default() else None)
+    tile_q = tile_f = n_buffers = None
+    if variant == "stream":
+        from ..query.autotune import stream_tile_params
+
+        tile_q, tile_f, n_buffers = stream_tile_params()
     if index is None:
+        # the Pallas variants walk a coarse (leaf_size == tile_f) twin
+        # of the fine XLA index; requesting the companion at that
+        # granularity up front keeps the build inside the engine span
+        # (an explicitly passed mismatched companion still rebuilds
+        # through the digest cache below)
+        params = {}
+        if variant == "resident":
+            params = {"leaf_size": 256}    # resident kernel's tile_f
+        elif variant == "stream":
+            params = {"leaf_size": int(tile_f)}
         if no_engine():
-            index = get_index(v, f_np, kind=kind)
+            index = get_index(v, f_np, kind=kind, **params)
         else:
             from ..engine.planner import get_planner
 
-            index = get_planner().accel_companion(v, f_np, kind=kind)
-    backend = "xla"
+            index = get_planner().accel_companion(v, f_np, kind=kind,
+                                                  **params)
     with obs_span("accel.traverse", kind=kind, faces=n_faces,
                   queries=n_queries) as sp:
-        if (kind == "bvh" and pallas_default()
-                and n_faces <= PALLAS_BVH_MAX_FACES):
+        if variant == "resident":
             from .pallas_bvh import closest_point_pallas_bvh
 
             backend = "pallas"
             res = closest_point_pallas_bvh(
                 np.asarray(v, np.float32), f_np.astype(np.int32),
-                np.asarray(points, np.float32).reshape(-1, 3))
+                np.asarray(points, np.float32).reshape(-1, 3),
+                index=index, rebuild_mismatched=True)
+        elif variant == "stream":
+            from .pallas_stream import closest_point_pallas_bvh_stream
+
+            backend = "pallas_stream"
+            res = closest_point_pallas_bvh_stream(
+                np.asarray(v, np.float32), f_np.astype(np.int32),
+                np.asarray(points, np.float32).reshape(-1, 3),
+                tile_q=tile_q, tile_f=tile_f, n_buffers=n_buffers,
+                index=index, rebuild_mismatched=True)
         else:
             res = _run_index(index, v, f_np, points)
         out = {key: np.asarray(val) for key, val in res.items()}

@@ -14,6 +14,13 @@ query/pallas_*.py builder uses), module-level constants, and simple
 arithmetic over those; unresolvable specs are skipped, and the budget
 message says how many specs it could price.
 
+Pricing is of the *padded* physical footprint: the last two dims are
+rounded up to the (8, 128) f32 tile (matching what VMEM002/VMEM003
+warn about), and any leading dims multiply it — so a double-buffered
+DMA ring like ``pltpu.VMEM((n_buffers, rows, tile_f), f32)`` is
+charged ``n_buffers`` times its padded block, the way Mosaic actually
+allocates it.
+
 Codes:
 
 - VMEM001 (error): priced blocks for one ``pallas_call`` exceed the
@@ -46,6 +53,22 @@ _DTYPE_SIZES = {
 
 def _last_part(name):
     return name.rsplit(".", 1)[-1] if name else None
+
+
+def _padded_bytes(dims, itemsize):
+    """Physical footprint of one block: last two dims rounded up to the
+    (8, 128) tile (dims of 1 stay 1 — scalar rows/columns are exempt,
+    same as the VMEM002/VMEM003 checks), leading dims (buffer rings,
+    stacked scratch) multiplying the padded tile count."""
+    padded = [int(d) for d in dims]
+    if padded and padded[-1] > 1:
+        padded[-1] = -(-padded[-1] // 128) * 128
+    if len(padded) >= 2 and padded[-2] > 1:
+        padded[-2] = -(-padded[-2] // 8) * 8
+    size = itemsize
+    for d in padded:
+        size *= d
+    return size
 
 
 def _dtype_itemsize(node):
@@ -107,19 +130,16 @@ class VmemBudgetRule(Rule):
                 if dims and all(isinstance(d, (int, float)) and d > 0
                                 for d in dims):
                     priced += 1
-                    size = itemsize
-                    for d in dims:
-                        size *= int(d)
-                    total += size
+                    total += _padded_bytes(dims, itemsize)
                 else:
                     unpriced += 1
             if total > VMEM_BUDGET_BYTES:
                 findings.append(ctx.finding(
                     "VMEM001", "error", node,
                     "pallas_call blocks total ~%.2f MiB (%d spec(s) "
-                    "priced%s, f32 assumed) — over the %d MiB VMEM "
-                    "ceiling; Mosaic will fail or spill on the real "
-                    "chip" % (
+                    "priced%s, (8, 128)-padded, f32 assumed) — over the "
+                    "%d MiB VMEM ceiling; Mosaic will fail or spill on "
+                    "the real chip" % (
                         total / 2 ** 20, priced,
                         ", %d unpriced" % unpriced if unpriced else "",
                         VMEM_BUDGET_BYTES // 2 ** 20),

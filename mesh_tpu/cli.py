@@ -432,12 +432,18 @@ def cmd_perfcheck(args):
         args.accel_golden or os.path.join(repo_root, "benchmarks",
                                           "accel_golden.json"),
         "accel golden")
+    stream_golden = _load_optional(
+        args.stream_golden or os.path.join(repo_root, "benchmarks",
+                                           "accel_stream_golden.json"),
+        "stream golden")
     rc, lines = perfcheck(doc, baseline=baseline, proxy_golden=golden,
                           proxy_tol=args.proxy_tol,
                           headline_tol=args.headline_tol,
                           flops_tol=args.flops_tol,
                           accel_golden=accel_golden,
-                          accel_tol=args.accel_tol)
+                          accel_tol=args.accel_tol,
+                          stream_golden=stream_golden,
+                          stream_tol=args.stream_tol)
     if args.json:
         json.dump({"rc": rc, "lines": lines}, sys.stdout, indent=2)
         sys.stdout.write("\n")
@@ -635,6 +641,13 @@ def main():
                         help="allowed fractional drop of the accel "
                              "pair-tests-skipped ratio vs the golden "
                              "(default 0.05: the ratio is deterministic)")
+    p_perf.add_argument("--stream-golden", default=None,
+                        help="streamed-kernel golden record (default: "
+                             "repo benchmarks/accel_stream_golden.json)")
+    p_perf.add_argument("--stream-tol", type=float, default=0.05,
+                        help="allowed fractional drop of the streamed "
+                             "kernel's pair-tests-skipped ratio vs the "
+                             "golden (default 0.05)")
     p_perf.add_argument("--json", action="store_true",
                         help="machine-readable {rc, lines} instead of the "
                              "summary")

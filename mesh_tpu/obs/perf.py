@@ -387,7 +387,8 @@ def read_bench_json(path):
 
 def extract_records(doc):
     """Normalize either bench JSON shape into ``{"headline": rec|None,
-    "proxy": rec|None, "accel": rec|None, "stages": {...}|None}``.
+    "proxy": rec|None, "accel": rec|None, "stream": rec|None,
+    "stages": {...}|None}``.
 
     The headline slot is only filled by a FRESH measurement — a
     ``stale: true`` envelope (last-good value republished while the
@@ -397,6 +398,7 @@ def extract_records(doc):
     headline = None
     proxy = None
     accel = None
+    stream = None
     stages = None
     if doc.get("kind") == "bench_partial":
         stages = doc.get("stages") or {}
@@ -409,6 +411,9 @@ def extract_records(doc):
         ax = stages.get("accel_proxy") or {}
         if ax.get("status") == "ok":
             accel = ax.get("record")
+        st = stages.get("accel_stream_proxy") or {}
+        if st.get("status") == "ok":
+            stream = st.get("record")
     else:
         if doc.get("value") is not None and not doc.get("stale"):
             headline = doc
@@ -418,14 +423,17 @@ def extract_records(doc):
         acc = doc.get("accel")
         if isinstance(acc, dict) and acc.get("value") is not None:
             accel = acc
+        stm = doc.get("stream")
+        if isinstance(stm, dict) and stm.get("value") is not None:
+            stream = stm
         stages = doc.get("stages")
     return {"headline": headline, "proxy": proxy, "accel": accel,
-            "stages": stages}
+            "stream": stream, "stages": stages}
 
 
 def perfcheck(doc, baseline=None, proxy_golden=None, proxy_tol=0.5,
               headline_tol=0.2, flops_tol=0.25, accel_golden=None,
-              accel_tol=0.05):
+              accel_tol=0.05, stream_golden=None, stream_tol=0.05):
     """Compare a bench JSON against the last-good baseline and the
     committed proxy golden.  Returns ``(rc, lines)`` — rc 0 when nothing
     regressed beyond its tolerance band, 1 on regression (including a
@@ -443,49 +451,58 @@ def perfcheck(doc, baseline=None, proxy_golden=None, proxy_tol=0.5,
     mesh, fixed queries, exact traversal), so its band is tight
     (``accel_tol`` default 5%) and a checksum drift is a hard FAIL —
     a changed checksum means the index returned different answers,
-    which no tolerance can excuse.
+    which no tolerance can excuse.  ``stream_golden``/``stream_tol``
+    grade the accel_stream_proxy stage (the DMA-streamed rope kernel's
+    chip-free twin) under the identical contract.
     """
     lines = []
     rc = 0
     recs = extract_records(doc)
 
-    accel_gold = None
-    if accel_golden:
-        accel_gold = (extract_records(accel_golden)["accel"]
-                      or (accel_golden
-                          if accel_golden.get("value") is not None
-                          else None))
-    cand_accel = recs["accel"]
-    if accel_gold is not None:
-        if cand_accel is None:
-            rc = 1
-            lines.append(
-                "FAIL accel: candidate carries no accel_proxy record "
-                "(a golden exists — the chip-free index metric must "
-                "always be fresh)")
-        else:
-            floor = accel_gold["value"] * (1.0 - accel_tol)
-            verdict = ("ok" if cand_accel["value"] >= floor else "FAIL")
+    for slot, golden_doc, tol, stage_name, make_cmd in (
+            ("accel", accel_golden, accel_tol, "accel_proxy",
+             "make accel-golden"),
+            ("stream", stream_golden, stream_tol, "accel_stream_proxy",
+             "make accel-stream-golden")):
+        gold = None
+        if golden_doc:
+            gold = (extract_records(golden_doc)[slot]
+                    or (golden_doc
+                        if golden_doc.get("value") is not None
+                        else None))
+        cand = recs[slot]
+        if gold is not None:
+            if cand is None:
+                rc = 1
+                lines.append(
+                    "FAIL %s: candidate carries no %s record (a golden "
+                    "exists — the chip-free index metric must always be "
+                    "fresh)" % (slot, stage_name))
+                continue
+            floor = gold["value"] * (1.0 - tol)
+            verdict = ("ok" if cand["value"] >= floor else "FAIL")
             if verdict == "FAIL":
                 rc = 1
             lines.append(
-                "%s accel pair-tests-skipped ratio: %.4f vs golden %.4f "
+                "%s %s pair-tests-skipped ratio: %.4f vs golden %.4f "
                 "(floor %.4f, tol %.0f%%)"
-                % (verdict, cand_accel["value"], accel_gold["value"],
-                   floor, 100 * accel_tol))
-            cand_sum = cand_accel.get("checksum")
-            gold_sum = accel_gold.get("checksum")
+                % (verdict, slot, cand["value"], gold["value"],
+                   floor, 100 * tol))
+            cand_sum = cand.get("checksum")
+            gold_sum = gold.get("checksum")
             if cand_sum is not None and gold_sum is not None:
                 same = abs(cand_sum - gold_sum) <= 1e-6 * max(
                     1.0, abs(gold_sum))
                 if not same:
                     rc = 1
                 lines.append(
-                    "%s accel checksum: %.6f vs golden %.6f (exact)"
-                    % ("ok" if same else "FAIL", cand_sum, gold_sum))
-    elif cand_accel is not None:
-        lines.append("note: accel record present but no golden to "
-                     "compare against (record one: make accel-golden)")
+                    "%s %s checksum: %.6f vs golden %.6f (exact)"
+                    % ("ok" if same else "FAIL", slot, cand_sum,
+                       gold_sum))
+        elif cand is not None:
+            lines.append("note: %s record present but no golden to "
+                         "compare against (record one: %s)"
+                         % (slot, make_cmd))
 
     golden_rec = None
     if proxy_golden:

@@ -39,11 +39,20 @@ DEFAULT_CROSSOVER = 32768
 # isn't guaranteed to pay for itself.
 ACCEL_DEFAULT_CROSSOVER = 131072
 
+# default (tile_q, tile_f, n_buffers) for the streamed rope kernel, and
+# the sweep calibrate_stream_tiles ranks: tile_f stays a multiple of 128
+# (DMA lane alignment) and n_buffers >= 2 (double buffering)
+STREAM_DEFAULT_TILES = (128, 256, 2)
+STREAM_SWEEP = (
+    (128, 256, 2), (128, 256, 3), (128, 512, 2), (256, 256, 2),
+)
+
 # in-process resolution cache (covers the cache-file miss too, so hot query
 # loops don't pay a filesystem probe per call; a calibration persisted by
 # ANOTHER process mid-run is picked up on the next interpreter start)
 _measured = None
 _accel_measured = None
+_stream_measured = None
 
 
 def _cache_path():
@@ -119,6 +128,103 @@ def accel_crossover_faces():
     except (OSError, ValueError, KeyError, TypeError):
         _accel_measured = ACCEL_DEFAULT_CROSSOVER
     return _accel_measured
+
+
+def _stream_cache_path():
+    return _cache_path().replace("crossover_", "stream_tiles_")
+
+
+def stream_tile_params():
+    """``(tile_q, tile_f, n_buffers)`` the accel facade hands the
+    streamed rope kernel: the cached ``calibrate_stream_tiles``
+    measurement when one exists (else the conservative default), with
+    the MESH_TPU_BVH_STREAM_BUFFERS override applied on top."""
+    from ..utils.dispatch import bvh_stream_buffers
+
+    global _stream_measured
+    if _stream_measured is None:
+        try:
+            with open(_stream_cache_path()) as fh:
+                data = json.load(fh)
+            params = (int(data["tile_q"]), int(data["tile_f"]),
+                      int(data["n_buffers"]))
+            if params[0] <= 0 or params[1] <= 0 or params[1] % 128 \
+                    or params[2] < 2:
+                raise ValueError(params)
+            log.info("using measured stream tiles %r from %s (delete the "
+                     "file or re-run calibrate_stream_tiles() to "
+                     "re-measure)", params, _stream_cache_path())
+            _stream_measured = params
+        except (OSError, ValueError, KeyError, TypeError):
+            _stream_measured = STREAM_DEFAULT_TILES
+    tile_q, tile_f, n_buffers = _stream_measured
+    return tile_q, tile_f, bvh_stream_buffers(default=n_buffers)
+
+
+def calibrate_stream_tiles(n_faces=262144, n_queries=1024, reps=3,
+                           sweep=STREAM_SWEEP, save=True):
+    """Rank ``(tile_q, tile_f, n_buffers)`` configs for the streamed
+    rope kernel on the live backend and persist the winner.
+
+    Mirrors the crossover calibrations: each config's coarse index build
+    is warmed OUTSIDE the timed region (steady-state regime), a
+    re-measure of the winner that disagrees with itself by >2x marks
+    the run unstable and skips persisting.  Off-TPU the sweep runs the
+    interpret-mode kernel — rankings there reflect emulation, so they
+    are persisted under the CPU device key and never leak onto a chip.
+    """
+    from ..accel.build import get_index
+    from ..accel.pallas_stream import closest_point_pallas_bvh_stream
+    from ..utils.dispatch import pallas_default
+
+    interpret = not pallas_default()
+    rng = np.random.RandomState(0)
+    pts = rng.randn(n_queries, 3).astype(np.float32)
+    v, f = _sphere_mesh(n_faces)
+    timings = []
+    for tile_q, tile_f, n_buffers in sweep:
+        index = get_index(v, f, kind="bvh", leaf_size=int(tile_f))
+        timings.append((
+            _time_best(lambda: closest_point_pallas_bvh_stream(
+                v, f, pts, tile_q=tile_q, tile_f=tile_f,
+                n_buffers=n_buffers, interpret=interpret, index=index),
+                reps),
+            (tile_q, tile_f, n_buffers)))
+    t_best, best = min(timings)
+    tile_q, tile_f, n_buffers = best
+    index = get_index(v, f, kind="bvh", leaf_size=int(tile_f))
+    recheck = _time_best(lambda: closest_point_pallas_bvh_stream(
+        v, f, pts, tile_q=tile_q, tile_f=tile_f, n_buffers=n_buffers,
+        interpret=interpret, index=index), reps)
+    stable = max(t_best, recheck) <= 2.0 * min(t_best, recheck)
+    global _stream_measured
+    _stream_measured = best
+    if not stable:
+        log.warning(
+            "calibrate_stream_tiles: backend timings unstable (%.3fs vs "
+            "%.3fs for %r) — not persisting; using %r for this process "
+            "only", t_best, recheck, best, best)
+        save = False
+    if save:
+        try:
+            with open(_stream_cache_path(), "w") as fh:
+                json.dump({
+                    "tile_q": tile_q,
+                    "tile_f": tile_f,
+                    "n_buffers": n_buffers,
+                    "interpret": bool(interpret),
+                    "sweep": [
+                        {"tile_q": tq, "tile_f": tf, "n_buffers": nb,
+                         "t": t}
+                        for t, (tq, tf, nb) in timings
+                    ],
+                    "n_faces": n_faces,
+                    "n_queries": n_queries,
+                    "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                }, fh, indent=1)
+        except OSError:
+            pass
+    return best
 
 
 def _sphere_mesh(n_faces, seed=0):
@@ -239,7 +345,8 @@ def calibrate_crossover(ladder=(8192, 16384, 32768, 65536, 131072),
     return crossover
 
 
-def calibrate_accel_crossover(ladder=(32768, 65536, 131072, 262144),
+def calibrate_accel_crossover(ladder=(32768, 65536, 131072, 262144,
+                                      524288),
                               n_queries=1024, reps=3, save=True):
     """Measure where the spatial-index path starts beating the ladder's
     incumbent large-F strategy (culled) on the live backend.
@@ -250,13 +357,20 @@ def calibrate_accel_crossover(ladder=(32768, 65536, 131072, 262144),
     build is paid OUTSIDE the timed region — the steady-state regime the
     per-topology cache puts every real caller in — and persisted to the
     cache dir unless ``save=False`` or the timings look unstable.
+
+    The top rung(s) sit past the resident rope kernel's VMEM budget on
+    purpose, so on TPU they time the STREAMED kernel — the ladder spans
+    both Pallas variants, and each persisted rung records which one
+    (``variant``) served it.
     """
     from ..accel.build import get_index
-    from ..accel.traverse import closest_faces_and_points_accel
+    from ..accel.traverse import closest_faces_and_points_accel, \
+        pallas_bvh_variant
     from ..utils.dispatch import accel_kind, pallas_default
     from .culled import closest_faces_and_points_auto
 
     kind = accel_kind()
+    use_pallas = bool(pallas_default())
     rng = np.random.RandomState(0)
     pts = rng.randn(n_queries, 3).astype(np.float32)
     # time the incumbent through the auto facade with accel disabled, so
@@ -279,8 +393,10 @@ def calibrate_accel_crossover(ladder=(32768, 65536, 131072, 262144),
         t_accel = _time_best(
             lambda: closest_faces_and_points_accel(v, f, pts, kind=kind),
             reps)
-        wins.append((f.shape[0], t_inc, t_accel))
-    check_f, check_t, _ = wins[len(wins) // 2]
+        variant = (pallas_bvh_variant(f.shape[0])
+                   if kind == "bvh" and use_pallas else None)
+        wins.append((f.shape[0], t_inc, t_accel, variant or "xla"))
+    check_f, check_t = wins[len(wins) // 2][:2]
     v, f = _sphere_mesh(check_f)
     old = {k: os.environ.get(k) for k in incumbent_env}
     os.environ.update(incumbent_env)
@@ -293,8 +409,8 @@ def calibrate_accel_crossover(ladder=(32768, 65536, 131072, 262144),
                 else os.environ.__setitem__(k, val)
     stable = max(check_t, recheck) <= 2.0 * min(check_t, recheck)
     crossover = None
-    for i, (n_f, t_i, t_a) in enumerate(wins):
-        if t_a < t_i and all(ta < ti for _, ti, ta in wins[i:]):
+    for i, (n_f, t_i, t_a, _var) in enumerate(wins):
+        if t_a < t_i and all(ta < ti for _, ti, ta, _v in wins[i:]):
             crossover = n_f
             break
     if crossover is None:
@@ -314,10 +430,11 @@ def calibrate_accel_crossover(ladder=(32768, 65536, 131072, 262144),
                 json.dump({
                     "accel_min_faces": crossover,
                     "kind": kind,
-                    "pallas": bool(pallas_default()),
+                    "pallas": use_pallas,
                     "ladder": [
-                        {"faces": n, "t_incumbent": ti, "t_accel": ta}
-                        for n, ti, ta in wins
+                        {"faces": n, "t_incumbent": ti, "t_accel": ta,
+                         "variant": var}
+                        for n, ti, ta, var in wins
                     ],
                     "n_queries": n_queries,
                     "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),

@@ -88,15 +88,21 @@ class Deadline(object):
 
 
 class ServeResult(object):
-    """What a rung hands back: facade-convention arrays plus provenance."""
+    """What a rung hands back: facade-convention arrays plus provenance.
 
-    __slots__ = ("faces", "points", "rung", "certified")
+    ``backend`` is extra provenance for rungs that dispatch through a
+    multi-backend facade (the accel rung reports ``"xla"`` /
+    ``"pallas"`` / ``"pallas_stream"``); None for single-backend rungs.
+    """
 
-    def __init__(self, faces, points, rung, certified):
+    __slots__ = ("faces", "points", "rung", "certified", "backend")
+
+    def __init__(self, faces, points, rung, certified, backend=None):
         self.faces = faces              # [1, Q] uint32
         self.points = points            # [Q, 3] f64
         self.rung = rung
         self.certified = bool(certified)
+        self.backend = backend
 
     @property
     def approximate(self):
@@ -252,15 +258,20 @@ def _rung_accel(mesh, points, chunk, timeout):
 
         v, f = _facade_arrays(mesh)
         pts, n_q = _bucket_queries(points, 256)
-        res = closest_faces_and_points_accel(v, f, pts)
-        return {key: np.asarray(val)[:n_q] for key, val in res.items()}
+        res, stats = closest_faces_and_points_accel(
+            v, f, pts, with_stats=True)
+        out = {key: np.asarray(val)[:n_q] for key, val in res.items()}
+        out["__backend__"] = stats["backend"]
+        return out
 
     out = call_with_timeout(_call, timeout)
     faces = out["face"].astype("uint32")[None, :]
     # the facade already repaired loose queries through the dense path,
-    # so the answer is exact regardless of how many certificates missed
+    # so the answer is exact regardless of how many certificates missed;
+    # surface which traversal backend (xla / pallas / pallas_stream)
+    # actually served the request as provenance
     return ServeResult(faces, out["point"].astype("float64"), "accel",
-                       certified=True)
+                       certified=True, backend=out["__backend__"])
 
 
 def default_ladder():

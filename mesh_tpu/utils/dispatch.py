@@ -19,7 +19,9 @@ from . import knobs
 
 __all__ = ["env_flag", "force_xla", "safe_tiles", "tile_variant",
            "pallas_default", "mesh_on_tpu", "no_engine", "vertex_chamfer",
-           "no_accel", "accel_kind"]
+           "no_accel", "accel_kind", "bvh_stream_enabled",
+           "bvh_stream_force", "bvh_stream_buffers",
+           "bvh_stream_vmem_budget"]
 
 
 def force_xla():
@@ -68,6 +70,38 @@ def accel_kind():
     uniform grid.  Unknown values fall back to bvh."""
     value = (knobs.get_str("MESH_TPU_ACCEL_KIND") or "").lower()
     return "grid" if value == "grid" else "bvh"
+
+
+def bvh_stream_enabled():
+    """True unless MESH_TPU_BVH_STREAM turns the streamed Pallas rope
+    kernel off — the kill switch that restores the legacy behavior
+    (XLA traversal above the resident VMEM ceiling)."""
+    return env_flag("MESH_TPU_BVH_STREAM")
+
+
+def bvh_stream_force():
+    """True when MESH_TPU_BVH_STREAM_FORCE pins the accel facade to the
+    STREAMED rope kernel even where the resident variant fits VMEM —
+    the bit-identity A/B hatch (results are identical by construction,
+    only DMA traffic and pair accounting differ)."""
+    return env_flag("MESH_TPU_BVH_STREAM_FORCE")
+
+
+def bvh_stream_buffers(default=2):
+    """Leaf-ring depth for the streamed rope kernel: the
+    MESH_TPU_BVH_STREAM_BUFFERS override when set, else ``default``
+    (the facade passes the autotuned value), clamped to >= 2."""
+    value = knobs.get_int("MESH_TPU_BVH_STREAM_BUFFERS")
+    if value is None:
+        value = default
+    return max(2, int(value))
+
+
+def bvh_stream_vmem_budget():
+    """The VMEM byte budget the facade measures the resident kernel's
+    face-plane footprint against (MESH_TPU_BVH_STREAM_VMEM_MB, MiB)."""
+    mb = knobs.get_float("MESH_TPU_BVH_STREAM_VMEM_MB")
+    return int(float(mb) * 1024 * 1024)
 
 
 def no_engine():

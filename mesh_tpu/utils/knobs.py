@@ -104,6 +104,27 @@ ACCEL_MIN_FACES = _declare(
     "MESH_TPU_ACCEL_MIN_FACES", "int", None,
     "Face count at which the auto strategy switches to the spatial "
     "index (overrides the calibrated accel crossover).", "Dispatch")
+BVH_STREAM = _declare(
+    "MESH_TPU_BVH_STREAM", "flag", True,
+    "Streamed Pallas BVH kill switch: on (default) lets meshes whose "
+    "face planes exceed the VMEM budget run the double-buffered "
+    "DMA-streamed rope kernel; off restores the legacy behavior (XLA "
+    "traversal above the resident ceiling).", "Dispatch")
+BVH_STREAM_FORCE = _declare(
+    "MESH_TPU_BVH_STREAM_FORCE", "flag", False,
+    "Force the STREAMED Pallas rope kernel even when the resident "
+    "variant would fit VMEM (A/B hatch; results are bit-identical).",
+    "Dispatch")
+BVH_STREAM_BUFFERS = _declare(
+    "MESH_TPU_BVH_STREAM_BUFFERS", "int", None,
+    "Leaf-ring buffer count for the streamed Pallas rope kernel "
+    "(min 2); unset uses the autotuned value, else 2.", "Dispatch")
+BVH_STREAM_VMEM_MB = _declare(
+    "MESH_TPU_BVH_STREAM_VMEM_MB", "float", 12.0,
+    "VMEM budget (MiB) the accel facade measures the resident rope "
+    "kernel's face planes against when picking resident vs streamed "
+    "(headroom below the ~16 MiB ceiling for accumulators and Mosaic "
+    "overhead).", "Dispatch")
 NO_XLA_CACHE = _declare(
     "MESH_TPU_NO_XLA_CACHE", "flag", False,
     "Opt out of the persistent XLA compilation cache "
@@ -214,6 +235,14 @@ ACCEL_PROXY_QUERIES = _declare(
     "MESH_TPU_ACCEL_PROXY_QUERIES", "int", None,
     "accel_proxy bench stage: override the proxy query count (read by "
     "bench.py).", "Bench harness")
+STREAM_PROXY_FACES = _declare(
+    "MESH_TPU_STREAM_PROXY_FACES", "int", None,
+    "accel_stream_proxy bench stage: override the proxy mesh face count "
+    "(read by bench.py).", "Bench harness")
+STREAM_PROXY_QUERIES = _declare(
+    "MESH_TPU_STREAM_PROXY_QUERIES", "int", None,
+    "accel_stream_proxy bench stage: override the proxy query count "
+    "(read by bench.py).", "Bench harness")
 
 
 # -- accessors -------------------------------------------------------------

@@ -405,6 +405,71 @@ def test_vmem001_prices_scratch_dtypes():
         """)
 
 
+def test_vmem001_leading_dims_multiply():
+    # a double-buffered DMA ring: (4, 2048, 1024) f32 = 4 x 8 MiB — the
+    # leading (buffer) dim must multiply the per-block footprint
+    findings = _run(VmemBudgetRule(), """
+        import jax.experimental.pallas as pl
+        import jax.experimental.pallas.tpu as pltpu
+        import jax.numpy as jnp
+
+        def build(kernel, n_buffers=4):
+            return pl.pallas_call(
+                kernel,
+                scratch_shapes=[
+                    pltpu.VMEM((n_buffers, 2048, 1024), jnp.float32)],
+            )
+        """)
+    assert _codes(findings) == ["VMEM001"]
+    assert "32.00 MiB" in findings[0].message
+    # two buffers of the same block fit (16 MiB is not > the budget)
+    assert not _run(VmemBudgetRule(), """
+        import jax.experimental.pallas as pl
+        import jax.experimental.pallas.tpu as pltpu
+        import jax.numpy as jnp
+
+        def build(kernel, n_buffers=2):
+            return pl.pallas_call(
+                kernel,
+                scratch_shapes=[
+                    pltpu.VMEM((n_buffers, 2048, 1024), jnp.float32)],
+            )
+        """)
+
+
+def test_vmem001_prices_sublane_padding():
+    # (2, 19, 90112) f32 is ~13.1 MiB unpadded but Mosaic lays the 19
+    # sublanes out as 24 -> ~16.5 MiB: over budget only under padded
+    # pricing.  The misaligned sublane also gets its VMEM003 note.
+    findings = _run(VmemBudgetRule(), """
+        import jax.experimental.pallas as pl
+        import jax.experimental.pallas.tpu as pltpu
+        import jax.numpy as jnp
+
+        def build(kernel):
+            return pl.pallas_call(
+                kernel,
+                scratch_shapes=[
+                    pltpu.VMEM((2, 19, 90112), jnp.float32)],
+            )
+        """)
+    assert _codes(findings) == ["VMEM003", "VMEM001"]
+    # an explicitly padded, aligned ring under budget (2*24*81920*4 B
+    # = 15 MiB) is clean — the fix VMEM001's hint asks for
+    assert not _run(VmemBudgetRule(), """
+        import jax.experimental.pallas as pl
+        import jax.experimental.pallas.tpu as pltpu
+        import jax.numpy as jnp
+
+        def build(kernel):
+            return pl.pallas_call(
+                kernel,
+                scratch_shapes=[
+                    pltpu.VMEM((2, 24, 81920), jnp.float32)],
+            )
+        """)
+
+
 def test_vmem002_lane_alignment():
     findings = _run(VmemBudgetRule(), """
         import jax.experimental.pallas as pl

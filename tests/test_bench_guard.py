@@ -126,11 +126,20 @@ def test_obs_overhead_guard(monkeypatch):
     assert "MESH_TPU_OBS" not in os.environ
     # obs-off latency is the same steady-state sweep the pre-PR
     # dispatch-latency guard measures — it must stay within noise of it
-    # (3x either way; the plans are shared in-process, so this re-run
-    # is compile-free)
-    lat = bench.dispatch_latency_small_q(repeats=1)
-    assert lat["engine_ms_per_call"] / 3 < rec["off_ms_per_call"] < (
-        3 * lat["engine_ms_per_call"])
+    # (3x either way; the plans are shared in-process, so these re-runs
+    # are compile-free).  A single sample of either side can be a
+    # scheduler outlier on a loaded host, so the band compares the
+    # MEDIAN of 3 latency sweeps and retries once with fresh samples
+    # before declaring a real regression.
+    def band_holds():
+        samples = sorted(
+            bench.dispatch_latency_small_q(repeats=1)["engine_ms_per_call"]
+            for _ in range(3))
+        median = samples[1]
+        return median / 3 < rec["off_ms_per_call"] < 3 * median
+
+    assert band_holds() or band_holds(), \
+        "obs-off latency left the 3x band of the dispatch sweep twice"
 
 
 def test_obs_overhead_wedged_is_null(monkeypatch):
