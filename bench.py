@@ -8,7 +8,7 @@ Prints ONE JSON line:
 Since the staging rework (doc/benchmarking.md) the default run is a
 subprocess-isolated staged pipeline (mesh_tpu/obs/perf.py): probe ->
 warmup -> normals -> closest_point -> dispatch_latency -> fit_step ->
-serve_load -> obs/recorder overhead guards -> pallas_proxy, each stage
+serve_load -> obs/recorder/prof overhead guards -> pallas_proxy, each stage
 under its own timeout with partial results persisted to
 bench_partial.json, one flight-recorder incident per wedged run, and a
 chip-free CPU-interpreter Pallas proxy metric riding every record.
@@ -525,6 +525,82 @@ def recorder_overhead(rounds=5, sweeps_per_round=3):
         "overhead_frac": round(overhead, 4) if overhead is not None else None,
         "events_recorded": len(obs.get_recorder().events()),
     }
+
+
+def prof_overhead(rounds=5, clients=2, requests_per_client=32,
+                  deadline_s=1.0, queries=128):
+    """Always-on cost of the per-request latency ledger on the
+    closed-loop serving path: p50 with MESH_TPU_LEDGER=0 (open() returns
+    None, nothing stamps) vs the default always-on stamping + histogram
+    + ring append.  Same interleaved min-of-rounds shape as the
+    obs/recorder overhead guards; tests/test_bench_guard.py pins
+    ``overhead_frac`` < 0.05 — the bound that makes the ledger's
+    "always on" claim honest.  The record embeds the on-arm's per-stage
+    breakdown (``stage_stats``) so perfcheck / ``mesh-tpu prof diff``
+    can attribute later regressions to a named stage.
+    """
+    from mesh_tpu import Mesh, obs
+    from mesh_tpu.obs import prof
+    from mesh_tpu.serve import HealthMonitor, QueryService, run_closed_loop
+    from mesh_tpu.sphere import _icosphere
+
+    rng = np.random.RandomState(0)
+    v, f = _icosphere(2)
+    mesh = Mesh(v=v, f=f)
+    pts = np.asarray(rng.randn(queries, 3) * 0.4, np.float32)
+
+    service = QueryService(workers=2, default_deadline_s=deadline_s,
+                           health=HealthMonitor(watchdog=False))
+    prev = os.environ.pop("MESH_TPU_LEDGER", None)
+    try:
+        warmed = service.warmup(mesh, queries=queries)
+        log("prof-overhead: warmed rungs %s" % (warmed,))
+
+        def p50():
+            report = run_closed_loop(
+                service, mesh, pts, clients=clients,
+                requests_per_client=requests_per_client,
+                deadline_s=deadline_s)
+            return report["p50_ms"]
+
+        os.environ["MESH_TPU_LEDGER"] = "0"
+        p50()                            # warm both code paths
+        os.environ.pop("MESH_TPU_LEDGER", None)
+        p50()
+        off_best, on_best = np.inf, np.inf
+        for _ in range(rounds):
+            os.environ["MESH_TPU_LEDGER"] = "0"
+            off_best = min(off_best, p50())
+            os.environ.pop("MESH_TPU_LEDGER", None)
+            on_best = min(on_best, p50())
+        rows = obs.get_ledger().records()
+    finally:
+        service.stop(write_stats=False)
+        if prev is None:
+            os.environ.pop("MESH_TPU_LEDGER", None)
+        else:
+            os.environ["MESH_TPU_LEDGER"] = prev
+    overhead = max(0.0, (on_best - off_best) / off_best) if off_best else None
+    record = {
+        "metric": "prof_overhead_closed_loop",
+        "value": round(overhead, 4) if overhead is not None else None,
+        "unit": "overhead_frac",
+        "vs_baseline": None,
+        "off_p50_ms": round(off_best, 3),
+        "on_p50_ms": round(on_best, 3),
+        "overhead_frac": round(overhead, 4) if overhead is not None else None,
+        "requests_recorded": len(rows),
+        "clients": clients,
+        "deadline_s": deadline_s,
+    }
+    try:
+        stats = prof.stats_from_records(rows)
+        record["stage_stats"] = stats["stages"]
+        record["stage_total"] = stats["total"]
+        record["stage_backends"] = stats["backends"]
+    except prof.ProfError:
+        pass        # off-arm-only run: no attribution evidence to embed
+    return record
 
 
 def fit_step_latency(repeats=10, n_scan=256):
@@ -1133,6 +1209,7 @@ _STAGE_DEFS = OrderedDict((
     ("serve_load", (serve_load, 300.0, True, False, {})),
     ("obs_overhead", (obs_overhead, 300.0, True, False, {})),
     ("recorder_overhead", (recorder_overhead, 300.0, True, False, {})),
+    ("prof_overhead", (prof_overhead, 300.0, True, False, {})),
     # PALLAS_AXON_POOL_IPS must ALSO be cleared: the axon hook ignores
     # JAX_PLATFORMS=cpu alone (same idiom as tests/conftest.py), and a
     # proxy child that silently lands on the wedged tunnel defeats the
@@ -1287,7 +1364,7 @@ def main():
         return
     legacy = [flag for flag in (
         "--dispatch-latency", "--obs-overhead", "--recorder-overhead",
-        "--fit-step", "--serve-load") if flag in argv]
+        "--prof-overhead", "--fit-step", "--serve-load") if flag in argv]
     if legacy:
         # pre-staging single-mode flows, kept in-process: their guard
         # tests monkeypatch backend_responsive and time the sweeps with
@@ -1302,6 +1379,8 @@ def main():
                 ("--obs-overhead", "obs_overhead_small_q",
                  "overhead_frac"),
                 ("--recorder-overhead", "recorder_overhead_small_q",
+                 "overhead_frac"),
+                ("--prof-overhead", "prof_overhead_closed_loop",
                  "overhead_frac"),
                 ("--fit-step", "fit_step_latency", "ms/call"),
                 ("--serve-load", "serve_load_closed_loop", "p99_ms"),
@@ -1323,6 +1402,8 @@ def main():
             print(json.dumps(_with_obs(obs_overhead())))
         elif "--recorder-overhead" in argv:
             print(json.dumps(_with_obs(recorder_overhead())))
+        elif "--prof-overhead" in argv:
+            print(json.dumps(_with_obs(prof_overhead())))
         elif "--fit-step" in argv:
             print(json.dumps(_with_obs(fit_step_latency())))
         elif "--serve-load" in argv:

@@ -338,7 +338,7 @@ def bvh_search_faces(index, v, f, points):
 
 
 def closest_faces_and_points_accel(v, f, points, kind=None, index=None,
-                                   with_stats=False):
+                                   with_stats=False, record=None):
     """Index-accelerated exact closest point — the ``accel`` strategy of
     ``closest_faces_and_points_auto``.  Host-boundary function (numpy
     out), exact-by-fallback: loose-certificate queries re-run through
@@ -361,6 +361,9 @@ def closest_faces_and_points_accel(v, f, points, kind=None, index=None,
     :param with_stats: also return ``{"pair_tests", "fallback",
         "tight_frac", "kind", "backend"}`` — ``backend`` is ``"xla"``,
         ``"pallas"`` (resident) or ``"pallas_stream"``.
+    :param record: optional ``obs.ledger.RequestRecord``; the traversal
+        stamps its ``device`` stage and backend onto it (the serving
+        tier's accel rung threads the request's ledger record here).
     """
     from ..obs.trace import span as obs_span
     from ..utils.dispatch import accel_kind, no_engine, pallas_default
@@ -423,6 +426,9 @@ def closest_faces_and_points_accel(v, f, points, kind=None, index=None,
         out.pop("steps", None)
         loose = np.nonzero(~tight)[0]
         sp.set(backend=backend, pair_tests=pairs, fallback=int(loose.size))
+    if record is not None:
+        record.stamp("device")
+        record.set(backend=backend)
     _record_pair_tests(pairs, kind)
     if loose.size:
         from ..query.culled import _record_fallback

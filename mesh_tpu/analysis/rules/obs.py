@@ -25,6 +25,10 @@ Codes:
   utils/profiling.py, obs/, viewer/, and analysis/ — hot-path timing
   must go through obs.clock / Timer / timed_span so the sync-aware
   accounting and the overhead gate stay honest.
+- OBS005 (error): a latency-ledger stage name (the ``LEDGER_STAGES``
+  tuple in obs/ledger.py) is absent from doc/observability.md — the
+  stage vocabulary is the ``mesh-tpu prof`` CLI's user-facing contract,
+  so every name must appear in the doc as a backticked literal.
 """
 
 import ast
@@ -118,6 +122,31 @@ def collect_code_names(project):
     return names
 
 
+def collect_ledger_stages(project):
+    """{stage_name: (relpath, line)} from every ``LEDGER_STAGES = (...)``
+    tuple-of-string-literals assignment in the tree (obs/ledger.py owns
+    the canonical one; the collector is name-keyed so a moved definition
+    stays covered)."""
+    stages = {}
+    for ctx in project.contexts:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            targets = [t.id for t in node.targets
+                       if isinstance(t, ast.Name)]
+            if "LEDGER_STAGES" not in targets:
+                continue
+            if not isinstance(node.value, (ast.Tuple, ast.List)):
+                continue
+            for elt in node.value.elts:
+                if (isinstance(elt, ast.Constant)
+                        and isinstance(elt.value, str)):
+                    stages.setdefault(
+                        elt.value,
+                        (ctx.relpath, getattr(node, "lineno", 0)))
+    return stages
+
+
 class ObservabilityHygieneRule(Rule):
 
     id = "OBS"
@@ -184,6 +213,17 @@ class ObservabilityHygieneRule(Rule):
                     hint="add it to the series table in "
                          "doc/observability.md (the {a,b} brace "
                          "shorthand is expanded)"))
+        for stage, (relpath, line) in sorted(
+                collect_ledger_stages(project).items()):
+            if ("`%s`" % stage) not in doc:
+                findings.append(Finding(
+                    "OBS005", "error", relpath, line,
+                    "ledger stage '%s' (LEDGER_STAGES) is absent from "
+                    "doc/observability.md" % stage,
+                    hint="add `%s` (backticked) to the ledger stage "
+                         "table in doc/observability.md — the stage "
+                         "vocabulary is the `mesh-tpu prof` CLI's "
+                         "user-facing contract" % stage))
         return findings
 
 

@@ -11,6 +11,8 @@ Subcommands:
   slo — evaluate SLO compliance from the serve-stats sink (no jax init)
   perfcheck — compare a saved bench JSON against the last-good record
     and the CPU-proxy golden with tolerance bands (no jax init)
+  prof — per-request stage profiling: `prof top` breakdowns and
+    `prof diff` regression attribution (no jax init)
   lint — run the meshlint static analyzer over the package (no jax
     init; gate 0 of tools/run_tpu_gates.sh)
 
@@ -26,6 +28,8 @@ Examples:
   mesh-tpu incidents incident-...-watchdog_trip-001.json --json
   mesh-tpu slo --latency-ms 250 --target 0.99
   mesh-tpu perfcheck bench_partial.json
+  mesh-tpu prof top ~/.mesh_tpu/serve_stats.json
+  mesh-tpu prof diff ledger_before.jsonl ledger_after.jsonl
   mesh-tpu lint --json
   mesh-tpu lint --rules VMEM,TRC mesh_tpu/query
 """
@@ -455,6 +459,51 @@ def cmd_perfcheck(args):
     sys.exit(rc)
 
 
+def cmd_prof(args):
+    """Stage-level latency profiling from on-disk evidence (no jax init).
+
+    ``prof top SOURCE`` prints the per-stage p50/p99/mean breakdown of
+    one profile source — a ledger JSONL dump, a serve-stats sink, a
+    flight-recorder incident (schema >= 2), or a bench JSON with an
+    embedded stage_stats block.  ``prof diff A B`` attributes the
+    p50/p99 total delta between two sources to named stages and exits 1
+    on a regression past --tol — the "p99 regressed because DISPATCH got
+    slower" answer perf CI wants (doc/observability.md runbook).
+    Exit codes: 0 ok, 1 regression (diff only), 2 unreadable input.
+    """
+    import json
+
+    from mesh_tpu.obs import prof
+
+    try:
+        if args.prof_command == "top":
+            stats = prof.load(args.source)
+            rc = 0
+            if args.json:
+                json.dump(stats, sys.stdout, indent=2, sort_keys=True)
+                sys.stdout.write("\n")
+            else:
+                print("prof top %s" % args.source)
+                for line in prof.top_lines(stats):
+                    print("  " + line)
+        else:
+            a = prof.load(args.a)
+            b = prof.load(args.b)
+            rc, lines = prof.diff(a, b, tol=args.tol)
+            if args.json:
+                json.dump({"rc": rc, "lines": lines}, sys.stdout, indent=2)
+                sys.stdout.write("\n")
+            else:
+                print("prof diff %s -> %s" % (args.a, args.b))
+                for line in lines:
+                    print("  " + line)
+                print("prof diff: %s" % ("OK" if rc == 0 else "REGRESSION"))
+    except prof.ProfError as exc:
+        print("prof: %s" % exc, file=sys.stderr)
+        sys.exit(2)
+    sys.exit(rc)
+
+
 def cmd_lint(args):
     """Run meshlint (mesh_tpu.analysis) over the package.
 
@@ -652,6 +701,34 @@ def main():
                         help="machine-readable {rc, lines} instead of the "
                              "summary")
     p_perf.set_defaults(func=cmd_perfcheck)
+
+    p_prof = sub.add_parser(
+        "prof",
+        help="per-request stage profiling: live breakdowns and "
+             "regression attribution (no jax init)")
+    prof_sub = p_prof.add_subparsers(dest="prof_command", required=True)
+    p_ptop = prof_sub.add_parser(
+        "top",
+        help="per-stage p50/p99/mean breakdown of one profile source "
+             "(ledger JSONL, serve-stats sink, incident, bench JSON)")
+    p_ptop.add_argument("source",
+                        help="profile evidence file to summarize")
+    p_ptop.add_argument("--json", action="store_true",
+                        help="the normalized stats dict instead of the "
+                             "table")
+    p_ptop.set_defaults(func=cmd_prof)
+    p_pdiff = prof_sub.add_parser(
+        "diff",
+        help="attribute the p50/p99 delta between two profile sources "
+             "to named stages; exit 1 on regression")
+    p_pdiff.add_argument("a", help="baseline profile source")
+    p_pdiff.add_argument("b", help="candidate profile source")
+    p_pdiff.add_argument("--tol", type=float, default=0.2,
+                         help="allowed fractional total-latency growth "
+                              "before rc 1 (default 0.2)")
+    p_pdiff.add_argument("--json", action="store_true",
+                         help="machine-readable {rc, lines}")
+    p_pdiff.set_defaults(func=cmd_prof)
 
     p_lint = sub.add_parser(
         "lint",

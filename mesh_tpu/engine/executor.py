@@ -47,9 +47,10 @@ _OPS = ("closest_point", "fused")
 
 class _Request(object):
     __slots__ = ("op", "mesh", "points", "chunk", "future", "key",
-                 "t_submit", "deadline")
+                 "t_submit", "deadline", "record")
 
-    def __init__(self, op, mesh, points, chunk, key, deadline=None):
+    def __init__(self, op, mesh, points, chunk, key, deadline=None,
+                 record=None):
         self.op = op
         self.mesh = mesh
         self.points = points
@@ -58,6 +59,7 @@ class _Request(object):
         self.future = Future()
         self.t_submit = _now()
         self.deadline = deadline    # absolute obs.clock.monotonic, or None
+        self.record = record        # obs.ledger.RequestRecord, or None
 
 
 class EngineExecutor(object):
@@ -77,7 +79,8 @@ class EngineExecutor(object):
     # ------------------------------------------------------------------
     # submission API
 
-    def submit(self, op, mesh, points, chunk=512, deadline=None):
+    def submit(self, op, mesh, points, chunk=512, deadline=None,
+               record=None):
         """Enqueue one (mesh, query set) request; returns a Future.
 
         Future results match the sequential facade conventions:
@@ -93,6 +96,11 @@ class EngineExecutor(object):
         whose result nobody will wait for.  ``future.cancel()`` before
         dispatch likewise skips the request (the serving tier's retry
         path uses both — doc/serving.md).
+
+        ``record`` is an optional ``obs.ledger.RequestRecord`` that
+        rides the request through the worker so the coalesce / pad /
+        compile / dispatch / device stages are stamped on the serving
+        tier's latency ledger (doc/observability.md).
         """
         if op not in _OPS:
             raise ValueError("unknown engine op %r (have %s)" % (op, _OPS))
@@ -110,7 +118,8 @@ class EngineExecutor(object):
         key = (op, chunk, f.shape, zlib.crc32(
             np.ascontiguousarray(f).tobytes()), np.asarray(mesh.v).shape)
         req = _Request(op, mesh, pts, chunk, key,
-                       deadline=None if deadline is None else float(deadline))
+                       deadline=None if deadline is None else float(deadline),
+                       record=record)
         with obs_span("engine.enqueue", op=op, q=pts.shape[0]):
             with self._cond:
                 if self._shutdown or not self._thread.is_alive():
@@ -254,6 +263,9 @@ class EngineExecutor(object):
                 # queue-vs-device latency split (device time is the
                 # engine.dispatch histogram)
                 STATS.record_queue_wait(drained - req.t_submit)
+                if req.record is not None:
+                    # the batching window just closed for this group
+                    req.record.stamp("coalesce", drained)
             planner = get_planner()
             with obs_span("engine.stack", meshes=len(group)):
                 v, f = stack_mesh_batch([req.mesh for req in group])
@@ -265,6 +277,11 @@ class EngineExecutor(object):
                            mode="edge")
                     for req in group
                 ])
+            records = [req.record for req in group
+                       if req.record is not None]
+            for record in records:
+                record.stamp("pad")
+                record.set(op=op, bucket=qb)
             chunk = group[0].chunk
             use_pallas, use_culled = _strategy(f)
             normals, res = planner.run_batch_step(
@@ -273,6 +290,7 @@ class EngineExecutor(object):
                 with_normals=(op == "fused"),
                 nondegen=_batch_nondegen(v, f, use_pallas),
                 variant=tile_variant(), op=op,
+                records=records,
             )
             STATS.record_coalesced(len(group))
         faces_all = np.asarray(res["face"]).astype(np.uint32)
@@ -317,7 +335,7 @@ def get_executor():
         return _EXECUTOR
 
 
-def submit(op, mesh, points, chunk=512, deadline=None):
+def submit(op, mesh, points, chunk=512, deadline=None, record=None):
     """Module-level shortcut: ``engine.submit("closest_point", m, pts)``."""
     return get_executor().submit(op, mesh, points, chunk=chunk,
-                                 deadline=deadline)
+                                 deadline=deadline, record=record)

@@ -159,12 +159,15 @@ class Planner(object):
         ).compile()
 
     def run_batch_step(self, v, f, pts, *, use_pallas, use_culled, chunk,
-                       with_normals, nondegen, variant, op):
+                       with_normals, nondegen, variant, op, records=None):
         """Bucket-pad -> plan -> dispatch -> slice for batch._batch_step.
 
         :param v: [B, V, 3] f32 vertices (numpy or device array)
         :param f: [F, 3] int32 faces
         :param pts: [B, Q, 3] f32 queries, or None (normals-only ops)
+        :param records: optional list of ``obs.ledger.RequestRecord`` to
+            stamp at compile / dispatch / device boundaries (the
+            executor passes the coalesced group's records through).
         :returns: ``(normals, res)`` exactly like ``_batch_step``, sliced
             back to the caller's true B and Q.
         """
@@ -196,6 +199,10 @@ class Planner(object):
                     variant,
                 ),
             )
+            backend = "pallas" if use_pallas else "xla"
+            for rec in records or ():
+                rec.stamp("compile")
+                rec.set(backend=backend)
             import jax
 
             with timed_span("engine.dispatch", op=op) as disp:
@@ -203,8 +210,12 @@ class Planner(object):
                     jnp.asarray(vs), jnp.asarray(f),
                     None if pts_p is None else jnp.asarray(pts_p),
                 )
+                for rec in records or ():
+                    rec.stamp("dispatch")
                 jax.block_until_ready((normals, res))
-            STATS.record_dispatch(op, disp.elapsed)
+            for rec in records or ():
+                rec.stamp("device")
+            STATS.record_dispatch(op, disp.elapsed, backend=backend)
             STATS.record_padding(
                 n_batch * (n_queries or 1), bb * (qb or 1)
             )
@@ -263,7 +274,8 @@ class Planner(object):
                     jnp.asarray(nrm_p), jnp.float32(min_dist),
                 )
                 jax.block_until_ready((vis, ndc))
-            STATS.record_dispatch("visibility", disp.elapsed)
+            STATS.record_dispatch("visibility", disp.elapsed,
+                                  backend="pallas" if use_pallas else "xla")
             STATS.record_padding(n_batch * n_cams, bb * cb)
             get_recorder().record(
                 "engine.dispatch", op="visibility", b=n_batch, q=n_cams,

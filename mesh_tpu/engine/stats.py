@@ -136,8 +136,12 @@ class EngineStats(object):
         self._coalesced_requests.inc(int(batch_size))
         self._coalesced_max_batch.set_max(int(batch_size))
 
-    def record_dispatch(self, op, seconds):
-        self._dispatch_seconds.observe(float(seconds), op=op)
+    def record_dispatch(self, op, seconds, backend="xla"):
+        """One engine device dispatch: ``backend`` separates pallas vs
+        xla latency (the engine path never streams, so the accel-facade
+        ``pallas_stream`` value does not appear on this series)."""
+        self._dispatch_seconds.observe(float(seconds), op=op,
+                                       backend=backend)
 
     def record_queue_wait(self, seconds):
         """Executor-only: submit-to-dispatch latency of one request
@@ -170,15 +174,29 @@ class EngineStats(object):
             co_dispatches = self._coalesced_dispatches.value()
             co_requests = self._coalesced_requests.value()
             co_max = self._coalesced_max_batch.value()
-            latency = {}
+            # aggregate across the backend label so the compat snapshot
+            # stays keyed by op alone (one op can now carry several
+            # backend-labeled series)
+            agg = {}
             for labels in self._dispatch_seconds.label_sets():
                 op = labels.get("op", "")
                 stat = self._dispatch_seconds.stat(**labels)
+                row = agg.get(op)
+                if row is None:
+                    agg[op] = {"count": stat["count"], "sum": stat["sum"],
+                               "max": stat["max"]}
+                else:
+                    row["count"] += stat["count"]
+                    row["sum"] += stat["sum"]
+                    row["max"] = max(row["max"], stat["max"])
+            latency = {}
+            for op, row in agg.items():
                 latency[op] = {
-                    "count": stat["count"],
-                    "total_s": stat["sum"],
-                    "max_s": stat["max"],
-                    "mean_ms": round(1e3 * stat["mean"], 3),
+                    "count": row["count"],
+                    "total_s": row["sum"],
+                    "max_s": row["max"],
+                    "mean_ms": round(1e3 * row["sum"] / row["count"], 3)
+                    if row["count"] else 0.0,
                 }
             pad_waste = 1.0 - useful / dispatched if dispatched else 0.0
             return {

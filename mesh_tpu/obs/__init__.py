@@ -40,6 +40,16 @@ Import cost: stdlib only — jax is touched lazily and never required.
 from .clock import enabled, monotonic, wall  # noqa: F401
 from .export import prometheus_text, render_tree, write_jsonl  # noqa: F401
 from .jax_bridge import install_jax_monitoring_bridge  # noqa: F401
+from .ledger import (  # noqa: F401
+    LEDGER,
+    LEDGER_STAGES,
+    LatencyLedger,
+    RequestRecord,
+    bind_current,
+    current_record,
+    get_ledger,
+    ledger_enabled,
+)
 from .metrics import (  # noqa: F401
     LATENCY_BUCKETS_S,
     Counter,
@@ -63,6 +73,13 @@ from .recorder import (  # noqa: F401
     get_recorder,
     list_incidents,
     recorder_enabled,
+)
+from .series import (  # noqa: F401
+    SERIES,
+    SampleRing,
+    WindowedSeries,
+    get_series,
+    quantile_from_cumulative,
 )
 from .slo import (  # noqa: F401
     SLO,
@@ -93,6 +110,10 @@ __all__ = [
     "install_jax_monitoring_bridge",
     "RECORDER", "FlightRecorder", "get_recorder", "recorder_enabled",
     "default_incident_dir", "list_incidents",
+    "LEDGER", "LEDGER_STAGES", "LatencyLedger", "RequestRecord",
+    "get_ledger", "ledger_enabled", "bind_current", "current_record",
+    "SERIES", "SampleRing", "WindowedSeries", "get_series",
+    "quantile_from_cumulative",
     "SLO", "BurnRateRule", "SLOMonitor", "default_slos", "default_rules",
     "compliance", "bind_incident_response",
     "monotonic", "wall",
@@ -126,8 +147,10 @@ export_jsonl = write_jsonl
 
 def reset():
     """Zero every metric series, drop buffered spans, and empty the
-    flight-recorder ring (tests, and the per-run isolation of the CLI
-    subcommands)."""
+    flight-recorder ring, latency-ledger ring, and windowed-series ring
+    (tests, and the per-run isolation of the CLI subcommands)."""
     REGISTRY.reset()
     TRACER.clear()
     RECORDER.clear()
+    LEDGER.clear()
+    SERIES.clear()

@@ -1,0 +1,267 @@
+"""Per-request latency ledger: where inside each request the time went.
+
+The SLO engine says latency is bad; the flight recorder says what events
+surrounded it; neither says WHICH STAGE of a request ate the budget.
+The ledger closes that gap: every served request carries one
+:class:`RequestRecord` from admission to response, stamped as each stage
+finishes —
+
+    admit -> queue -> coalesce -> pad -> compile -> dispatch
+          -> device -> respond
+
+(``queue`` = serve-queue wait, ``coalesce`` = the engine executor's
+batching window, ``pad`` = stack/bucket-pad cost, ``compile`` =
+plan-cache lookup or trace+compile, ``dispatch`` = host-side launch,
+``device`` = on-device wall time, ``respond`` = split + response build).
+Stages a request never visits (cache hits, non-engine ladder rungs) are
+simply absent; durations chain across the gap, so the per-record stage
+seconds always sum to the full admit-to-respond latency.
+
+Records carry provenance — tenant, op, query-shape bucket, accel
+backend, degradation-ladder rung, certified/approximate — so breakdowns
+separate pallas vs pallas_stream vs xla and certified vs degraded
+traffic.  Closing a record feeds each stage duration into the
+``mesh_tpu_request_stage_seconds{stage,backend}`` histogram (windowed
+percentiles via obs/series.py) and appends one JSON-able row to a
+bounded ring; the flight recorder copies the ring tail into incident
+dumps, ``dump_jsonl()`` saves it for ``mesh-tpu prof diff``.
+
+Always on (same contract as the recorder: the ``prof_overhead`` bench
+guard pins the closed-loop p50 cost below 5%); kill switch
+``MESH_TPU_LEDGER=0``; ring capacity ``MESH_TPU_LEDGER_CAPACITY``
+(default 512); incident tail length ``MESH_TPU_LEDGER_TAIL`` (default
+32).  Hot-path cost is one knob read at open, one perf_counter read per
+stamp, and one locked append plus a handful of histogram observes at
+close.  Stdlib-only; every clock read goes through the injected
+``clock`` for fake-clock tests.
+"""
+
+import json
+import threading
+from collections import deque
+from contextlib import contextmanager
+
+from ..utils import knobs
+from .clock import monotonic
+from .metrics import REGISTRY
+
+__all__ = [
+    "LEDGER_STAGES", "RequestRecord", "LatencyLedger", "LEDGER",
+    "get_ledger", "ledger_enabled", "bind_current", "current_record",
+    "LEDGER_ENV", "LEDGER_CAPACITY_ENV", "LEDGER_TAIL_ENV",
+]
+
+#: kill switch: set to 0/false/no/off to disable record creation
+LEDGER_ENV = "MESH_TPU_LEDGER"
+
+#: bounded-ring capacity in request records (default 512)
+LEDGER_CAPACITY_ENV = "MESH_TPU_LEDGER_CAPACITY"
+
+#: how many ring-tail records ride along in flight-recorder incidents
+LEDGER_TAIL_ENV = "MESH_TPU_LEDGER_TAIL"
+
+#: stage names in request order; each is stamped when that stage ENDS
+#: (the record's open time is the admit stamp).  The meshlint OBS rule
+#: checks every name here is documented in doc/observability.md.
+LEDGER_STAGES = (
+    "queue", "coalesce", "pad", "compile", "dispatch", "device", "respond",
+)
+
+_STAGE_INDEX = {name: i for i, name in enumerate(LEDGER_STAGES)}
+
+
+def ledger_enabled():
+    """True unless MESH_TPU_LEDGER explicitly turns the ledger off
+    (unset means ON — attribution must be there when latency goes bad,
+    like the flight recorder)."""
+    return knobs.flag(LEDGER_ENV)
+
+
+def _ring_capacity():
+    return max(16, knobs.get_int(LEDGER_CAPACITY_ENV))
+
+
+def tail_length():
+    """How many ring-tail records incident dumps carry (min 1)."""
+    return max(1, knobs.get_int(LEDGER_TAIL_ENV))
+
+
+class RequestRecord(object):
+    """One request's stage stamps + provenance.
+
+    Mutable and intentionally unlocked: each stamp is written by exactly
+    one thread at a time (the request moves serve worker -> executor
+    worker with happens-before edges at the queue handoffs), and the
+    ledger only reads it at ``close()``.
+    """
+
+    __slots__ = ("t_admit", "stamps", "meta", "_clock")
+
+    def __init__(self, t_admit, meta, clock):
+        self.t_admit = float(t_admit)
+        self.stamps = {}
+        self.meta = meta
+        self._clock = clock
+
+    def stamp(self, stage, t=None):
+        """Mark ``stage`` as finished at ``t`` (now by default).  Unknown
+        stage names raise — a typo'd stamp site must fail tests, not
+        silently vanish from every breakdown."""
+        if stage not in _STAGE_INDEX:
+            raise ValueError("unknown ledger stage %r (have %s)"
+                             % (stage, LEDGER_STAGES))
+        self.stamps[stage] = self._clock() if t is None else float(t)
+
+    def set(self, **meta):
+        """Attach/overwrite provenance fields (tenant, op, bucket,
+        backend, rung, certified, ...)."""
+        self.meta.update(meta)
+
+    def stage_seconds(self):
+        """{stage: seconds} for every stamped stage, in stage order.
+        Each duration runs from the previous PRESENT stamp (or admit),
+        so missing stages are skipped, never double-counted, and the
+        values sum to the last stamp minus admit.  Out-of-order stamps
+        clamp to 0 rather than going negative."""
+        out = {}
+        prev = self.t_admit
+        for stage in LEDGER_STAGES:
+            t = self.stamps.get(stage)
+            if t is None:
+                continue
+            out[stage] = max(t - prev, 0.0)
+            prev = t
+        return out
+
+    def to_dict(self):
+        """One JSON-able row: provenance + per-stage seconds + total."""
+        stages = self.stage_seconds()
+        row = dict(self.meta)
+        row["t_admit"] = round(self.t_admit, 6)
+        row["stages"] = {k: round(v, 9) for k, v in stages.items()}
+        row["total_s"] = round(sum(stages.values()), 9)
+        return row
+
+
+class LatencyLedger(object):
+    """Bounded ring of closed request records + the stage histogram.
+
+    ``open()`` returns a record (or None with the ledger off — every
+    stamp site is None-guarded, so the kill switch removes all cost but
+    the one knob read).  ``close()`` stamps ``respond``, feeds the
+    ``mesh_tpu_request_stage_seconds`` histogram, and appends the row to
+    the ring.  Thread-safe: concurrent closes serialize on one lock.
+    """
+
+    def __init__(self, capacity=None, registry=None, clock=monotonic):
+        self._registry = registry if registry is not None else REGISTRY
+        self._clock = clock
+        self._capacity = capacity
+        self._lock = threading.Lock()
+        self._ring = deque(maxlen=capacity or _ring_capacity())
+
+    # -- lifecycle of one record ---------------------------------------
+
+    def open(self, **meta):
+        """Start a record at admit time; None when the ledger is off."""
+        if not ledger_enabled():
+            return None
+        return RequestRecord(self._clock(), meta, self._clock)
+
+    def close(self, record, outcome="ok", **meta):
+        """Finish ``record``: stamp ``respond`` (unless already
+        stamped), observe every stage duration into the stage histogram
+        labeled with this record's backend, and ring-append the row.
+        Returns the row dict (None for a None record)."""
+        if record is None:
+            return None
+        if meta:
+            record.meta.update(meta)
+        record.meta.setdefault("outcome", outcome)
+        if "respond" not in record.stamps:
+            record.stamp("respond")
+        stages = record.stage_seconds()
+        backend = record.meta.get("backend") or "none"
+        hist = self._registry.histogram(
+            "mesh_tpu_request_stage_seconds",
+            "Per-request wall seconds by ledger stage and accel backend.",
+        )
+        for stage, seconds in stages.items():
+            hist.observe(seconds, stage=stage, backend=backend)
+        row = record.to_dict()
+        with self._lock:
+            self._ring.append(row)
+        return row
+
+    # -- consumption ---------------------------------------------------
+
+    def tail(self, n=None):
+        """The newest ``n`` closed rows (default: the incident tail
+        length), oldest first."""
+        n = tail_length() if n is None else int(n)
+        with self._lock:
+            rows = list(self._ring)
+        return rows[-n:] if n < len(rows) else rows
+
+    def records(self):
+        """Every retained row, oldest first."""
+        with self._lock:
+            return list(self._ring)
+
+    def __len__(self):
+        with self._lock:
+            return len(self._ring)
+
+    def clear(self):
+        """Empty the ring and re-read the capacity knob (tests resize
+        via env + obs.reset())."""
+        with self._lock:
+            self._ring = deque(maxlen=self._capacity or _ring_capacity())
+
+    def dump_jsonl(self, path, n=None):
+        """Write the newest ``n`` rows (default: everything retained) as
+        JSON lines — the ``mesh-tpu prof diff`` input format.  Returns
+        the row count written."""
+        rows = self.records() if n is None else self.tail(n)
+        with open(path, "w") as fh:
+            for row in rows:
+                fh.write(json.dumps(row, sort_keys=True))
+                fh.write("\n")
+        return len(rows)
+
+
+# -- current-record binding -------------------------------------------------
+
+_TLS = threading.local()
+
+
+@contextmanager
+def bind_current(record):
+    """Bind ``record`` as this thread's in-flight request for the block.
+
+    The degradation ladder (serve/deadline.py) keeps its
+    ``fn(mesh, points, chunk, timeout)`` rung signature — custom rungs
+    stay source-compatible — so built-in rungs reach the record through
+    this binding instead of a threaded parameter.  Nesting restores the
+    previous binding on exit; binding None is a no-op-shaped guard."""
+    prev = getattr(_TLS, "record", None)
+    _TLS.record = record
+    try:
+        yield record
+    finally:
+        _TLS.record = prev
+
+
+def current_record():
+    """The record bound on THIS thread, or None."""
+    return getattr(_TLS, "record", None)
+
+
+#: the process-wide ledger every serve/engine stamp site feeds
+LEDGER = LatencyLedger()
+
+
+def get_ledger():
+    """The process-wide LatencyLedger (hot paths call this instead of
+    importing LEDGER directly so tests can monkeypatch one place)."""
+    return LEDGER

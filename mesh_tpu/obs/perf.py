@@ -571,4 +571,36 @@ def perfcheck(doc, baseline=None, proxy_golden=None, proxy_tol=0.5,
     elif base_head is None:
         lines.append("note: no usable last-good baseline — headline "
                      "not checked")
+
+    # stage attribution: when both sides carry ledger stage evidence
+    # (the prof_overhead / serve-load stages embed a stage_stats block),
+    # say WHICH stage moved.  Informational — the bands above gate; this
+    # turns "a band failed" into "p99 regressed because dispatch got
+    # slower" (doc/observability.md runbook).
+    cand_stage = _stage_stats_block(doc)
+    base_stage = _stage_stats_block(baseline) if baseline else None
+    if cand_stage is not None and base_stage is not None:
+        from . import prof
+
+        _, diff_lines = prof.diff(base_stage, cand_stage)
+        lines.append("stage attribution vs last-good (prof diff):")
+        lines.extend("  " + line for line in diff_lines)
+    elif cand_stage is not None:
+        lines.append("note: candidate carries stage_stats but the "
+                     "baseline does not — stage attribution skipped")
     return rc, lines
+
+
+def _stage_stats_block(doc):
+    """The prof-shaped stage stats embedded in a bench doc (a final
+    record with ``stage_stats``, or any record inside ``records`` /
+    staged ``stages``), or None."""
+    if not isinstance(doc, dict):
+        return None
+    if isinstance(doc.get("stage_stats"), dict):
+        return {"stages": doc["stage_stats"],
+                "total": doc.get("stage_total"),
+                "backends": doc.get("stage_backends") or {}}
+    from . import prof
+
+    return prof._from_bench_doc(doc)

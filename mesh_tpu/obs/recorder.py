@@ -56,8 +56,10 @@ KEEP_ENV = "MESH_TPU_INCIDENT_KEEP"
 #: ring capacity for the process-wide recorder (default 2048 events)
 EVENTS_ENV = "MESH_TPU_RECORDER_EVENTS"
 
-#: incident-file schema version (bump on breaking shape changes)
-SCHEMA_VERSION = 1
+#: incident-file schema version (bump on breaking shape changes).
+#: v2: incidents carry a ``"ledger"`` key — the latency ledger's newest
+#: MESH_TPU_LEDGER_TAIL request records (``mesh-tpu prof top`` reads it).
+SCHEMA_VERSION = 2
 
 #: env prefixes captured into each incident (config forensics)
 _ENV_PREFIXES = ("MESH_TPU_", "JAX_", "XLA_")
@@ -241,12 +243,25 @@ class FlightRecorder(object):
             "metrics": self._registry.snapshot(),
             "health": self._health_snapshot(health),
             "engine": self._engine_summary(),
+            "ledger": self._ledger_tail(),
             "env": {
                 k: v for k, v in sorted(os.environ.items())
                 if k.startswith(_ENV_PREFIXES)
             },
         }
         return self._write(incident, reason, seq)
+
+    @staticmethod
+    def _ledger_tail():
+        """The latency ledger's newest request records (schema v2) —
+        imported lazily so recorder stays importable standalone (ledger
+        never imports recorder back, so no cycle either way)."""
+        try:
+            from .ledger import get_ledger
+
+            return get_ledger().tail()
+        except Exception:
+            return []
 
     @staticmethod
     def _health_snapshot(health):

@@ -28,10 +28,10 @@ starts before the error budget is gone.  See doc/observability.md.
 """
 
 import threading
-from collections import deque
 
 from .clock import env_flag, monotonic
 from .metrics import REGISTRY
+from .series import SampleRing, get_series
 
 __all__ = [
     "SLO", "BurnRateRule", "SLOMonitor", "default_rules", "default_slos",
@@ -197,7 +197,9 @@ class SLOMonitor(object):
         self._registry = registry
         self._clock = clock
         self._history = history
-        self._samples = {}        # (objective, tenant) -> deque[(t, good, total)]
+        # (objective, tenant) -> SampleRing of cumulative (good, total):
+        # the windowed-delta arithmetic lives in obs/series.py now
+        self._samples = {}
         self._breached = set()    # (objective, tenant, rule) currently firing
         self._callbacks = []
         self._lock = threading.Lock()
@@ -220,37 +222,25 @@ class SLOMonitor(object):
                 for tenant in self._tenant_list(metrics, slo):
                     good, total = good_total(metrics, slo, tenant)
                     key = (slo.name, tenant)
-                    series = self._samples.get(key)
-                    if series is None:
-                        series = self._samples[key] = deque(
-                            maxlen=self._history)
-                    series.append((now, good, total))
+                    ring = self._samples.get(key)
+                    if ring is None:
+                        ring = self._samples[key] = SampleRing(
+                            history=self._history)
+                    ring.append(now, (good, total))
         return now
 
-    @staticmethod
-    def _boundary(series, start_t):
-        """Newest sample at/before ``start_t`` (window baseline); falls
-        back to the oldest retained sample when history is shorter than
-        the window."""
-        boundary = series[0]
-        for sample in series:
-            if sample[0] <= start_t:
-                boundary = sample
-            else:
-                break
-        return boundary
-
-    def _burn(self, series, slo, window_s, now):
-        """Burn rate over [now - window_s, now] from cumulative samples:
-        bad_fraction / error_budget; 0.0 with no traffic in window."""
-        t0, good0, total0 = self._boundary(series, now - window_s)
-        _, good1, total1 = series[-1]
-        d_total = total1 - total0
+    def _burn(self, ring, slo, window_s, now):
+        """Burn rate over [now - window_s, now]: bad_fraction /
+        error_budget from the ring's windowed deltas; 0.0 with no
+        traffic in the window."""
+        deltas = ring.deltas(window_s, now)
+        if not deltas:
+            return 0.0
+        d_good, d_total = deltas
         if d_total <= 0:
             return 0.0
-        d_bad = max(d_total - (good1 - good0), 0)
-        bad_fraction = d_bad / d_total
-        return bad_fraction / (1.0 - slo.target)
+        d_bad = max(d_total - d_good, 0)
+        return (d_bad / d_total) / (1.0 - slo.target)
 
     # -- evaluation ----------------------------------------------------
 
@@ -268,11 +258,11 @@ class SLOMonitor(object):
         results, fired = [], []
         with self._lock:
             slos = {s.name: s for s in self.objectives}
-            items = [(key, list(series))
-                     for key, series in self._samples.items()]
+            items = [(key, ring.copy())
+                     for key, ring in self._samples.items()]
         for (obj_name, tenant), series in items:
             slo = slos.get(obj_name)
-            if slo is None or not series:
+            if slo is None or not len(series):
                 continue
             row = {"objective": obj_name, "tenant": tenant, "rules": []}
             for rule in self.rules:
@@ -343,6 +333,7 @@ class SLOMonitor(object):
             while not self._stop.wait(interval_s):
                 try:
                     self.tick()
+                    get_series().tick()
                     if recorder is not None:
                         recorder.sample()
                     self.evaluate()

@@ -37,6 +37,7 @@ import threading
 
 from ..errors import DeadlineExceeded
 from ..obs.clock import monotonic
+from ..obs.ledger import current_record
 from ..obs.perf import call_with_timeout
 from ..obs.recorder import get_recorder
 from ..obs.trace import span as obs_span
@@ -164,7 +165,8 @@ def _rung_engine(mesh, points, chunk, timeout):
     from .. import engine
 
     fut = engine.submit("closest_point", mesh, points, chunk=chunk,
-                        deadline=monotonic() + timeout)
+                        deadline=monotonic() + timeout,
+                        record=current_record())
     try:
         faces, pts = fut.result(timeout=timeout)
     except concurrent.futures.TimeoutError:
@@ -189,6 +191,10 @@ def _rung_culled(mesh, points, chunk, timeout, k=64):
         return {key: np.asarray(val)[:n_q] for key, val in res.items()}
 
     out = call_with_timeout(_call, timeout)
+    rec = current_record()
+    if rec is not None:
+        rec.stamp("device")
+        rec.set(backend="xla")
     faces = out["face"].astype("uint32")[None, :]
     return ServeResult(faces, out["point"].astype("float64"), "culled",
                        certified=bool(out["tight"].all()))
@@ -239,6 +245,10 @@ def _rung_anchored(mesh, points, chunk, timeout, k=16):
         return {key: np.asarray(val)[:n_q] for key, val in res.items()}
 
     out = call_with_timeout(_call, timeout)
+    rec = current_record()
+    if rec is not None:
+        rec.stamp("device")
+        rec.set(backend="xla")
     faces = out["face"].astype("uint32")[None, :]
     return ServeResult(faces, out["point"].astype("float64"), "anchored",
                        certified=bool(out["tight"].all()))
@@ -253,13 +263,17 @@ def _rung_accel(mesh, points, chunk, timeout):
     MESH_TPU_SERVE_LADDER, e.g. ``accel,culled,anchored``."""
     import numpy as np
 
+    # captured here because _call runs on the watchdog helper thread,
+    # where the serving worker's thread-local binding is invisible
+    rec = current_record()
+
     def _call():
         from ..accel.traverse import closest_faces_and_points_accel
 
         v, f = _facade_arrays(mesh)
         pts, n_q = _bucket_queries(points, 256)
         res, stats = closest_faces_and_points_accel(
-            v, f, pts, with_stats=True)
+            v, f, pts, with_stats=True, record=rec)
         out = {key: np.asarray(val)[:n_q] for key, val in res.items()}
         out["__backend__"] = stats["backend"]
         return out

@@ -33,6 +33,7 @@ from concurrent.futures import Future
 from ..errors import DeadlineExceeded, EngineShutdown, ServeRejected
 from ..utils import knobs
 from ..obs.clock import monotonic, wall
+from ..obs.ledger import bind_current, get_ledger
 from ..obs.recorder import get_recorder
 from ..obs.trace import span as obs_span
 from .deadline import Deadline, default_ladder, run_with_ladder
@@ -147,7 +148,7 @@ class ServeResponse(object):
 
 class _ServeRequest(object):
     __slots__ = ("mesh", "points", "tenant", "priority", "deadline",
-                 "future", "t_admit")
+                 "future", "t_admit", "record")
 
     def __init__(self, mesh, points, tenant, priority, deadline):
         self.mesh = mesh
@@ -157,6 +158,7 @@ class _ServeRequest(object):
         self.deadline = deadline
         self.future = Future()
         self.t_admit = monotonic()
+        self.record = None      # obs.ledger.RequestRecord, or None
 
 
 class QueryService(object):
@@ -281,6 +283,11 @@ class QueryService(object):
                     reason="queue_full")
             req = _ServeRequest(mesh, points, tenant, priority,
                                 Deadline(deadline_s))
+            # admission IS the ledger's t_admit: every stamped stage
+            # downstream is measured from here (obs/ledger.py)
+            req.record = get_ledger().open(
+                tenant=tenant, priority=priority,
+                deadline_s=float(deadline_s))
             self._wfq.push(tenant, req)
             depth = self._wfq.depth(tenant)
             self._m_depth.set(depth, tenant=tenant)
@@ -354,8 +361,13 @@ class QueryService(object):
 
     def _execute(self, req):
         if not req.future.set_running_or_notify_cancel():
+            if req.record is not None:
+                get_ledger().close(req.record, outcome="cancelled")
             return
         tenant = req.tenant
+        if req.record is not None:
+            # queue stage ends the moment a drain worker owns the request
+            req.record.stamp("queue")
         if req.deadline.expired():
             # it died waiting in the queue: shed, do not burn device time
             self._m_shed.inc(reason="expired_in_queue")
@@ -364,6 +376,8 @@ class QueryService(object):
             self._recorder.record("serve.deadline", tenant=tenant,
                                   where="expired_in_queue",
                                   queued_s=round(req.deadline.elapsed(), 6))
+            if req.record is not None:
+                get_ledger().close(req.record, outcome="deadline")
             req.future.set_exception(DeadlineExceeded(
                 "deadline (%.3fs) expired after %.3fs in the %r queue"
                 % (req.deadline.seconds, req.deadline.elapsed(), tenant)))
@@ -378,10 +392,14 @@ class QueryService(object):
                           req.points, "shape") else len(req.points)),
                       priority=req.priority):
             try:
-                result, retries = run_with_ladder(
-                    req.mesh, req.points, req.deadline, ladder=self.ladder,
-                    chunk=self.chunk, start_rung=start_rung,
-                    health=self.health)
+                # the thread-local binding lets rungs downstream (engine
+                # submit, accel facade) stamp stages without widening the
+                # Rung.fn signature
+                with bind_current(req.record):
+                    result, retries = run_with_ladder(
+                        req.mesh, req.points, req.deadline,
+                        ladder=self.ladder, chunk=self.chunk,
+                        start_rung=start_rung, health=self.health)
             except Exception as e:      # noqa: BLE001 — futures carry it
                 latency = req.deadline.elapsed()
                 missed = latency > req.deadline.seconds
@@ -390,18 +408,24 @@ class QueryService(object):
                 outcome = ("deadline" if isinstance(e, DeadlineExceeded)
                            else "error")
                 self._m_requests.inc(tenant=tenant, outcome=outcome)
-                self._m_latency.observe(latency, tenant=tenant)
+                self._m_latency.observe(latency, tenant=tenant,
+                                        backend="none")
                 self._recorder.record(
                     "serve.error", tenant=tenant, outcome=outcome,
                     error=type(e).__name__,
                     latency_ms=round(1e3 * latency, 3))
+                if req.record is not None:
+                    get_ledger().close(req.record, outcome=outcome)
                 req.future.set_exception(e)
                 return
         latency = req.deadline.elapsed()
         response = ServeResponse(result, tenant, retries, latency,
                                  req.deadline)
+        backend = result.backend or (
+            req.record.meta.get("backend") if req.record is not None
+            else None) or "none"
         self._m_requests.inc(tenant=tenant, outcome="ok")
-        self._m_latency.observe(latency, tenant=tenant)
+        self._m_latency.observe(latency, tenant=tenant, backend=backend)
         self._m_rung.inc(rung=response.rung,
                          certified=str(response.certified).lower())
         if response.deadline_missed:
@@ -412,6 +436,10 @@ class QueryService(object):
             "serve.response", tenant=tenant, rung=response.rung,
             retries=retries, latency_ms=round(1e3 * latency, 3),
             deadline_missed=response.deadline_missed)
+        if req.record is not None:
+            get_ledger().close(
+                req.record, outcome="ok", rung=response.rung,
+                certified=response.certified, backend=backend)
         req.future.set_result(response)
 
     # ------------------------------------------------------------------
@@ -480,7 +508,9 @@ class QueryService(object):
 
         series = {
             name: REGISTRY.get(name).snapshot()
-            for name in REGISTRY.names() if name.startswith("mesh_tpu_serve")
+            for name in REGISTRY.names()
+            if name.startswith("mesh_tpu_serve")
+            or name == "mesh_tpu_request_stage_seconds"
         }
         return {
             "written_utc": wall(),

@@ -176,6 +176,19 @@ SLO_DRIVES_HEALTH = _declare(
     "Opt-in: a confirmed SLO fast-burn breach trips the serving "
     "HealthMonitor to degraded (closes the detect->capture->degrade "
     "loop).", "Observability")
+LEDGER = _declare(
+    "MESH_TPU_LEDGER", "flag", True,
+    "Always-on per-request latency ledger kill switch (obs/ledger.py): "
+    "unset means ON; set to 0/false/off to skip stage stamping and the "
+    "request-stage histogram entirely.", "Observability")
+LEDGER_CAPACITY = _declare(
+    "MESH_TPU_LEDGER_CAPACITY", "int", 512,
+    "Ledger ring capacity in closed request records (min 16).",
+    "Observability")
+LEDGER_TAIL = _declare(
+    "MESH_TPU_LEDGER_TAIL", "int", 32,
+    "How many newest ledger records ride along in each flight-recorder "
+    "incident dump (min 1).", "Observability")
 
 # -- serving ---------------------------------------------------------------
 

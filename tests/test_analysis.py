@@ -722,6 +722,41 @@ def test_obs004_raw_clock_reads():
         relpath="mesh_tpu/obs/clock_impl.py")
 
 
+def test_obs005_ledger_stage_doc_coverage(tmp_path):
+    pkg = tmp_path / "mesh_tpu" / "obs"
+    pkg.mkdir(parents=True)
+    (pkg / "ledger.py").write_text(
+        'LEDGER_STAGES = ("queue", "dispatch")\n')
+    doc = tmp_path / "doc"
+    doc.mkdir()
+    rule = ObservabilityHygieneRule()
+
+    def run():
+        project, failures = build_project(str(tmp_path))
+        assert not failures
+        return list(rule.finalize(project))
+
+    # one stage documented, one missing -> the missing one is flagged
+    # at the tuple's assignment line with an error severity
+    (doc / "observability.md").write_text("| `queue` | ... |\n")
+    findings = run()
+    assert _codes(findings) == ["OBS005"]
+    assert findings[0].severity == "error"
+    assert "dispatch" in findings[0].message
+    assert findings[0].path == "mesh_tpu/obs/ledger.py"
+    assert findings[0].line == 1
+    # an unbackticked mention does NOT count: the doc contract is the
+    # literal `stage` form the runbook tells operators to grep for
+    (doc / "observability.md").write_text(
+        "| `queue` |\nthe dispatch stage\n")
+    findings = run()
+    assert _codes(findings) == ["OBS005"]
+    # both stages backticked -> clean
+    (doc / "observability.md").write_text(
+        "| `queue` | ... |\n| `dispatch` | ... |\n")
+    assert not run()
+
+
 # -- the shipped tree (the gate-0 contract) ----------------------------
 
 def test_shipped_tree_lints_clean_and_fast():

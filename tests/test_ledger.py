@@ -1,0 +1,422 @@
+"""Latency ledger, windowed series, and ``mesh-tpu prof`` attribution.
+
+The acceptance chain the ISSUE pins: stage stamps stay monotone and sum
+to the admit-to-respond total, the ring is bounded (env-resizable,
+floor 16), concurrent writers never lose rows, windowed percentiles are
+exact under a fake clock, and ``prof diff`` names the stage a fault-
+injected slowdown landed in — end to end through the CLI rc matrix.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from mesh_tpu import obs
+from mesh_tpu.obs import prof
+from mesh_tpu.obs.ledger import (
+    LEDGER_STAGES,
+    LatencyLedger,
+    bind_current,
+    current_record,
+    ledger_enabled,
+)
+from mesh_tpu.obs.metrics import Registry
+from mesh_tpu.obs.series import SampleRing, WindowedSeries
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    for var in ("MESH_TPU_LEDGER", "MESH_TPU_LEDGER_CAPACITY",
+                "MESH_TPU_LEDGER_TAIL", "MESH_TPU_OBS"):
+        monkeypatch.delenv(var, raising=False)
+    obs.reset()
+    yield
+    obs.reset()
+
+
+class FakeClock(object):
+    """Callable monotonic clock a test advances by hand."""
+
+    def __init__(self, t=100.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += float(dt)
+        return self.t
+
+
+def _fake_ledger(capacity=64, t=100.0):
+    clk = FakeClock(t)
+    return LatencyLedger(capacity=capacity, registry=Registry(),
+                         clock=clk), clk
+
+
+def _serve_one(ledger, clk, tenant="t1", backend="xla",
+               queue_s=0.001, dispatch_s=0.002, device_s=0.003):
+    """One synthetic request: fault-inject per-stage cost via the fake
+    clock, exactly where the real stamp sites sit."""
+    rec = ledger.open(tenant=tenant)
+    clk.advance(queue_s)
+    rec.stamp("queue")
+    clk.advance(dispatch_s)
+    rec.stamp("dispatch")
+    clk.advance(device_s)
+    rec.stamp("device")
+    clk.advance(0.0005)
+    return ledger.close(rec, backend=backend)
+
+
+# ---------------------------------------------------------------------------
+# record semantics
+
+
+class TestRecordStamps:
+
+    def test_unknown_stage_raises(self):
+        led, _ = _fake_ledger()
+        rec = led.open()
+        with pytest.raises(ValueError, match="unknown ledger stage"):
+            rec.stamp("warmup")
+
+    def test_stage_seconds_chain_and_sum(self):
+        """Durations chain across missing stages and sum to the span
+        from admit to the last stamp — no gap is ever double-counted."""
+        led, clk = _fake_ledger()
+        rec = led.open()
+        clk.advance(0.010)
+        rec.stamp("queue")
+        clk.advance(0.030)          # coalesce + pad never stamped
+        rec.stamp("dispatch")
+        clk.advance(0.005)
+        rec.stamp("device")
+        stages = rec.stage_seconds()
+        assert list(stages) == ["queue", "dispatch", "device"]
+        assert stages["queue"] == pytest.approx(0.010)
+        assert stages["dispatch"] == pytest.approx(0.030)
+        assert stages["device"] == pytest.approx(0.005)
+        assert sum(stages.values()) == pytest.approx(
+            max(rec.stamps.values()) - rec.t_admit)
+
+    def test_out_of_order_stamp_clamps_to_zero(self):
+        led, clk = _fake_ledger()
+        rec = led.open()
+        clk.advance(0.010)
+        rec.stamp("dispatch")
+        rec.stamp("queue", t=rec.t_admit + 0.020)   # later than dispatch
+        stages = rec.stage_seconds()
+        assert stages["queue"] == pytest.approx(0.020)
+        assert stages["dispatch"] == 0.0            # clamped, not negative
+
+    def test_close_stamps_respond_and_rows_carry_provenance(self):
+        led, clk = _fake_ledger()
+        row = _serve_one(led, clk, tenant="acme", backend="pallas")
+        assert row["tenant"] == "acme"
+        assert row["backend"] == "pallas"
+        assert row["outcome"] == "ok"
+        assert "respond" in row["stages"]
+        assert row["total_s"] == pytest.approx(sum(row["stages"].values()))
+        order = [s for s in LEDGER_STAGES if s in row["stages"]]
+        assert list(row["stages"]) == order
+
+    def test_close_observes_stage_histogram_with_backend_label(self):
+        reg = Registry()
+        clk = FakeClock()
+        led = LatencyLedger(capacity=16, registry=reg, clock=clk)
+        _serve_one(led, clk, backend="pallas_stream")
+        hist = reg.get("mesh_tpu_request_stage_seconds")
+        stat = hist.stat(stage="dispatch", backend="pallas_stream")
+        assert stat["count"] == 1
+        assert stat["sum"] == pytest.approx(0.002)
+
+    def test_bind_current_nests_and_restores(self):
+        led, _ = _fake_ledger()
+        outer, inner = led.open(), led.open()
+        assert current_record() is None
+        with bind_current(outer):
+            assert current_record() is outer
+            with bind_current(inner):
+                assert current_record() is inner
+            assert current_record() is outer
+        assert current_record() is None
+
+
+# ---------------------------------------------------------------------------
+# ring bounds + kill switch
+
+
+class TestRingBounds:
+
+    def test_env_capacity_bounds_ring(self, monkeypatch):
+        monkeypatch.setenv("MESH_TPU_LEDGER_CAPACITY", "17")
+        obs.reset()                         # clear() re-reads the knob
+        led = obs.get_ledger()
+        for i in range(40):
+            led.close(led.open(tenant="t%d" % i))
+        assert len(led) == 17
+        rows = led.records()
+        assert rows[0]["tenant"] == "t23"   # oldest evicted
+        assert rows[-1]["tenant"] == "t39"
+
+    def test_capacity_floor_is_16(self, monkeypatch):
+        monkeypatch.setenv("MESH_TPU_LEDGER_CAPACITY", "3")
+        obs.reset()
+        led = obs.get_ledger()
+        for i in range(40):
+            led.close(led.open())
+        assert len(led) == 16
+
+    def test_kill_switch_disables_record_creation(self, monkeypatch):
+        assert ledger_enabled()
+        monkeypatch.setenv("MESH_TPU_LEDGER", "0")
+        assert not ledger_enabled()
+        led = obs.get_ledger()
+        assert led.open(tenant="t") is None
+        assert led.close(None) is None      # stamp sites are None-guarded
+        assert len(led) == 0
+
+    def test_tail_defaults_to_env_knob(self, monkeypatch):
+        monkeypatch.setenv("MESH_TPU_LEDGER_TAIL", "4")
+        led, clk = _fake_ledger()
+        for i in range(9):
+            led.close(led.open(tenant="t%d" % i))
+        tail = led.tail()
+        assert [r["tenant"] for r in tail] == ["t5", "t6", "t7", "t8"]
+        assert [r["tenant"] for r in led.tail(2)] == ["t7", "t8"]
+
+    def test_concurrent_writers_lose_nothing(self):
+        reg = Registry()
+        led = LatencyLedger(capacity=4096, registry=reg)
+        n_threads, per_thread = 8, 50
+
+        def work(tid):
+            for i in range(per_thread):
+                rec = led.open(tenant="w%d" % tid)
+                rec.stamp("queue")
+                rec.stamp("dispatch")
+                led.close(rec, backend="xla")
+
+        threads = [threading.Thread(target=work, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        rows = led.records()
+        assert len(rows) == n_threads * per_thread
+        assert all(isinstance(r["stages"], dict) for r in rows)
+        hist = reg.get("mesh_tpu_request_stage_seconds")
+        stat = hist.stat(stage="queue", backend="xla")
+        assert stat["count"] == n_threads * per_thread
+
+
+# ---------------------------------------------------------------------------
+# windowed series under a fake clock
+
+
+class TestWindowedSeries:
+
+    def _filled(self):
+        """Registry + series: 20 fast (2 ms) observations in the first
+        window, 20 slow (90 ms) in the second, snapshotted at t=10 and
+        t=70."""
+        reg = Registry()
+        ws = WindowedSeries(registry=reg, resolution_s=1.0, capacity=64,
+                            clock=FakeClock(0.0))
+        hist = reg.histogram("mesh_tpu_request_stage_seconds")
+        req = reg.counter("mesh_tpu_serve_requests_total")
+        for _ in range(20):
+            hist.observe(0.002, stage="queue", backend="xla")
+            req.inc(tenant="t1", outcome="ok")
+        ws.tick(now=10.0)
+        for _ in range(20):
+            hist.observe(0.090, stage="queue", backend="xla")
+            req.inc(tenant="t1", outcome="ok")
+        ws.tick(now=70.0)
+        return reg, ws
+
+    def test_trailing_window_percentile_sees_only_the_delta(self):
+        _, ws = self._filled()
+        p50 = ws.percentile("mesh_tpu_request_stage_seconds", 0.50,
+                            window_s=30.0, now=70.0)
+        # only the 90 ms phase is inside [40, 70]: interpolated inside
+        # the (0.05, 0.1] bucket
+        assert 0.05 < p50 <= 0.1
+
+    def test_full_history_percentile_mixes_both_phases(self):
+        _, ws = self._filled()
+        p50 = ws.percentile("mesh_tpu_request_stage_seconds", 0.50,
+                            window_s=500.0, now=70.0)
+        # rank 20 of 40 lands in the fast phase's (1e-3, 2.5e-3] bucket
+        assert p50 < 0.005
+
+    def test_rate_and_delta_difference_window_boundary(self):
+        _, ws = self._filled()
+        assert ws.delta("mesh_tpu_serve_requests_total",
+                        window_s=30.0, now=70.0) == 20
+        assert ws.rate("mesh_tpu_serve_requests_total",
+                       window_s=30.0, now=70.0) == pytest.approx(20 / 30.0)
+        assert ws.delta("mesh_tpu_serve_requests_total",
+                        window_s=500.0, now=70.0) == 40
+
+    def test_stage_breakdown_windowed(self):
+        _, ws = self._filled()
+        brk = ws.stage_breakdown(window_s=30.0, now=70.0)
+        assert ("queue", "xla") in brk
+        row = brk[("queue", "xla")]
+        assert row["count"] == 20
+        assert 0.05 < row["p99_s"] <= 0.1
+
+    def test_percentile_none_without_observations(self):
+        reg = Registry()
+        ws = WindowedSeries(registry=reg, clock=FakeClock(0.0))
+        assert ws.percentile("mesh_tpu_request_stage_seconds", 0.99,
+                             window_s=60.0, now=1.0) is None
+
+    def test_sample_ring_boundary_semantics(self):
+        ring = SampleRing(history=16)
+        for t, v in ((0.0, 0), (10.0, 5), (20.0, 9), (30.0, 12)):
+            ring.append(t, (v,))
+        # window [10, 30]: boundary is the sample AT 10
+        assert ring.deltas(20.0, now=30.0) == (7,)
+        # window longer than history: oldest sample is the baseline
+        assert ring.deltas(500.0, now=30.0) == (12,)
+
+
+# ---------------------------------------------------------------------------
+# prof diff attribution (fault-injected per-stage slowdowns)
+
+
+def _workload(led, clk, n=24, **stage_s):
+    for _ in range(n):
+        _serve_one(led, clk, **stage_s)
+
+
+class TestProfAttribution:
+
+    def test_identical_loads_pass(self):
+        led, clk = _fake_ledger()
+        _workload(led, clk)
+        stats = prof.stats_from_records(led.records())
+        rc, lines = prof.diff(stats, stats)
+        assert rc == 0
+        assert any(line.startswith("ok   p99") for line in lines)
+
+    def test_diff_names_the_slow_stage_queue(self):
+        a_led, a_clk = _fake_ledger()
+        _workload(a_led, a_clk)
+        b_led, b_clk = _fake_ledger()
+        _workload(b_led, b_clk, queue_s=0.050)      # sleep in queue
+        a = prof.stats_from_records(a_led.records())
+        b = prof.stats_from_records(b_led.records())
+        rc, lines = prof.diff(a, b)
+        assert rc == 1
+        fails = [line for line in lines if line.startswith("FAIL")]
+        assert fails and all("stage 'queue'" in line for line in fails)
+
+    def test_diff_names_the_slow_stage_dispatch(self):
+        a_led, a_clk = _fake_ledger()
+        _workload(a_led, a_clk)
+        b_led, b_clk = _fake_ledger()
+        _workload(b_led, b_clk, dispatch_s=0.050)   # sleep in dispatch
+        a = prof.stats_from_records(a_led.records())
+        b = prof.stats_from_records(b_led.records())
+        rc, lines = prof.diff(a, b)
+        assert rc == 1
+        assert any("stage 'dispatch'" in line for line in lines
+                   if line.startswith("FAIL"))
+
+    def test_small_absolute_deltas_never_fail(self):
+        """Large relative but sub-min_delta_s absolute growth stays rc 0
+        — noise at the 10 us scale must not gate CI."""
+        a = {"stages": {"queue": {"count": 5, "p50_s": 2e-5, "p99_s": 2e-5,
+                                  "mean_s": 2e-5}},
+             "total": {"count": 5, "p50_s": 2e-5, "p99_s": 2e-5},
+             "backends": {"xla": 5}}
+        b = json.loads(json.dumps(a))
+        for blk in (b["stages"]["queue"], b["total"]):
+            blk["p50_s"] = blk["p99_s"] = 6e-5      # 3x but only +40 us
+        rc, _ = prof.diff(a, b)
+        assert rc == 0
+
+    def test_stats_from_records_requires_stage_rows(self):
+        with pytest.raises(prof.ProfError):
+            prof.stats_from_records([{"tenant": "t"}])
+
+
+# ---------------------------------------------------------------------------
+# CLI rc matrix (subprocess, no jax backend init)
+
+
+def _prof_cli(*argv):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "mesh_tpu.cli", "prof"] + list(argv),
+        capture_output=True, text=True, env=env, cwd=_REPO, timeout=120)
+
+
+class TestProfCLI:
+
+    @pytest.fixture()
+    def dumps(self, tmp_path):
+        """baseline.jsonl, slow_dispatch.jsonl, garbage.txt"""
+        a_led, a_clk = _fake_ledger()
+        _workload(a_led, a_clk)
+        b_led, b_clk = _fake_ledger()
+        _workload(b_led, b_clk, dispatch_s=0.060)
+        a_path = tmp_path / "baseline.jsonl"
+        b_path = tmp_path / "slow_dispatch.jsonl"
+        assert a_led.dump_jsonl(str(a_path)) == 24
+        assert b_led.dump_jsonl(str(b_path)) == 24
+        garbage = tmp_path / "garbage.txt"
+        garbage.write_text("this is not a profile {\n")
+        return str(a_path), str(b_path), str(garbage)
+
+    def test_top_rc0_prints_stage_table(self, dumps):
+        a_path, _, _ = dumps
+        res = _prof_cli("top", a_path)
+        assert res.returncode == 0, res.stderr
+        for needle in ("queue", "dispatch", "respond", "TOTAL",
+                       "backends: xla=24"):
+            assert needle in res.stdout
+
+    def test_top_json_round_trips(self, dumps):
+        a_path, _, _ = dumps
+        res = _prof_cli("top", a_path, "--json")
+        assert res.returncode == 0, res.stderr
+        stats = json.loads(res.stdout)
+        assert stats["total"]["count"] == 24
+        assert set(stats["stages"]) == {"queue", "dispatch", "device",
+                                        "respond"}
+
+    def test_diff_same_source_rc0(self, dumps):
+        a_path, _, _ = dumps
+        res = _prof_cli("diff", a_path, a_path)
+        assert res.returncode == 0, res.stderr
+        assert "prof diff: OK" in res.stdout
+
+    def test_diff_regression_rc1_names_stage(self, dumps):
+        a_path, b_path, _ = dumps
+        res = _prof_cli("diff", a_path, b_path)
+        assert res.returncode == 1
+        assert "prof diff: REGRESSION" in res.stdout
+        assert "stage 'dispatch'" in res.stdout
+
+    def test_diff_loose_tol_rc0(self, dumps):
+        a_path, b_path, _ = dumps
+        res = _prof_cli("diff", a_path, b_path, "--tol", "1000")
+        assert res.returncode == 0, res.stderr
+
+    def test_unreadable_input_rc2(self, dumps, tmp_path):
+        a_path, _, garbage = dumps
+        assert _prof_cli("top", garbage).returncode == 2
+        missing = str(tmp_path / "never_written.jsonl")
+        assert _prof_cli("diff", a_path, missing).returncode == 2

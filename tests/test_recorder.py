@@ -27,10 +27,11 @@ from mesh_tpu.serve import HealthMonitor, QueryService, Rung, ServeResult
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-#: every key an incident file must carry (doc/observability.md schema)
+#: every key an incident file must carry (doc/observability.md schema).
+#: schema v2 added "ledger": the latency ledger's newest request records.
 _INCIDENT_KEYS = {
     "schema_version", "kind", "reason", "written_utc", "mono_at_dump",
-    "context", "ring", "metrics", "health", "engine", "env",
+    "context", "ring", "metrics", "health", "engine", "env", "ledger",
 }
 
 
@@ -80,6 +81,7 @@ def _check_incident(path, reason):
     assert incident["reason"] == reason
     assert isinstance(incident["ring"], list)
     assert isinstance(incident["metrics"], dict)
+    assert isinstance(incident["ledger"], list)
     assert all(k.startswith(("MESH_TPU_", "JAX_", "XLA_"))
                for k in incident["env"])
     return incident
@@ -157,6 +159,24 @@ def test_trigger_writes_schema_complete_dump():
     # the dump itself is counted (next incident's metrics carry it)
     assert obs.REGISTRY.get("mesh_tpu_incident_dumps_total").value(
         reason="manual_test") == 1
+
+
+def test_incident_carries_bounded_ledger_tail(monkeypatch):
+    # schema v2: the newest MESH_TPU_LEDGER_TAIL request records ride
+    # along so `mesh-tpu prof top <incident>` can attribute stage time
+    monkeypatch.setenv("MESH_TPU_LEDGER_TAIL", "2")
+    ledger = obs.get_ledger()
+    for i in range(5):
+        record = ledger.open(tenant="t%d" % i)
+        record.stamp("queue")
+        ledger.close(record, outcome="ok")
+    rec = FlightRecorder(capacity=8)
+    path = rec.trigger("ledger_tail_test")
+    incident = _check_incident(path, "ledger_tail_test")
+    assert len(incident["ledger"]) == 2
+    assert [row["tenant"] for row in incident["ledger"]] == ["t3", "t4"]
+    assert all("stages" in row and "outcome" in row
+               for row in incident["ledger"])
 
 
 def test_trigger_rate_limited_and_force_bypasses():
