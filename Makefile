@@ -37,7 +37,7 @@ perfcheck:
 	@echo "----- [ ${package_name} ] Chip-free perf gate (staged probe + CPU proxies)"
 	@PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
 		MESH_TPU_BENCH_PARTIAL=/tmp/mesh_tpu_perfcheck_partial.json \
-		python bench.py --stages probe,pallas_proxy,accel_proxy,accel_stream_proxy > /tmp/mesh_tpu_perfcheck_bench.json || true
+		python bench.py --stages probe,pallas_proxy,accel_proxy,accel_stream_proxy,store_cold_start > /tmp/mesh_tpu_perfcheck_bench.json || true
 	@python -m mesh_tpu.cli perfcheck /tmp/mesh_tpu_perfcheck_bench.json
 
 proxy-golden:
@@ -57,6 +57,12 @@ accel-stream-golden:
 	@PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
 		python bench.py --stage accel_stream_proxy > benchmarks/accel_stream_golden.json
 	@cat benchmarks/accel_stream_golden.json
+
+store-golden:
+	@echo "----- [ ${package_name} ] Recording the store cold-start CPU golden"
+	@PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+		python bench.py --stage store_cold_start > benchmarks/store_golden.json
+	@cat benchmarks/store_golden.json
 
 gates:
 	@bash tools/run_tpu_gates.sh
@@ -84,4 +90,4 @@ docs:
 clean:
 	@rm -rf build dist *.egg-info doc/_build
 
-.PHONY: all import_tests unit_tests tpu_tests tests lint bench perfcheck proxy-golden accel-golden accel-stream-golden gates sweep sdist wheel documentation docs clean
+.PHONY: all import_tests unit_tests tpu_tests tests lint bench perfcheck proxy-golden accel-golden accel-stream-golden store-golden gates sweep sdist wheel documentation docs clean

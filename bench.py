@@ -1195,6 +1195,125 @@ def accel_stream_proxy_stage(n_rep=1):
     }
 
 
+def store_cold_start_stage(n_rep=2):
+    """Stage ``store_cold_start``: the chip-free mesh-store metric.
+    Ingests the same >=200k-face parametric sphere the accel stages
+    walk into a throwaway store root, persists its BVH side-car, then
+    times a replica cold start — open the mesh off the store and answer
+    the first closest-point query — WITH the side-car (mmap rehydrate
+    via ``get_index``) vs WITHOUT (host ``build_bvh`` from the same
+    opened mesh).  The reported value is the rebuild/side-car speedup
+    (>1 means the side-car wins), graded by ``mesh-tpu perfcheck``
+    against benchmarks/store_golden.json with a hard 1.0x floor.
+
+    Exactness and the cold-start contract are enforced in-stage, not
+    just graded: both arms must return answers bit-identical to the
+    warm reference, the side-car arm must count
+    ``mesh_tpu_store_sidecar_hits_total >= 1``, and the accel build-miss
+    counter must stay at zero — the acceptance criterion of
+    doc/store.md, proven every bench run.  Both arms share one warm-up
+    compile (the persistent XLA compilation cache plays that role in a
+    real cold start).  Sizes are overridable via
+    MESH_TPU_STORE_PROXY_FACES / MESH_TPU_STORE_PROXY_QUERIES."""
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from mesh_tpu.accel.build import build_bvh, clear_index_cache, get_index
+    from mesh_tpu.accel.traverse import bvh_closest_point
+    from mesh_tpu.query.autotune import _sphere_mesh
+    from mesh_tpu.obs.metrics import REGISTRY
+    from mesh_tpu.store import get_store
+
+    n_faces = knobs.get_int("MESH_TPU_STORE_PROXY_FACES", 210000)
+    # few queries on purpose: the metric contrasts open-to-first-answer
+    # paths, so the shared traversal cost must not drown the build delta
+    n_q = knobs.get_int("MESH_TPU_STORE_PROXY_QUERIES", 64)
+    tmp_root = tempfile.mkdtemp(prefix="mesh_tpu_store_bench.")
+    os.environ["MESH_TPU_STORE_DIR"] = tmp_root
+    try:
+        v, f = _sphere_mesh(n_faces)
+        rng = np.random.RandomState(0)
+        pts = rng.randn(n_q, 3)
+        pts /= np.linalg.norm(pts, axis=1, keepdims=True)
+        pts *= 1.0 + 0.05 * rng.randn(n_q, 1)
+        pts = np.asarray(pts, np.float32)
+
+        store = get_store()
+        digest = store.ingest(v, f)
+        idx_ref = build_bvh(v, f)
+        store.put_sidecar(idx_ref)
+
+        ref = bvh_closest_point(v, f, pts, index=idx_ref)   # shared compile
+        jax.block_until_ready(ref["sqdist"])
+        checksum = float(jnp.sum(ref["sqdist"]) + jnp.sum(ref["point"]))
+
+        def check(out, arm):
+            for key in ("face", "point", "sqdist"):
+                if not np.array_equal(np.asarray(ref[key]),
+                                      np.asarray(out[key])):
+                    raise RuntimeError(
+                        "store %s arm diverged from the warm reference on "
+                        "%r — the cold-start bit-identity contract is "
+                        "broken" % (arm, key))
+
+        def sidecar_arm():
+            clear_index_cache()
+            mesh = store.open(digest)
+            idx = get_index(mesh.v, mesh.f, "bvh")
+            out = bvh_closest_point(mesh.v, mesh.f, pts, index=idx)
+            jax.block_until_ready((out["sqdist"], out["point"]))
+            return out
+
+        def rebuild_arm():
+            clear_index_cache()
+            mesh = store.open(digest)
+            idx = build_bvh(mesh.v, mesh.f)
+            out = bvh_closest_point(mesh.v, mesh.f, pts, index=idx)
+            jax.block_until_ready((out["sqdist"], out["point"]))
+            return out
+
+        best_sidecar = np.inf
+        best_rebuild = np.inf
+        for _ in range(max(int(n_rep), 1)):
+            t0 = time.perf_counter()
+            out = sidecar_arm()
+            best_sidecar = min(best_sidecar, time.perf_counter() - t0)
+            check(out, "sidecar")
+            t0 = time.perf_counter()
+            out = rebuild_arm()
+            best_rebuild = min(best_rebuild, time.perf_counter() - t0)
+            check(out, "rebuild")
+
+        hits = REGISTRY.counter(
+            "mesh_tpu_store_sidecar_hits_total").value(kind="bvh")
+        misses = REGISTRY.counter(
+            "mesh_tpu_accel_cache_misses_total").value(kind="bvh")
+        if hits < 1 or misses != 0:
+            raise RuntimeError(
+                "cold-start contract violated: sidecar_hits=%s (need >=1), "
+                "build_misses=%s (need 0) — the side-car arm host-built "
+                "instead of rehydrating" % (hits, misses))
+        return {
+            "metric": "store_cold_start_speedup",
+            "value": round(best_rebuild / best_sidecar, 3),
+            "unit": "rebuild_over_sidecar",
+            "vs_baseline": None,
+            "faces": int(f.shape[0]),
+            "queries": n_q,
+            "sidecar_seconds": round(best_sidecar, 3),
+            "rebuild_seconds": round(best_rebuild, 3),
+            "store_bytes": store.object_bytes(digest),
+            "sidecar_hits": int(hits),
+            "build_misses": int(misses),
+            "checksum": round(checksum, 4),
+        }
+    finally:
+        shutil.rmtree(tmp_root, ignore_errors=True)
+
+
 #: declarative stage table: name -> (fn, default timeout_s,
 #: requires_backend, gate, extra child env).  Budgets bound a WEDGE —
 #: they are not measurements; override one with
@@ -1228,6 +1347,11 @@ _STAGE_DEFS = OrderedDict((
     ("accel_stream_proxy", (accel_stream_proxy_stage, 300.0, False, False,
                             {"JAX_PLATFORMS": "cpu",
                              "PALLAS_AXON_POOL_IPS": ""})),
+    # chip-free like the other proxies; budget covers two host BVH
+    # builds per rep plus the CPU traversal on the ~210k-face sphere
+    ("store_cold_start", (store_cold_start_stage, 420.0, False, False,
+                          {"JAX_PLATFORMS": "cpu",
+                           "PALLAS_AXON_POOL_IPS": ""})),
 ))
 
 
@@ -1333,6 +1457,9 @@ def run_staged(names=None):
     stream = results.get("accel_stream_proxy")
     if stream is not None and stream.ok:
         record["stream"] = stream.record
+    store_res = results.get("store_cold_start")
+    if store_res is not None and store_res.ok:
+        record["store"] = store_res.record
     record["stages"] = OrderedDict(
         (n, r.to_json()) for n, r in results.items())
     record["bench_partial"] = partial_path

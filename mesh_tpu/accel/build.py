@@ -324,6 +324,7 @@ _CACHE_LOCK = threading.Lock()
 _HIT_COUNTER = None
 _MISS_COUNTER = None
 _BUILD_HIST = None
+_SIDECAR_HITS = None
 
 
 def _cache_counters():
@@ -341,6 +342,63 @@ def _cache_counters():
             "mesh_tpu_accel_build_seconds",
             "host-side spatial-index build wall seconds (label: kind)")
     return _HIT_COUNTER, _MISS_COUNTER, _BUILD_HIST
+
+
+def _sidecar_hits_counter():
+    global _SIDECAR_HITS
+    if _SIDECAR_HITS is None:
+        from ..obs.metrics import REGISTRY
+
+        _SIDECAR_HITS = REGISTRY.counter(
+            "mesh_tpu_store_sidecar_hits_total",
+            "get_index served off a persisted store side-car — no host "
+            "build, no digest-cache miss (label: kind)")
+    return _SIDECAR_HITS
+
+
+def _sidecar_lookup(digest, kind, params):
+    """Rehydrate a persisted side-car for this digest, or None.  Best
+    effort by contract: ANY store trouble (unreadable root, corruption —
+    already counted + flight-recorded downstream) means host build."""
+    try:
+        from ..utils import knobs
+
+        if not knobs.flag("MESH_TPU_STORE_SIDECAR"):
+            return None
+        from ..store.store import get_store
+
+        store = get_store()
+        if not store.exists(digest):
+            return None
+        from ..store import sidecar as sidecar_mod
+
+        idx = sidecar_mod.load_sidecar(store, digest, kind, params)
+    except Exception:
+        return None
+    if idx is not None:
+        _sidecar_hits_counter().inc(kind=kind)
+    return idx
+
+
+def _sidecar_persist(idx, params):
+    """Best-effort write-back so the NEXT cold process skips this build
+    (only when the mesh object itself is already published — a side-car
+    without its mesh is unservable)."""
+    try:
+        from ..utils import knobs
+
+        if not knobs.flag("MESH_TPU_STORE_SIDECAR"):
+            return
+        from ..store.store import get_store
+
+        store = get_store()
+        if not store.exists(idx.digest):
+            return
+        if store.sidecar_tag_exists(idx.digest, idx.kind, params):
+            return
+        store.put_sidecar(idx, params)
+    except Exception:
+        pass
 
 
 def get_index(v, f, kind="bvh", **params):
@@ -365,6 +423,15 @@ def get_index(v, f, kind="bvh", **params):
             _CACHE.move_to_end(key)
             hits.inc(kind=kind)
             return idx
+        # consult the store side-car BEFORE declaring a miss: a cold
+        # replica with a populated store serves its first query with
+        # zero host builds and the miss counter untouched
+        idx = _sidecar_lookup(digest, kind, params)
+        if idx is not None:
+            _CACHE[key] = idx
+            while len(_CACHE) > _MAX_CACHED:
+                _CACHE.popitem(last=False)
+            return idx
         misses.inc(kind=kind)
         with obs_span("accel.build", kind=kind,
                       faces=int(np.asarray(f).shape[0])) as sp:
@@ -377,6 +444,7 @@ def get_index(v, f, kind="bvh", **params):
         _CACHE[key] = idx
         while len(_CACHE) > _MAX_CACHED:
             _CACHE.popitem(last=False)
+    _sidecar_persist(idx, params)        # outside the lock: disk write
     return idx
 
 

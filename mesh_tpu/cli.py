@@ -440,6 +440,10 @@ def cmd_perfcheck(args):
         args.stream_golden or os.path.join(repo_root, "benchmarks",
                                            "accel_stream_golden.json"),
         "stream golden")
+    store_golden = _load_optional(
+        args.store_golden or os.path.join(repo_root, "benchmarks",
+                                          "store_golden.json"),
+        "store golden")
     rc, lines = perfcheck(doc, baseline=baseline, proxy_golden=golden,
                           proxy_tol=args.proxy_tol,
                           headline_tol=args.headline_tol,
@@ -447,7 +451,9 @@ def cmd_perfcheck(args):
                           accel_golden=accel_golden,
                           accel_tol=args.accel_tol,
                           stream_golden=stream_golden,
-                          stream_tol=args.stream_tol)
+                          stream_tol=args.stream_tol,
+                          store_golden=store_golden,
+                          store_tol=args.store_tol)
     if args.json:
         json.dump({"rc": rc, "lines": lines}, sys.stdout, indent=2)
         sys.stdout.write("\n")
@@ -456,6 +462,92 @@ def cmd_perfcheck(args):
         for line in lines:
             print("  " + line)
         print("perfcheck: %s" % ("OK" if rc == 0 else "REGRESSION"))
+    sys.exit(rc)
+
+
+def cmd_store(args):
+    """Inspect and maintain the content-addressed mesh store
+    (doc/store.md) — ls / stat / verify / gc.
+
+    Same import discipline as serve-stats/incidents: the store package
+    is numpy + stdlib at import, no jax, no backend initialization, so
+    corpus forensics work while the chip is wedged.  Exit codes follow
+    the established contract: 0 ok, 1 corruption found (verify), 2
+    unreadable (missing object / unreadable root / bad usage).
+    """
+    import json
+
+    from mesh_tpu.errors import StoreCorrupt, StoreError
+    from mesh_tpu.store import get_store
+
+    store = get_store(args.root)
+    rc = 0
+    try:
+        if args.store_command == "ls":
+            digests = store.ls()
+            if args.json:
+                rows = [store.stat(d) for d in digests]
+                json.dump({"root": store.root, "objects": rows},
+                          sys.stdout, indent=2, sort_keys=True)
+                sys.stdout.write("\n")
+            elif not digests:
+                print("store %s: no objects" % store.root)
+            else:
+                print("store %s (%d object%s)"
+                      % (store.root, len(digests),
+                         "" if len(digests) == 1 else "s"))
+                for d in digests:
+                    s = store.stat(d)
+                    print("  %s  v=%s f=%s  %.1f KiB  sidecars=%s"
+                          % (d, s["n_vertices"], s["n_faces"],
+                             s["bytes"] / 1024.0,
+                             ",".join(s["sidecars"]) or "-"))
+        elif args.store_command == "stat":
+            s = store.stat(args.digest)
+            if args.json:
+                json.dump(s, sys.stdout, indent=2, sort_keys=True)
+                sys.stdout.write("\n")
+            else:
+                for key in ("digest", "n_vertices", "n_faces", "v_dtype",
+                            "f_dtype", "bytes", "tiers", "sidecars",
+                            "source"):
+                    print("%-12s %s" % (key, s[key]))
+        elif args.store_command == "verify":
+            problems = store.verify(args.digest, deep=not args.shallow)
+            if args.json:
+                json.dump({"root": store.root, "problems": problems},
+                          sys.stdout, indent=2)
+                sys.stdout.write("\n")
+            else:
+                for p in problems:
+                    print("CORRUPT: %s" % p)
+                print("verify %s: %s"
+                      % (store.root,
+                         "OK" if not problems
+                         else "%d problem(s)" % len(problems)))
+            rc = 1 if problems else 0
+        else:                                   # gc
+            budget = (None if args.budget_mb is None
+                      else int(args.budget_mb * 1024 * 1024))
+            deleted = store.gc(budget_bytes=budget, dry_run=args.dry_run)
+            verb = "would delete" if args.dry_run else "deleted"
+            if args.json:
+                json.dump({"root": store.root, "deleted": deleted,
+                           "dry_run": bool(args.dry_run)},
+                          sys.stdout, indent=2)
+                sys.stdout.write("\n")
+            else:
+                for d in deleted:
+                    print("%s %s" % (verb, d))
+                print("gc %s: %s %d object(s), %.1f MiB remain"
+                      % (store.root, verb, len(deleted),
+                         store.total_bytes() / 1048576.0))
+    except StoreCorrupt as exc:
+        print("store: CORRUPT: %s" % exc, file=sys.stderr)
+        sys.exit(1)
+    except (StoreError, OSError) as exc:
+        print("store: %s" % exc, file=sys.stderr)
+        sys.exit(2)
     sys.exit(rc)
 
 
@@ -697,10 +789,64 @@ def main():
                         help="allowed fractional drop of the streamed "
                              "kernel's pair-tests-skipped ratio vs the "
                              "golden (default 0.05)")
+    p_perf.add_argument("--store-golden", default=None,
+                        help="store cold-start golden record (default: "
+                             "repo benchmarks/store_golden.json)")
+    p_perf.add_argument("--store-tol", type=float, default=0.6,
+                        help="allowed fractional drop of the side-car "
+                             "cold-start speedup vs the golden (default "
+                             "0.6: disk + interpreter timing is noisy; "
+                             "the band catches the side-car path losing "
+                             "to rebuild)")
     p_perf.add_argument("--json", action="store_true",
                         help="machine-readable {rc, lines} instead of the "
                              "summary")
     p_perf.set_defaults(func=cmd_perfcheck)
+
+    p_store = sub.add_parser(
+        "store",
+        help="inspect/maintain the content-addressed mesh store "
+             "(no jax init)")
+    p_store.add_argument("--root", default=None,
+                         help="store root (default: MESH_TPU_STORE_DIR "
+                              "or ~/.mesh_tpu/store)")
+    store_sub = p_store.add_subparsers(dest="store_command", required=True)
+    p_sls = store_sub.add_parser(
+        "ls", help="list published objects, LRU-oldest first")
+    p_sls.add_argument("--json", action="store_true",
+                       help="machine-readable object list")
+    p_sls.set_defaults(func=cmd_store)
+    p_sstat = store_sub.add_parser(
+        "stat", help="manifest summary for one object")
+    p_sstat.add_argument("digest", help="store key (topology digest)")
+    p_sstat.add_argument("--json", action="store_true",
+                         help="machine-readable stat dict")
+    p_sstat.set_defaults(func=cmd_store)
+    p_sver = store_sub.add_parser(
+        "verify",
+        help="re-check block CRCs, manifest digests, and side-cars "
+             "(exit 1 on corruption)")
+    p_sver.add_argument("digest", nargs="?", default=None,
+                        help="one store key (default: every object)")
+    p_sver.add_argument("--shallow", action="store_true",
+                        help="skip recomputing the topology digest from "
+                             "the exact tier (CRC checks only)")
+    p_sver.add_argument("--json", action="store_true",
+                        help="machine-readable {root, problems}")
+    p_sver.set_defaults(func=cmd_store)
+    p_sgc = store_sub.add_parser(
+        "gc",
+        help="delete least-recently-used objects until the corpus fits "
+             "the byte budget")
+    p_sgc.add_argument("--budget-mb", type=float, default=None,
+                       help="corpus budget in MiB (default: "
+                            "MESH_TPU_STORE_GC_MB)")
+    p_sgc.add_argument("--dry-run", action="store_true",
+                       help="report what would be deleted without "
+                            "deleting")
+    p_sgc.add_argument("--json", action="store_true",
+                       help="machine-readable {root, deleted, dry_run}")
+    p_sgc.set_defaults(func=cmd_store)
 
     p_prof = sub.add_parser(
         "prof",

@@ -114,9 +114,13 @@ class EngineExecutor(object):
         f = np.asarray(mesh.f)
         # topology digest groups compatible requests cheaply; the stacked
         # build re-validates exactly (stack_mesh_batch), so a crc
-        # collision costs an error, never a wrong answer
-        key = (op, chunk, f.shape, zlib.crc32(
-            np.ascontiguousarray(f).tobytes()), np.asarray(mesh.v).shape)
+        # collision costs an error, never a wrong answer.  A store-paged
+        # mesh carries its content digest already (StoredMesh
+        # .topology_key) — reuse it and skip hashing the face bytes.
+        topo = getattr(mesh, "topology_key", None)
+        if topo is None:
+            topo = zlib.crc32(np.ascontiguousarray(f).tobytes())
+        key = (op, chunk, f.shape, topo, np.asarray(mesh.v).shape)
         req = _Request(op, mesh, pts, chunk, key,
                        deadline=None if deadline is None else float(deadline),
                        record=record)

@@ -40,6 +40,23 @@ class DeadlineExceeded(MeshError, TimeoutError):
     failed inside the request's hard time budget (doc/serving.md)."""
 
 
+class StoreError(MeshError):
+    """Content-addressed mesh-store failure: missing object, bad key,
+    unwritable root (mesh_tpu/store, doc/store.md)."""
+
+
+class StoreCorrupt(StoreError):
+    """On-disk store state failed digest/CRC verification (truncated
+    block, manifest mismatch, stale side-car).  ``what`` names the
+    check that failed — the same label the
+    ``mesh_tpu_store_corrupt_total`` counter carries."""
+
+    def __init__(self, message, what="block_crc", digest=None):
+        super(StoreCorrupt, self).__init__(message)
+        self.what = what
+        self.digest = digest
+
+
 class ServeRejected(MeshError):
     """Admission control turned a request away (queue full, tenant over
     budget, or the service is draining).  ``retry_after`` is the server's

@@ -609,6 +609,23 @@ class Mesh(object):
     def load_from_obj_cpp(self, filename):
         serialization.load_from_obj_cpp(self, filename)
 
+    def write_store(self, store=None):
+        """Publish this mesh into the content-addressed store
+        (doc/store.md); returns the store key (topology digest)."""
+        from .serialization.store_io import ingest_mesh
+
+        return ingest_mesh(self, store=store)
+
+    def load_from_store(self, digest, store=None, tier="exact"):
+        """Load geometry from a store object (exact tier is
+        bit-identical to what was ingested)."""
+        from .store import get_store
+
+        stored = (store or get_store()).open(digest, tier=tier)
+        self.v = np.array(stored.v)
+        self.f = np.array(stored.f)
+        return self
+
     def set_landmark_indices_from_ppfile(self, ppfilename):
         serialization.set_landmark_indices_from_ppfile(self, ppfilename)
 

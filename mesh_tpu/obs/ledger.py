@@ -6,13 +6,15 @@ The ledger closes that gap: every served request carries one
 :class:`RequestRecord` from admission to response, stamped as each stage
 finishes —
 
-    admit -> queue -> coalesce -> pad -> compile -> dispatch
-          -> device -> respond
+    admit -> queue -> page_in -> coalesce -> pad -> compile
+          -> dispatch -> device -> respond
 
-(``queue`` = serve-queue wait, ``coalesce`` = the engine executor's
-batching window, ``pad`` = stack/bucket-pad cost, ``compile`` =
-plan-cache lookup or trace+compile, ``dispatch`` = host-side launch,
-``device`` = on-device wall time, ``respond`` = split + response build).
+(``queue`` = serve-queue wait, ``page_in`` = store-key resolution
+through the mesh-store page cache (store-keyed requests only,
+doc/store.md), ``coalesce`` = the engine executor's batching window,
+``pad`` = stack/bucket-pad cost, ``compile`` = plan-cache lookup or
+trace+compile, ``dispatch`` = host-side launch, ``device`` = on-device
+wall time, ``respond`` = split + response build).
 Stages a request never visits (cache hits, non-engine ladder rungs) are
 simply absent; durations chain across the gap, so the per-record stage
 seconds always sum to the full admit-to-respond latency.
@@ -64,7 +66,8 @@ LEDGER_TAIL_ENV = "MESH_TPU_LEDGER_TAIL"
 #: (the record's open time is the admit stamp).  The meshlint OBS rule
 #: checks every name here is documented in doc/observability.md.
 LEDGER_STAGES = (
-    "queue", "coalesce", "pad", "compile", "dispatch", "device", "respond",
+    "queue", "page_in", "coalesce", "pad", "compile", "dispatch", "device",
+    "respond",
 )
 
 _STAGE_INDEX = {name: i for i, name in enumerate(LEDGER_STAGES)}

@@ -388,7 +388,7 @@ def read_bench_json(path):
 def extract_records(doc):
     """Normalize either bench JSON shape into ``{"headline": rec|None,
     "proxy": rec|None, "accel": rec|None, "stream": rec|None,
-    "stages": {...}|None}``.
+    "store": rec|None, "stages": {...}|None}``.
 
     The headline slot is only filled by a FRESH measurement — a
     ``stale: true`` envelope (last-good value republished while the
@@ -399,6 +399,7 @@ def extract_records(doc):
     proxy = None
     accel = None
     stream = None
+    store = None
     stages = None
     if doc.get("kind") == "bench_partial":
         stages = doc.get("stages") or {}
@@ -414,6 +415,9 @@ def extract_records(doc):
         st = stages.get("accel_stream_proxy") or {}
         if st.get("status") == "ok":
             stream = st.get("record")
+        sc = stages.get("store_cold_start") or {}
+        if sc.get("status") == "ok":
+            store = sc.get("record")
     else:
         if doc.get("value") is not None and not doc.get("stale"):
             headline = doc
@@ -426,14 +430,18 @@ def extract_records(doc):
         stm = doc.get("stream")
         if isinstance(stm, dict) and stm.get("value") is not None:
             stream = stm
+        sto = doc.get("store")
+        if isinstance(sto, dict) and sto.get("value") is not None:
+            store = sto
         stages = doc.get("stages")
     return {"headline": headline, "proxy": proxy, "accel": accel,
-            "stream": stream, "stages": stages}
+            "stream": stream, "store": store, "stages": stages}
 
 
 def perfcheck(doc, baseline=None, proxy_golden=None, proxy_tol=0.5,
               headline_tol=0.2, flops_tol=0.25, accel_golden=None,
-              accel_tol=0.05, stream_golden=None, stream_tol=0.05):
+              accel_tol=0.05, stream_golden=None, stream_tol=0.05,
+              store_golden=None, store_tol=0.6):
     """Compare a bench JSON against the last-good baseline and the
     committed proxy golden.  Returns ``(rc, lines)`` — rc 0 when nothing
     regressed beyond its tolerance band, 1 on regression (including a
@@ -454,6 +462,15 @@ def perfcheck(doc, baseline=None, proxy_golden=None, proxy_tol=0.5,
     which no tolerance can excuse.  ``stream_golden``/``stream_tol``
     grade the accel_stream_proxy stage (the DMA-streamed rope kernel's
     chip-free twin) under the identical contract.
+
+    ``store_golden`` grades the store_cold_start stage: its value is
+    the SPEEDUP of side-car open+first-query over rebuild-from-source
+    (>1 means the side-car wins).  The band floor is
+    ``max(golden * (1 - store_tol), 1.0)`` — wide (disk + interpreter
+    timing), but never below 1.0, because a side-car that loses to
+    rebuilding is a broken cold-start contract regardless of what the
+    golden said.  Checksum drift is a hard FAIL (the side-car must be
+    bit-identical to the built index's answers).
     """
     lines = []
     rc = 0
@@ -503,6 +520,44 @@ def perfcheck(doc, baseline=None, proxy_golden=None, proxy_tol=0.5,
             lines.append("note: %s record present but no golden to "
                          "compare against (record one: %s)"
                          % (slot, make_cmd))
+
+    store_gold = None
+    if store_golden:
+        store_gold = (extract_records(store_golden)["store"]
+                      or (store_golden
+                          if store_golden.get("value") is not None
+                          else None))
+    cand_store = recs["store"]
+    if store_gold is not None:
+        if cand_store is None:
+            rc = 1
+            lines.append(
+                "FAIL store: candidate carries no store_cold_start "
+                "record (a golden exists — the chip-free cold-start "
+                "metric must always be fresh)")
+        else:
+            floor = max(store_gold["value"] * (1.0 - store_tol), 1.0)
+            verdict = "ok" if cand_store["value"] >= floor else "FAIL"
+            if verdict == "FAIL":
+                rc = 1
+            lines.append(
+                "%s store cold-start speedup (rebuild/sidecar): %.3fx "
+                "vs golden %.3fx (floor %.3fx, tol %.0f%%, hard floor "
+                "1.0x)" % (verdict, cand_store["value"],
+                           store_gold["value"], floor, 100 * store_tol))
+            cand_sum = cand_store.get("checksum")
+            gold_sum = store_gold.get("checksum")
+            if cand_sum is not None and gold_sum is not None:
+                same = abs(cand_sum - gold_sum) <= 1e-6 * max(
+                    1.0, abs(gold_sum))
+                if not same:
+                    rc = 1
+                lines.append(
+                    "%s store checksum: %.6f vs golden %.6f (exact)"
+                    % ("ok" if same else "FAIL", cand_sum, gold_sum))
+    elif cand_store is not None:
+        lines.append("note: store record present but no golden to "
+                     "compare against (record one: make store-golden)")
 
     golden_rec = None
     if proxy_golden:

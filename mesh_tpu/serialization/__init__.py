@@ -1,3 +1,6 @@
 from . import serialization  # noqa: F401
 from .ply import read_ply, write_ply_data  # noqa: F401
 from .obj import load_obj, write_obj_data  # noqa: F401
+from .store_io import (  # noqa: F401
+    export_file, ingest_file, ingest_mesh, parse_file,
+)

@@ -245,9 +245,13 @@ class QueryService(object):
     def submit(self, mesh, points, tenant="default", priority=0,
                deadline_s=None):
         """Admit one closest-point request; returns a Future of
-        ServeResponse.  Raises ServeRejected (with ``retry_after``) when
-        backpressure applies — callers back off, the queue never grows
-        unbounded."""
+        ServeResponse.  ``mesh`` may be a live mesh object or a *store
+        key* (topology digest string) — keyed requests are resolved
+        through the in-process page cache at execution time, with the
+        paged/resident provenance recorded on the request's ledger
+        record (doc/store.md).  Raises ServeRejected (with
+        ``retry_after``) when backpressure applies — callers back off,
+        the queue never grows unbounded."""
         if deadline_s is None:
             deadline_s = self.default_deadline_s
         state = self.health.state
@@ -382,12 +386,44 @@ class QueryService(object):
                 "deadline (%.3fs) expired after %.3fs in the %r queue"
                 % (req.deadline.seconds, req.deadline.elapsed(), tenant)))
             return
+        # store-keyed request: resolve the digest through the page
+        # cache before the ladder.  Provenance ("paged" off disk vs
+        # "resident" in the cache) rides the ledger; resolution failure
+        # (unknown key, corrupt object) is a request error, same path
+        # as a ladder failure — admission already charged the tenant.
+        mesh_source = "inline"
+        if isinstance(req.mesh, str):
+            store_key = req.mesh
+            try:
+                from ..store import get_page_cache
+
+                req.mesh, mesh_source = get_page_cache().resolve(store_key)
+            except Exception as e:  # noqa: BLE001 — futures carry it
+                latency = req.deadline.elapsed()
+                self._m_requests.inc(tenant=tenant, outcome="error")
+                self._m_latency.observe(latency, tenant=tenant,
+                                        backend="none")
+                self._recorder.record(
+                    "serve.error", tenant=tenant, outcome="error",
+                    error=type(e).__name__, store_key=store_key,
+                    latency_ms=round(1e3 * latency, 3))
+                if req.record is not None:
+                    req.record.set(store_key=store_key)
+                    get_ledger().close(req.record, outcome="error")
+                req.future.set_exception(e)
+                return
+            if req.record is not None:
+                req.record.stamp("page_in")
+                req.record.set(store_key=store_key)
+        if req.record is not None:
+            req.record.set(mesh_source=mesh_source)
         # degraded: the top rung is the one the watchdog saw wedge — skip
         # it so degraded traffic stops feeding the wedged path
         start_rung = (
             1 if (self.health.state == DEGRADED and len(self.ladder) > 1)
             else 0)
         with obs_span("serve.request", tenant=tenant,
+                      mesh_source=mesh_source,
                       q=int(req.points.shape[0] if hasattr(
                           req.points, "shape") else len(req.points)),
                       priority=req.priority):

@@ -1,0 +1,22 @@
+"""Content-addressed on-disk mesh corpus (doc/store.md).
+
+Objects are keyed by the same topology digest the accel index cache
+uses (``accel/build.py:topology_digest``), so a mesh, its spatial-index
+side-car, and its engine plan companion all share one identity.  The
+package is numpy + stdlib only at import time — the jax-free ``mesh-tpu
+store`` CLI subcommands sit directly on it, and the accel side-car
+consult path only imports jax lazily (through accel.build) when an
+index object is actually materialized.
+"""
+
+from .blocks import quantize_rows, dequantize_rows  # noqa: F401
+from .store import (  # noqa: F401
+    MeshStore, StoredMesh, default_store_root, get_store,
+)
+from .pages import PageCache, get_page_cache, clear_page_cache  # noqa: F401
+
+__all__ = [
+    "MeshStore", "StoredMesh", "default_store_root", "get_store",
+    "PageCache", "get_page_cache", "clear_page_cache",
+    "quantize_rows", "dequantize_rows",
+]

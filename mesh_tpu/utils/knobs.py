@@ -216,6 +216,42 @@ SERVE_WEDGE_S = _declare(
     "In-flight seconds before the health watchdog counts a dispatch as "
     "wedged.", "Serving")
 
+# -- store -----------------------------------------------------------------
+
+STORE_DIR = _declare(
+    "MESH_TPU_STORE_DIR", "path", "~/.mesh_tpu/store",
+    "Content-addressed mesh-store root (doc/store.md): objects/, tmp/ "
+    "staging, and accel side-cars all live under it.", "Store")
+STORE_BLOCK_ROWS = _declare(
+    "MESH_TPU_STORE_BLOCK_ROWS", "int", 262144,
+    "Rows per chunked store block; a single-block tier is served as one "
+    "zero-copy mmap.", "Store")
+STORE_COMPACT = _declare(
+    "MESH_TPU_STORE_COMPACT", "flag", True,
+    "Write the quantized uint16 compact vertex tier on ingest (the "
+    "manifest states its worst-case tolerance); off stores the exact "
+    "tier only.", "Store")
+STORE_SIDECAR = _declare(
+    "MESH_TPU_STORE_SIDECAR", "flag", True,
+    "AccelIndex side-car consult/persist in accel get_index: a side-car "
+    "hit serves the index off mmap with no host build "
+    "(mesh_tpu_store_sidecar_hits_total); off restores build-only "
+    "behavior.", "Store")
+STORE_VERIFY = _declare(
+    "MESH_TPU_STORE_VERIFY", "flag", True,
+    "CRC-verify every store block on read; off trades integrity "
+    "checking for open latency (verification stays on for `mesh-tpu "
+    "store verify` regardless).", "Store")
+STORE_PAGE_CACHE_MB = _declare(
+    "MESH_TPU_STORE_PAGE_CACHE_MB", "float", 256.0,
+    "Byte budget (MiB) of the in-process digest-keyed page cache the "
+    "serving tier resolves store keys through.", "Store")
+STORE_GC_MB = _declare(
+    "MESH_TPU_STORE_GC_MB", "float", 4096.0,
+    "Default corpus size budget (MiB) for `mesh-tpu store gc` / "
+    "MeshStore.gc: least-recently-used objects are deleted until the "
+    "corpus fits.", "Store")
+
 # -- bench harness ---------------------------------------------------------
 
 BENCH_FAULT = _declare(
@@ -255,6 +291,14 @@ STREAM_PROXY_FACES = _declare(
 STREAM_PROXY_QUERIES = _declare(
     "MESH_TPU_STREAM_PROXY_QUERIES", "int", None,
     "accel_stream_proxy bench stage: override the proxy query count "
+    "(read by bench.py).", "Bench harness")
+STORE_PROXY_FACES = _declare(
+    "MESH_TPU_STORE_PROXY_FACES", "int", None,
+    "store_cold_start bench stage: override the proxy mesh face count "
+    "(read by bench.py).", "Bench harness")
+STORE_PROXY_QUERIES = _declare(
+    "MESH_TPU_STORE_PROXY_QUERIES", "int", None,
+    "store_cold_start bench stage: override the proxy query count "
     "(read by bench.py).", "Bench harness")
 
 

@@ -349,15 +349,17 @@ def test_perfcheck_cli_exit_codes(tmp_path):
     bad.write_text(json.dumps(_proxy_doc(100.0)))
 
     def run(path):
-        # --accel-golden/--stream-golden at nonexistent paths keep the
-        # repo's committed goldens from grading these proxy-only docs
-        # (those bands have their own CLI-observable coverage in
-        # tests/test_accel.py and tests/test_accel_stream.py)
+        # --accel-golden/--stream-golden/--store-golden at nonexistent
+        # paths keep the repo's committed goldens from grading these
+        # proxy-only docs (those bands have their own CLI-observable
+        # coverage in tests/test_accel.py, tests/test_accel_stream.py,
+        # and tests/test_store.py)
         return subprocess.run(
             [sys.executable, "-m", "mesh_tpu.cli", "perfcheck", str(path),
              "--proxy-golden", str(golden),
              "--accel-golden", str(tmp_path / "no_accel_golden.json"),
-             "--stream-golden", str(tmp_path / "no_stream_golden.json")],
+             "--stream-golden", str(tmp_path / "no_stream_golden.json"),
+             "--store-golden", str(tmp_path / "no_store_golden.json")],
             capture_output=True, text=True, cwd=_REPO)
 
     ok = run(good)

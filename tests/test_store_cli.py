@@ -1,0 +1,140 @@
+"""``mesh-tpu store``: the jax-free corpus CLI and its rc contract.
+
+rc 0 = healthy, rc 1 = corruption found, rc 2 = unreadable store or
+arguments — pinned in subprocesses, exactly as operators and cron jobs
+consume it.  The commands must work on hosts with no accelerator
+stack, so every child runs without a jax backend init.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from mesh_tpu.store import MeshStore
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _store_cli(root, *argv):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    return subprocess.run(
+        [sys.executable, "-m", "mesh_tpu.cli", "store", "--root",
+         str(root)] + list(argv),
+        capture_output=True, text=True, env=env, cwd=_REPO, timeout=120)
+
+
+def _soup(seed=0, n_v=150, n_f=320):
+    rng = np.random.default_rng(seed)
+    v = rng.normal(size=(n_v, 3)).astype(np.float32)
+    f = rng.integers(0, n_v, size=(n_f, 3)).astype(np.int32)
+    return v, f
+
+
+@pytest.fixture()
+def corpus(tmp_path):
+    """A store with two healthy objects; returns (root, [digests])."""
+    root = str(tmp_path / "store")
+    store = MeshStore(root)
+    digests = [store.ingest(*_soup(i)) for i in range(2)]
+    return root, digests, store
+
+
+class TestHealthyRc0:
+
+    def test_ls_lists_objects(self, corpus):
+        root, digests, _ = corpus
+        res = _store_cli(root, "ls")
+        assert res.returncode == 0, res.stderr
+        for d in digests:
+            assert d in res.stdout
+
+    def test_ls_json_round_trips(self, corpus):
+        root, digests, _ = corpus
+        res = _store_cli(root, "ls", "--json")
+        assert res.returncode == 0, res.stderr
+        doc = json.loads(res.stdout)
+        assert sorted(o["digest"] for o in doc["objects"]) == \
+            sorted(digests)
+
+    def test_ls_empty_store(self, tmp_path):
+        res = _store_cli(tmp_path / "fresh", "ls")
+        assert res.returncode == 0, res.stderr
+        assert "no objects" in res.stdout
+
+    def test_stat_prints_schema_fields(self, corpus):
+        root, digests, _ = corpus
+        res = _store_cli(root, "stat", digests[0], "--json")
+        assert res.returncode == 0, res.stderr
+        doc = json.loads(res.stdout)
+        assert doc["digest"] == digests[0]
+        assert "exact" in doc["tiers"] and "compact" in doc["tiers"]
+
+    def test_verify_clean(self, corpus):
+        root, _, _ = corpus
+        res = _store_cli(root, "verify")
+        assert res.returncode == 0, res.stderr
+        assert "OK" in res.stdout
+
+    def test_gc_dry_run_and_real(self, corpus):
+        root, digests, store = corpus
+        res = _store_cli(root, "gc", "--budget-mb", "0", "--dry-run",
+                         "--json")
+        assert res.returncode == 0, res.stderr
+        assert json.loads(res.stdout)["deleted"] == digests
+        assert sorted(store.ls()) == sorted(digests)    # nothing deleted
+        res = _store_cli(root, "gc", "--budget-mb", "0")
+        assert res.returncode == 0, res.stderr
+        assert store.ls() == []
+
+
+class TestCorruptionRc1:
+
+    def test_verify_bitflip_rc1_names_object(self, corpus):
+        root, digests, store = corpus
+        man = store.manifest(digests[0])
+        path = os.path.join(store.object_dir(digests[0]),
+                            man["tiers"]["exact"]["v"][0]["file"])
+        data = bytearray(open(path, "rb").read())
+        data[-1] ^= 0xFF
+        open(path, "wb").write(bytes(data))
+        res = _store_cli(root, "verify")
+        assert res.returncode == 1
+        assert "CORRUPT" in res.stdout
+        assert digests[0] in res.stdout
+        # the other object still verifies clean on its own
+        res = _store_cli(root, "verify", digests[1])
+        assert res.returncode == 0, res.stderr
+
+    def test_stat_manifest_drift_rc1(self, corpus):
+        root, digests, store = corpus
+        man_path = store.manifest_path(digests[0])
+        doc = json.load(open(man_path))
+        doc["digest"] = "deadbeef-deadbeef-v9-f9"
+        json.dump(doc, open(man_path, "w"))
+        res = _store_cli(root, "stat", digests[0])
+        assert res.returncode == 1
+        assert "CORRUPT" in res.stderr
+
+
+class TestUnreadableRc2:
+
+    def test_stat_unknown_digest_rc2(self, corpus):
+        root, _, _ = corpus
+        res = _store_cli(root, "stat", "0badc0de-0badc0de-v3-f1")
+        assert res.returncode == 2
+        assert "store:" in res.stderr
+
+    def test_root_is_a_file_rc2(self, tmp_path):
+        bogus = tmp_path / "not_a_dir"
+        bogus.write_text("hello")
+        res = _store_cli(bogus, "ls")
+        assert res.returncode == 2
+
+    def test_verify_unknown_digest_rc2(self, corpus):
+        root, _, _ = corpus
+        res = _store_cli(root, "verify", "0badc0de-0badc0de-v3-f1")
+        assert res.returncode == 2
