@@ -30,6 +30,10 @@ lint:
 	@echo "----- [ ${package_name} ] meshlint static analysis (no jax init)"
 	@PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m mesh_tpu.cli lint
 
+lint-fast:
+	@echo "----- [ ${package_name} ] meshlint, changed files only"
+	@PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m mesh_tpu.cli lint --changed
+
 bench:
 	@python bench.py
 
@@ -90,4 +94,4 @@ docs:
 clean:
 	@rm -rf build dist *.egg-info doc/_build
 
-.PHONY: all import_tests unit_tests tpu_tests tests lint bench perfcheck proxy-golden accel-golden accel-stream-golden store-golden gates sweep sdist wheel documentation docs clean
+.PHONY: all import_tests unit_tests tpu_tests tests lint lint-fast bench perfcheck proxy-golden accel-golden accel-stream-golden store-golden gates sweep sdist wheel documentation docs clean

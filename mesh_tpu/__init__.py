@@ -11,6 +11,14 @@ $PSBODY_MESH_CACHE idea).
 import os
 
 from .utils import knobs
+
+# The lock witness must patch the threading factories before any
+# lock-creating module below is imported (doc/concurrency.md).
+if knobs.flag("MESH_TPU_LOCK_WITNESS"):
+    from .utils import lockwitness as _lockwitness
+
+    _lockwitness.install()
+
 from .core import MeshArrays  # noqa: F401
 from .mesh import Mesh  # noqa: F401
 from .batch import (  # noqa: F401

@@ -133,6 +133,18 @@ class Project(object):
         self.root = root
         self.contexts = list(contexts)
         self.by_relpath = {ctx.relpath: ctx for ctx in self.contexts}
+        self._inter = None
+
+    def interproc(self):
+        """The interprocedural model (cross-module call graph + lock
+        acquisition-order edges), built once per lint run no matter how
+        many rules consume it — that sharing is what keeps the
+        whole-tree run inside its <3s budget."""
+        if self._inter is None:
+            from .interproc import InterGraph
+
+            self._inter = InterGraph.build(self)
+        return self._inter
 
     def doc_text(self, *relparts):
         """Text of a repo file (docs live outside the scanned package),
@@ -315,6 +327,59 @@ class Report(object):
                 for fp, entry in sorted(self.stale.items())
             ],
         }
+
+    def to_sarif(self):
+        """SARIF 2.1.0 for code-scanning UIs.  Only NEW findings become
+        ``results`` (baselined ones are suppressed with a reason), so
+        the rc contract and the JSON schema-v1 report are untouched —
+        this is a parallel serialization, not a new schema version."""
+        severity_level = {"note": "note", "warning": "warning",
+                          "error": "error"}
+        rule_ids = sorted({f.rule for f in self.findings})
+        results = []
+        for f in self.new:
+            results.append(self._sarif_result(f, severity_level))
+        for f in self.suppressed:
+            entry = self.baseline.get(f.fingerprint, {})
+            result = self._sarif_result(f, severity_level)
+            result["suppressions"] = [{
+                "kind": "external",
+                "justification": entry.get("reason", ""),
+            }]
+            results.append(result)
+        return {
+            "version": "2.1.0",
+            "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                        "sarif-spec/master/Schemata/sarif-schema-2.1.0"
+                        ".json"),
+            "runs": [{
+                "tool": {"driver": {
+                    "name": "meshlint",
+                    "informationUri":
+                        "doc/static_analysis.md",
+                    "rules": [{"id": rid} for rid in rule_ids],
+                }},
+                "results": results,
+            }],
+        }
+
+    @staticmethod
+    def _sarif_result(f, severity_level):
+        result = {
+            "ruleId": f.rule,
+            "level": severity_level[f.severity],
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": max(1, f.line)},
+                },
+            }],
+            "partialFingerprints": {"meshlint/v1": f.fingerprint},
+        }
+        if f.hint:
+            result["message"]["text"] += "  [fix: %s]" % f.hint
+        return result
 
     def render_human(self, verbose=False):
         lines = []

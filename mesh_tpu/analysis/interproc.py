@@ -1,0 +1,558 @@
+"""Interprocedural layer: cross-module call graph with held-lock context.
+
+PR 8's rules are per-file; the LOK family needs whole-program facts:
+which function calls which (across modules), which locks exist (module
+globals, ``self._lock`` instance attributes, function-local closure
+locks), and which locks are *held* when a call is made.  This module
+builds that model once per lint run (``Project.interproc()`` caches it)
+and derives the global lock **acquisition-order graph**: an edge
+``A -> B`` means some path acquires ``B`` while already holding ``A``.
+
+Resolution is name-level and deliberately conservative, in the spirit
+of the TRC rule's per-module frontier:
+
+- bare calls resolve to module functions, ``from``-imported symbols
+  (relative imports included — the lazy-import idiom used everywhere in
+  this codebase), nested closures, and class constructors;
+- ``self.method()`` resolves within the enclosing class;
+- ``alias.func()`` resolves through ``import``/``from``-module aliases;
+- other attribute calls resolve only when exactly one project class
+  defines that method name and the name is not a ubiquitous container
+  method (the ``_COMMON_METHODS`` guard) — missing an edge is fine
+  (the runtime lock witness covers dynamic dispatch), inventing one
+  is not.
+
+Stdlib-only, like the rest of the analysis package.
+"""
+
+import ast
+
+__all__ = ["InterGraph", "LockInfo", "LOCK_FACTORY_PARTS"]
+
+#: threading factory callables whose result is an acquisition-ordered
+#: primitive (Condition wraps an RLock; Semaphore orders like a lock)
+LOCK_FACTORY_PARTS = (
+    "Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore")
+
+#: method names too generic to resolve by the unique-method heuristic —
+#: they collide with dict/list/file/Future/str usage constantly
+_COMMON_METHODS = frozenset({
+    "get", "items", "keys", "values", "append", "pop", "add", "update",
+    "clear", "copy", "read", "write", "split", "strip", "sort", "remove",
+    "extend", "insert", "encode", "decode", "format", "join", "wait",
+    "notify", "notify_all", "acquire", "release", "start", "close",
+    "flush", "tell", "seek", "cancel", "result", "set_result", "done",
+    "set_exception", "put", "send", "recv", "info", "debug", "warning",
+    "error", "record", "set", "inc", "observe", "count", "index", "next",
+    "setdefault", "popitem", "move_to_end", "tobytes", "reshape", "item",
+})
+
+#: callable names whose invocation can block indefinitely (I/O, process
+#: waits) — making one while holding a lock serializes every contender
+#: behind the disk/child process (LOK002)
+_BLOCKING_PARTS = frozenset({
+    "sleep", "rename", "replace", "rmtree", "copytree", "makedirs",
+    "urlopen", "run", "Popen", "check_call", "check_output",
+    "communicate",
+})
+
+
+def module_name_of(relpath):
+    """Dotted module name of a repo-relative path:
+    ``mesh_tpu/store/store.py`` -> ``mesh_tpu.store.store``;
+    ``mesh_tpu/store/__init__.py`` -> ``mesh_tpu.store``."""
+    parts = relpath[:-3].split("/") if relpath.endswith(".py") else \
+        relpath.split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+class LockInfo(object):
+    """One discovered lock primitive (module / instance / local)."""
+
+    __slots__ = ("key", "relpath", "lineno", "kind", "scope", "name")
+
+    def __init__(self, relpath, lineno, kind, scope, name):
+        self.key = "%s:%d" % (relpath, lineno)
+        self.relpath = relpath
+        self.lineno = lineno
+        self.kind = kind          # Lock | RLock | Condition | Semaphore...
+        self.scope = scope        # module | instance | local
+        self.name = name          # display: "<relpath>:<qualified var>"
+
+
+class FunctionInfo(object):
+    __slots__ = ("key", "relpath", "qualname", "node", "cls", "parent")
+
+    def __init__(self, relpath, qualname, node, cls, parent):
+        self.key = "%s::%s" % (relpath, qualname)
+        self.relpath = relpath
+        self.qualname = qualname
+        self.node = node
+        self.cls = cls            # enclosing class name or None
+        self.parent = parent      # enclosing FunctionInfo key or None
+
+
+class Edge(object):
+    """One acquisition-order edge with a human-readable witness site."""
+
+    __slots__ = ("src", "dst", "relpath", "lineno", "via")
+
+    def __init__(self, src, dst, relpath, lineno, via):
+        self.src = src
+        self.dst = dst
+        self.relpath = relpath
+        self.lineno = lineno
+        self.via = via
+
+
+class _Summary(object):
+    __slots__ = ("acquires", "calls", "blocking")
+
+    def __init__(self):
+        self.acquires = []    # (lock_key, held_tuple, lineno)
+        self.calls = []       # (callee_key, held_tuple, lineno)
+        self.blocking = []    # (desc, held_tuple, lineno)
+
+
+def _qualname(node):
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _lock_kind(value):
+    """Factory kind when ``value`` is a lock-constructor call, else
+    None.  Accepts ``threading.Lock()`` and bare ``Lock()``."""
+    if not isinstance(value, ast.Call):
+        return None
+    qn = _qualname(value.func)
+    if not qn:
+        return None
+    last = qn.rsplit(".", 1)[-1]
+    if last in LOCK_FACTORY_PARTS:
+        root = qn.split(".", 1)[0]
+        if root in ("threading", last):
+            return last
+    return None
+
+
+class InterGraph(object):
+    """The whole-program lock/call model.  Build with
+    :meth:`InterGraph.build`; prefer ``project.interproc()`` which
+    caches one instance per lint run."""
+
+    def __init__(self):
+        self.locks = {}           # key -> LockInfo
+        self.functions = {}       # key -> FunctionInfo
+        self.summaries = {}       # fn key -> _Summary
+        self.edges = {}           # (src,dst) -> Edge (first witness wins)
+        self.all_acquires = {}    # fn key -> frozenset(lock keys)
+        self.blocking_reach = {}  # fn key -> ((desc, site_qual), ...)
+        # per-module resolution state
+        self._mod_locks = {}      # (relpath, var) -> lock key
+        self._inst_locks = {}     # (relpath, cls, attr) -> lock key
+        self._local_locks = {}    # (fn key, var) -> lock key
+        self._mod_funcs = {}      # (relpath, name) -> fn key
+        self._nested = {}         # (fn key, name) -> fn key
+        self._methods = {}        # (relpath, cls, name) -> fn key
+        self._by_method = {}      # name -> [fn key, ...]
+        self._classes = {}        # (relpath, name) -> True
+        self._mod_alias = {}      # (relpath, alias) -> target relpath
+        self._sym_alias = {}      # (relpath, alias) -> (relpath, symbol)
+        self._relpaths = set()
+
+    # -- construction --------------------------------------------------
+
+    @classmethod
+    def build(cls, project):
+        graph = cls()
+        graph._relpaths = set(project.by_relpath)
+        for ctx in project.contexts:
+            graph._index_module(ctx)
+        for ctx in project.contexts:
+            graph._resolve_imports(ctx)
+        for ctx in project.contexts:
+            graph._summarize_module(ctx)
+        graph._propagate()
+        graph._derive_edges()
+        return graph
+
+    def _index_module(self, ctx):
+        relpath = ctx.relpath
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                kind = _lock_kind(stmt.value)
+                if kind:
+                    var = stmt.targets[0].id
+                    info = LockInfo(relpath, stmt.lineno, kind, "module",
+                                    "%s:%s" % (relpath, var))
+                    self.locks[info.key] = info
+                    self._mod_locks[(relpath, var)] = info.key
+        self._index_scope(ctx, ctx.tree, qual="", cls=None, parent=None)
+
+    def _index_scope(self, ctx, node, qual, cls, parent):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = "%s.%s" % (qual, child.name) if qual else child.name
+                info = FunctionInfo(ctx.relpath, q, child, cls, parent)
+                self.functions[info.key] = info
+                if parent is None and cls is None:
+                    self._mod_funcs[(ctx.relpath, child.name)] = info.key
+                elif parent is not None:
+                    self._nested[(parent, child.name)] = info.key
+                if cls is not None and parent is None:
+                    self._methods[(ctx.relpath, cls, child.name)] = info.key
+                    self._by_method.setdefault(child.name, []).append(
+                        info.key)
+                self._index_function(ctx, info)
+                # nested defs keep ``cls``: closures capture self
+                self._index_scope(ctx, child, q, cls=cls, parent=info.key)
+            elif isinstance(child, ast.ClassDef):
+                self._classes[(ctx.relpath, child.name)] = True
+                self._index_scope(ctx, child, child.name, cls=child.name,
+                                  parent=None)
+
+    @staticmethod
+    def _own_nodes(root):
+        """Walk a function body without descending into nested
+        function/class scopes (those are indexed on their own)."""
+        todo = list(ast.iter_child_nodes(root))
+        while todo:
+            node = todo.pop()
+            yield node
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                continue
+            todo.extend(ast.iter_child_nodes(node))
+
+    def _index_function(self, ctx, fn):
+        """Function-local and ``self.<attr>`` lock assignments."""
+        for stmt in self._own_nodes(fn.node):
+            if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
+                continue
+            kind = _lock_kind(stmt.value)
+            if not kind:
+                continue
+            target = stmt.targets[0]
+            if isinstance(target, ast.Name):
+                info = LockInfo(
+                    ctx.relpath, stmt.lineno, kind, "local",
+                    "%s:%s.%s" % (ctx.relpath, fn.qualname, target.id))
+                self.locks[info.key] = info
+                self._local_locks[(fn.key, target.id)] = info.key
+            elif isinstance(target, ast.Attribute) and isinstance(
+                    target.value, ast.Name) and target.value.id == "self" \
+                    and fn.cls is not None:
+                lock_key = self._inst_locks.get(
+                    (ctx.relpath, fn.cls, target.attr))
+                if lock_key is None:
+                    info = LockInfo(
+                        ctx.relpath, stmt.lineno, kind, "instance",
+                        "%s:%s.%s" % (ctx.relpath, fn.cls, target.attr))
+                    self.locks[info.key] = info
+                    self._inst_locks[
+                        (ctx.relpath, fn.cls, target.attr)] = info.key
+
+    # -- import resolution ---------------------------------------------
+
+    def _module_relpath(self, dotted):
+        """Project relpath of a dotted module name, or None."""
+        base = dotted.replace(".", "/")
+        for cand in (base + ".py", base + "/__init__.py"):
+            if cand in self._relpaths:
+                return cand
+        return None
+
+    def _resolve_imports(self, ctx):
+        relpath = ctx.relpath
+        pkg_parts = module_name_of(relpath).split(".")
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    target = self._module_relpath(alias.name)
+                    if target:
+                        local = alias.asname or alias.name.split(".", 1)[0]
+                        self._mod_alias[(relpath, local)] = target
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    # relative: strip the module's own name, then one
+                    # more package per extra dot
+                    base = pkg_parts[:-node.level] if not \
+                        relpath.endswith("__init__.py") else \
+                        pkg_parts[:len(pkg_parts) - node.level + 1]
+                    prefix = ".".join(base)
+                else:
+                    prefix = ""
+                mod = ".".join(p for p in (prefix, node.module or "") if p)
+                mod_rel = self._module_relpath(mod) if mod else None
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    sub = "%s.%s" % (mod, alias.name) if mod else alias.name
+                    sub_rel = self._module_relpath(sub)
+                    if sub_rel:
+                        self._mod_alias[(relpath, local)] = sub_rel
+                    elif mod_rel:
+                        self._sym_alias[(relpath, local)] = (
+                            mod_rel, alias.name)
+
+    # -- call / lock-expression resolution -----------------------------
+
+    def _resolve_symbol(self, relpath, name):
+        """A bare name to a function key (module function, imported
+        symbol, or class constructor), or None."""
+        key = self._mod_funcs.get((relpath, name))
+        if key:
+            return key
+        if (relpath, name) in self._classes:
+            return self._methods.get((relpath, name, "__init__"))
+        sym = self._sym_alias.get((relpath, name))
+        if sym:
+            target_rel, target_name = sym
+            if target_rel == relpath and target_name == name:
+                return None
+            return self._resolve_symbol(target_rel, target_name)
+        return None
+
+    def _resolve_call(self, fn, node):
+        """Callee FunctionInfo key for a Call node, or None."""
+        func = node.func
+        if isinstance(func, ast.Name):
+            scope = fn
+            while scope is not None:
+                key = self._nested.get((scope.key, func.id))
+                if key:
+                    return key
+                scope = self.functions.get(scope.parent) \
+                    if scope.parent else None
+            return self._resolve_symbol(fn.relpath, func.id)
+        if isinstance(func, ast.Attribute):
+            if isinstance(func.value, ast.Name):
+                base = func.value.id
+                if base == "self" and fn.cls is not None:
+                    key = self._methods.get(
+                        (fn.relpath, fn.cls, func.attr))
+                    if key:
+                        return key
+                target_rel = self._mod_alias.get((fn.relpath, base))
+                if target_rel:
+                    return self._resolve_symbol(target_rel, func.attr)
+            if func.attr not in _COMMON_METHODS:
+                owners = self._by_method.get(func.attr, ())
+                if len(owners) == 1:
+                    return owners[0]
+        return None
+
+    def _resolve_lock_expr(self, fn, node):
+        """Lock key for a ``with`` item's context expression, or None."""
+        if isinstance(node, ast.Name):
+            scope = fn
+            while scope is not None:
+                key = self._local_locks.get((scope.key, node.id))
+                if key:
+                    return key
+                scope = self.functions.get(scope.parent) \
+                    if scope.parent else None
+            key = self._mod_locks.get((fn.relpath, node.id))
+            if key:
+                return key
+            sym = self._sym_alias.get((fn.relpath, node.id))
+            if sym:
+                return self._mod_locks.get(sym)
+            return None
+        if isinstance(node, ast.Attribute) and isinstance(
+                node.value, ast.Name):
+            if node.value.id == "self" and fn.cls is not None:
+                return self._inst_locks.get(
+                    (fn.relpath, fn.cls, node.attr))
+            target_rel = self._mod_alias.get((fn.relpath, node.value.id))
+            if target_rel:
+                return self._mod_locks.get((target_rel, node.attr))
+        return None
+
+    # -- summaries -----------------------------------------------------
+
+    @staticmethod
+    def _blocking_desc(node):
+        """Dotted description when the call can block, else None."""
+        func = node.func
+        if isinstance(func, ast.Name):
+            return "open" if func.id == "open" else None
+        if not isinstance(func, ast.Attribute):
+            return None
+        qn = _qualname(func) or func.attr
+        if func.attr == "join":
+            # thread/process join, not str.join / os.path.join
+            if "path" in qn or isinstance(func.value, ast.Constant):
+                return None
+            return qn
+        if func.attr in _BLOCKING_PARTS:
+            return qn
+        return None
+
+    def _summarize_module(self, ctx):
+        for key, fn in self.functions.items():
+            if fn.relpath != ctx.relpath:
+                continue
+            summary = _Summary()
+            self._walk_body(fn, fn.node, (), summary)
+            self.summaries[key] = summary
+
+    def _walk_body(self, fn, node, held, summary):
+        for child in ast.iter_child_nodes(node):
+            self._visit(fn, child, held, summary)
+
+    def _visit(self, fn, node, held, summary):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return    # separate scope, summarized on its own
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = list(held)
+            for item in node.items:
+                # the context expression evaluates under the locks
+                # already pushed by earlier items of this statement
+                self._visit(fn, item.context_expr, tuple(inner), summary)
+                lock = self._resolve_lock_expr(fn, item.context_expr)
+                if lock is not None:
+                    summary.acquires.append(
+                        (lock, tuple(inner), node.lineno))
+                    inner.append(lock)
+            for stmt in node.body:
+                self._visit(fn, stmt, tuple(inner), summary)
+            return
+        if isinstance(node, ast.Call):
+            callee = self._resolve_call(fn, node)
+            if callee is not None:
+                summary.calls.append((callee, held, node.lineno))
+            desc = self._blocking_desc(node)
+            if desc is not None:
+                summary.blocking.append((desc, held, node.lineno))
+        self._walk_body(fn, node, held, summary)
+
+    # -- propagation ---------------------------------------------------
+
+    def _propagate(self):
+        direct = {}
+        for key, summary in self.summaries.items():
+            direct[key] = {lock for lock, _, _ in summary.acquires}
+        acquires = {key: set(v) for key, v in direct.items()}
+        blocking = {
+            key: {(desc, self.functions[key].qualname)
+                  for desc, _, _ in summary.blocking}
+            for key, summary in self.summaries.items()
+        }
+        changed = True
+        passes = 0
+        while changed and passes < 50:
+            changed = False
+            passes += 1
+            for key, summary in self.summaries.items():
+                acc = acquires[key]
+                blk = blocking[key]
+                for callee, _, _ in summary.calls:
+                    extra = acquires.get(callee)
+                    if extra and not extra <= acc:
+                        acc |= extra
+                        changed = True
+                    more = blocking.get(callee)
+                    if more and not more <= blk:
+                        blk |= more
+                        changed = True
+        self.all_acquires = {k: frozenset(v) for k, v in acquires.items()}
+        self.blocking_reach = {
+            k: tuple(sorted(v)) for k, v in blocking.items()}
+
+    def _derive_edges(self):
+        for key, summary in self.summaries.items():
+            fn = self.functions[key]
+            for lock, held, lineno in summary.acquires:
+                for h in held:
+                    self._add_edge(h, lock, fn.relpath, lineno,
+                                   "`with` nesting in %s" % fn.qualname)
+            for callee, held, lineno in summary.calls:
+                if not held:
+                    continue
+                callee_fn = self.functions[callee]
+                for m in self.all_acquires.get(callee, ()):
+                    for h in held:
+                        self._add_edge(
+                            h, m, fn.relpath, lineno,
+                            "%s calls %s" % (fn.qualname,
+                                             callee_fn.qualname))
+
+    def _add_edge(self, src, dst, relpath, lineno, via):
+        if src == dst:
+            # re-acquisition is only a hazard for non-reentrant kinds
+            if self.locks[src].kind == "RLock":
+                return
+        if (src, dst) not in self.edges:
+            self.edges[(src, dst)] = Edge(src, dst, relpath, lineno, via)
+
+    # -- queries -------------------------------------------------------
+
+    def cycles(self):
+        """Strongly connected components of the acquisition graph with
+        more than one lock (plus non-reentrant self-edges), each a
+        sorted list of lock keys."""
+        adj = {}
+        for (src, dst) in self.edges:
+            adj.setdefault(src, []).append(dst)
+        index_counter = [0]
+        stack, on_stack = [], set()
+        index, lowlink = {}, {}
+        out = []
+
+        def strongconnect(v):
+            work = [(v, 0)]
+            while work:
+                node, pi = work[-1]
+                if pi == 0:
+                    index[node] = lowlink[node] = index_counter[0]
+                    index_counter[0] += 1
+                    stack.append(node)
+                    on_stack.add(node)
+                recurse = False
+                neighbors = adj.get(node, ())
+                for i in range(pi, len(neighbors)):
+                    w = neighbors[i]
+                    if w not in index:
+                        work[-1] = (node, i + 1)
+                        work.append((w, 0))
+                        recurse = True
+                        break
+                    if w in on_stack:
+                        lowlink[node] = min(lowlink[node], index[w])
+                if recurse:
+                    continue
+                if lowlink[node] == index[node]:
+                    scc = []
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        scc.append(w)
+                        if w == node:
+                            break
+                    if len(scc) > 1 or (node, node) in self.edges:
+                        out.append(sorted(scc))
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    lowlink[parent] = min(lowlink[parent], lowlink[node])
+
+        for v in list(adj):
+            if v not in index:
+                strongconnect(v)
+        return out
+
+    def lock_by_site(self, relpath, lineno):
+        """LockInfo at a creation site, or None — the join key the
+        runtime witness uses (its wrapper records file:line of the
+        ``threading.Lock()`` call)."""
+        return self.locks.get("%s:%d" % (relpath, lineno))

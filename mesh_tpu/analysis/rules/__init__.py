@@ -11,10 +11,13 @@ from .vmem import VmemBudgetRule
 from .lck import LockDisciplineRule
 from .knb import KnobRegistryRule
 from .obs import ObservabilityHygieneRule
+from .lok import LockOrderRule
+from .pal import PallasDmaRule
 
 __all__ = [
     "TracerLeakRule", "RecompileHazardRule", "VmemBudgetRule",
     "LockDisciplineRule", "KnobRegistryRule", "ObservabilityHygieneRule",
+    "LockOrderRule", "PallasDmaRule",
     "all_rules",
 ]
 
@@ -28,4 +31,6 @@ def all_rules():
         LockDisciplineRule(),
         KnobRegistryRule(),
         ObservabilityHygieneRule(),
+        LockOrderRule(),
+        PallasDmaRule(),
     ]

@@ -1,0 +1,325 @@
+"""LOK: interprocedural lock-order / deadlock analysis.
+
+Consumes the cross-module model from ``project.interproc()`` (see
+``analysis/interproc.py``): every module/instance/local lock, and the
+global acquisition-order graph built by propagating held-lock context
+through resolved calls.
+
+========  ========  =====================================================
+code      severity  fires on
+========  ========  =====================================================
+LOK001    error     a cycle in the global lock acquisition-order graph
+                    (including re-acquisition of a non-reentrant lock)
+LOK002    warning   a blocking call (file I/O, ``join``, ``subprocess``,
+                    ``os.rename``/``replace``, ``sleep`` ...) made while
+                    holding a lock — directly or through any resolved
+                    call chain — unless allowlisted in
+                    ``doc/concurrency.md``
+LOK003    error     an observed acquisition edge that contradicts the
+                    canonical lock order declared in
+                    ``doc/concurrency.md``
+LOK004    warning   a cross-subsystem acquisition edge whose locks are
+                    not (both) declared in the canonical order table
+LOK005    warning   a canonical-order entry naming a lock the analysis
+                    no longer discovers (stale doc)
+========  ========  =====================================================
+
+The canonical order and the blocking allowlist live in
+``doc/concurrency.md``:
+
+- the **Canonical lock order** section is scanned for backticked lock
+  names (``path.py:QualifiedName``) in declaration order — earlier
+  means "acquired first" (outermost);
+- the **Blocking-under-lock allowlist** section is scanned for table
+  rows whose first backticked tokens are a lock name, a call name
+  (last dotted part, or ``*`` for any call under that lock), and
+  optionally the function qualname the blocking call lives in — a
+  site-scoped entry keeps the *rest* of the locked region checked,
+  which is how PR 11's "persist back outside the cache lock" stays a
+  machine-checked invariant rather than a wildcard.
+
+Messages are deliberately line-free (function qualnames, not line
+numbers) so baseline fingerprints survive unrelated edits — same
+contract as every other family.
+"""
+
+import re
+
+from ..engine import Rule
+
+__all__ = ["LockOrderRule", "parse_concurrency_doc", "validate_witness"]
+
+#: doc/concurrency.md section headers the parser anchors on
+_ORDER_HEADER = "canonical lock order"
+_ALLOW_HEADER = "blocking-under-lock allowlist"
+
+_BACKTICK = re.compile(r"`([^`]+)`")
+
+#: a lock name as written in the doc: path.py:Qualified.Name
+_LOCK_TOKEN = re.compile(r"^[\w/.-]+\.py:[\w.]+$")
+
+
+def parse_concurrency_doc(text):
+    """(order, allow) from doc/concurrency.md text.
+
+    ``order`` maps lock display name -> rank (0 = outermost);
+    ``allow`` is a set of (lock display name, call last-part or "*",
+    site qualname or "*").
+    """
+    order, allow = {}, set()
+    if not text:
+        return order, allow
+    section = None
+    for line in text.splitlines():
+        if line.startswith("#"):
+            title = line.lstrip("#").strip().lower()
+            if _ORDER_HEADER in title:
+                section = "order"
+            elif _ALLOW_HEADER in title:
+                section = "allow"
+            else:
+                section = None
+            continue
+        tokens = [t for t in _BACKTICK.findall(line)]
+        if section == "order":
+            for token in tokens:
+                if _LOCK_TOKEN.match(token) and token not in order:
+                    order[token] = len(order)
+        elif section == "allow" and len(tokens) >= 2 \
+                and _LOCK_TOKEN.match(tokens[0]):
+            site = tokens[2] if len(tokens) >= 3 else "*"
+            allow.add((tokens[0], tokens[1], site))
+    return order, allow
+
+
+def _family(lock_name):
+    """Subsystem of a lock display name: second path component
+    (``mesh_tpu/store/...`` -> ``store``), or the filename for
+    top-level modules."""
+    path = lock_name.split(":", 1)[0]
+    parts = path.split("/")
+    return parts[1] if len(parts) > 2 else parts[-1]
+
+
+class LockOrderRule(Rule):
+    id = "LOK"
+    name = "interprocedural lock order"
+
+    def finalize(self, project):
+        graph = project.interproc()
+        order, allow = parse_concurrency_doc(
+            project.doc_text("doc", "concurrency.md"))
+        findings = []
+        findings.extend(self._cycles(project, graph))
+        findings.extend(self._blocking(project, graph, allow))
+        findings.extend(self._declared_order(project, graph, order))
+        return findings
+
+    # -- LOK001: cycles ------------------------------------------------
+
+    def _cycles(self, project, graph, _rule="LOK001"):
+        from ..engine import Finding
+
+        findings = []
+        for scc in graph.cycles():
+            names = [graph.locks[k].name for k in scc]
+            # anchor at the lexically first witness edge inside the SCC
+            witness = min(
+                (e for (s, d), e in graph.edges.items()
+                 if s in scc and d in scc),
+                key=lambda e: (e.relpath, e.lineno))
+            if len(scc) == 1:
+                message = ("non-reentrant lock %s can be re-acquired "
+                           "on the same thread (%s)" % (
+                               names[0], witness.via))
+            else:
+                message = ("lock-order cycle between %s (%s)" % (
+                    " <-> ".join(sorted(names)), witness.via))
+            findings.append(Finding(
+                _rule, "error", witness.relpath, witness.lineno, message,
+                hint="break the cycle: pick one order, document it in "
+                     "doc/concurrency.md, and release before crossing"))
+        return findings
+
+    # -- LOK002: blocking calls under a lock ---------------------------
+
+    def _blocking(self, project, graph, allow):
+        from ..engine import Finding
+
+        findings = []
+        seen = set()
+
+        def allowed(lock_name, desc, site):
+            last = desc.rsplit(".", 1)[-1]
+            for call in (last, desc, "*"):
+                for where in (site, "*"):
+                    if (lock_name, call, where) in allow:
+                        return True
+            return False
+
+        for key, summary in sorted(graph.summaries.items()):
+            fn = graph.functions[key]
+            for desc, held, lineno in summary.blocking:
+                if not held:
+                    continue
+                lock = graph.locks[held[-1]].name
+                dedup = (lock, desc, fn.qualname)
+                if dedup in seen or allowed(lock, desc, fn.qualname):
+                    continue
+                seen.add(dedup)
+                findings.append(Finding(
+                    "LOK002", "warning", fn.relpath, lineno,
+                    "blocking call `%s` while holding %s (in %s)" % (
+                        desc, lock, fn.qualname),
+                    hint="move the blocking work outside the lock, or "
+                         "allowlist it with a reason in "
+                         "doc/concurrency.md"))
+            for callee, held, lineno in summary.calls:
+                if not held:
+                    continue
+                lock = graph.locks[held[-1]].name
+                callee_fn = graph.functions[callee]
+                for desc, site in graph.blocking_reach.get(callee, ()):
+                    dedup = (lock, desc, site)
+                    if dedup in seen or allowed(lock, desc, site):
+                        continue
+                    seen.add(dedup)
+                    findings.append(Finding(
+                        "LOK002", "warning", fn.relpath, lineno,
+                        "holding %s, call to %s() reaches blocking "
+                        "`%s` (in %s)" % (
+                            lock, callee_fn.qualname, desc, site),
+                        hint="hoist the call out of the locked region, "
+                             "or allowlist it with a reason in "
+                             "doc/concurrency.md"))
+        return findings
+
+    # -- LOK003/4/5: the declared canonical order ----------------------
+
+    def _declared_order(self, project, graph, order):
+        from ..engine import Finding
+
+        findings = []
+        if not order:
+            return findings    # no doc (fixture runs) — nothing to check
+        known = {info.name for info in graph.locks.values()}
+        scanned = {ctx.relpath for ctx in project.contexts}
+        for name in sorted(order):
+            # partial runs (--changed) can't judge staleness for files
+            # they never parsed — only report when the file was scanned
+            if name.split(":", 1)[0] not in scanned:
+                continue
+            if name not in known:
+                findings.append(Finding(
+                    "LOK005", "warning", "doc/concurrency.md", 0,
+                    "canonical order lists %s but no such lock is "
+                    "discovered" % name,
+                    hint="update doc/concurrency.md after moving or "
+                         "removing a lock"))
+        seen_undeclared = set()
+        for (src, dst), edge in sorted(graph.edges.items()):
+            if src == dst:
+                continue    # LOK001 owns self-edges
+            src_name = graph.locks[src].name
+            dst_name = graph.locks[dst].name
+            if src_name in order and dst_name in order:
+                if order[src_name] > order[dst_name]:
+                    findings.append(Finding(
+                        "LOK003", "error", edge.relpath, edge.lineno,
+                        "%s is acquired while holding %s, against the "
+                        "canonical order in doc/concurrency.md (%s)" % (
+                            dst_name, src_name, edge.via),
+                        hint="acquire in the declared order or update "
+                             "the canonical table (with review)"))
+            elif _family(src_name) != _family(dst_name):
+                dedup = (src_name, dst_name)
+                if dedup in seen_undeclared:
+                    continue
+                seen_undeclared.add(dedup)
+                missing = [n for n in (src_name, dst_name)
+                           if n not in order]
+                findings.append(Finding(
+                    "LOK004", "warning", edge.relpath, edge.lineno,
+                    "cross-subsystem acquisition %s -> %s is not "
+                    "declared in doc/concurrency.md (%s undeclared; "
+                    "%s)" % (src_name, dst_name,
+                             " and ".join(missing), edge.via),
+                    hint="add the lock(s) to the canonical order table "
+                         "in doc/concurrency.md"))
+        return findings
+
+
+# -- witness cross-check (mesh-tpu lint --witness) ----------------------
+
+def validate_witness(project, witness_edges):
+    """Cross-check dynamically recorded acquisition edges against the
+    static graph and the declared canonical order.
+
+    ``witness_edges``: iterable of ((src_path, src_line),
+    (dst_path, dst_line), count) from the runtime lock witness.
+
+    Returns a dict: ``ok`` (bool), ``problems`` (list of strings —
+    order contradictions and cycles introduced by dynamic edges),
+    ``dynamic_only`` (edges the static analysis missed — informational:
+    name-level resolution can't see every dynamic dispatch),
+    ``unknown_sites`` (creation sites not matching any discovered
+    lock), ``checked`` (count of validated edges).
+    """
+    graph = project.interproc()
+    order, _ = parse_concurrency_doc(
+        project.doc_text("doc", "concurrency.md"))
+    problems, dynamic_only, unknown = [], [], []
+    combined = {(s, d) for (s, d) in graph.edges}
+    checked = 0
+    for (src_site, dst_site, count) in witness_edges:
+        src = graph.lock_by_site(*src_site)
+        dst = graph.lock_by_site(*dst_site)
+        if src is None or dst is None:
+            for site, info in ((src_site, src), (dst_site, dst)):
+                if info is None:
+                    unknown.append("%s:%d" % site)
+            continue
+        checked += 1
+        if src.key == dst.key:
+            continue    # per-site aggregation can't split instances
+        if (src.key, dst.key) not in combined:
+            dynamic_only.append(
+                "%s -> %s (seen %dx at runtime, not in the static "
+                "graph)" % (src.name, dst.name, count))
+            combined.add((src.key, dst.key))
+        if src.name in order and dst.name in order \
+                and order[src.name] > order[dst.name]:
+            problems.append(
+                "witnessed acquisition %s -> %s contradicts the "
+                "canonical order in doc/concurrency.md" % (
+                    src.name, dst.name))
+    # cycle check over static + dynamic union
+    adj = {}
+    for (s, d) in combined:
+        adj.setdefault(s, set()).add(d)
+    state = {}
+
+    def has_cycle(v, path):
+        state[v] = 1
+        for w in adj.get(v, ()):
+            if state.get(w) == 1:
+                names = [graph.locks[k].name for k in path + [w]]
+                problems.append(
+                    "combined static+dynamic graph has a lock-order "
+                    "cycle: %s" % " -> ".join(names))
+                return True
+            if state.get(w) is None and has_cycle(w, path + [w]):
+                return True
+        state[v] = 2
+        return False
+
+    for v in sorted(adj):
+        if state.get(v) is None and has_cycle(v, [v]):
+            break
+    return {
+        "ok": not problems,
+        "problems": problems,
+        "dynamic_only": sorted(set(dynamic_only)),
+        "unknown_sites": sorted(set(unknown)),
+        "checked": checked,
+    }

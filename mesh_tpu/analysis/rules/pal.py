@@ -1,0 +1,557 @@
+"""PAL: Pallas DMA / semaphore verifier (abstract interpretation).
+
+The streamed BVH kernel (accel/pallas_stream.py) hand-maintains a
+double-buffered DMA ring: ``pltpu.make_async_copy(...).start()`` in the
+refill walk, ``.wait()`` at the ring head, compute strictly on landed
+slots.  Nothing but review enforced that discipline; the ROADMAP's next
+kernels repeat it.  PAL abstracts each kernel's DMA descriptors into
+*families* (a descriptor-returning helper, a bound variable, or a
+direct ``make_async_copy`` chain), tracks start/wait sites and the ring
+slot expression each touches (with helper-argument substitution), and
+checks:
+
+========  ========  =====================================================
+code      severity  fires on
+========  ========  =====================================================
+PAL001    error     a DMA family with starts but no wait anywhere in the
+                    kernel (or waits with no start)
+PAL002    error     compute reads/writes a ring-buffer slot the kernel
+                    never waits (a slot with potentially outstanding DMA)
+PAL003    error     a ``memory_space=ANY`` operand touched by compute
+                    instead of exclusively via ``make_async_copy``
+PAL004    warning   a ``fori_loop``/``while_loop`` body with an unequal
+                    number of start and wait sites for one family
+                    (per-iteration semaphore drift)
+PAL005    error     the DMA ring scratch and its semaphore array declare
+                    different slot counts (``pltpu.VMEM((N, ...))`` vs
+                    ``pltpu.SemaphoreType.DMA((M,))``), or the kernel
+                    signature arity disagrees with
+                    in_specs+out_shape+scratch_shapes
+========  ========  =====================================================
+
+Slot tracking is syntactic (normalized expression equality), which is
+exactly what the ring idiom gives us: the wait and the compute read use
+the same ``head`` expression, the start uses the tail.  One-sided
+loops (starts in the refill walk, waits in the main loop) are the
+*intended* prefetch shape and stay silent; PAL004 only fires when a
+single loop body both starts and waits a family unevenly.
+
+Shape facts resolve through the VMEM rule's ``ConstEnv`` (module
+constants + enclosing kw defaults), and the ring-mismatch message
+prices the slot footprint with the same (8, 128) padded-tile model, so
+the two rules can never disagree about a kernel's geometry.
+"""
+
+import ast
+
+from ..engine import Rule
+from .common import ConstEnv, qualname
+from .vmem import _DTYPE_SIZES, _padded_bytes
+
+__all__ = ["PallasDmaRule"]
+
+_LOOP_CALLS = {"while_loop": 1, "fori_loop": 2}   # body arg position
+
+_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _last(qn):
+    return qn.rsplit(".", 1)[-1] if qn else None
+
+
+def _ref_root(node):
+    """Root buffer name of ``buf``, ``buf.at[...]``, ``buf[...]`` chains,
+    plus the first slot index expression (or None)."""
+    slot = None
+    while True:
+        if isinstance(node, ast.Subscript):
+            idx = node.slice
+            first = idx.elts[0] if isinstance(idx, ast.Tuple) and idx.elts \
+                else idx
+            if slot is None:
+                slot = first
+            node = node.value
+        elif isinstance(node, ast.Attribute) and node.attr == "at":
+            node = node.value
+        elif isinstance(node, ast.Name):
+            return node.id, slot
+        else:
+            return None, slot
+
+
+def _norm(node):
+    return None if node is None else ast.dump(node)
+
+
+class _Family(object):
+    __slots__ = ("label", "dst_root", "starts", "waits")
+
+    def __init__(self, label, dst_root):
+        self.label = label
+        self.dst_root = dst_root
+        self.starts = []     # (slot_norm, call_node)
+        self.waits = []      # (slot_norm, call_node)
+
+
+class _Helper(object):
+    """A nested def returning a make_async_copy descriptor."""
+
+    __slots__ = ("name", "params", "dst_root", "slot", "copy_call")
+
+    def __init__(self, node, copy_call):
+        self.name = node.name
+        self.params = [a.arg for a in node.args.args]
+        self.copy_call = copy_call
+        dst = copy_call.args[1] if len(copy_call.args) > 1 else None
+        self.dst_root, self.slot = _ref_root(dst) if dst is not None \
+            else (None, None)
+
+    def slot_at(self, call):
+        """The ring-slot expression at a helper call site, with the
+        helper's formal substituted by the actual argument."""
+        if isinstance(self.slot, ast.Name) and self.slot.id in self.params:
+            pos = self.params.index(self.slot.id)
+            if pos < len(call.args):
+                return call.args[pos]
+        return self.slot
+
+
+class PallasDmaRule(Rule):
+    id = "PAL"
+    name = "pallas DMA/semaphore discipline"
+
+    def check(self, ctx):
+        findings = []
+        units = [node for node in self._top_defs(ctx.tree)
+                 if any(isinstance(n, ast.Call)
+                        and _last(qualname(n.func)) == "make_async_copy"
+                        for n in ast.walk(node))]
+        for unit in units:
+            findings.extend(self._check_unit(ctx, unit))
+        for call in ast.walk(ctx.tree):
+            if isinstance(call, ast.Call) \
+                    and _last(qualname(call.func)) == "pallas_call":
+                findings.extend(self._check_call_site(ctx, call))
+        return findings
+
+    @staticmethod
+    def _top_defs(tree):
+        for node in tree.body:
+            if isinstance(node, _SCOPES):
+                yield node
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, _SCOPES):
+                        yield sub
+
+    # -- kernel-body DMA analysis (PAL001/002/004) ---------------------
+
+    def _check_unit(self, ctx, unit):
+        parents = ctx.parents()
+        # nested-def scope tree: name resolution walks outward
+        def_parent = {}
+        for node in ast.walk(unit):
+            if isinstance(node, _SCOPES) and node is not unit:
+                p = parents.get(node)
+                while p is not None and not isinstance(p, _SCOPES):
+                    p = parents.get(p)
+                def_parent[node] = p or unit
+
+        def resolve_def(name, scope):
+            while scope is not None:
+                for child in ast.iter_child_nodes(scope):
+                    if isinstance(child, _SCOPES) and child.name == name:
+                        return child
+                scope = def_parent.get(scope)
+            return None
+
+        def scope_of(node):
+            p = parents.get(node)
+            while p is not None and not isinstance(p, _SCOPES):
+                p = parents.get(p)
+            return p or unit
+
+        helpers = {}     # def node -> _Helper
+        for node in ast.walk(unit):
+            if isinstance(node, _SCOPES):
+                for stmt in node.body:
+                    if isinstance(stmt, ast.Return) \
+                            and isinstance(stmt.value, ast.Call) \
+                            and _last(qualname(stmt.value.func)) == \
+                            "make_async_copy":
+                        helpers[node] = _Helper(node, stmt.value)
+
+        # simple descriptor bindings: dma = make_async_copy(...) / helper()
+        bindings = {}    # var name -> ("copy", call) | ("helper", h, call)
+        for node in ast.walk(unit):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Call):
+                call = node.value
+                if _last(qualname(call.func)) == "make_async_copy":
+                    bindings[node.targets[0].id] = ("copy", call)
+                elif isinstance(call.func, ast.Name):
+                    target = resolve_def(call.func.id, scope_of(node))
+                    if target in helpers:
+                        bindings[node.targets[0].id] = (
+                            "helper", helpers[target], call)
+
+        families = {}    # label -> _Family
+        event_chain = {}  # event call node -> tuple of enclosing defs
+
+        def family(label, dst_root):
+            if label not in families:
+                families[label] = _Family(label, dst_root)
+            return families[label]
+
+        def descriptor_of(recv, scope):
+            """(family, slot expr) for a ``.start()``/``.wait()``
+            receiver, or (None, None)."""
+            if isinstance(recv, ast.Call):
+                if _last(qualname(recv.func)) == "make_async_copy":
+                    dst = recv.args[1] if len(recv.args) > 1 else None
+                    root, slot = _ref_root(dst) if dst is not None \
+                        else (None, None)
+                    return family("copy(->%s)" % root, root), slot
+                if isinstance(recv.func, ast.Name):
+                    target = resolve_def(recv.func.id, scope)
+                    if target in helpers:
+                        h = helpers[target]
+                        return (family("%s()" % h.name, h.dst_root),
+                                h.slot_at(recv))
+            elif isinstance(recv, ast.Name) and recv.id in bindings:
+                bound = bindings[recv.id]
+                if bound[0] == "copy":
+                    dst = bound[1].args[1] if len(bound[1].args) > 1 \
+                        else None
+                    root, slot = _ref_root(dst) if dst is not None \
+                        else (None, None)
+                    return family(recv.id, root), slot
+                h, call = bound[1], bound[2]
+                return family(recv.id, h.dst_root), h.slot_at(call)
+            return None, None
+
+        for node in ast.walk(unit):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("start", "wait")):
+                continue
+            fam, slot = descriptor_of(node.func.value, scope_of(node))
+            if fam is None:
+                continue
+            chain = []
+            scope = scope_of(node)
+            while scope is not None:
+                chain.append(scope)
+                scope = def_parent.get(scope)
+            event_chain[node] = tuple(chain)
+            record = (fam.starts if node.func.attr == "start"
+                      else fam.waits)
+            record.append((_norm(slot), slot, node))
+
+        findings = []
+        for fam in sorted(families.values(), key=lambda f: f.label):
+            findings.extend(self._check_family(ctx, unit, fam))
+        findings.extend(self._check_loop_balance(
+            ctx, unit, families, event_chain, resolve_def, scope_of))
+        return findings
+
+    def _check_family(self, ctx, unit, fam):
+        findings = []
+        if fam.starts and not fam.waits:
+            findings.append(ctx.finding(
+                "PAL001", "error", fam.starts[0][2],
+                "DMA %s in %s is started but never awaited" % (
+                    fam.label, unit.name),
+                hint="every make_async_copy start needs a .wait() on "
+                     "the same descriptor before its data is read"))
+        elif fam.waits and not fam.starts:
+            findings.append(ctx.finding(
+                "PAL001", "error", fam.waits[0][2],
+                "DMA %s in %s is awaited but never started" % (
+                    fam.label, unit.name),
+                hint="a wait with no start deadlocks the kernel on an "
+                     "unsignalled semaphore"))
+        findings.extend(self._check_aliasing(ctx, unit, fam))
+        return findings
+
+    def _check_aliasing(self, ctx, unit, fam):
+        """PAL002: compute access to a ring slot nobody waits."""
+        if not fam.dst_root or not fam.waits or not fam.starts:
+            return
+        waited = {norm for norm, _, _ in fam.waits if norm is not None}
+        if not waited:
+            return
+        waited_src = sorted({
+            ast.unparse(snode) if hasattr(ast, "unparse") else "<slot>"
+            for _, snode, _ in fam.waits if snode is not None})
+        # every node inside a make_async_copy call is DMA plumbing
+        dma_nodes = set()
+        for node in ast.walk(unit):
+            if isinstance(node, ast.Call) \
+                    and _last(qualname(node.func)) == "make_async_copy":
+                for sub in ast.walk(node):
+                    dma_nodes.add(sub)
+        for node in ast.walk(unit):
+            if not isinstance(node, ast.Subscript) or node in dma_nodes:
+                continue
+            root, slot = _ref_root(node)
+            if root != fam.dst_root or slot is None:
+                continue
+            if _norm(slot) not in waited:
+                yield ctx.finding(
+                    "PAL002", "error", node,
+                    "ring slot aliasing in %s: %s[%s] is accessed by "
+                    "compute but only slot(s) %s are awaited for DMA "
+                    "%s — the slot may have an outstanding copy" % (
+                        unit.name, fam.dst_root,
+                        ast.unparse(slot) if hasattr(ast, "unparse")
+                        else "<slot>",
+                        ", ".join(waited_src), fam.label),
+                    hint="read only slots whose DMA was awaited (the "
+                         "ring head), or wait this slot first")
+
+    def _check_loop_balance(self, ctx, unit, families, event_chain,
+                            resolve_def, scope_of):
+        """PAL004: start/wait site imbalance inside one loop body."""
+        loop_bodies = set()
+        for node in ast.walk(unit):
+            if isinstance(node, ast.Call):
+                pos = _LOOP_CALLS.get(_last(qualname(node.func)))
+                if pos is not None and pos < len(node.args) \
+                        and isinstance(node.args[pos], ast.Name):
+                    body = resolve_def(node.args[pos].id, scope_of(node))
+                    if body is not None:
+                        loop_bodies.add(body)
+        findings = []
+        for body in sorted(loop_bodies, key=lambda n: n.lineno):
+            for label in sorted(families):
+                fam = families[label]
+                starts = sum(1 for _, _, node in fam.starts
+                             if body in event_chain.get(node, ()))
+                waits = sum(1 for _, _, node in fam.waits
+                            if body in event_chain.get(node, ()))
+                if starts and waits and starts != waits:
+                    findings.append(ctx.finding(
+                        "PAL004", "warning", body,
+                        "loop body %s starts DMA %s at %d site(s) but "
+                        "waits at %d — per-iteration semaphore drift" % (
+                            body.name, fam.label, starts, waits),
+                        hint="balance start/wait sites per iteration, "
+                             "or split the prefetch into its own loop"))
+        return findings
+
+    # -- pallas_call site checks (PAL003/005) --------------------------
+
+    def _check_call_site(self, ctx, call):
+        parents = ctx.parents()
+        enclosing = parents.get(call)
+        while enclosing is not None and not isinstance(enclosing, _SCOPES):
+            enclosing = parents.get(enclosing)
+        env = ConstEnv(ctx.tree, enclosing)
+        kw = {k.arg: k.value for k in call.keywords if k.arg}
+        spec_src = kw
+        grid_spec = kw.get("grid_spec")
+        if isinstance(grid_spec, ast.Call):
+            spec_src = dict(kw)
+            spec_src.update({k.arg: k.value for k in grid_spec.keywords
+                             if k.arg})
+        in_specs = spec_src.get("in_specs")
+        out_shape = spec_src.get("out_shape")
+        scratch = spec_src.get("scratch_shapes")
+        prefetch = env.resolve(spec_src.get("num_scalar_prefetch")) \
+            if spec_src.get("num_scalar_prefetch") is not None else 0
+        kernel = self._resolve_kernel(ctx, call)
+        findings = []
+        if kernel is not None:
+            findings.extend(self._check_any_operands(
+                ctx, call, kernel, in_specs, enclosing, int(prefetch or 0)))
+        findings.extend(self._check_arity(
+            ctx, call, kernel, in_specs, out_shape, scratch,
+            int(prefetch or 0)))
+        if kernel is not None and isinstance(scratch, ast.List):
+            findings.extend(self._check_ring_shapes(
+                ctx, call, kernel, in_specs, out_shape, scratch, env,
+                int(prefetch or 0)))
+        return findings
+
+    def _resolve_kernel(self, ctx, call):
+        """The kernel FunctionDef behind pallas_call's first argument:
+        a module-level def, or the def a module-level factory returns."""
+        if not call.args:
+            return None
+        target = call.args[0]
+        module_defs = {node.name: node for node in ctx.tree.body
+                       if isinstance(node, _SCOPES)}
+        if isinstance(target, ast.Name):
+            return module_defs.get(target.id)
+        if isinstance(target, ast.Call) and isinstance(
+                target.func, ast.Name):
+            factory = module_defs.get(target.func.id)
+            if factory is None:
+                return None
+            nested = {node.name: node
+                      for node in ast.iter_child_nodes(factory)
+                      if isinstance(node, _SCOPES)}
+            for stmt in factory.body:
+                if isinstance(stmt, ast.Return) \
+                        and isinstance(stmt.value, ast.Name):
+                    return nested.get(stmt.value.id)
+        return None
+
+    @staticmethod
+    def _spec_is_any(spec, enclosing):
+        """True when an in_specs element is BlockSpec(memory_space=ANY),
+        following one level of local-variable indirection."""
+        if isinstance(spec, ast.Name) and enclosing is not None:
+            for node in ast.walk(enclosing):
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name) \
+                        and node.targets[0].id == spec.id:
+                    spec = node.value
+                    break
+        if not (isinstance(spec, ast.Call)
+                and _last(qualname(spec.func)) == "BlockSpec"):
+            return False
+        for k in spec.keywords:
+            if k.arg == "memory_space" \
+                    and _last(qualname(k.value)) == "ANY":
+                return True
+        return False
+
+    def _check_any_operands(self, ctx, call, kernel, in_specs,
+                            enclosing, prefetch):
+        """PAL003: ANY-space operands are DMA-only."""
+        if not isinstance(in_specs, ast.List):
+            return
+        params = [a.arg for a in kernel.args.args]
+        for i, spec in enumerate(in_specs.elts):
+            if not self._spec_is_any(spec, enclosing):
+                continue
+            idx = prefetch + i
+            if idx >= len(params):
+                continue
+            name = params[idx]
+            dma_nodes = set()
+            for node in ast.walk(kernel):
+                if isinstance(node, ast.Call) and _last(
+                        qualname(node.func)) == "make_async_copy":
+                    for sub in ast.walk(node):
+                        dma_nodes.add(sub)
+            for node in ast.walk(kernel):
+                if isinstance(node, ast.Name) and node.id == name \
+                        and isinstance(node.ctx, ast.Load) \
+                        and node not in dma_nodes:
+                    yield ctx.finding(
+                        "PAL003", "error", node,
+                        "memory_space=ANY operand %s of kernel %s is "
+                        "touched by compute — ANY-resident data is only "
+                        "reachable via make_async_copy" % (
+                            name, kernel.name),
+                        hint="DMA the block into VMEM scratch and "
+                             "compute on the landed copy")
+                    break
+
+    def _check_arity(self, ctx, call, kernel, in_specs, out_shape,
+                     scratch, prefetch):
+        if kernel is None or not isinstance(in_specs, ast.List):
+            return
+        if kernel.args.vararg is not None:
+            return    # *refs kernels unpack positionally — arity is theirs
+        if isinstance(out_shape, ast.List):
+            n_out = len(out_shape.elts)
+        elif isinstance(out_shape, ast.Call):
+            n_out = 1
+        else:
+            return
+        n_scratch = len(scratch.elts) if isinstance(scratch, ast.List) \
+            else 0
+        expected = prefetch + len(in_specs.elts) + n_out + n_scratch
+        params = kernel.args.args
+        if len(params) != expected:
+            yield ctx.finding(
+                "PAL005", "error", call,
+                "kernel %s takes %d ref(s) but pallas_call wires %d "
+                "(%d prefetch + %d in + %d out + %d scratch)" % (
+                    kernel.name, len(params), expected, prefetch,
+                    len(in_specs.elts), n_out, n_scratch),
+                hint="every in_spec, out_shape and scratch_shapes entry "
+                     "becomes exactly one kernel ref argument, in order")
+
+    def _check_ring_shapes(self, ctx, call, kernel, in_specs, out_shape,
+                           scratch, env, prefetch):
+        """PAL005: DMA ring slot count vs its semaphore array."""
+        params = [a.arg for a in kernel.args.args]
+        if isinstance(out_shape, ast.List):
+            n_out = len(out_shape.elts)
+        elif isinstance(out_shape, ast.Call):
+            n_out = 1
+        else:
+            return
+        n_in = len(in_specs.elts) if isinstance(in_specs, ast.List) \
+            else None
+        if n_in is None:
+            return
+        first_scratch = prefetch + n_in + n_out
+        scratch_params = params[first_scratch:]
+        if len(scratch_params) != len(scratch.elts):
+            return    # arity check already reports the wiring bug
+        by_param = dict(zip(scratch_params, scratch.elts))
+        seen_pairs = set()
+        for node in ast.walk(kernel):
+            if not (isinstance(node, ast.Call) and _last(
+                    qualname(node.func)) == "make_async_copy"):
+                continue
+            if len(node.args) < 3:
+                continue
+            dst_root, _ = _ref_root(node.args[1])
+            sem_root, _ = _ref_root(node.args[2])
+            if (dst_root, sem_root) in seen_pairs:
+                continue
+            seen_pairs.add((dst_root, sem_root))
+            ring = by_param.get(dst_root)
+            sem = by_param.get(sem_root)
+            if not (isinstance(ring, ast.Call)
+                    and isinstance(sem, ast.Call)):
+                continue
+            ring_dims = self._shape_dims(ring)
+            sem_dims = self._shape_dims(sem)
+            if not ring_dims or sem_dims is None:
+                continue
+            n_slots = env.resolve(ring_dims[0])
+            n_sems = env.resolve(sem_dims[0]) if sem_dims else 1
+            if n_slots is None or n_sems is None:
+                continue
+            if int(n_slots) != int(n_sems):
+                slot_bytes = None
+                rest = [env.resolve(d) for d in ring_dims[1:]]
+                if rest and all(r is not None for r in rest):
+                    itemsize = _DTYPE_SIZES.get(
+                        _last(qualname(ring.args[1]))
+                        if len(ring.args) > 1 else "", 4)
+                    slot_bytes = _padded_bytes(
+                        [int(r) for r in rest], itemsize)
+                detail = (" (each slot ~%d KiB padded)" %
+                          (slot_bytes // 1024)) if slot_bytes else ""
+                yield ctx.finding(
+                    "PAL005", "error", call,
+                    "DMA ring %s in kernel %s has %d slot(s) but "
+                    "semaphore array %s has %d%s" % (
+                        dst_root, kernel.name, int(n_slots), sem_root,
+                        int(n_sems), detail),
+                    hint="ring buffer and SemaphoreType.DMA leading "
+                         "dims must agree — one semaphore per in-"
+                         "flight slot")
+
+    @staticmethod
+    def _shape_dims(spec_call):
+        """Dim expression list of pltpu.VMEM((a, b), dt) /
+        SemaphoreType.DMA((n,)); [] for scalar shapes, None when the
+        call isn't shaped that way."""
+        if not spec_call.args:
+            return None
+        shape = spec_call.args[0]
+        if isinstance(shape, ast.Tuple):
+            return list(shape.elts)
+        return None

@@ -623,9 +623,24 @@ def cmd_lint(args):
                      ", ".join(r.id for r in all_rules())),
                   file=sys.stderr)
             sys.exit(2)
+    paths = args.paths or None
+    if args.changed:
+        if paths:
+            print("mesh-tpu lint: --changed and explicit paths are "
+                  "mutually exclusive", file=sys.stderr)
+            sys.exit(2)
+        changed = _git_changed_files(repo_root)
+        if changed is None:
+            print("mesh-tpu lint: --changed needs a git checkout",
+                  file=sys.stderr)
+            sys.exit(2)
+        if not changed:
+            print("meshlint: no changed mesh_tpu files -> OK")
+            sys.exit(0)
+        paths = changed
     baseline_path = args.baseline or engine.default_baseline_path(repo_root)
     report = engine.run_lint(
-        repo_root, paths=args.paths or None, rules=rules,
+        repo_root, paths=paths, rules=rules,
         baseline_path=baseline_path,
         use_baseline=not args.no_baseline)
     if args.write_baseline:
@@ -636,12 +651,69 @@ def cmd_lint(args):
                  "y" if len(report.findings) == 1 else "ies",
                  baseline_path))
         return
-    if args.json:
+    fmt = args.format or ("json" if args.json else "human")
+    if fmt == "json":
         json.dump(report.to_dict(), sys.stdout, indent=2, sort_keys=True)
+        sys.stdout.write("\n")
+    elif fmt == "sarif":
+        json.dump(report.to_sarif(), sys.stdout, indent=2,
+                  sort_keys=True)
         sys.stdout.write("\n")
     else:
         print(report.render_human(verbose=args.verbose))
-    sys.exit(report.rc)
+    rc = report.rc
+    if args.witness:
+        rc = max(rc, _check_witness(engine, repo_root, args.witness,
+                                    human=(fmt == "human")))
+    sys.exit(rc)
+
+
+def _git_changed_files(repo_root):
+    """mesh_tpu/**.py files touched vs HEAD plus untracked ones, as
+    absolute paths; None when git is unavailable, [] when clean."""
+    import subprocess
+
+    names = set()
+    for cmd in (["git", "diff", "--name-only", "HEAD"],
+                ["git", "ls-files", "-o", "--exclude-standard"]):
+        try:
+            out = subprocess.run(
+                cmd, cwd=repo_root, capture_output=True, text=True,
+                check=True).stdout
+        except (OSError, subprocess.CalledProcessError):
+            return None
+        names.update(line.strip() for line in out.splitlines()
+                     if line.strip())
+    return sorted(
+        os.path.join(repo_root, name) for name in names
+        if name.endswith(".py") and name.startswith("mesh_tpu/")
+        and os.path.exists(os.path.join(repo_root, name)))
+
+
+def _check_witness(engine, repo_root, witness_path, human):
+    """Cross-check a runtime lock-witness log; returns 0/1."""
+    from mesh_tpu.analysis.rules.lok import validate_witness
+    from mesh_tpu.utils import lockwitness
+
+    try:
+        witness_edges = lockwitness.load(witness_path)
+    except (OSError, ValueError) as exc:
+        print("mesh-tpu lint: cannot read witness %s: %s"
+              % (witness_path, exc), file=sys.stderr)
+        sys.exit(2)
+    project, _ = engine.build_project(repo_root)
+    result = validate_witness(project, witness_edges)
+    out = sys.stdout if human else sys.stderr
+    print("witness: %d edge(s) checked, %d dynamic-only, %d unknown "
+          "site(s) -> %s"
+          % (result["checked"], len(result["dynamic_only"]),
+             len(result["unknown_sites"]),
+             "OK" if result["ok"] else "FAIL"), file=out)
+    for line in result["problems"]:
+        print("witness: PROBLEM %s" % line, file=out)
+    for line in result["dynamic_only"]:
+        print("witness: note %s" % line, file=out)
+    return 0 if result["ok"] else 1
 
 
 def main():
@@ -884,7 +956,10 @@ def main():
                              "package)")
     p_lint.add_argument("--rules", default=None,
                         help="comma-separated rule-id filter "
-                             "(TRC,RCP,VMEM,LCK,KNB,OBS)")
+                             "(TRC,RCP,VMEM,LCK,KNB,OBS,LOK,PAL)")
+    p_lint.add_argument("--changed", action="store_true",
+                        help="lint only files touched vs git HEAD "
+                             "(plus untracked) — `make lint-fast`")
     p_lint.add_argument("--baseline", default=None,
                         help="baseline file (default: "
                              "tools/meshlint_baseline.json)")
@@ -897,7 +972,17 @@ def main():
                              "exit 0")
     p_lint.add_argument("--json", action="store_true",
                         help="machine-readable report (the perf-gate "
-                             "harvester consumes this)")
+                             "harvester consumes this); alias for "
+                             "--format json")
+    p_lint.add_argument("--format", default=None,
+                        choices=("human", "json", "sarif"),
+                        help="output format (default human; sarif for "
+                             "code-scanning UIs)")
+    p_lint.add_argument("--witness", default=None, metavar="FILE",
+                        help="cross-check a MESH_TPU_LOCK_WITNESS "
+                             "JSONL log against the static lock graph "
+                             "and doc/concurrency.md (rc 1 on "
+                             "contradiction)")
     p_lint.add_argument("-v", "--verbose", action="store_true",
                         help="also list baseline-suppressed findings")
     p_lint.set_defaults(func=cmd_lint)

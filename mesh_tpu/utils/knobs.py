@@ -189,6 +189,18 @@ LEDGER_TAIL = _declare(
     "MESH_TPU_LEDGER_TAIL", "int", 32,
     "How many newest ledger records ride along in each flight-recorder "
     "incident dump (min 1).", "Observability")
+LOCK_WITNESS = _declare(
+    "MESH_TPU_LOCK_WITNESS", "flag", False,
+    "Wrap every threading.Lock/RLock/Condition created by mesh_tpu "
+    "modules to record real lock-acquisition orders, keyed by creation "
+    "site; cross-check the log with `mesh-tpu lint --witness <file>` "
+    "(doc/concurrency.md). Must be set before the first import.",
+    "Observability")
+LOCK_WITNESS_FILE = _declare(
+    "MESH_TPU_LOCK_WITNESS_FILE", "path", "~/.mesh_tpu/lock_witness.jsonl",
+    "Where the lock witness dumps its acquisition-order log (JSONL, "
+    "written at process exit and by tests that flush explicitly).",
+    "Observability")
 
 # -- serving ---------------------------------------------------------------
 

@@ -1,0 +1,268 @@
+"""The runtime lock witness (mesh_tpu/utils/lockwitness.py) and its
+cross-check against the static LOK graph.
+
+Unit tests drive the wrapper and the shadow-stack state directly —
+no global factory patching, so they cannot perturb other tests.  The
+slow-marked hammer is the end-to-end loop the ISSUE asks for: a
+subprocess imports mesh_tpu with ``MESH_TPU_LOCK_WITNESS=1``, drives
+store ingest, the page cache, the accel build cache, and the ledger
+writers from 8 threads, dumps the witnessed acquisition orders, and
+``mesh-tpu lint --witness`` validates the dynamic log against the
+static graph and doc/concurrency.md.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+import pytest
+
+from mesh_tpu.analysis import engine
+from mesh_tpu.analysis.rules.lok import validate_witness
+from mesh_tpu.utils import lockwitness
+from mesh_tpu.utils.lockwitness import _WitnessedLock
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    lockwitness.reset()
+    yield
+    lockwitness.reset()
+
+
+def _wrapped(site, factory=threading.Lock):
+    return _WitnessedLock(factory(), site)
+
+
+def test_nested_acquire_records_one_edge_per_held_lock():
+    a = _wrapped("mesh_tpu/a.py:1")
+    b = _wrapped("mesh_tpu/b.py:2")
+    c = _wrapped("mesh_tpu/c.py:3")
+    with a:
+        with b:
+            with c:
+                pass
+    edges = lockwitness.edges()
+    assert edges == {
+        ("mesh_tpu/a.py:1", "mesh_tpu/b.py:2"): 1,
+        ("mesh_tpu/a.py:1", "mesh_tpu/c.py:3"): 1,
+        ("mesh_tpu/b.py:2", "mesh_tpu/c.py:3"): 1,
+    }
+    # counts accumulate; disjoint acquisitions add no edges
+    with a:
+        with b:
+            pass
+    with c:
+        pass
+    edges = lockwitness.edges()
+    assert edges[("mesh_tpu/a.py:1", "mesh_tpu/b.py:2")] == 2
+    assert len(edges) == 3
+
+
+def test_reentrant_reacquire_is_not_an_ordering_fact():
+    a = _wrapped("mesh_tpu/a.py:1", threading.RLock)
+    b = _wrapped("mesh_tpu/b.py:2")
+    with a:
+        with b:
+            with a:          # re-entrant: must NOT record b -> a
+                pass
+    assert lockwitness.edges() == {
+        ("mesh_tpu/a.py:1", "mesh_tpu/b.py:2"): 1}
+    # the shadow stack survived the nested release
+    with a:
+        with b:
+            pass
+    assert lockwitness.edges()[
+        ("mesh_tpu/a.py:1", "mesh_tpu/b.py:2")] == 2
+
+
+def test_edges_are_per_thread():
+    a = _wrapped("mesh_tpu/a.py:1")
+    b = _wrapped("mesh_tpu/b.py:2")
+
+    def other():
+        with b:
+            pass
+
+    with a:
+        t = threading.Thread(target=other)
+        t.start()
+        t.join()
+    # thread 2 held nothing of its own: no a -> b edge
+    assert lockwitness.edges() == {}
+
+
+def test_condition_protocol_passthrough():
+    lock = _WitnessedLock(threading.RLock(), "mesh_tpu/a.py:1")
+    cond = threading.Condition(lock)
+    with cond:
+        cond.notify_all()    # requires a working _is_owned
+    assert lockwitness.edges() == {}
+
+
+def test_dump_load_roundtrip(tmp_path):
+    a = _wrapped("mesh_tpu/a.py:1")
+    b = _wrapped("mesh_tpu/b.py:2")
+    with a:
+        with b:
+            pass
+    path = str(tmp_path / "wit.jsonl")
+    lockwitness.dump(path)
+    assert lockwitness.load(path) == [
+        (("mesh_tpu/a.py", 1), ("mesh_tpu/b.py", 2), 1)]
+    # site lines survive too (single-lock runs still prove coverage)
+    with open(path, encoding="utf-8") as fh:
+        records = [json.loads(line) for line in fh]
+    assert {"site": ["mesh_tpu/a.py", 1]} in records
+
+
+# -- validate_witness against a synthetic project ----------------------
+
+def _project(tmp_path, doc=None):
+    pkg = tmp_path / "mesh_tpu" / "store"
+    pkg.mkdir(parents=True)
+    (pkg / "a.py").write_text(textwrap.dedent("""\
+        import threading
+        A_LOCK = threading.Lock()
+        B_LOCK = threading.Lock()
+
+        def f():
+            with A_LOCK:
+                with B_LOCK:
+                    pass
+        """))
+    if doc is not None:
+        (tmp_path / "doc").mkdir()
+        (tmp_path / "doc" / "concurrency.md").write_text(doc)
+    project, failures = engine.build_project(str(tmp_path))
+    assert not failures
+    return project
+
+
+def test_witness_edge_matching_static_graph_validates(tmp_path):
+    project = _project(tmp_path)
+    result = validate_witness(project, [
+        (("mesh_tpu/store/a.py", 2), ("mesh_tpu/store/a.py", 3), 5)])
+    assert result["ok"]
+    assert result["checked"] == 1
+    assert result["dynamic_only"] == []    # static analysis saw it too
+
+
+def test_witness_reversed_edge_closes_a_cycle(tmp_path):
+    project = _project(tmp_path)
+    result = validate_witness(project, [
+        (("mesh_tpu/store/a.py", 3), ("mesh_tpu/store/a.py", 2), 1)])
+    assert not result["ok"]
+    assert any("cycle" in p for p in result["problems"])
+    assert result["dynamic_only"]          # the AST never saw B -> A
+
+
+def test_witness_edge_contradicting_declared_order(tmp_path):
+    project = _project(tmp_path, doc=textwrap.dedent("""\
+        # Canonical lock order
+        1. `mesh_tpu/store/a.py:B_LOCK`
+        2. `mesh_tpu/store/a.py:A_LOCK`
+        """))
+    result = validate_witness(project, [
+        (("mesh_tpu/store/a.py", 2), ("mesh_tpu/store/a.py", 3), 1)])
+    assert not result["ok"]
+    assert any("canonical order" in p for p in result["problems"])
+
+
+def test_witness_unknown_sites_are_reported_not_fatal(tmp_path):
+    project = _project(tmp_path)
+    result = validate_witness(project, [
+        (("somewhere/else.py", 9), ("mesh_tpu/store/a.py", 2), 1)])
+    assert result["ok"]
+    assert result["checked"] == 0
+    assert result["unknown_sites"] == ["somewhere/else.py:9"]
+
+
+# -- the end-to-end hammer ---------------------------------------------
+
+_HAMMER = """
+import os, sys, tempfile, threading
+import numpy as np
+
+import mesh_tpu
+from mesh_tpu.utils import lockwitness
+assert lockwitness.installed(), "witness knob did not install"
+
+from mesh_tpu.accel.build import get_index
+from mesh_tpu.obs.ledger import get_ledger
+from mesh_tpu.store import pages
+from mesh_tpu.store.store import MeshStore
+
+tmp = tempfile.mkdtemp(prefix="witness_hammer_")
+store = MeshStore(os.path.join(tmp, "store"))
+
+def mesh(seed):
+    rng = np.random.default_rng(seed)
+    v = rng.standard_normal((12, 3)).astype(np.float32)
+    f = np.array([[0, 1, 2], [3, 4, 5], [6, 7, 8], [9, 10, 11]],
+                 dtype=np.int32)
+    return v, f
+
+errors = []
+barrier = threading.Barrier(8)
+
+def worker(tid):
+    try:
+        barrier.wait(timeout=30)
+        ledger = get_ledger()
+        for i in range(6):
+            v, f = mesh(100 + (tid * 6 + i) % 9)   # overlap -> dedupe races
+            store.ingest(v, f)                     # store locks
+            get_index(v, f, kind="bvh")            # accel build cache lock
+            pages.get_page_cache()                 # page-cache locks
+            pages.clear_page_cache()
+            rec = ledger.open(backend="hammer")    # ledger + registry locks
+            ledger.close(rec)
+    except Exception as exc:                       # pragma: no cover
+        errors.append("t%d: %r" % (tid, exc))
+
+threads = [threading.Thread(target=worker, args=(t,)) for t in range(8)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join(timeout=120)
+assert not errors, errors
+path = lockwitness.dump(sys.argv[1])
+print("witness edges:", len(lockwitness.edges()))
+"""
+
+
+@pytest.mark.slow
+def test_hammer_witnessed_orders_validate_against_static_graph(tmp_path):
+    witness_path = str(tmp_path / "witness.jsonl")
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "MESH_TPU_LOCK_WITNESS": "1",
+        "MESH_TPU_LOCK_WITNESS_FILE": witness_path,
+        "MESH_TPU_OBS": "1",
+        "MESH_TPU_LEDGER": "1",
+    })
+    proc = subprocess.run(
+        [sys.executable, "-c", _HAMMER, witness_path],
+        cwd=_REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    witnessed = lockwitness.load(witness_path)
+    assert witnessed, "8 threads over 4 subsystems recorded no orders"
+
+    # the closing of the loop: the dynamic log validates against the
+    # static graph + doc/concurrency.md of the real tree
+    proc = subprocess.run(
+        [sys.executable, "-m", "mesh_tpu.cli", "lint", "--witness",
+         witness_path],
+        cwd=_REPO, env={k: v for k, v in env.items()
+                        if not k.startswith("MESH_TPU_LOCK")},
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "witness:" in proc.stdout and "-> OK" in proc.stdout

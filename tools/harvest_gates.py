@@ -101,6 +101,21 @@ def harvest(logdir):
     return out
 
 
+def _lint_family_suffix(rec):
+    """Per-family breakdown for the whole-program rule packs (LOK =
+    lock order, PAL = Pallas DMA) — the families whose findings mean a
+    deadlock or a chip hang rather than hygiene, so the gate row names
+    them explicitly."""
+    parts = []
+    for fam in ("LOK", "PAL"):
+        new = sum(1 for f in (rec.get("findings") or [])
+                  if str(f.get("rule", "")).startswith(fam))
+        kept = sum(1 for f in (rec.get("suppressed") or [])
+                   if str(f.get("rule", "")).startswith(fam))
+        parts.append("%s %d new/%d baselined" % (fam, new, kept))
+    return "; " + ", ".join(parts)
+
+
 def render_table(h):
     """The human-readable summary (also what lands in BASELINE.md)."""
     lines = []
@@ -117,17 +132,19 @@ def render_table(h):
         elif rec.get("rc") or counts.get("new"):
             lines.append(
                 "gate 0 (meshlint, %s): NOT AN IMPROVEMENT — %s new "
-                "static-analysis finding(s); fix or baseline them "
+                "static-analysis finding(s)%s; fix or baseline them "
                 "(tools/meshlint_baseline.json) before quoting numbers"
-                % (h["lint"]["mtime_utc"], counts.get("new", "?")))
+                % (h["lint"]["mtime_utc"], counts.get("new", "?"),
+                   _lint_family_suffix(rec)))
         else:
             lines.append(
                 "gate 0 (meshlint, %s): OK — 0 new findings over %s "
-                "file(s) (%s baselined, %s stale)" % (
+                "file(s) (%s baselined, %s stale%s)" % (
                     h["lint"]["mtime_utc"],
                     rec.get("files_scanned", "?"),
                     counts.get("suppressed", 0),
-                    counts.get("stale_baseline", 0)))
+                    counts.get("stale_baseline", 0),
+                    _lint_family_suffix(rec)))
     if h["gate1"]:
         lines.append("gate 1 (compiled kernels, %s): %s" % (
             h["gate1"]["mtime_utc"], h["gate1"]["summary"]))
