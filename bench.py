@@ -603,6 +603,66 @@ def prof_overhead(rounds=5, clients=2, requests_per_client=32,
     return record
 
 
+def tuner_overhead(rounds=5, sweeps_per_round=3):
+    """Cost of the tuned-knob layer on the steady-state dispatch sweep:
+    per-call latency with MESH_TPU_TUNER=0 (every ``tuning.get`` is the
+    kill-switch default lookup) vs the default enabled layer (pin check
+    + tuned-value read on every consult).  Same interleaved
+    min-of-rounds shape as the obs/recorder guards;
+    tests/test_bench_guard.py pins ``overhead_frac`` < 0.05 — the bound
+    that keeps "the tuner costs nothing until it acts" honest.
+    """
+    from mesh_tpu import Mesh
+    from mesh_tpu.sphere import _icosphere
+    from mesh_tpu.utils import tuning
+
+    rng = np.random.RandomState(0)
+    v, f = _icosphere(2)
+    mesh = Mesh(v=v, f=f)
+    query_sets = [
+        np.asarray(rng.randn(q, 3) * 0.4, np.float32) for q in _DISPATCH_QS
+    ]
+
+    def sweep():
+        for q in query_sets:
+            mesh.closest_faces_and_points(q)
+
+    def timed():
+        t0 = time.perf_counter()
+        for _ in range(sweeps_per_round):
+            sweep()
+        return (time.perf_counter() - t0) / (
+            sweeps_per_round * len(query_sets))
+
+    prev = os.environ.pop("MESH_TPU_TUNER", None)
+    try:
+        sweep()                              # warm-up: compile every plan
+        os.environ["MESH_TPU_TUNER"] = "0"
+        sweep()                              # warm both code paths
+        off_best, on_best = np.inf, np.inf
+        for _ in range(rounds):
+            os.environ["MESH_TPU_TUNER"] = "0"
+            off_best = min(off_best, timed())
+            os.environ.pop("MESH_TPU_TUNER", None)
+            on_best = min(on_best, timed())
+    finally:
+        if prev is None:
+            os.environ.pop("MESH_TPU_TUNER", None)
+        else:
+            os.environ["MESH_TPU_TUNER"] = prev
+    overhead = max(0.0, (on_best - off_best) / off_best) if off_best else None
+    return {
+        "metric": "tuner_overhead_small_q",
+        "value": round(overhead, 4) if overhead is not None else None,
+        "unit": "overhead_frac",
+        "vs_baseline": None,
+        "off_ms_per_call": round(off_best * 1e3, 3),
+        "on_ms_per_call": round(on_best * 1e3, 3),
+        "overhead_frac": round(overhead, 4) if overhead is not None else None,
+        "generation": tuning.generation(),
+    }
+
+
 def fit_step_latency(repeats=10, n_scan=256):
     """Forward / backward / re-correspondence latency of one scan-fit
     step on the differentiable point-to-surface loss (doc/differentiable.md).
@@ -1314,6 +1374,127 @@ def store_cold_start_stage(n_rep=2):
         shutil.rmtree(tmp_root, ignore_errors=True)
 
 
+def tuner_convergence_stage():
+    """Stage ``tuner_convergence``: the closed-loop controller's
+    chip-free metric.  Drives a real TunerController + tuning layer
+    through a scripted load profile entirely under a fake clock — a
+    fast-burn spike (latency mode must pre-trip the ladder), a long
+    low-burn phase with steady traffic (throughput mode must widen the
+    coalescing window step-by-step to its bound, each widen confirmed
+    by its shadow A/B hold-out), and one mid-flight regression window
+    (the guard must auto-revert exactly once) — then reports
+    STEPS-TO-CONVERGE plus the steady-state knob values.
+
+    Everything is deterministic: fake clock, synthetic histogram
+    observations, scripted burn rates.  The knob-trajectory checksum
+    therefore identifies the controller's *decision sequence*; perfcheck
+    grades steps-to-converge against benchmarks/tuner_golden.json with
+    an upward band and fails hard on checksum drift (a different
+    trajectory is a changed policy, not noise).
+    """
+    from mesh_tpu.obs.controller import LATENCY_METRIC, TunerController
+    from mesh_tpu.obs.metrics import Registry
+    from mesh_tpu.obs.recorder import FlightRecorder
+    from mesh_tpu.obs.series import WindowedSeries
+    from mesh_tpu.utils import tuning
+
+    tuning.reset()
+    t = [0.0]
+    clock = lambda: t[0]                 # noqa: E731 — fake clock
+    registry = Registry()
+    hist = registry.histogram(LATENCY_METRIC,
+                              "synthetic serve latency (bench tuner stage)")
+    series = WindowedSeries(registry=registry, resolution_s=1.0,
+                            capacity=4096, clock=clock)
+    recorder = FlightRecorder(capacity=4096, registry=registry, clock=clock)
+
+    class _ScriptedMonitor(object):
+        pressure = 1.2                   # fast-burn spike first
+
+        def burn_rates(self, now=None):
+            return [{"objective": "latency", "tenant": "bench",
+                     "rule": "fast_burn", "factor": 14.4,
+                     "long_burn": self.pressure * 14.4,
+                     "short_burn": self.pressure * 14.4,
+                     "pressure": self.pressure}]
+
+    monitor = _ScriptedMonitor()
+    ctrl = TunerController(series=series, monitor=monitor,
+                           registry=registry, recorder=recorder,
+                           clock=clock, ab_tol=0.2, holdout_s=30.0)
+    knob_order = [tun.name for tun in tuning.tunables()]
+    hi = tuning.lookup("coalesce_window_ms").hi
+    step_s = 15.0
+    max_steps = 400
+    degrade_steps = 0        # >0: feed regressed latency (forces a revert)
+    reverted_once = False
+    last_action_step = 0
+    n_actions = 0
+    checksum = 0.0
+    for step in range(1, max_steps + 1):
+        t[0] += step_s
+        if step == 5:
+            monitor.pressure = 0.0       # spike over: throughput phase
+        latency_s = 0.5 if degrade_steps > 0 else 0.01
+        degrade_steps = max(0, degrade_steps - 1)
+        for _ in range(8):
+            hist.observe(latency_s, tenant="bench")
+        series.tick(now=t[0])
+        result = ctrl.step(now=t[0])
+        for event in result["actions"]:
+            n_actions += 1
+            after = float(event["after"] or 0)
+            checksum += (n_actions
+                         * (knob_order.index(event["knob"]) + 1)
+                         * (1.0 + abs(after)))
+            last_action_step = step
+            if (not reverted_once and event["action"] == "set"
+                    and event["knob"] == "coalesce_window_ms"
+                    and after >= 3.0):
+                # regress the next hold-out window exactly once: the
+                # guard must catch it and revert
+                degrade_steps = 3
+                reverted_once = True
+        if result["actions"]:
+            quiet = 0
+        else:
+            quiet = step - last_action_step
+        if tuning.get("coalesce_window_ms") >= hi and quiet >= 3:
+            break
+    else:
+        raise RuntimeError(
+            "tuner failed to converge within %d steps (coalesce=%s, "
+            "last action at step %d) — the control policy is unstable"
+            % (max_steps, tuning.get("coalesce_window_ms"),
+               last_action_step))
+
+    ab = registry.get("mesh_tpu_tuner_ab_total")
+    confirmed = int(ab.value(knob="coalesce_window_ms",
+                             verdict="confirmed")) if ab else 0
+    reverted = int(ab.value(knob="coalesce_window_ms",
+                            verdict="reverted")) if ab else 0
+    if reverted != 1:
+        raise RuntimeError(
+            "scripted regression window produced %d auto-revert(s) "
+            "(need exactly 1) — the shadow A/B guard is broken"
+            % reverted)
+    steady = {name: tuning.get(name) for name in knob_order}
+    record = {
+        "metric": "tuner_convergence_steps",
+        "value": last_action_step,
+        "unit": "steps",
+        "vs_baseline": None,
+        "actions": n_actions,
+        "ab_confirmed": confirmed,
+        "ab_reverted": reverted,
+        "steady_state": steady,
+        "knob_changes": len(tuning.history_tail(64)),
+        "checksum": round(checksum, 4),
+    }
+    tuning.reset()
+    return record
+
+
 #: declarative stage table: name -> (fn, default timeout_s,
 #: requires_backend, gate, extra child env).  Budgets bound a WEDGE —
 #: they are not measurements; override one with
@@ -1352,6 +1533,19 @@ _STAGE_DEFS = OrderedDict((
     ("store_cold_start", (store_cold_start_stage, 420.0, False, False,
                           {"JAX_PLATFORMS": "cpu",
                            "PALLAS_AXON_POOL_IPS": ""})),
+    # chip-free and fully fake-clocked: no device, no sleeps.  The env
+    # pins the tuner ON and clears every knob pin so the scripted
+    # scenario owns the whole tunable layer regardless of the caller's
+    # environment (a pinned knob would legitimately refuse to move and
+    # fail convergence).
+    ("tuner_convergence", (tuner_convergence_stage, 120.0, False, False,
+                           {"JAX_PLATFORMS": "cpu",
+                            "PALLAS_AXON_POOL_IPS": "",
+                            "MESH_TPU_TUNER": "1",
+                            "MESH_TPU_COALESCE_WINDOW_MS": "",
+                            "MESH_TPU_ACCEL_MIN_FACES": "",
+                            "MESH_TPU_BVH_STREAM_BUFFERS": "",
+                            "MESH_TPU_SERVE_LADDER": ""})),
 ))
 
 
@@ -1460,6 +1654,9 @@ def run_staged(names=None):
     store_res = results.get("store_cold_start")
     if store_res is not None and store_res.ok:
         record["store"] = store_res.record
+    tuner_res = results.get("tuner_convergence")
+    if tuner_res is not None and tuner_res.ok:
+        record["tuner"] = tuner_res.record
     record["stages"] = OrderedDict(
         (n, r.to_json()) for n, r in results.items())
     record["bench_partial"] = partial_path
@@ -1491,7 +1688,8 @@ def main():
         return
     legacy = [flag for flag in (
         "--dispatch-latency", "--obs-overhead", "--recorder-overhead",
-        "--prof-overhead", "--fit-step", "--serve-load") if flag in argv]
+        "--prof-overhead", "--tuner-overhead", "--fit-step",
+        "--serve-load") if flag in argv]
     if legacy:
         # pre-staging single-mode flows, kept in-process: their guard
         # tests monkeypatch backend_responsive and time the sweeps with
@@ -1508,6 +1706,8 @@ def main():
                 ("--recorder-overhead", "recorder_overhead_small_q",
                  "overhead_frac"),
                 ("--prof-overhead", "prof_overhead_closed_loop",
+                 "overhead_frac"),
+                ("--tuner-overhead", "tuner_overhead_small_q",
                  "overhead_frac"),
                 ("--fit-step", "fit_step_latency", "ms/call"),
                 ("--serve-load", "serve_load_closed_loop", "p99_ms"),
@@ -1531,6 +1731,8 @@ def main():
             print(json.dumps(_with_obs(recorder_overhead())))
         elif "--prof-overhead" in argv:
             print(json.dumps(_with_obs(prof_overhead())))
+        elif "--tuner-overhead" in argv:
+            print(json.dumps(_with_obs(tuner_overhead())))
         elif "--fit-step" in argv:
             print(json.dumps(_with_obs(fit_step_latency())))
         elif "--serve-load" in argv:

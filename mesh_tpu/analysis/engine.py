@@ -101,6 +101,7 @@ class FileContext(object):
         self.tree = tree
         self._lines = None
         self._parents = None
+        self._nodes = None
 
     def line(self, lineno):
         """1-based source line (stripped), for messages."""
@@ -113,12 +114,30 @@ class FileContext(object):
     def parents(self):
         """{node: parent} over the whole tree, built once per file."""
         if self._parents is None:
-            parents = {}
-            for node in ast.walk(self.tree):
-                for child in ast.iter_child_nodes(node):
-                    parents[child] = node
-            self._parents = parents
+            self._build_maps()
         return self._parents
+
+    def nodes(self):
+        """Flat list of every AST node (module first, breadth-first),
+        built once per file in the same pass as the parent map.  Rules
+        iterate this instead of re-walking the tree with ``ast.walk`` —
+        eight rules each re-walking ~140k nodes per run is what pushed
+        the whole-tree lint toward its <3s budget."""
+        if self._nodes is None:
+            self._build_maps()
+        return self._nodes
+
+    def _build_maps(self):
+        parents = {}
+        nodes = [self.tree]
+        # iterating while appending gives the same breadth-first order
+        # as ast.walk, in one pass for both maps
+        for node in nodes:
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+                nodes.append(child)
+        self._parents = parents
+        self._nodes = nodes
 
     def finding(self, rule, severity, node, message, hint=None):
         """Convenience constructor anchored at an AST node."""

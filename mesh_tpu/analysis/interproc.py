@@ -274,7 +274,7 @@ class InterGraph(object):
     def _resolve_imports(self, ctx):
         relpath = ctx.relpath
         pkg_parts = module_name_of(relpath).split(".")
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes():
             if isinstance(node, ast.Import):
                 for alias in node.names:
                     target = self._module_relpath(alias.name)

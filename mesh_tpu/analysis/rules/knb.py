@@ -20,6 +20,17 @@ Codes:
 - KNB002 (error): a knob declared in the registry is missing from
   doc/configuration.md — the generated table is stale; rerun
   ``make docs`` / tools/build_docs.py.
+- KNB003 (error): tunable-knob state is written outside
+  utils/tuning.py — a direct assignment / augmented assignment /
+  deletion through a ``tuning`` module alias (``tuning._values[...] =
+  ...``, ``tuning._generation += 1``, monkeypatching ``tuning.get``),
+  or a call into the module's private API (``tuning._emit(...)``).
+  ``tuning.actuate()`` is the SINGLE write path: it is what clamps to
+  the declared bounds, bumps the generation counter, appends the
+  audited history, and emits the ``knob_change`` flight-recorder event
+  + ``mesh_tpu_tuner_*`` series — a side-door write silently skips all
+  four, which is exactly the audit hole the tuner layer exists to
+  close.
 """
 
 import ast
@@ -55,6 +66,36 @@ def _resolve_key(node, consts):
     return None
 
 
+def _tuning_prefixes(tree):
+    """Dotted-name prefixes bound to the tuning module in this file:
+    ``from ..utils import tuning`` -> {"tuning"}, ``import
+    mesh_tpu.utils.tuning as knobs_rt`` -> {"knobs_rt"}, a bare
+    ``import mesh_tpu.utils.tuning`` -> {"mesh_tpu.utils.tuning"}."""
+    prefixes = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "tuning" or alias.name.endswith(".tuning"):
+                    prefixes.add(alias.asname or alias.name)
+        elif isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if alias.name == "tuning":
+                    prefixes.add(alias.asname or alias.name)
+    return prefixes
+
+
+def _tuning_remainder(name, prefixes):
+    """The attribute path under a tuning alias (``"_values"`` for
+    ``tuning._values`` with prefix ``tuning``), or None when ``name``
+    is not rooted at one."""
+    if not name:
+        return None
+    for prefix in prefixes:
+        if name != prefix and name.startswith(prefix + "."):
+            return name[len(prefix) + 1:]
+    return None
+
+
 def _is_store_context(parents, node):
     """True when the Subscript is an assignment/deletion target."""
     parent = parents.get(node)
@@ -73,12 +114,15 @@ class KnobRegistryRule(Rule):
     name = "central env-knob registry enforcement"
 
     def check(self, ctx):
-        if ctx.relpath.replace("\\", "/").endswith("utils/knobs.py"):
+        relpath = ctx.relpath.replace("\\", "/")
+        if relpath.endswith("utils/knobs.py"):
             return []
         findings = []
+        if not relpath.endswith("utils/tuning.py"):
+            findings.extend(self._check_tuning_writes(ctx))
         parents = ctx.parents()
         consts = module_constants(ctx.tree)
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes():
             key_node = None
             if isinstance(node, ast.Call):
                 name = qualname(node.func)
@@ -100,6 +144,56 @@ class KnobRegistryRule(Rule):
                     hint="declare it in mesh_tpu/utils/knobs.py and "
                          "read it via knobs.flag/get_int/get_float/"
                          "get_str/raw"))
+        return findings
+
+    def _check_tuning_writes(self, ctx):
+        """KNB003: the tuning module's state is written, or its private
+        API called, outside utils/tuning.py itself."""
+        if "tuning" not in ctx.source:
+            return []    # no alias can exist without the word appearing
+        prefixes = _tuning_prefixes(ctx.tree)
+        if not prefixes:
+            return []
+        findings = []
+        for node in ctx.nodes():
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            elif isinstance(node, ast.Delete):
+                targets = node.targets
+            elif isinstance(node, ast.Call):
+                remainder = _tuning_remainder(qualname(node.func),
+                                              prefixes)
+                if remainder is not None and remainder.startswith("_"):
+                    findings.append(ctx.finding(
+                        "KNB003", "error", node,
+                        "call into the tuning module's private API "
+                        "(%s) outside utils/tuning.py"
+                        % qualname(node.func),
+                        hint="go through the audited write path: "
+                             "tuning.actuate(name, value, reason=...) "
+                             "clamps, bumps the generation, and emits "
+                             "the knob_change event"))
+                continue
+            for target in targets:
+                # a subscript store (tuning._values["x"] = 5) roots at
+                # the attribute being indexed
+                probe = target.value if isinstance(
+                    target, ast.Subscript) else target
+                remainder = _tuning_remainder(qualname(probe), prefixes)
+                if remainder is None:
+                    continue
+                findings.append(ctx.finding(
+                    "KNB003", "error", node,
+                    "direct write to tuner state (%s) outside "
+                    "utils/tuning.py" % qualname(probe),
+                    hint="tuning.actuate() is the single write path: "
+                         "it clamps to declared bounds, bumps the "
+                         "generation counter, appends the audited "
+                         "history, and emits knob_change + "
+                         "mesh_tpu_tuner_* series"))
         return findings
 
     def finalize(self, project):

@@ -125,7 +125,7 @@ class LockDisciplineRule(Rule):
         lock_names = set(locks)
         # name -> [(node, guarded)]
         sites = {}
-        for node in ast.walk(tree):
+        for node in ctx.nodes():
             name = _mutated_name(node)
             if name not in containers:
                 continue

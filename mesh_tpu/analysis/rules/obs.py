@@ -89,7 +89,7 @@ def documented_names(doc_text):
 def _created_names(ctx):
     """[(name, node)] of series/span names this file can create."""
     out = []
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes():
         if not isinstance(node, ast.Call) or not node.args:
             continue
         func_name = qualname(node.func)
@@ -103,7 +103,7 @@ def _created_names(ctx):
         elif last in _SPAN_FUNCS and first.value.startswith("serve."):
             out.append((first.value, node))
     if ctx.relpath.rsplit("/", 1)[-1] == _BRIDGE_BASENAME:
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes():
             if (isinstance(node, ast.Constant)
                     and isinstance(node.value, str)
                     and _BRIDGE_NAME_RE.match(node.value)):
@@ -129,7 +129,7 @@ def collect_ledger_stages(project):
     stays covered)."""
     stages = {}
     for ctx in project.contexts:
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes():
             if not isinstance(node, ast.Assign):
                 continue
             targets = [t.id for t in node.targets
@@ -159,7 +159,7 @@ class ObservabilityHygieneRule(Rule):
         clock_exempt = any(e in relpath if e.endswith("/")
                            else relpath.endswith(e)
                            for e in _CLOCK_EXEMPT)
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes():
             if not isinstance(node, ast.Call):
                 continue
             func_name = qualname(node.func)

@@ -128,7 +128,7 @@ class PallasDmaRule(Rule):
                         for n in ast.walk(node))]
         for unit in units:
             findings.extend(self._check_unit(ctx, unit))
-        for call in ast.walk(ctx.tree):
+        for call in ctx.nodes():
             if isinstance(call, ast.Call) \
                     and _last(qualname(call.func)) == "pallas_call":
                 findings.extend(self._check_call_site(ctx, call))

@@ -55,7 +55,7 @@ class RecompileHazardRule(Rule):
         findings = []
         parents = ctx.parents()
         consts = module_constants(ctx.tree)
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes():
             if not _is_jit_call(node):
                 continue
             jit_name = qualname(node.func)

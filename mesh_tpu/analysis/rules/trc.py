@@ -62,11 +62,11 @@ class _OneDecorator(object):
         self.decorator_list = decorator_list
 
 
-def _collect_function_defs(tree):
+def _collect_function_defs(nodes):
     """Every def in the module keyed by bare name (nested and methods
     included; last definition wins, which is fine for lint purposes)."""
     defs = {}
-    for node in ast.walk(tree):
+    for node in nodes:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             defs[node.name] = node
     return defs
@@ -103,7 +103,7 @@ def _static_spec(keywords):
     return names, nums
 
 
-def _traced_roots(tree):
+def _traced_roots(nodes):
     """{name: (static_names, static_nums)} of functions that directly
     enter tracing in this module."""
     roots = {}
@@ -116,7 +116,7 @@ def _traced_roots(tree):
             nums |= prev[1]
         roots[name] = (names, nums)
 
-    for node in ast.walk(tree):
+    for node in nodes:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             for deco in node.decorator_list:
                 deco_names = decorator_names(
@@ -150,12 +150,12 @@ def _traced_roots(tree):
     return roots
 
 
-def _traced_functions(tree):
+def _traced_functions(nodes):
     """[(funcdef, direct_root_spec_or_None)] reachable from the traced
     roots by name; the spec is (static_names, static_nums) for direct
     roots and None for transitively reached helpers."""
-    defs = _collect_function_defs(tree)
-    roots = _traced_roots(tree)
+    defs = _collect_function_defs(nodes)
+    roots = _traced_roots(nodes)
     seen = set()
     frontier = [name for name in roots if name in defs]
     while frontier:
@@ -239,7 +239,7 @@ class TracerLeakRule(Rule):
 
     def check(self, ctx):
         findings = []
-        for funcdef, spec in _traced_functions(ctx.tree):
+        for funcdef, spec in _traced_functions(ctx.nodes()):
             params = _param_names(funcdef, spec)
             for node in ast.walk(funcdef):
                 if not isinstance(node, ast.Call):

@@ -104,7 +104,7 @@ class VmemBudgetRule(Rule):
     def check(self, ctx):
         findings = []
         parents = ctx.parents()
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes():
             if not (isinstance(node, ast.Call)
                     and _last_part(qualname(node.func)) == "pallas_call"):
                 continue

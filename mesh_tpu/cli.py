@@ -15,6 +15,8 @@ Subcommands:
     `prof diff` regression attribution (no jax init)
   lint — run the meshlint static analyzer over the package (no jax
     init; gate 0 of tools/run_tpu_gates.sh)
+  tune — inspect the adaptive tuner: `tune status` knob table and
+    `tune history` audited knob_change trail (no jax init)
 
 Examples:
   meshviewer view body.ply
@@ -32,6 +34,8 @@ Examples:
   mesh-tpu prof diff ledger_before.jsonl ledger_after.jsonl
   mesh-tpu lint --json
   mesh-tpu lint --rules VMEM,TRC mesh_tpu/query
+  mesh-tpu tune status
+  mesh-tpu tune history incident-...-slo_fast_burn-001.json
 """
 
 import argparse
@@ -444,6 +448,10 @@ def cmd_perfcheck(args):
         args.store_golden or os.path.join(repo_root, "benchmarks",
                                           "store_golden.json"),
         "store golden")
+    tuner_golden = _load_optional(
+        args.tuner_golden or os.path.join(repo_root, "benchmarks",
+                                          "tuner_golden.json"),
+        "tuner golden")
     rc, lines = perfcheck(doc, baseline=baseline, proxy_golden=golden,
                           proxy_tol=args.proxy_tol,
                           headline_tol=args.headline_tol,
@@ -453,7 +461,9 @@ def cmd_perfcheck(args):
                           stream_golden=stream_golden,
                           stream_tol=args.stream_tol,
                           store_golden=store_golden,
-                          store_tol=args.store_tol)
+                          store_tol=args.store_tol,
+                          tuner_golden=tuner_golden,
+                          tuner_tol=args.tuner_tol)
     if args.json:
         json.dump({"rc": rc, "lines": lines}, sys.stdout, indent=2)
         sys.stdout.write("\n")
@@ -594,6 +604,99 @@ def cmd_prof(args):
         print("prof: %s" % exc, file=sys.stderr)
         sys.exit(2)
     sys.exit(rc)
+
+
+def cmd_tune(args):
+    """Inspect the closed-loop adaptive tuner (doc/observability.md).
+
+    ``tune status`` prints the declared tunables — current effective
+    value, bounds, whether an env pin disables tuning, and the
+    process-wide generation counter.  ``tune history`` prints the
+    audited ``knob_change`` trail: from an incident dump's
+    ``knob_history`` key (schema >= 3) when a file is named or one
+    exists, else from the live process (usually empty in a fresh CLI).
+
+    Import discipline matches serve-stats/prof: json/os plus the
+    stdlib-only mesh_tpu.utils.tuning — no jax, no backend init; this
+    is what you run mid-incident to answer "what did the tuner do?".
+    Exit codes: 0 ok, 2 unreadable input.
+    """
+    import json
+
+    from mesh_tpu.utils import tuning
+
+    if args.tune_command == "status":
+        status = tuning.status()
+        if args.json:
+            json.dump(status, sys.stdout, indent=2, sort_keys=True)
+            sys.stdout.write("\n")
+            return
+        print("tuner: %s (generation %d)"
+              % ("enabled" if status["enabled"] else
+                 "DISABLED (MESH_TPU_TUNER=0)", status["generation"]))
+        for row in status["knobs"]:
+            state = ("pinned by %s" % row["pin_env"] if row["pinned"]
+                     else ("tuned" if row["tuned"] else "default"))
+            print("  %-20s %-8s [%s..%s step %s]  %s"
+                  % (row["knob"], row["value"], row["lo"], row["hi"],
+                     row["step"], state))
+        return
+
+    # history — prefer on-disk incident evidence over the (usually
+    # empty) live ring of a fresh CLI process
+    events = None
+    source = None
+    if args.source:
+        path = (args.source if os.path.sep in args.source
+                else os.path.join(_incident_dir(args), args.source))
+        try:
+            with open(path) as fh:
+                incident = json.load(fh)
+        except (OSError, ValueError) as exc:
+            print("tune: %s is unreadable: %s" % (path, exc),
+                  file=sys.stderr)
+            sys.exit(2)
+        events = incident.get("knob_history") or []
+        source = path
+    else:
+        directory = _incident_dir(args)
+        try:
+            names = sorted(
+                n for n in os.listdir(directory)
+                if n.startswith("incident-") and n.endswith(".json"))
+        except OSError:
+            names = []
+        for name in reversed(names):    # newest incident first
+            try:
+                with open(os.path.join(directory, name)) as fh:
+                    incident = json.load(fh)
+            except (OSError, ValueError):
+                continue
+            if incident.get("knob_history"):
+                events = incident["knob_history"]
+                source = os.path.join(directory, name)
+                break
+        if events is None:
+            events = tuning.history_tail()
+            source = "live process"
+    if args.json:
+        json.dump({"source": source, "events": events}, sys.stdout,
+                  indent=2, sort_keys=True)
+        sys.stdout.write("\n")
+        return
+    print("tune history (%s)" % source)
+    if not events:
+        print("  no knob changes recorded (tuner idle, disabled, or "
+              "every knob env-pinned)")
+        return
+    for event in events:
+        evidence = event.get("evidence") or {}
+        tag = " ".join("%s=%s" % kv for kv in sorted(evidence.items()))
+        print("  [gen %s] t=%s %s %s %s -> %s  (%s)%s"
+              % (event.get("generation"), event.get("t"),
+                 event.get("knob"), event.get("action"),
+                 event.get("before"), event.get("after"),
+                 event.get("reason"), ("  " + tag) if tag else ""))
 
 
 def cmd_lint(args):
@@ -870,6 +973,14 @@ def main():
                              "0.6: disk + interpreter timing is noisy; "
                              "the band catches the side-car path losing "
                              "to rebuild)")
+    p_perf.add_argument("--tuner-golden", default=None,
+                        help="tuner convergence golden record (default: "
+                             "repo benchmarks/tuner_golden.json)")
+    p_perf.add_argument("--tuner-tol", type=float, default=0.25,
+                        help="allowed fractional growth of the tuner's "
+                             "steps-to-converge vs the golden (default "
+                             "0.25; the knob-trajectory checksum must "
+                             "match exactly regardless)")
     p_perf.add_argument("--json", action="store_true",
                         help="machine-readable {rc, lines} instead of the "
                              "summary")
@@ -947,6 +1058,34 @@ def main():
     p_pdiff.add_argument("--json", action="store_true",
                          help="machine-readable {rc, lines}")
     p_pdiff.set_defaults(func=cmd_prof)
+
+    p_tune = sub.add_parser(
+        "tune",
+        help="inspect the adaptive tuner: knob status and the audited "
+             "knob_change history (no jax init)")
+    tune_sub = p_tune.add_subparsers(dest="tune_command", required=True)
+    p_tstat = tune_sub.add_parser(
+        "status",
+        help="declared tunables with current value, bounds, pin state, "
+             "and the generation counter")
+    p_tstat.add_argument("--json", action="store_true",
+                         help="the raw status dict instead of the table")
+    p_tstat.set_defaults(func=cmd_tune)
+    p_thist = tune_sub.add_parser(
+        "history",
+        help="audited knob_change trail from an incident dump's "
+             "knob_history (schema >= 3), newest incident by default")
+    p_thist.add_argument("source", nargs="?", default=None,
+                         help="incident file (name in the dir, or a "
+                              "path) to read; omit to use the newest "
+                              "incident carrying knob_history")
+    p_thist.add_argument("--dir", default=None,
+                         help="incident directory (default: "
+                              "MESH_TPU_INCIDENT_DIR or "
+                              "~/.mesh_tpu/incidents)")
+    p_thist.add_argument("--json", action="store_true",
+                         help="machine-readable {source, events}")
+    p_thist.set_defaults(func=cmd_tune)
 
     p_lint = sub.add_parser(
         "lint",

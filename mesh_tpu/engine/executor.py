@@ -36,6 +36,7 @@ import numpy as np
 from ..errors import DeadlineExceeded, EngineShutdown
 from ..obs.clock import monotonic as _now
 from ..obs.trace import span as obs_span
+from ..utils import tuning
 from .stats import STATS
 
 __all__ = ["EngineExecutor", "EngineShutdown", "get_executor", "submit"]
@@ -196,8 +197,25 @@ class EngineExecutor(object):
     def _drain_loop(self):
         while True:
             with self._cond:
-                while (self._held or not self._pending) and not self._shutdown:
-                    self._cond.wait()
+                while True:
+                    while (self._held or not self._pending) \
+                            and not self._shutdown:
+                        self._cond.wait()
+                    if self._shutdown:
+                        break
+                    # tuned coalescing window (utils/tuning.py; 0 —
+                    # the static default — drains immediately): linger
+                    # until the OLDEST pending request has aged
+                    # window_s, so an un-fenced burst rides one
+                    # dispatch.  hold()/shutdown during the linger loop
+                    # back into the predicates above.
+                    window_s = tuning.get("coalesce_window_ms") / 1000.0
+                    if window_s <= 0:
+                        break
+                    wait_s = self._pending[0].t_submit + window_s - _now()
+                    if wait_s <= 0:
+                        break
+                    self._cond.wait(timeout=wait_s)
                 if self._shutdown:
                     # complete what's queued, then exit
                     batch, self._pending = self._pending, []

@@ -147,10 +147,14 @@ export_jsonl = write_jsonl
 
 def reset():
     """Zero every metric series, drop buffered spans, and empty the
-    flight-recorder ring, latency-ledger ring, and windowed-series ring
-    (tests, and the per-run isolation of the CLI subcommands)."""
+    flight-recorder ring, latency-ledger ring, windowed-series ring,
+    and the tuned-knob layer (tests, and the per-run isolation of the
+    CLI subcommands)."""
+    from ..utils import tuning
+
     REGISTRY.reset()
     TRACER.clear()
     RECORDER.clear()
     LEDGER.clear()
     SERIES.clear()
+    tuning.reset()

@@ -388,7 +388,7 @@ def read_bench_json(path):
 def extract_records(doc):
     """Normalize either bench JSON shape into ``{"headline": rec|None,
     "proxy": rec|None, "accel": rec|None, "stream": rec|None,
-    "store": rec|None, "stages": {...}|None}``.
+    "store": rec|None, "tuner": rec|None, "stages": {...}|None}``.
 
     The headline slot is only filled by a FRESH measurement — a
     ``stale: true`` envelope (last-good value republished while the
@@ -400,6 +400,7 @@ def extract_records(doc):
     accel = None
     stream = None
     store = None
+    tuner = None
     stages = None
     if doc.get("kind") == "bench_partial":
         stages = doc.get("stages") or {}
@@ -418,6 +419,9 @@ def extract_records(doc):
         sc = stages.get("store_cold_start") or {}
         if sc.get("status") == "ok":
             store = sc.get("record")
+        tc = stages.get("tuner_convergence") or {}
+        if tc.get("status") == "ok":
+            tuner = tc.get("record")
     else:
         if doc.get("value") is not None and not doc.get("stale"):
             headline = doc
@@ -433,15 +437,20 @@ def extract_records(doc):
         sto = doc.get("store")
         if isinstance(sto, dict) and sto.get("value") is not None:
             store = sto
+        tun = doc.get("tuner")
+        if isinstance(tun, dict) and tun.get("value") is not None:
+            tuner = tun
         stages = doc.get("stages")
     return {"headline": headline, "proxy": proxy, "accel": accel,
-            "stream": stream, "store": store, "stages": stages}
+            "stream": stream, "store": store, "tuner": tuner,
+            "stages": stages}
 
 
 def perfcheck(doc, baseline=None, proxy_golden=None, proxy_tol=0.5,
               headline_tol=0.2, flops_tol=0.25, accel_golden=None,
               accel_tol=0.05, stream_golden=None, stream_tol=0.05,
-              store_golden=None, store_tol=0.6):
+              store_golden=None, store_tol=0.6, tuner_golden=None,
+              tuner_tol=0.25):
     """Compare a bench JSON against the last-good baseline and the
     committed proxy golden.  Returns ``(rc, lines)`` — rc 0 when nothing
     regressed beyond its tolerance band, 1 on regression (including a
@@ -471,6 +480,15 @@ def perfcheck(doc, baseline=None, proxy_golden=None, proxy_tol=0.5,
     rebuilding is a broken cold-start contract regardless of what the
     golden said.  Checksum drift is a hard FAIL (the side-car must be
     bit-identical to the built index's answers).
+
+    ``tuner_golden`` grades the tuner_convergence stage: its value is
+    the closed-loop controller's STEPS-TO-CONVERGE on a deterministic
+    fake-clock scenario — smaller is better, so this band fails in the
+    *upward* direction (``> golden * (1 + tuner_tol)``: the control
+    policy got slower to settle).  The knob-trajectory checksum is
+    deterministic (fake clock, synthetic load) and drift is a hard
+    FAIL — a changed checksum means the controller made *different
+    decisions*, which no steps tolerance can excuse.
     """
     lines = []
     rc = 0
@@ -558,6 +576,46 @@ def perfcheck(doc, baseline=None, proxy_golden=None, proxy_tol=0.5,
     elif cand_store is not None:
         lines.append("note: store record present but no golden to "
                      "compare against (record one: make store-golden)")
+
+    tuner_gold = None
+    if tuner_golden:
+        tuner_gold = (extract_records(tuner_golden)["tuner"]
+                      or (tuner_golden
+                          if tuner_golden.get("value") is not None
+                          else None))
+    cand_tuner = recs["tuner"]
+    if tuner_gold is not None:
+        if cand_tuner is None:
+            rc = 1
+            lines.append(
+                "FAIL tuner: candidate carries no tuner_convergence "
+                "record (a golden exists — the chip-free controller "
+                "metric must always be fresh)")
+        else:
+            # smaller-is-better: steps-to-converge fails upward
+            ceil = tuner_gold["value"] * (1.0 + tuner_tol)
+            verdict = "ok" if cand_tuner["value"] <= ceil else "FAIL"
+            if verdict == "FAIL":
+                rc = 1
+            lines.append(
+                "%s tuner steps-to-converge: %d vs golden %d "
+                "(ceiling %.1f, tol %.0f%%)"
+                % (verdict, cand_tuner["value"], tuner_gold["value"],
+                   ceil, 100 * tuner_tol))
+            cand_sum = cand_tuner.get("checksum")
+            gold_sum = tuner_gold.get("checksum")
+            if cand_sum is not None and gold_sum is not None:
+                same = abs(cand_sum - gold_sum) <= 1e-6 * max(
+                    1.0, abs(gold_sum))
+                if not same:
+                    rc = 1
+                lines.append(
+                    "%s tuner trajectory checksum: %.6f vs golden %.6f "
+                    "(exact)" % ("ok" if same else "FAIL", cand_sum,
+                                 gold_sum))
+    elif cand_tuner is not None:
+        lines.append("note: tuner record present but no golden to "
+                     "compare against (record one: make tuner-golden)")
 
     golden_rec = None
     if proxy_golden:

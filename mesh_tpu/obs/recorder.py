@@ -59,7 +59,10 @@ EVENTS_ENV = "MESH_TPU_RECORDER_EVENTS"
 #: incident-file schema version (bump on breaking shape changes).
 #: v2: incidents carry a ``"ledger"`` key — the latency ledger's newest
 #: MESH_TPU_LEDGER_TAIL request records (``mesh-tpu prof top`` reads it).
-SCHEMA_VERSION = 2
+#: v3: incidents carry a ``"knob_history"`` key — the tuning layer's
+#: newest MESH_TPU_KNOB_TAIL ``knob_change`` events (``mesh-tpu tune
+#: history`` reads it: "what did the tuner do during this incident?").
+SCHEMA_VERSION = 3
 
 #: env prefixes captured into each incident (config forensics)
 _ENV_PREFIXES = ("MESH_TPU_", "JAX_", "XLA_")
@@ -244,6 +247,7 @@ class FlightRecorder(object):
             "health": self._health_snapshot(health),
             "engine": self._engine_summary(),
             "ledger": self._ledger_tail(),
+            "knob_history": self._knob_history(),
             "env": {
                 k: v for k, v in sorted(os.environ.items())
                 if k.startswith(_ENV_PREFIXES)
@@ -260,6 +264,18 @@ class FlightRecorder(object):
             from .ledger import get_ledger
 
             return get_ledger().tail()
+        except Exception:
+            return []
+
+    @staticmethod
+    def _knob_history():
+        """The tuning layer's newest knob_change events (schema v3) —
+        imported lazily like the ledger tail (tuning never imports
+        recorder at module scope, so no cycle either way)."""
+        try:
+            from ..utils import tuning
+
+            return tuning.history_tail()
         except Exception:
             return []
 

@@ -268,6 +268,41 @@ class WindowedSeries(object):
             windowed.append([bound, cum_new - cum_old])
         return quantile_from_cumulative(windowed, q)
 
+    def _at(self, t):
+        """Newest snapshot at/before ``t`` (None when history starts
+        later than ``t`` or is empty)."""
+        with self._lock:
+            ring = list(self._ring)
+        snap = None
+        for window_t, s in ring:
+            if window_t <= float(t):
+                snap = s
+            else:
+                break
+        return snap
+
+    def window_percentile(self, name, q, start_t, end_t, labels=None):
+        """The q-quantile of histogram ``name`` over the ABSOLUTE window
+        ``[start_t, end_t]`` — unlike :meth:`percentile`, the window end
+        need not be "now", so the shadow A/B guard (obs/controller.py)
+        can read its before-change hold-out window after the fact.  None
+        with no snapshot at/before ``end_t`` or no observations in the
+        window."""
+        last = self._at(end_t)
+        if last is None or name not in last:
+            return None
+        state = _hist_state(last[name], labels)
+        if state is None:
+            return None
+        base = self._at(start_t)
+        base_state = (_hist_state(base[name], labels)
+                      if base is not None and name in base else None)
+        windowed = []
+        for i, (bound, cum_new) in enumerate(state[2]):
+            cum_old = base_state[2][i][1] if base_state is not None else 0
+            windowed.append([bound, cum_new - cum_old])
+        return quantile_from_cumulative(windowed, q)
+
     def stage_breakdown(self, window_s=60.0, now=None,
                         name="mesh_tpu_request_stage_seconds"):
         """Per-(stage, backend) {count, p50_s, p99_s} over the trailing

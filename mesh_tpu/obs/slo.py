@@ -310,6 +310,35 @@ class SLOMonitor(object):
                     pass
         return results
 
+    def burn_rates(self, now=None):
+        """Read-only burn rates per objective/tenant/rule: no gauges,
+        no edge-triggered breach state, no callbacks — the poll the
+        tuner controller (obs/controller.py) steers by.  Each row
+        carries ``pressure`` = max(long, short burn) / rule factor, so
+        1.0 means "breaching right now" and e.g. 0.5 means "fast burn
+        approaching"."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            slos = {s.name: s for s in self.objectives}
+            items = [(key, ring.copy())
+                     for key, ring in self._samples.items()]
+        rows = []
+        for (obj_name, tenant), series in items:
+            slo = slos.get(obj_name)
+            if slo is None or not len(series):
+                continue
+            for rule in self.rules:
+                long_burn = self._burn(series, slo, rule.long_s, now)
+                short_burn = self._burn(series, slo, rule.short_s, now)
+                rows.append({
+                    "objective": obj_name, "tenant": tenant,
+                    "rule": rule.name, "factor": rule.factor,
+                    "long_burn": long_burn, "short_burn": short_burn,
+                    "pressure": (max(long_burn, short_burn) / rule.factor
+                                 if rule.factor else 0.0),
+                })
+        return rows
+
     def on_breach(self, callback):
         """Register ``callback(event_dict)`` for NEW breaches."""
         self._callbacks.append(callback)

@@ -55,6 +55,16 @@ _accel_measured = None
 _stream_measured = None
 
 
+def _tuned(name):
+    """Live closed-loop override (utils/tuning.py), consulted between
+    the env hard pin and the measured cache: None when the tuner is
+    off, the knob is env-pinned, or the controller never actuated it —
+    every one of those falls through to the static chain."""
+    from ..utils import tuning
+
+    return tuning.tuned_value(name)
+
+
 def _cache_path():
     from .. import mesh_package_cache_folder
 
@@ -113,6 +123,9 @@ def accel_crossover_faces():
             "ignoring malformed MESH_TPU_ACCEL_MIN_FACES=%r "
             "(want an integer face count)", env,
         )
+    tuned = _tuned("accel_min_faces")
+    if tuned is not None:
+        return int(tuned)
     global _accel_measured
     if _accel_measured is not None:
         return _accel_measured
@@ -158,7 +171,38 @@ def stream_tile_params():
         except (OSError, ValueError, KeyError, TypeError):
             _stream_measured = STREAM_DEFAULT_TILES
     tile_q, tile_f, n_buffers = _stream_measured
+    tuned = _tuned("stream_n_buffers")
+    if tuned is not None:
+        n_buffers = int(tuned)
     return tile_q, tile_f, bvh_stream_buffers(default=n_buffers)
+
+
+def retune_hooks():
+    """Controller-facing retune callables (obs/controller.py background
+    retune): each re-resolves the CHEAP persisted calibration — the
+    side-effect-free read of the calibrate_* cache file — and returns
+    ``(value, evidence)`` for ``tuning.actuate``, or None when nothing
+    was ever measured (publishing the static default would be
+    generation churn for no signal).  The expensive calibrate_* sweeps
+    themselves stay explicit and operator-driven."""
+
+    def _from_file(path_fn, key, floor):
+        try:
+            path = path_fn()
+            with open(path) as fh:
+                value = int(json.load(fh)[key])
+            if value < floor:
+                raise ValueError(value)
+        except Exception:     # includes the jax probe in _cache_path
+            return None
+        return value, {"source": path, "key": key}
+
+    return {
+        "accel_min_faces": lambda: _from_file(
+            _accel_cache_path, "accel_min_faces", 1),
+        "stream_n_buffers": lambda: _from_file(
+            _stream_cache_path, "n_buffers", 2),
+    }
 
 
 def calibrate_stream_tiles(n_faces=262144, n_queries=1024, reps=3,

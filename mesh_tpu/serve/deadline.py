@@ -43,8 +43,8 @@ from ..obs.recorder import get_recorder
 from ..obs.trace import span as obs_span
 
 __all__ = [
-    "Deadline", "Rung", "ServeResult", "default_ladder", "run_with_ladder",
-    "call_with_timeout",
+    "Deadline", "Rung", "ServeResult", "default_ladder",
+    "effective_start_rung", "run_with_ladder", "call_with_timeout",
 ]
 
 #: smallest per-attempt time slice: below this a rung cannot even launch
@@ -316,6 +316,22 @@ def default_ladder():
     if not chosen:
         raise ValueError("MESH_TPU_SERVE_LADDER selected no rungs")
     return chosen
+
+
+def effective_start_rung(degraded, ladder):
+    """Which rung a request starts on: one rung down when serving health
+    is degraded — the top rung is the one the watchdog saw wedge — OR
+    when the tuner pre-tripped the ladder (utils/tuning.py
+    ``serve_pre_trip``: latency mode trading the top rung away while
+    fast burn is still only *approaching*); 0 otherwise, and always 0
+    on a single-rung ladder."""
+    from ..utils import tuning
+
+    if len(ladder) <= 1:
+        return 0
+    if degraded or tuning.get("serve_pre_trip"):
+        return 1
+    return 0
 
 
 # ---------------------------------------------------------------------------

@@ -36,7 +36,8 @@ from ..obs.clock import monotonic, wall
 from ..obs.ledger import bind_current, get_ledger
 from ..obs.recorder import get_recorder
 from ..obs.trace import span as obs_span
-from .deadline import Deadline, default_ladder, run_with_ladder
+from .deadline import (Deadline, default_ladder, effective_start_rung,
+                       run_with_ladder)
 from .health import DEGRADED, DRAINING, HealthMonitor
 
 __all__ = [
@@ -417,11 +418,11 @@ class QueryService(object):
                 req.record.set(store_key=store_key)
         if req.record is not None:
             req.record.set(mesh_source=mesh_source)
-        # degraded: the top rung is the one the watchdog saw wedge — skip
-        # it so degraded traffic stops feeding the wedged path
-        start_rung = (
-            1 if (self.health.state == DEGRADED and len(self.ladder) > 1)
-            else 0)
+        # degraded (the top rung is the one the watchdog saw wedge) or
+        # tuner pre-trip: skip the top rung so this traffic stops
+        # feeding the slow path (serve/deadline.py effective_start_rung)
+        start_rung = effective_start_rung(
+            self.health.state == DEGRADED, self.ladder)
         with obs_span("serve.request", tenant=tenant,
                       mesh_source=mesh_source,
                       q=int(req.points.shape[0] if hasattr(

@@ -125,6 +125,12 @@ BVH_STREAM_VMEM_MB = _declare(
     "kernel's face planes against when picking resident vs streamed "
     "(headroom below the ~16 MiB ceiling for accumulators and Mosaic "
     "overhead).", "Dispatch")
+COALESCE_WINDOW_MS = _declare(
+    "MESH_TPU_COALESCE_WINDOW_MS", "float", None,
+    "Hard pin for the executor's request-coalescing window in "
+    "milliseconds (0 = drain immediately, today's behavior); setting it "
+    "pins the `coalesce_window_ms` tunable and disables tuner actuation "
+    "for it (utils/tuning.py).", "Dispatch")
 NO_XLA_CACHE = _declare(
     "MESH_TPU_NO_XLA_CACHE", "flag", False,
     "Opt out of the persistent XLA compilation cache "
@@ -200,6 +206,29 @@ LOCK_WITNESS_FILE = _declare(
     "MESH_TPU_LOCK_WITNESS_FILE", "path", "~/.mesh_tpu/lock_witness.jsonl",
     "Where the lock witness dumps its acquisition-order log (JSONL, "
     "written at process exit and by tests that flush explicitly).",
+    "Observability")
+TUNER = _declare(
+    "MESH_TPU_TUNER", "flag", True,
+    "Closed-loop tuner kill switch (utils/tuning.py + obs/controller.py): "
+    "unset means the tunable-knob layer is live (the controller still "
+    "only runs when started explicitly); set to 0/false/off to freeze "
+    "every tunable at its static default — bit-identical to the "
+    "pre-tuner behavior.", "Observability")
+TUNER_INTERVAL = _declare(
+    "MESH_TPU_TUNER_INTERVAL", "float", 15.0,
+    "TunerController background evaluation interval in seconds "
+    "(controller.start(); tests drive step() under a fake clock "
+    "instead).", "Observability")
+TUNER_AB_TOL = _declare(
+    "MESH_TPU_TUNER_AB_TOL", "float", 0.2,
+    "Shadow A/B guard tolerance: a knob change whose hold-out window "
+    "p99 regresses past `before * (1 + tol)` is auto-reverted "
+    "(harvest-gates provenance semantics: missing/failed evidence never "
+    "reads as an improvement).", "Observability")
+KNOB_TAIL = _declare(
+    "MESH_TPU_KNOB_TAIL", "int", 8,
+    "How many newest `knob_change` events ride along in each "
+    "flight-recorder incident dump's `knob_history` tail (min 1).",
     "Observability")
 
 # -- serving ---------------------------------------------------------------

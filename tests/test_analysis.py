@@ -637,6 +637,51 @@ def test_knb002_doc_table_coverage(tmp_path):
     assert not run()
 
 
+def test_knb003_tuning_writes_outside_actuate():
+    rule = KnobRegistryRule()
+    findings = _run(rule, """
+        from mesh_tpu.utils import tuning
+
+        def sidestep():
+            tuning._values["coalesce_window_ms"] = 5.0
+            tuning._generation += 1
+            tuning.get = lambda name: 99
+            del tuning._history
+            tuning._emit({"knob": "x"}, 1)
+        """)
+    assert _codes(findings) == ["KNB003"] * 5
+    assert "single write path" in " ".join(
+        f.hint or "" for f in findings)
+    # import alias still resolves
+    findings = _run(rule, """
+        import mesh_tpu.utils.tuning as rt
+
+        rt._values.clear
+        rt._generation = 0
+        """)
+    assert _codes(findings) == ["KNB003"]
+    # negatives: the audited API is fine, reads are fine, and a file
+    # with no tuning import is never scanned
+    assert not _run(rule, """
+        from mesh_tpu.utils import tuning
+
+        def legit():
+            tuning.actuate("coalesce_window_ms", 5.0, reason="test")
+            return tuning.get("coalesce_window_ms"), tuning.status()
+        """)
+    assert not _run(rule, """
+        _values = {}
+
+        def unrelated():
+            _values["x"] = 1
+        """)
+    # the write path itself is exempt
+    assert not check_source(
+        rule,
+        "from . import tuning\ntuning._generation = 1\n",
+        relpath="mesh_tpu/utils/tuning.py")
+
+
 # -- OBS fixtures ------------------------------------------------------
 
 def test_obs001_undocumented_series(tmp_path):

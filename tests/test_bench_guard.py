@@ -247,6 +247,43 @@ def test_prof_overhead_wedged_is_null(monkeypatch):
     assert "synthetic" in rec["error"]
 
 
+def test_tuner_overhead_guard(monkeypatch):
+    """ISSUE-13 acceptance: the tunable-knob reads on the hot path (the
+    executor drain loop consulting the coalescing window, autotune
+    consulting its tuned override) must cost under 5% of steady-state
+    dispatch latency — same bar and interleaved min-of-rounds protocol
+    as the obs/recorder/ledger gates, same one-retry noise policy."""
+    monkeypatch.delenv("MESH_TPU_NO_ENGINE", raising=False)
+    monkeypatch.delenv("MESH_TPU_TUNER", raising=False)
+    rec = bench.tuner_overhead(rounds=5, sweeps_per_round=2)
+    if rec["overhead_frac"] >= 0.05:
+        rec = bench.tuner_overhead(rounds=5, sweeps_per_round=2)
+    assert rec["metric"] == "tuner_overhead_small_q"
+    assert rec["unit"] == "overhead_frac"
+    assert rec["off_ms_per_call"] > 0
+    assert rec["on_ms_per_call"] > 0
+    assert rec["overhead_frac"] == rec["value"]
+    assert rec["overhead_frac"] < 0.05
+    # the kill switch is restored: a guard run must leave the tuner in
+    # its default (on) state
+    assert "MESH_TPU_TUNER" not in os.environ
+
+
+def test_tuner_overhead_wedged_is_null(monkeypatch):
+    monkeypatch.setattr(
+        bench, "backend_responsive", lambda *a, **k: (False, "synthetic")
+    )
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--tuner-overhead"])
+    buf = io.StringIO()
+    with redirect_stdout(buf), pytest.raises(SystemExit) as e:
+        bench.main()
+    rec = json.loads(buf.getvalue())
+    assert e.value.code == 1
+    assert rec["metric"] == "tuner_overhead_small_q"
+    assert rec["value"] is None and "stale" not in rec
+    assert "synthetic" in rec["error"]
+
+
 def test_bench_records_carry_metrics_snapshot(monkeypatch):
     """Every live bench record carries the final metrics-registry
     snapshot under "obs" (satellite f)."""

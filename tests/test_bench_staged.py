@@ -338,6 +338,49 @@ def test_perfcheck_reads_partial_shape():
     assert any(ln.startswith("ok proxy") for ln in lines)
 
 
+_TUNER_GOLDEN = {"metric": "tuner_convergence_steps", "value": 40,
+                 "unit": "steps", "checksum": 123.5}
+
+
+def _tuner_doc(steps, checksum=123.5):
+    return {"metric": "m", "value": None,
+            "tuner": {"metric": "tuner_convergence_steps", "value": steps,
+                      "unit": "steps", "checksum": checksum}}
+
+
+def test_perfcheck_tuner_band_fails_upward():
+    # smaller is better: fewer steps-to-converge never fails...
+    rc, lines = obs_perf.perfcheck(
+        _tuner_doc(30), tuner_golden=_TUNER_GOLDEN)
+    assert rc == 0
+    assert any(ln.startswith("ok tuner steps") for ln in lines)
+    # ...in-band slower is ok (40 * 1.25 = 50)...
+    rc, _ = obs_perf.perfcheck(_tuner_doc(50), tuner_golden=_TUNER_GOLDEN)
+    assert rc == 0
+    # ...past the ceiling the control policy got slower to settle
+    rc, lines = obs_perf.perfcheck(
+        _tuner_doc(51), tuner_golden=_TUNER_GOLDEN)
+    assert rc == 1
+    assert any(ln.startswith("FAIL tuner steps") for ln in lines)
+
+
+def test_perfcheck_tuner_checksum_drift_hard_fails():
+    # the trajectory is fake-clock deterministic: a changed checksum
+    # means different DECISIONS, which no steps tolerance can excuse
+    rc, lines = obs_perf.perfcheck(
+        _tuner_doc(40, checksum=123.6), tuner_golden=_TUNER_GOLDEN)
+    assert rc == 1
+    assert any("FAIL tuner trajectory checksum" in ln for ln in lines)
+
+
+def test_perfcheck_missing_tuner_fails_when_golden_exists():
+    rc, lines = obs_perf.perfcheck(
+        _proxy_doc(900.0), proxy_golden=_GOLDEN,
+        tuner_golden=_TUNER_GOLDEN)
+    assert rc == 1
+    assert any("no tuner_convergence record" in ln for ln in lines)
+
+
 def test_perfcheck_cli_exit_codes(tmp_path):
     """The CLI gate: rc 0 in-band, rc 1 on regression, rc 2 unreadable —
     jax-free, so it must answer even with the platform forced empty."""
@@ -349,17 +392,18 @@ def test_perfcheck_cli_exit_codes(tmp_path):
     bad.write_text(json.dumps(_proxy_doc(100.0)))
 
     def run(path):
-        # --accel-golden/--stream-golden/--store-golden at nonexistent
-        # paths keep the repo's committed goldens from grading these
-        # proxy-only docs (those bands have their own CLI-observable
+        # --accel-golden/--stream-golden/--store-golden/--tuner-golden at
+        # nonexistent paths keep the repo's committed goldens from
+        # grading these proxy-only docs (those bands have their own
         # coverage in tests/test_accel.py, tests/test_accel_stream.py,
-        # and tests/test_store.py)
+        # tests/test_store.py, and the tuner-band tests above)
         return subprocess.run(
             [sys.executable, "-m", "mesh_tpu.cli", "perfcheck", str(path),
              "--proxy-golden", str(golden),
              "--accel-golden", str(tmp_path / "no_accel_golden.json"),
              "--stream-golden", str(tmp_path / "no_stream_golden.json"),
-             "--store-golden", str(tmp_path / "no_store_golden.json")],
+             "--store-golden", str(tmp_path / "no_store_golden.json"),
+             "--tuner-golden", str(tmp_path / "no_tuner_golden.json")],
             capture_output=True, text=True, cwd=_REPO)
 
     ok = run(good)

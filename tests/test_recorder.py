@@ -29,9 +29,11 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 #: every key an incident file must carry (doc/observability.md schema).
 #: schema v2 added "ledger": the latency ledger's newest request records.
+#: schema v3 added "knob_history": the tuner's newest knob-change events.
 _INCIDENT_KEYS = {
     "schema_version", "kind", "reason", "written_utc", "mono_at_dump",
     "context", "ring", "metrics", "health", "engine", "env", "ledger",
+    "knob_history",
 }
 
 
@@ -177,6 +179,29 @@ def test_incident_carries_bounded_ledger_tail(monkeypatch):
     assert [row["tenant"] for row in incident["ledger"]] == ["t3", "t4"]
     assert all("stages" in row and "outcome" in row
                for row in incident["ledger"])
+
+
+def test_incident_carries_bounded_knob_tail(monkeypatch):
+    # schema v3: the newest MESH_TPU_KNOB_TAIL knob-change events ride
+    # along so `mesh-tpu tune history <incident>` can replay what the
+    # tuner did leading up to the dump
+    from mesh_tpu.utils import tuning
+
+    monkeypatch.setenv("MESH_TPU_KNOB_TAIL", "2")
+    monkeypatch.delenv("MESH_TPU_TUNER", raising=False)
+    monkeypatch.delenv("MESH_TPU_COALESCE_WINDOW_MS", raising=False)
+    for step in range(5):
+        tuning.actuate("coalesce_window_ms", float(step + 1),
+                       reason="test_step_%d" % step)
+    rec = FlightRecorder(capacity=8)
+    path = rec.trigger("knob_tail_test")
+    incident = _check_incident(path, "knob_tail_test")
+    assert len(incident["knob_history"]) == 2
+    assert [e["after"] for e in incident["knob_history"]] == [4.0, 5.0]
+    assert all(e["knob"] == "coalesce_window_ms"
+               and e["action"] == "set"
+               and "generation" in e and "reason" in e
+               for e in incident["knob_history"])
 
 
 def test_trigger_rate_limited_and_force_bypasses():
