@@ -1,0 +1,463 @@
+"""Per-function control-flow graphs for meshlint's flow-sensitive rules.
+
+``build_cfg(funcdef)`` lowers one ``ast.FunctionDef`` (or async def /
+lambda-free nested def) into a statement-granularity CFG:
+
+- every simple statement is one node; compound statements contribute a
+  node for their *evaluated head* (the ``if``/``while`` test, the
+  ``for`` iterable, the ``with`` context expression) plus nodes for the
+  statements in their bodies;
+- branch edges carry ``kind`` ("true"/"false") and, when the test is a
+  recognisable None-check (``x is None`` / ``x is not None`` / bare
+  truthiness), an *assumption* ``(expr_key, "none"|"notnone")`` so
+  dataflow clients can prune paths that contradict a guard;
+- loops get back edges ("back"), exit edges ("loop-exit"), and
+  ``break``/``continue`` edges routed through every intervening
+  ``finally`` body;
+- ``try/except/else/finally`` is modelled with *may* semantics: any
+  statement that can raise (contains a call, or is ``raise``/
+  ``assert``) gets exception edges to each live handler of the
+  innermost enclosing try, and — because the exception may not match a
+  non-catch-all handler — onward through ``finally`` bodies to the
+  next enclosing try or the synthetic ``raise_exit``;
+- ``with`` blocks whose context manager is ``contextlib.suppress`` (or
+  any ``*suppress*`` callee) swallow exception edges from their body to
+  the statement after the ``with``;
+- ``return`` routes through enclosing ``finally`` bodies to the
+  synthetic normal ``exit``; falling off the end does too.
+
+Over-approximations (deliberate, documented for rule authors):
+
+- ``finally`` bodies are shared nodes, so the join at a finally merges
+  the normal / exceptional / return continuations; a may-analysis sees
+  a superset of real paths, never a subset.
+- exception type matching is name-blind except that ``except:``,
+  ``except Exception`` and ``except BaseException`` count as catch-all.
+- ``yield`` is a plain flow-through node (no GeneratorExit edge): a
+  raise edge per yield would drown resource rules in noise.
+
+Stdlib-only.  ``STATS`` accumulates build/solve wall time for
+``mesh-tpu lint --profile``; ``reset_stats()`` also clears the
+per-function CFG cache.
+"""
+
+import ast
+import time
+
+__all__ = [
+    "CFG", "Edge", "Node", "build_cfg", "cfg_for", "expr_key",
+    "may_raise", "reset_stats", "snapshot_stats", "STATS",
+]
+
+STATS = {"cfg_s": 0.0, "cfg_builds": 0, "dataflow_s": 0.0,
+         "dataflow_solves": 0}
+
+_CACHE = {}
+
+#: caches keyed by function-object identity elsewhere in the analysis
+#: package (e.g. flw's reaching-defs cache) register here so one
+#: reset clears every per-run cache
+EXTRA_CACHES = []
+
+
+def reset_stats():
+    STATS["cfg_s"] = 0.0
+    STATS["cfg_builds"] = 0
+    STATS["dataflow_s"] = 0.0
+    STATS["dataflow_solves"] = 0
+    _CACHE.clear()
+    for cache in EXTRA_CACHES:
+        cache.clear()
+
+
+def snapshot_stats():
+    return dict(STATS)
+
+
+def qualname(node):
+    """Dotted name of a Name/Attribute chain, or None (duplicated from
+    rules/common.py — importing the rules package from here would be
+    circular, since every rule module imports this one)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def expr_key(node):
+    """Stable key for an expression: dotted path for name/attribute
+    chains (``req.record``), ``ast.dump`` otherwise."""
+    q = qualname(node)
+    return q if q else ast.dump(node)
+
+
+class Node(object):
+    """One CFG node.  ``stmt`` is the AST statement (or handler) it
+    represents; synthetic nodes (entry/exit/raise_exit) have none."""
+
+    __slots__ = ("stmt", "kind", "line")
+
+    def __init__(self, stmt=None, kind="stmt", line=0):
+        self.stmt = stmt
+        self.kind = kind
+        self.line = int(getattr(stmt, "lineno", line) or line)
+
+    def __repr__(self):   # pragma: no cover - debugging aid
+        return "<Node %s L%d>" % (self.kind, self.line)
+
+
+class Edge(object):
+    __slots__ = ("src", "dst", "kind", "assume")
+
+    def __init__(self, src, dst, kind, assume=None):
+        self.src = src
+        self.dst = dst
+        self.kind = kind
+        self.assume = assume
+
+    def __repr__(self):   # pragma: no cover - debugging aid
+        return "<Edge %s L%d->L%d>" % (self.kind, self.src.line,
+                                       self.dst.line)
+
+
+class CFG(object):
+    __slots__ = ("func", "entry", "exit", "raise_exit", "nodes",
+                 "succ", "pred")
+
+    def __init__(self, func):
+        self.func = func
+        self.entry = Node(kind="entry",
+                          line=getattr(func, "lineno", 0) or 0)
+        self.exit = Node(kind="exit")
+        self.raise_exit = Node(kind="raise_exit")
+        self.nodes = [self.entry, self.exit, self.raise_exit]
+        self.succ = {self.entry: [], self.exit: [], self.raise_exit: []}
+        self.pred = {self.entry: [], self.exit: [], self.raise_exit: []}
+
+    def add_node(self, node):
+        self.nodes.append(node)
+        self.succ[node] = []
+        self.pred[node] = []
+        return node
+
+    def link(self, src, dst, kind, assume=None):
+        for e in self.succ[src]:
+            if e.dst is dst and e.kind == kind and e.assume == assume:
+                return e
+        e = Edge(src, dst, kind, assume)
+        self.succ[src].append(e)
+        self.pred[dst].append(e)
+        return e
+
+    def stmt_nodes(self):
+        return [n for n in self.nodes if n.stmt is not None]
+
+
+_CATCH_ALL = ("Exception", "BaseException")
+
+
+def _is_catch_all(handler):
+    if handler.type is None:
+        return True
+    t = handler.type
+    names = t.elts if isinstance(t, ast.Tuple) else [t]
+    for n in names:
+        q = qualname(n) or ""
+        if q.split(".")[-1] in _CATCH_ALL:
+            return True
+    return False
+
+
+def may_raise(stmt):
+    """May evaluating this node's *own* code raise?  For compound
+    statements only the evaluated head counts (test / iter / items)."""
+    if isinstance(stmt, (ast.Raise, ast.Assert)):
+        return True
+    if isinstance(stmt, (ast.If, ast.While)):
+        probe = stmt.test
+    elif isinstance(stmt, ast.For):
+        probe = stmt.iter
+    elif isinstance(stmt, (ast.With, getattr(ast, "AsyncWith", ast.With))):
+        probe = ast.Module(body=[ast.Expr(value=i.context_expr)
+                                 for i in stmt.items],
+                           type_ignores=[])
+    elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef, ast.Try)):
+        return False
+    else:
+        probe = stmt
+    for sub in ast.walk(probe):
+        if isinstance(sub, (ast.Call, ast.Await, ast.Subscript)):
+            return True
+    return False
+
+
+def _test_assumes(test):
+    """(true_assume, false_assume) for a branch test, or (None, None).
+    Truthiness of a bare name approximates a not-None check — good
+    enough to prune ``if rec: close(rec)`` guard paths."""
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        t, f = _test_assumes(test.operand)
+        return f, t
+    if (isinstance(test, ast.Compare) and len(test.ops) == 1
+            and isinstance(test.comparators[0], ast.Constant)
+            and test.comparators[0].value is None):
+        key = expr_key(test.left)
+        if isinstance(test.ops[0], ast.Is):
+            return (key, "none"), (key, "notnone")
+        if isinstance(test.ops[0], ast.IsNot):
+            return (key, "notnone"), (key, "none")
+    if isinstance(test, (ast.Name, ast.Attribute)):
+        key = expr_key(test)
+        return (key, "notnone"), (key, "none")
+    return None, None
+
+
+class _Scope(object):
+    """One enclosing try (or swallowing with) as seen from a statement
+    being wired.  ``handlers`` are the live handler nodes, ``fin`` the
+    (entry_node, exit_frontier) of a finally body, ``swallow`` a
+    collector list for exception edges that vanish (contextlib.suppress).
+    """
+
+    __slots__ = ("handlers", "catch_all", "fin", "swallow")
+
+    def __init__(self, handlers=(), catch_all=False, fin=None,
+                 swallow=None):
+        self.handlers = list(handlers)
+        self.catch_all = catch_all
+        self.fin = fin          # (entry_node, exit_frontier) | None
+        self.swallow = swallow  # list collector | None
+
+
+class _Loop(object):
+    __slots__ = ("header", "breaks", "try_depth")
+
+    def __init__(self, header, try_depth):
+        self.header = header
+        self.breaks = []        # frontier entries wired to after-loop
+        self.try_depth = try_depth
+
+
+class _Builder(object):
+    def __init__(self, func):
+        self.cfg = CFG(func)
+        self.loops = []
+        self.tries = []
+
+    # frontier: list of (src_node, kind, assume) dangling edges
+
+    def build(self):
+        frontier = [(self.cfg.entry, "seq", None)]
+        frontier = self.seq(self.cfg.func.body, frontier)
+        for src, kind, assume in frontier:
+            self.cfg.link(src, self.cfg.exit, kind, assume)
+        return self.cfg
+
+    def attach(self, frontier, node, default_kind="seq"):
+        for src, kind, assume in frontier:
+            self.cfg.link(src, node, kind or default_kind, assume)
+
+    def seq(self, stmts, frontier):
+        for stmt in stmts:
+            if not frontier:
+                break           # unreachable tail; stop wiring
+            frontier = self.stmt(stmt, frontier)
+        return frontier
+
+    # -- exception / teardown routing ---------------------------------
+
+    def raise_from(self, node):
+        """Wire exception edges from ``node`` to handlers / finallys /
+        raise_exit per the live scope stack."""
+        srcs = [(node, "raise", None)]
+        for scope in reversed(self.tries):
+            if scope.swallow is not None:
+                scope.swallow.extend(
+                    (s, "swallow", a) for s, _k, a in srcs)
+                return
+            for h in scope.handlers:
+                for s, _k, a in srcs:
+                    self.cfg.link(s, h, "except", a)
+            if scope.handlers and scope.catch_all:
+                return
+            if scope.fin is not None:
+                fin_entry, fin_exits = scope.fin
+                for s, _k, a in srcs:
+                    self.cfg.link(s, fin_entry, "finally", a)
+                srcs = [(s, "raise", a) for s, _k, a in fin_exits]
+        for s, _k, a in srcs:
+            self.cfg.link(s, self.cfg.raise_exit, "raise", a)
+
+    def through_finallys(self, srcs, down_to_depth, kind):
+        """Route ``srcs`` through every finally between the current
+        scope depth and ``down_to_depth``; returns the surviving
+        frontier."""
+        for scope in reversed(self.tries[down_to_depth:]):
+            if scope.fin is not None:
+                fin_entry, fin_exits = scope.fin
+                for s, _k, a in srcs:
+                    self.cfg.link(s, fin_entry, kind, a)
+                srcs = [(s, kind, a) for s, _k, a in fin_exits]
+        return srcs
+
+    # -- statement dispatch -------------------------------------------
+
+    def stmt(self, stmt, frontier):
+        if isinstance(stmt, ast.If):
+            return self.if_(stmt, frontier)
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            return self.loop(stmt, frontier)
+        if isinstance(stmt, ast.Try):
+            return self.try_(stmt, frontier)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            return self.with_(stmt, frontier)
+        node = self.cfg.add_node(Node(stmt))
+        self.attach(frontier, node)
+        if isinstance(stmt, ast.Return):
+            srcs = self.through_finallys([(node, "return", None)], 0,
+                                         "return")
+            for s, k, a in srcs:
+                self.cfg.link(s, self.cfg.exit, k, a)
+            return []
+        if isinstance(stmt, ast.Raise):
+            self.raise_from(node)
+            return []
+        if isinstance(stmt, (ast.Break, ast.Continue)):
+            if not self.loops:
+                return []       # malformed source; be lenient
+            loop = self.loops[-1]
+            kind = "break" if isinstance(stmt, ast.Break) else "continue"
+            srcs = self.through_finallys([(node, kind, None)],
+                                         loop.try_depth, kind)
+            if kind == "break":
+                loop.breaks.extend(srcs)
+            else:
+                for s, k, a in srcs:
+                    self.cfg.link(s, loop.header, k, a)
+            return []
+        if may_raise(stmt):
+            self.raise_from(node)
+        return [(node, "seq", None)]
+
+    def if_(self, stmt, frontier):
+        node = self.cfg.add_node(Node(stmt))
+        self.attach(frontier, node)
+        if may_raise(stmt):
+            self.raise_from(node)
+        t_assume, f_assume = _test_assumes(stmt.test)
+        out = self.seq(stmt.body, [(node, "true", t_assume)])
+        if stmt.orelse:
+            out += self.seq(stmt.orelse, [(node, "false", f_assume)])
+        else:
+            out.append((node, "false", f_assume))
+        return out
+
+    def loop(self, stmt, frontier):
+        header = self.cfg.add_node(Node(stmt))
+        self.attach(frontier, header)
+        if may_raise(stmt):
+            self.raise_from(header)
+        loop = _Loop(header, len(self.tries))
+        self.loops.append(loop)
+        if isinstance(stmt, ast.While):
+            t_assume, f_assume = _test_assumes(stmt.test)
+            body_in = [(header, "true", t_assume)]
+            infinite = (isinstance(stmt.test, ast.Constant)
+                        and bool(stmt.test.value))
+            exit_out = [] if infinite else [(header, "false", f_assume)]
+        else:
+            body_in = [(header, "iter", None)]
+            exit_out = [(header, "loop-exit", None)]
+        body_out = self.seq(stmt.body, body_in)
+        for s, _k, a in body_out:
+            self.cfg.link(s, header, "back", a)
+        self.loops.pop()
+        if stmt.orelse:
+            exit_out = self.seq(stmt.orelse, exit_out)
+        return exit_out + loop.breaks
+
+    def try_(self, stmt, frontier):
+        fin = None
+        if stmt.finalbody:
+            # build the finally body first (under the *outer* scope
+            # stack — exceptions in a finally propagate outward) so
+            # teardown routing from the try/handler bodies can target it
+            fin_entry = self.cfg.add_node(
+                Node(kind="finally", line=stmt.finalbody[0].lineno))
+            fin_exits = self.seq(stmt.finalbody,
+                                 [(fin_entry, "seq", None)])
+            fin = (fin_entry, fin_exits)
+        handler_nodes = []
+        catch_all = False
+        for h in stmt.handlers:
+            hn = self.cfg.add_node(Node(h, kind="handler"))
+            handler_nodes.append(hn)
+            catch_all = catch_all or _is_catch_all(h)
+        # try body: exceptions live against our handlers + finally
+        self.tries.append(_Scope(handler_nodes, catch_all, fin))
+        body_out = self.seq(stmt.body, list(frontier))
+        self.tries.pop()
+        # handler / else bodies: our handlers no longer catch, but the
+        # finally still interposes on the way out
+        if fin is not None:
+            self.tries.append(_Scope((), False, fin))
+        out = []
+        for h, hn in zip(stmt.handlers, handler_nodes):
+            out += self.seq(h.body, [(hn, "seq", None)])
+        if stmt.orelse:
+            body_out = self.seq(stmt.orelse, body_out)
+        out += body_out
+        if fin is not None:
+            self.tries.pop()
+            fin_entry, fin_exits = fin
+            for s, _k, a in out:
+                self.cfg.link(s, fin_entry, "seq", a)
+            return list(fin_exits)
+        return out
+
+    def with_(self, stmt, frontier):
+        node = self.cfg.add_node(Node(stmt))
+        self.attach(frontier, node)
+        if may_raise(stmt):
+            self.raise_from(node)
+        swallow = None
+        for item in stmt.items:
+            expr = item.context_expr
+            callee = qualname(expr.func) if isinstance(expr, ast.Call) \
+                else None
+            if callee and "suppress" in callee.split(".")[-1]:
+                swallow = []
+        if swallow is not None:
+            self.tries.append(_Scope(swallow=swallow))
+        out = self.seq(stmt.body, [(node, "seq", None)])
+        if swallow is not None:
+            self.tries.pop()
+            out = out + swallow
+        return out
+
+
+def build_cfg(funcdef):
+    """Lower one function def to a :class:`CFG` (uncached)."""
+    t0 = time.monotonic()
+    try:
+        return _Builder(funcdef).build()
+    finally:
+        STATS["cfg_s"] += time.monotonic() - t0
+        STATS["cfg_builds"] += 1
+
+
+def cfg_for(funcdef):
+    """Cached :func:`build_cfg` — rules within one lint run share the
+    graph.  Cleared by :func:`reset_stats`."""
+    key = id(funcdef)
+    hit = _CACHE.get(key)
+    if hit is not None and hit[0] is funcdef:
+        return hit[1]
+    cfg = build_cfg(funcdef)
+    _CACHE[key] = (funcdef, cfg)
+    return cfg

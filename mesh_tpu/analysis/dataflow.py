@@ -1,0 +1,233 @@
+"""Forward dataflow over meshlint CFGs: generic worklist solver,
+reaching definitions, guarded-path reachability, and witness paths.
+
+The solver is a classic may-analysis kit: states merge with a
+client-supplied ``merge`` (usually set union) at joins, ``transfer``
+maps (node, in_state) -> out_state, and iteration runs to fixpoint over
+a FIFO worklist.  CFGs are per-function and small (tens of nodes), so
+no priority ordering is needed.
+
+``reachable``/``find_path`` are the path primitives the RES/LED rules
+are built on: BFS that can *avoid* a node set (e.g. close sites) and
+*prune* edges whose assumption contradicts a tracked fact (e.g. an
+``if rec is None`` edge while hunting paths where the record exists).
+``find_path`` returns the concrete edge sequence — the CFG path
+witness rendered into SARIF codeFlows.
+
+Stdlib-only; solve time lands in ``cfg.STATS`` for ``--profile``.
+"""
+
+import ast
+import time
+from collections import deque
+
+from .cfg import STATS, expr_key
+
+__all__ = [
+    "ReachingDefs", "defs_of", "find_path", "reachable",
+    "render_witness", "solve_forward",
+]
+
+
+def solve_forward(cfg, init, transfer, merge):
+    """Run a forward dataflow to fixpoint.  ``init`` seeds the entry
+    in-state; returns {node: in_state}.  ``transfer(node, state)``
+    must not mutate ``state``; ``merge(a, b)`` joins two in-states."""
+    t0 = time.monotonic()
+    try:
+        states = {cfg.entry: init}
+        work = deque([cfg.entry])
+        on_work = {cfg.entry}
+        while work:
+            node = work.popleft()
+            on_work.discard(node)
+            out = transfer(node, states[node])
+            for edge in cfg.succ[node]:
+                dst = edge.dst
+                cur = states.get(dst)
+                new = out if cur is None else merge(cur, out)
+                if cur is None or new != cur:
+                    states[dst] = new
+                    if dst not in on_work:
+                        work.append(dst)
+                        on_work.add(dst)
+        return states
+    finally:
+        STATS["dataflow_s"] += time.monotonic() - t0
+        STATS["dataflow_solves"] += 1
+
+
+# -- reaching definitions ---------------------------------------------
+
+PARAM = "<param>"
+
+
+def defs_of(stmt):
+    """Names (re)bound by executing this one statement node."""
+    names = []
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        targets = [stmt.target]
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        targets = [i.optional_vars for i in stmt.items
+                   if i.optional_vars is not None]
+    elif isinstance(stmt, ast.ExceptHandler):
+        return [stmt.name] if stmt.name else []
+    else:
+        targets = []
+    for t in targets:
+        for sub in ast.walk(t):
+            if isinstance(sub, ast.Name):
+                names.append(sub.id)
+    # walrus targets anywhere in the statement's expressions
+    for sub in ast.walk(stmt):
+        if isinstance(sub, ast.NamedExpr) and \
+                isinstance(sub.target, ast.Name):
+            names.append(sub.target.id)
+    return names
+
+
+class ReachingDefs(object):
+    """Which definition nodes may reach each program point.
+
+    ``at(node)[name]`` is a frozenset of defining CFG nodes (or the
+    :data:`PARAM` sentinel for the incoming parameter binding).  Absent
+    name: nothing assigns it in this function (global / closure)."""
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+        params = set()
+        args = cfg.func.args
+        for a in (list(args.posonlyargs) if hasattr(args, "posonlyargs")
+                  else []) + list(args.args) + list(args.kwonlyargs):
+            params.add(a.arg)
+        if args.vararg:
+            params.add(args.vararg.arg)
+        if args.kwarg:
+            params.add(args.kwarg.arg)
+        init = {p: frozenset([PARAM]) for p in params}
+
+        def transfer(node, state):
+            stmt = node.stmt
+            if stmt is None:
+                return state
+            bound = defs_of(stmt)
+            if not bound:
+                return state
+            out = dict(state)
+            for name in bound:
+                out[name] = frozenset([node])
+            return out
+
+        def merge(a, b):
+            if a == b:
+                return a
+            out = dict(a)
+            for k, v in b.items():
+                cur = out.get(k)
+                out[k] = v if cur is None else (cur | v)
+            return out
+
+        self._in = solve_forward(cfg, init, transfer, merge)
+
+    def at(self, node):
+        return self._in.get(node, {})
+
+
+# -- guarded reachability + witnesses ---------------------------------
+
+def _edge_ok(edge, prune_none_of):
+    if not prune_none_of or edge.assume is None:
+        return True
+    key, fact = edge.assume
+    return not (fact == "none" and key in prune_none_of)
+
+
+def reachable(cfg, start, goal_pred, avoid=(), prune_none_of=(),
+              edge_filter=None):
+    """Is any node satisfying ``goal_pred`` reachable from ``start``
+    without visiting ``avoid`` nodes, skipping edges that assume one of
+    ``prune_none_of`` is None?  ``start`` itself is tested first."""
+    return find_path(cfg, start, goal_pred, avoid, prune_none_of,
+                     edge_filter) is not None
+
+
+def find_path(cfg, start, goal_pred, avoid=(), prune_none_of=(),
+              edge_filter=None):
+    """BFS shortest edge-path from ``start`` to a goal node; returns
+    the list of edges (possibly empty when start is a goal), or None.
+    ``edge_filter(edge) -> bool`` can veto edges (e.g. loop back
+    edges)."""
+    avoid = set(avoid)
+    if start in avoid:
+        return None
+    if goal_pred(start):
+        return []
+    seen = {start}
+    work = deque([(start, ())])
+    while work:
+        node, path = work.popleft()
+        for edge in cfg.succ[node]:
+            dst = edge.dst
+            if dst in seen or dst in avoid:
+                continue
+            if not _edge_ok(edge, prune_none_of):
+                continue
+            if edge_filter is not None and not edge_filter(edge):
+                continue
+            new_path = path + (edge,)
+            if goal_pred(dst):
+                return list(new_path)
+            seen.add(dst)
+            work.append((dst, new_path))
+    return None
+
+
+_KIND_NOTE = {
+    "true": "branch taken", "false": "branch not taken",
+    "except": "exception caught by handler", "raise": "raise edge",
+    "finally": "into finally", "back": "loop repeats",
+    "loop-exit": "loop exhausted", "iter": "loop iterates",
+    "break": "break", "continue": "continue", "return": "return",
+    "swallow": "exception swallowed by with-block",
+}
+
+
+def render_witness(ctx, start, path):
+    """Render an edge path into [(line, note), ...] steps for SARIF
+    codeFlows / ``--witness`` output.  ``ctx`` is the FileContext (for
+    source lines); ``start`` the node the trace begins at."""
+    def src_line(line):
+        return ctx.line(line)
+
+    steps = []
+    if start.line:
+        steps.append((start.line, src_line(start.line)))
+    for edge in path:
+        dst = edge.dst
+        note = _KIND_NOTE.get(edge.kind, edge.kind)
+        if dst.kind == "exit":
+            steps.append((steps[-1][0] if steps else 1,
+                          "function exits (%s)" % note))
+        elif dst.kind == "raise_exit":
+            steps.append((steps[-1][0] if steps else 1,
+                          "exception escapes the function (%s)" % note))
+        elif dst.line:
+            text = src_line(dst.line)
+            if edge.kind in ("seq",):
+                steps.append((dst.line, text))
+            else:
+                steps.append((dst.line, "%s -> %s" % (note, text)))
+    # collapse runs of plain sequential steps to keep witnesses short
+    out = []
+    for line, note in steps:
+        if out and out[-1][0] == line and out[-1][1] == note:
+            continue
+        out.append((line, note))
+    if len(out) > 12:
+        out = out[:6] + [(out[6][0], "... %d steps elided ..."
+                          % (len(out) - 11))] + out[-5:]
+    return out

@@ -47,11 +47,19 @@ SCHEMA_VERSION = 1
 
 
 class Finding(object):
-    """One diagnostic: rule id, severity, location, message, fix hint."""
+    """One diagnostic: rule id, severity, location, message, fix hint.
 
-    __slots__ = ("rule", "severity", "path", "line", "message", "hint")
+    Flow-sensitive rules may attach a ``witness`` — the CFG path
+    proving the finding, as [(line, note), ...] steps — which rides
+    into the JSON report and SARIF codeFlows but stays OUT of the
+    fingerprint (a witness re-route from an unrelated edit must not
+    un-baseline a finding)."""
 
-    def __init__(self, rule, severity, path, line, message, hint=None):
+    __slots__ = ("rule", "severity", "path", "line", "message", "hint",
+                 "witness")
+
+    def __init__(self, rule, severity, path, line, message, hint=None,
+                 witness=None):
         assert severity in SEVERITIES, severity
         self.rule = rule
         self.severity = severity
@@ -59,6 +67,7 @@ class Finding(object):
         self.line = int(line or 0)
         self.message = message
         self.hint = hint
+        self.witness = witness      # [(line, note), ...] or None
 
     @property
     def fingerprint(self):
@@ -78,6 +87,10 @@ class Finding(object):
         }
         if self.hint:
             out["hint"] = self.hint
+        if self.witness:
+            out["witness"] = [
+                {"line": int(line), "note": note}
+                for line, note in self.witness]
         return out
 
     def render(self):
@@ -306,6 +319,11 @@ def save_baseline(path, findings, old_entries=None, default_reason=None):
 class Report(object):
     """One lint run's outcome: findings split against the baseline."""
 
+    #: per-phase / per-rule wall time, filled by run_lint (always
+    #: collected — it is a handful of monotonic reads — and rendered
+    #: only under ``mesh-tpu lint --profile``)
+    profile = None
+
     def __init__(self, findings, baseline, elapsed_s, files_scanned):
         self.findings = sorted(
             findings, key=lambda f: (f.path, f.line, f.rule, f.message))
@@ -328,7 +346,7 @@ class Report(object):
         return 1 if blocking else 0
 
     def to_dict(self):
-        return {
+        out = {
             "schema_version": SCHEMA_VERSION,
             "rc": self.rc,
             "files_scanned": self.files_scanned,
@@ -346,6 +364,31 @@ class Report(object):
                 for fp, entry in sorted(self.stale.items())
             ],
         }
+        if self.profile is not None:
+            out["profile"] = self.profile
+        return out
+
+    def render_profile(self):
+        """Attribution table for ``--profile``: where the gate-0 wall
+        time went — per phase, then per rule slowest-first.  The cfg/
+        dataflow rows are carved out of (not additional to) the rule
+        times: they accrue while RES/LED/FLW checks run."""
+        p = self.profile or {}
+        rules = p.get("rules_s", {})
+        lines = [
+            "meshlint profile (%.2fs total, %d files):"
+            % (self.elapsed_s, self.files_scanned),
+            "  parse     %7.3fs" % p.get("parse_s", 0.0),
+            "  cfg       %7.3fs  (%d builds)"
+            % (p.get("cfg_s", 0.0), p.get("cfg_builds", 0)),
+            "  dataflow  %7.3fs  (%d solves)"
+            % (p.get("dataflow_s", 0.0), p.get("dataflow_solves", 0)),
+            "  rules     %7.3fs" % sum(rules.values()),
+        ]
+        for rid, s in sorted(rules.items(), key=lambda kv: (-kv[1],
+                                                            kv[0])):
+            lines.append("    %-5s %7.3fs" % (rid, s))
+        return "\n".join(lines)
 
     def to_sarif(self):
         """SARIF 2.1.0 for code-scanning UIs.  Only NEW findings become
@@ -398,12 +441,32 @@ class Report(object):
         }
         if f.hint:
             result["message"]["text"] += "  [fix: %s]" % f.hint
+        if f.witness:
+            # the CFG path witness: the branch sequence proving the
+            # leaky path, one threadFlow location per step
+            result["codeFlows"] = [{
+                "threadFlows": [{
+                    "locations": [{
+                        "location": {
+                            "physicalLocation": {
+                                "artifactLocation": {"uri": f.path},
+                                "region": {"startLine": max(1,
+                                                            int(line))},
+                            },
+                            "message": {"text": note or "(step)"},
+                        },
+                    } for line, note in f.witness],
+                }],
+            }]
         return result
 
     def render_human(self, verbose=False):
         lines = []
         for f in self.new:
             lines.append(f.render())
+            for line, note in (f.witness or ()):
+                lines.append("    path: L%d%s"
+                             % (line, " — " + note if note else ""))
         if verbose:
             for f in self.suppressed:
                 lines.append("(baselined) " + f.render())
@@ -433,19 +496,39 @@ def run_lint(root, paths=None, rules=None, baseline_path=None,
     :param use_baseline: False disables suppression (every finding is
         "new") — the CI mode for fixture tests.
     """
+    from . import cfg as cfg_mod
+
     t0 = time.monotonic()
     if rules is None:
         from .rules import all_rules
 
         rules = all_rules()
+    cfg_mod.reset_stats()
     project, findings = build_project(root, paths)
+    t_parse = time.monotonic() - t0
+    per_rule = {rule.id: 0.0 for rule in rules}
     for ctx in project.contexts:
         for rule in rules:
+            t1 = time.monotonic()
             findings.extend(rule.check(ctx))
+            per_rule[rule.id] += time.monotonic() - t1
     for rule in rules:
+        t1 = time.monotonic()
         findings.extend(rule.finalize(project))
+        per_rule[rule.id] += time.monotonic() - t1
     if baseline_path is None:
         baseline_path = default_baseline_path(project.root)
     baseline = load_baseline(baseline_path) if use_baseline else {}
-    return Report(findings, baseline, time.monotonic() - t0,
-                  len(project.contexts))
+    report = Report(findings, baseline, time.monotonic() - t0,
+                    len(project.contexts))
+    stats = cfg_mod.snapshot_stats()
+    report.profile = {
+        "parse_s": round(t_parse, 4),
+        "cfg_s": round(stats["cfg_s"], 4),
+        "cfg_builds": stats["cfg_builds"],
+        "dataflow_s": round(stats["dataflow_s"], 4),
+        "dataflow_solves": stats["dataflow_solves"],
+        "rules_s": {rid: round(s, 4)
+                    for rid, s in sorted(per_rule.items())},
+    }
+    return report

@@ -2,7 +2,9 @@
 
 ``all_rules()`` is the registry the engine and CLI default to; the
 ``--rules TRC,VMEM`` CLI filter matches on each rule's ``id`` prefix.
-See doc/static_analysis.md for the catalog.
+See doc/static_analysis.md for the catalog.  The RES/LED/FLW families
+are the flow-sensitive layer (per-function CFG + dataflow, analysis/
+cfg.py); the rest are pattern rules.
 """
 
 from .trc import TracerLeakRule
@@ -13,11 +15,15 @@ from .knb import KnobRegistryRule
 from .obs import ObservabilityHygieneRule
 from .lok import LockOrderRule
 from .pal import PallasDmaRule
+from .res import ResourcePathRule
+from .led import LedgerLifecycleRule
+from .flw import FlowSensitiveRule
 
 __all__ = [
     "TracerLeakRule", "RecompileHazardRule", "VmemBudgetRule",
     "LockDisciplineRule", "KnobRegistryRule", "ObservabilityHygieneRule",
-    "LockOrderRule", "PallasDmaRule",
+    "LockOrderRule", "PallasDmaRule", "ResourcePathRule",
+    "LedgerLifecycleRule", "FlowSensitiveRule",
     "all_rules",
 ]
 
@@ -33,4 +39,7 @@ def all_rules():
         ObservabilityHygieneRule(),
         LockOrderRule(),
         PallasDmaRule(),
+        ResourcePathRule(),
+        LedgerLifecycleRule(),
+        FlowSensitiveRule(),
     ]

@@ -1,0 +1,213 @@
+"""FLW: flow-sensitive upgrades of the TRC/RCP families.
+
+The pattern rules reason name-locally: TRC004 flags ``float(x)`` when
+``x`` is a traced parameter even if every path rebinds ``x`` to a host
+value first, and misses ``y = jnp.sum(x); float(y)`` entirely because
+``y`` is not a parameter.  This family runs reaching definitions over
+the per-function CFG to close both gaps:
+
+- FLW001 (warning): ``float()``/``int()``/``bool()`` on a local whose
+  reaching definitions include a device-derived value (a ``jnp``/
+  ``jax``/``lax``/``pl`` call or an expression over traced parameters)
+  inside traced code — the leak TRC004's parameter-only view misses.
+- FLW002 (warning): ``.item()``/``.tolist()`` inside a host-side loop
+  on a value produced by a jitted callable in that same loop — one
+  device->host sync per iteration from the *caller* side, invisible to
+  TRC because the loop body is not traced.
+
+The exported helpers are the suppression side of the same analysis:
+``all_host_redefined`` lets TRC004 stay quiet when every reaching
+definition of the parameter is a proven host value (the measured
+false-positive reduction), without touching TRC's own structure.
+"""
+
+import ast
+
+from .common import in_loop, qualname
+from .trc import (_DEVICE_CALL_ROOTS, _is_dynamic, _param_names,
+                  _traced_functions, _traced_roots)
+from ..cfg import EXTRA_CACHES, cfg_for
+from ..dataflow import PARAM, ReachingDefs
+from ..engine import Rule
+
+_RD_CACHE = {}
+EXTRA_CACHES.append(_RD_CACHE)
+
+
+def _analysis_for(funcdef):
+    """(cfg, ReachingDefs) for a function, cached per function object
+    for the lifetime of the run (TRC suppression + FLW share it)."""
+    hit = _RD_CACHE.get(id(funcdef))
+    if hit is not None and hit[0] is funcdef:
+        return hit[1], hit[2]
+    cfg = cfg_for(funcdef)
+    rd = ReachingDefs(cfg)
+    _RD_CACHE[id(funcdef)] = (funcdef, cfg, rd)
+    return cfg, rd
+
+
+def _stmt_node_of(cfg, parents, ast_node):
+    """The CFG node whose statement contains ``ast_node``, or None."""
+    index = {id(n.stmt): n for n in cfg.stmt_nodes()}
+    cur = ast_node
+    while cur is not None:
+        hit = index.get(id(cur))
+        if hit is not None:
+            return hit
+        cur = parents.get(cur)
+    return None
+
+
+def _def_rhs(def_node):
+    """RHS expression of a defining CFG node, when it is a plain
+    single-target assignment; None otherwise (for-targets, with-as,
+    augmented — treated as opaque)."""
+    stmt = def_node.stmt
+    if isinstance(stmt, ast.Assign):
+        return stmt.value
+    return None
+
+
+def _device_rhs(rhs, params):
+    if rhs is None:
+        return False
+    if isinstance(rhs, ast.Call):
+        root = qualname(rhs.func)
+        if root and root.split(".", 1)[0] in _DEVICE_CALL_ROOTS:
+            return True
+    return _is_dynamic(rhs, params)
+
+
+def all_host_redefined(funcdef, parents, use_node, name, params):
+    """True when every definition of ``name`` reaching ``use_node`` is
+    a provable host value — i.e. the traced parameter binding cannot
+    reach this use.  TRC004's suppression hook."""
+    cfg, rd = _analysis_for(funcdef)
+    node = _stmt_node_of(cfg, parents, use_node)
+    if node is None:
+        return False
+    defs = rd.at(node).get(name)
+    if not defs or PARAM in defs:
+        return False
+    for d in defs:
+        rhs = _def_rhs(d)
+        if rhs is None or _device_rhs(rhs, params):
+            return False
+    return True
+
+
+def _device_defined(funcdef, parents, use_node, name, params):
+    """Some reaching definition of ``name`` is device-derived."""
+    cfg, rd = _analysis_for(funcdef)
+    node = _stmt_node_of(cfg, parents, use_node)
+    if node is None:
+        return False
+    defs = rd.at(node).get(name)
+    if not defs:
+        return False
+    for d in defs:
+        if d == PARAM:
+            continue
+        if _device_rhs(_def_rhs(d), params):
+            return True
+    return False
+
+
+class FlowSensitiveRule(Rule):
+
+    id = "FLW"
+    name = "flow-sensitive tracer/host-sync upgrades"
+
+    def check(self, ctx):
+        findings = []
+        source = ctx.source
+        traced = []
+        if "float(" in source or "int(" in source or "bool(" in source \
+                or ".item(" in source or ".tolist(" in source:
+            traced = _traced_functions(ctx.nodes())
+        if traced:
+            findings.extend(self._check_traced(ctx, traced))
+        if ".item(" in source or ".tolist(" in source:
+            findings.extend(self._check_host_loops(ctx, traced))
+        return findings
+
+    # -- FLW001: device-derived local crosses to host in traced code --
+
+    def _check_traced(self, ctx, traced):
+        parents = ctx.parents()
+        for funcdef, spec in traced:
+            params = _param_names(funcdef, spec)
+            for node in ast.walk(funcdef):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id in ("float", "int", "bool")
+                        and len(node.args) == 1
+                        and isinstance(node.args[0], ast.Name)):
+                    continue
+                name = node.args[0].id
+                if name in params:
+                    continue    # TRC004's case (possibly suppressed)
+                if _device_defined(funcdef, parents, node, name,
+                                   params):
+                    yield ctx.finding(
+                        "FLW001", "warning", node,
+                        "%s() on '%s' inside traced '%s': a reaching "
+                        "definition is device-derived, so this is a "
+                        "tracer leak TRC004's parameter-only view "
+                        "misses" % (node.func.id, name, funcdef.name),
+                        hint="keep the value as a jnp array (or "
+                             "rebind it to a host value on every "
+                             "path first)")
+
+    # -- FLW002: per-iteration host sync on jitted results ------------
+
+    def _check_host_loops(self, ctx, traced):
+        parents = ctx.parents()
+        traced_ids = {id(fd) for fd, _ in traced}
+        roots = set(_traced_roots(ctx.nodes()))
+        if not roots:
+            return
+        for node in ctx.nodes():
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("item", "tolist")
+                    and isinstance(node.func.value, ast.Name)
+                    and not node.args):
+                continue
+            if not in_loop(parents, node):
+                continue
+            funcdef = self._enclosing_def(parents, node)
+            if funcdef is None or id(funcdef) in traced_ids:
+                continue    # traced code is TRC001's territory
+            name = node.func.value.id
+            cfg, rd = _analysis_for(funcdef)
+            cnode = _stmt_node_of(cfg, parents, node)
+            if cnode is None:
+                continue
+            defs = rd.at(cnode).get(name, ())
+            for d in defs:
+                if d == PARAM:
+                    continue
+                rhs = _def_rhs(d)
+                if isinstance(rhs, ast.Call):
+                    callee = qualname(rhs.func)
+                    if callee and "." not in callee and \
+                            callee in roots:
+                        yield ctx.finding(
+                            "FLW002", "warning", node,
+                            ".%s() on '%s' inside a host loop syncs "
+                            "the device once per iteration ('%s' is "
+                            "produced by jitted '%s')"
+                            % (node.func.attr, name, name, callee),
+                            hint="accumulate on device and sync once "
+                                 "after the loop")
+                        break
+
+    @staticmethod
+    def _enclosing_def(parents, node):
+        cur = parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = parents.get(cur)
+        return None

@@ -1,0 +1,375 @@
+"""LED: request-lifecycle completeness for the latency ledger.
+
+The ledger contract (doc/observability.md): every admitted request's
+:class:`RequestRecord` reaches exactly one ``close()`` carrying an
+outcome label from the documented ``LEDGER_OUTCOMES`` set, on *every*
+path — cancelled, deadline-expired, errored, or shut down mid-queue.
+A missed close silently drops the request from every stage breakdown
+and the incident ring; a bogus outcome label splinters the breakdown
+cardinality.
+
+Scope is behavioral, not path-list based: the checks engage wherever
+records are *owned* — methods of any class that opens ledger records,
+plus any function that closes one — which today means
+``serve/service.py`` (opener) with ``serve/deadline.py`` and
+``engine/executor.py`` stamping but never owning (so they cannot
+false-fire).  Callee effects ride PR 12's interprocedural call graph:
+a call to a function that (transitively) closes the record counts as a
+close point on the path.
+
+Codes:
+
+- LED001 (error): a path that completes a request future
+  (``set_result`` / ``set_exception`` / ``cancel``) with no ledger
+  close anywhere on it, while a record can exist (``if record is not
+  None`` guard edges prune the record-absent paths).  Carries the CFG
+  path witness.
+- LED002 (error): a close site's outcome label — literal, conditional
+  literal, or a variable whose reaching definitions are all literals —
+  is not in the documented ``LEDGER_OUTCOMES`` set.
+- LED003 (error): an outcome in ``LEDGER_OUTCOMES`` is not documented
+  (backticked) in doc/observability.md — same contract OBS005 enforces
+  for stage names.
+- LED004 (warning): one path can close the same record twice with no
+  rebinding in between (loops excluded: a back edge means a new
+  record).
+"""
+
+import ast
+
+from .common import enclosing_function, qualname
+from ..cfg import cfg_for, expr_key
+from ..dataflow import PARAM, ReachingDefs, find_path, render_witness
+from ..engine import Finding, Rule
+from .res import node_calls, _ledgerish
+
+#: fallback when no LEDGER_OUTCOMES assignment exists in the scanned
+#: tree (single-file fixtures); obs/ledger.py owns the canonical tuple
+_DEFAULT_OUTCOMES = ("ok", "cancelled", "deadline", "error", "shutdown")
+
+_COMPLETION_ATTRS = ("set_result", "set_exception", "cancel")
+
+
+def collect_ledger_outcomes(project):
+    """(values, relpath, lineno) from the first ``LEDGER_OUTCOMES =
+    (...)`` tuple-of-string-literals in the tree, name-keyed like
+    obs.collect_ledger_stages so a moved definition stays covered."""
+    for ctx in project.contexts:
+        for node in ctx.nodes():
+            if not isinstance(node, ast.Assign):
+                continue
+            targets = [t.id for t in node.targets
+                       if isinstance(t, ast.Name)]
+            if "LEDGER_OUTCOMES" not in targets:
+                continue
+            if not isinstance(node.value, (ast.Tuple, ast.List)):
+                continue
+            values = tuple(
+                elt.value for elt in node.value.elts
+                if isinstance(elt, ast.Constant)
+                and isinstance(elt.value, str))
+            if values:
+                return values, ctx.relpath, node.lineno
+    return None
+
+
+def _is_ledger_close(call):
+    return (isinstance(call.func, ast.Attribute)
+            and call.func.attr == "close" and _ledgerish(call))
+
+
+def _is_ledger_open(call):
+    return (isinstance(call.func, ast.Attribute)
+            and call.func.attr == "open" and _ledgerish(call))
+
+
+def _is_completion(call):
+    """A future being completed: ``*.future.set_result(...)`` etc. —
+    receiver spelling must mention fut/future so dict ``cancel`` or
+    file ``close`` lookalikes stay out."""
+    func = call.func
+    if not isinstance(func, ast.Attribute) or \
+            func.attr not in _COMPLETION_ATTRS:
+        return False
+    recv = qualname(func.value) or ""
+    last = recv.rsplit(".", 1)[-1].lower()
+    return "fut" in last
+
+
+def _close_record_keys(cfg):
+    """Expr keys of the record arguments at direct close sites — these
+    drive the ``is None`` guard-edge pruning."""
+    keys = set()
+    for node in cfg.stmt_nodes():
+        for call in node_calls(node):
+            if _is_ledger_close(call) and call.args:
+                keys.add(expr_key(call.args[0]))
+    return keys
+
+
+class LedgerLifecycleRule(Rule):
+
+    id = "LED"
+    name = "ledger request-lifecycle completeness"
+
+    _inter = None
+
+    def finalize(self, project):
+        findings = []
+        contract = collect_ledger_outcomes(project)
+        outcomes = contract[0] if contract else _DEFAULT_OUTCOMES
+        closers = self._may_closers(project)
+        for ctx in project.contexts:
+            if ".close(" not in ctx.source and \
+                    ".open(" not in ctx.source:
+                continue
+            findings.extend(
+                self._check_file(ctx, outcomes, closers))
+        if contract:
+            findings.extend(self._check_doc(project, contract))
+        return findings
+
+    # -- callee close effects (PR 12 interprocedural graph) -----------
+
+    def _may_closers(self, project):
+        """Function keys that (transitively) may close a ledger record,
+        propagated backwards over the interprocedural call graph."""
+        inter = project.interproc()
+        direct = set()
+        for key, fn in inter.functions.items():
+            for node in ast.walk(fn.node):
+                if isinstance(node, ast.Call) and _is_ledger_close(node):
+                    direct.add(key)
+                    break
+        closers = set(direct)
+        changed = True
+        while changed:
+            changed = False
+            for key, summary in inter.summaries.items():
+                if key in closers:
+                    continue
+                if any(callee in closers
+                       for callee, _, _ in summary.calls):
+                    closers.add(key)
+                    changed = True
+        self._inter = inter
+        return closers
+
+    # -- per-file checks ----------------------------------------------
+
+    def _opener_classes(self, ctx):
+        names = set()
+        parents = ctx.parents()
+        for node in ctx.nodes():
+            if isinstance(node, ast.Call) and _is_ledger_open(node):
+                p = parents.get(node)
+                while p is not None:
+                    if isinstance(p, ast.ClassDef):
+                        names.add(p.name)
+                        break
+                    p = parents.get(p)
+        return names
+
+    def _check_file(self, ctx, outcomes, closers):
+        parents = ctx.parents()
+        opener_classes = self._opener_classes(ctx)
+        seen = set()
+        for node in ctx.nodes():
+            if not isinstance(node, ast.Call):
+                continue
+            if not (_is_ledger_close(node) or _is_completion(node)):
+                continue
+            funcdef = enclosing_function(parents, node)
+            if funcdef is None or id(funcdef) in seen:
+                continue
+            seen.add(id(funcdef))
+            in_opener = False
+            p = parents.get(funcdef)
+            while p is not None:
+                if isinstance(p, ast.ClassDef):
+                    in_opener = p.name in opener_classes
+                    break
+                p = parents.get(p)
+            has_close = any(
+                isinstance(n, ast.Call) and _is_ledger_close(n)
+                for n in ast.walk(funcdef))
+            if not (in_opener or has_close):
+                continue
+            yield from self._check_function(
+                ctx, funcdef, outcomes, closers)
+
+    def _check_function(self, ctx, funcdef, outcomes, closers):
+        cfg = cfg_for(funcdef)
+        close_nodes = {}
+        completion_nodes = {}
+        for node in cfg.stmt_nodes():
+            for call in node_calls(node):
+                if _is_ledger_close(call):
+                    close_nodes[node] = call
+                elif _is_completion(call):
+                    completion_nodes[node] = call
+                elif self._calls_closer(ctx, funcdef, call, closers):
+                    close_nodes.setdefault(node, None)
+        guard_keys = _close_record_keys(cfg)
+        rd = None
+        # LED002: outcome labels at direct close sites
+        for node, call in sorted(
+                close_nodes.items(), key=lambda kv: kv[0].line):
+            if call is None:
+                continue
+            if rd is None and any(
+                    isinstance(a, ast.Name)
+                    for a in self._outcome_exprs(call)):
+                rd = ReachingDefs(cfg)
+            for label in self._resolve_outcomes(call, rd, node):
+                if label not in outcomes:
+                    yield ctx.finding(
+                        "LED002", "error", call,
+                        "close() outcome %r in '%s' is not in the "
+                        "documented outcome set %s"
+                        % (label, funcdef.name, list(outcomes)),
+                        hint="use a documented label (or extend "
+                             "LEDGER_OUTCOMES + doc/observability.md)")
+        # LED001: completion with no close on the path
+        avoid = set(close_nodes)
+        for node, call in sorted(
+                completion_nodes.items(), key=lambda kv: kv[0].line):
+            if node in avoid:
+                continue
+            head = find_path(
+                cfg, cfg.entry, lambda n, node=node: n is node,
+                avoid=avoid, prune_none_of=guard_keys)
+            if head is None:
+                continue
+            tail = find_path(
+                cfg, node, lambda n: n is cfg.exit,
+                avoid=avoid, prune_none_of=guard_keys)
+            if tail is None:
+                continue
+            finding = ctx.finding(
+                "LED001", "error", call,
+                "request future completed in '%s' on a path with no "
+                "ledger close — the record never reaches the stage "
+                "histogram or the incident ring" % funcdef.name,
+                hint="close the record (with an outcome label) on "
+                     "every completion path")
+            finding.witness = render_witness(ctx, cfg.entry,
+                                             head + tail)
+            yield finding
+        # LED004: double close of one record expr on one path
+        direct = [(n, c) for n, c in close_nodes.items()
+                  if c is not None and c.args]
+        for i, (n1, c1) in enumerate(direct):
+            k1 = expr_key(c1.args[0])
+            for n2, c2 in direct:
+                if n2 is n1 or expr_key(c2.args[0]) != k1:
+                    continue
+                rebinds = self._rebind_nodes(cfg, c1.args[0])
+                path = find_path(
+                    cfg, n1, lambda n, n2=n2: n is n2,
+                    avoid=rebinds - {n1, n2},
+                    edge_filter=lambda e: e.kind != "back",
+                )
+                if path is not None:
+                    yield ctx.finding(
+                        "LED004", "warning", c2.args[0],
+                        "record '%s' can be closed twice on one path "
+                        "through '%s' (double ring-append skews the "
+                        "breakdown)" % (k1, funcdef.name),
+                        hint="make the closes mutually exclusive or "
+                             "guard the second with a closed flag")
+
+    def _calls_closer(self, ctx, funcdef, call, closers):
+        if not closers or self._inter is None:
+            return False
+        fn = None
+        for key, info in self._inter.functions.items():
+            if info.node is funcdef:
+                fn = info
+                break
+        if fn is None:
+            return False
+        callee = self._inter._resolve_call(fn, call)
+        return callee in closers
+
+    @staticmethod
+    def _outcome_exprs(call):
+        out = []
+        if len(call.args) >= 2:
+            out.append(call.args[1])
+        for kw in call.keywords:
+            if kw.arg == "outcome":
+                out.append(kw.value)
+        return out
+
+    def _resolve_outcomes(self, call, rd, node):
+        """Every string the outcome argument can statically be; empty
+        when unresolvable (conservative silence)."""
+        labels = set()
+        for expr in self._outcome_exprs(call):
+            labels |= self._expr_strings(expr, rd, node)
+        return sorted(labels)
+
+    def _expr_strings(self, expr, rd, node, depth=0):
+        if depth > 3:
+            return set()
+        if isinstance(expr, ast.Constant) and \
+                isinstance(expr.value, str):
+            return {expr.value}
+        if isinstance(expr, ast.IfExp):
+            return (self._expr_strings(expr.body, rd, node, depth + 1)
+                    | self._expr_strings(expr.orelse, rd, node,
+                                         depth + 1))
+        if isinstance(expr, ast.Name) and rd is not None:
+            defs = rd.at(node).get(expr.id)
+            if not defs or PARAM in defs:
+                return set()
+            out = set()
+            for d in defs:
+                stmt = d.stmt
+                if isinstance(stmt, ast.Assign):
+                    got = self._expr_strings(stmt.value, rd, d,
+                                             depth + 1)
+                    if not got:
+                        return set()    # one opaque def: give up
+                    out |= got
+                else:
+                    return set()
+            return out
+        return set()
+
+    def _rebind_nodes(self, cfg, record_expr):
+        """Nodes that rebind the record expression's root name — a
+        close after a rebind is a different record."""
+        if isinstance(record_expr, ast.Name):
+            root = record_expr.id
+        else:
+            q = qualname(record_expr)
+            root = q.split(".", 1)[0] if q else None
+        if root is None:
+            return set()
+        out = set()
+        for node in cfg.stmt_nodes():
+            stmt = node.stmt
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    for sub in ast.walk(t):
+                        if isinstance(sub, ast.Name) and sub.id == root:
+                            out.add(node)
+        return out
+
+    # -- LED003: doc coverage of the outcome contract -----------------
+
+    def _check_doc(self, project, contract):
+        values, relpath, lineno = contract
+        doc = project.doc_text("doc", "observability.md")
+        if doc is None:
+            return
+        for outcome in values:
+            if "`%s`" % outcome not in doc:
+                yield Finding(
+                    "LED003", "error", relpath, lineno,
+                    "ledger outcome '%s' is not documented in "
+                    "doc/observability.md" % outcome,
+                    hint="add it to the outcome-label table (the "
+                         "LED/OBS doc-coverage contract)")

@@ -34,6 +34,56 @@ def _is_jit_call(node):
     return bool(name) and name.rsplit(".", 1)[-1] in _JIT_LAST_PARTS
 
 
+def _build_once_guard(parents, node):
+    """True when a jit call inside a loop sits under a build-once memo
+    guard — ``if f is None: f = jit(g)``, ``if not f: f = jit(g)``, or
+    ``if key not in cache: cache[key] = jit(g)`` — so it runs once, not
+    per iteration.  The flow-sensitive suppression FLW brings to
+    RCP001: the jit result must be bound back to the guarded subject."""
+    assign = parents.get(node)
+    if not isinstance(assign, ast.Assign) or len(assign.targets) != 1:
+        return False
+    target = assign.targets[0]
+    cur = parents.get(assign)
+    while cur is not None and not isinstance(
+            cur, (ast.For, ast.While, ast.FunctionDef,
+                  ast.AsyncFunctionDef, ast.Lambda)):
+        if isinstance(cur, ast.If):
+            test = cur.test
+            if isinstance(test, ast.UnaryOp) and \
+                    isinstance(test.op, ast.Not):
+                test_subject = test.operand
+                kind = "falsy"
+            elif isinstance(test, ast.Compare) and len(test.ops) == 1:
+                if isinstance(test.ops[0], ast.Is) and isinstance(
+                        test.comparators[0], ast.Constant) and \
+                        test.comparators[0].value is None:
+                    test_subject = test.left
+                    kind = "none"
+                elif isinstance(test.ops[0], ast.NotIn):
+                    # membership guard: target must index the container
+                    if isinstance(target, ast.Subscript):
+                        container = qualname(test.comparators[0])
+                        indexed = qualname(target.value)
+                        if container and container == indexed:
+                            return True
+                    test_subject = None
+                    kind = None
+                else:
+                    test_subject = None
+                    kind = None
+            else:
+                test_subject = None
+                kind = None
+            if kind in ("falsy", "none") and test_subject is not None:
+                subject = qualname(test_subject)
+                bound = qualname(target)
+                if subject and subject == bound:
+                    return True
+        cur = parents.get(cur)
+    return False
+
+
 def _is_constant_static_spec(node, consts):
     """Literal int/str, or a tuple/list of those, possibly via one
     module-level constant indirection."""
@@ -59,7 +109,8 @@ class RecompileHazardRule(Rule):
             if not _is_jit_call(node):
                 continue
             jit_name = qualname(node.func)
-            if in_loop(parents, node):
+            if in_loop(parents, node) and not _build_once_guard(
+                    parents, node):
                 findings.append(ctx.finding(
                     "RCP001", "warning", node,
                     "%s(...) constructed inside a loop: every iteration "

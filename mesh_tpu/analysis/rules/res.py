@@ -1,0 +1,375 @@
+"""RES: path-sensitive resource pairing over the per-function CFG.
+
+The pattern rules (LCK/PAL) count sites; this family walks paths.  A
+ledger record bound to a local, a manual ``lock.acquire()``, or a
+manual ``cm.__enter__()`` must reach its close / release / ``__exit__``
+on *every* path out of the function — including the exception edges the
+CFG models for any statement that can raise.  PAL004's loop-body
+site counting is upgraded here to real per-path balance: a DMA
+``start``/``wait`` pair inside a ``fori_loop``/``while_loop`` body must
+balance on every branch combination, not merely have equal site counts.
+
+Codes:
+
+- RES001 (error): resource opened here can reach the function's normal
+  exit with no close on the path.  The finding carries the CFG path
+  witness (the branch sequence proving the leak) into SARIF codeFlows.
+- RES002 (error): every normal path closes, but an exception edge
+  escapes the function between open and close — the close belongs in a
+  ``finally`` (or the resource in a ``with``).
+- RES003 (warning): DMA start/wait imbalance on some path through a
+  loop-body function (both operations present, but a branch skips one
+  side) — the path-sensitive upgrade of PAL004.
+
+Escape hatches keep this conservative: a ledger record that is
+returned, yielded, stored into an attribute/container, or passed to
+another callable is someone else's to close and is not tracked.
+``with``-managed resources never fire (the with IS the pairing), and a
+``self.*`` attribute entered inside a method named ``__enter__`` is
+the cm-delegation idiom — its ``__exit__`` lives in the sibling
+``__exit__`` method, outside this CFG — so it is not tracked either.
+"""
+
+import ast
+
+from .common import enclosing_function, qualname
+from ..cfg import cfg_for, expr_key
+from ..dataflow import find_path, render_witness, solve_forward
+from ..engine import Rule
+
+#: any of these substrings in a file skips the whole-file prefilter
+_FILE_TOKENS = (".acquire(", "__enter__", ".open(", "make_async_copy")
+
+#: loop constructs whose body callee gets per-path DMA balance checks
+_LOOP_WRAPPER_PARTS = {"fori_loop", "while_loop"}
+
+_MAX_CFG_NODES = 600
+
+
+def _own_exprs(stmt):
+    """The expressions a CFG node for ``stmt`` actually evaluates —
+    compound statements contribute only their head, and nested defs
+    contribute nothing (they are separate CFGs)."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        return (stmt.test,)
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return (stmt.iter,)
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return tuple(i.context_expr for i in stmt.items)
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef, ast.Try)):
+        return ()
+    if isinstance(stmt, ast.ExceptHandler):
+        return (stmt.type,) if stmt.type is not None else ()
+    return (stmt,)
+
+
+def node_calls(node):
+    """Every Call in the expressions this CFG node evaluates."""
+    out = []
+    for expr in _own_exprs(node.stmt):
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Call):
+                out.append(sub)
+    return out
+
+
+def _receiver_text(call):
+    """Dotted text of a ``recv.method(...)`` receiver; calls in the
+    chain resolve through their callee (``get_ledger().open`` ->
+    ``get_ledger``)."""
+    func = call.func
+    if not isinstance(func, ast.Attribute):
+        return ""
+    recv = func.value
+    if isinstance(recv, ast.Call):
+        return qualname(recv.func) or ""
+    return qualname(recv) or ""
+
+
+def _ledgerish(call):
+    return "ledger" in _receiver_text(call).lower()
+
+
+class _Spec(object):
+    """One tracked resource: where it opens, how it closes."""
+
+    __slots__ = ("kind", "key", "open_node", "noun", "closer")
+
+    def __init__(self, kind, key, open_node, noun, closer):
+        self.kind = kind          # ledger | lock | cm
+        self.key = key            # var name or receiver expr key
+        self.open_node = open_node
+        self.noun = noun          # human text for messages
+        self.closer = closer      # human text of the expected close
+
+
+def _collect_specs(cfg, funcdef):
+    specs = []
+    for node in cfg.stmt_nodes():
+        stmt = node.stmt
+        for call in node_calls(node):
+            func = call.func
+            if not isinstance(func, ast.Attribute):
+                continue
+            if func.attr == "open" and _ledgerish(call) and \
+                    isinstance(stmt, ast.Assign) and \
+                    len(stmt.targets) == 1 and \
+                    isinstance(stmt.targets[0], ast.Name) and \
+                    stmt.value is call:
+                var = stmt.targets[0].id
+                specs.append(_Spec(
+                    "ledger", var, node,
+                    "ledger record '%s'" % var, "close"))
+            elif func.attr == "acquire":
+                key = expr_key(func.value)
+                specs.append(_Spec(
+                    "lock", key, node,
+                    "lock '%s'" % key, "release"))
+            elif func.attr == "__enter__":
+                key = expr_key(func.value)
+                if funcdef.name == "__enter__" and \
+                        key.startswith("self."):
+                    # delegation idiom: a cm class entering an inner cm
+                    # stored on self — the paired __exit__ lives in the
+                    # sibling __exit__ method, outside this CFG
+                    continue
+                specs.append(_Spec(
+                    "cm", key, node,
+                    "context manager '%s'" % key, "__exit__"))
+    return specs
+
+
+def _close_nodes(cfg, spec):
+    """CFG nodes that close this resource (plus, for ledger records,
+    escape nodes that transfer ownership — treated as closes so the
+    rule stays conservative)."""
+    out = set()
+    for node in cfg.stmt_nodes():
+        if node is spec.open_node:
+            continue
+        for call in node_calls(node):
+            func = call.func
+            if not isinstance(func, ast.Attribute):
+                continue
+            if spec.kind == "ledger" and func.attr == "close" and \
+                    _ledgerish(call):
+                for arg in call.args[:1]:
+                    if isinstance(arg, ast.Name) and arg.id == spec.key:
+                        out.add(node)
+            elif spec.kind == "lock" and func.attr == "release" and \
+                    expr_key(func.value) == spec.key:
+                out.add(node)
+            elif spec.kind == "cm" and func.attr == "__exit__" and \
+                    expr_key(func.value) == spec.key:
+                out.add(node)
+    return out
+
+
+def _ledger_escapes(cfg, spec):
+    """Does the record var leave this function's custody?  Returns,
+    yields, attribute/container stores, deletes, re-binds, or being
+    passed as a call argument all count."""
+    for node in cfg.stmt_nodes():
+        if node is spec.open_node:
+            continue
+        stmt = node.stmt
+        for expr in _own_exprs(stmt):
+            for sub in ast.walk(expr):
+                if isinstance(sub, ast.Name) and sub.id == spec.key:
+                    if isinstance(sub.ctx, (ast.Store, ast.Del)):
+                        return True
+        if isinstance(stmt, ast.Assign):
+            for sub in ast.walk(stmt.value):
+                if isinstance(sub, ast.Name) and sub.id == spec.key:
+                    return True    # aliased / stored somewhere
+        if isinstance(stmt, (ast.Return, ast.Expr)) and isinstance(
+                getattr(stmt, "value", None), (ast.Yield, ast.YieldFrom)):
+            probe = stmt.value
+        elif isinstance(stmt, ast.Return):
+            probe = stmt.value
+        else:
+            probe = None
+        if probe is not None:
+            for sub in ast.walk(probe):
+                if isinstance(sub, ast.Name) and sub.id == spec.key:
+                    return True
+        for call in node_calls(node):
+            func = call.func
+            is_close = (isinstance(func, ast.Attribute)
+                        and func.attr == "close" and _ledgerish(call))
+            if is_close:
+                continue
+            for arg in list(call.args) + [k.value for k in call.keywords]:
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Name) and sub.id == spec.key:
+                        return True
+    return False
+
+
+def _candidate_functions(ctx):
+    """Functions worth building a CFG for, found in one pass over the
+    flat node cache (the PR 13 prefilter pattern)."""
+    parents = ctx.parents()
+    out = {}
+    for node in ctx.nodes():
+        if not isinstance(node, ast.Call) or not \
+                isinstance(node.func, ast.Attribute):
+            continue
+        if node.func.attr in ("acquire", "__enter__") or (
+                node.func.attr == "open" and _ledgerish(node)):
+            fn = enclosing_function(parents, node)
+            if fn is not None:
+                out[id(fn)] = fn
+    return list(out.values())
+
+
+class ResourcePathRule(Rule):
+
+    id = "RES"
+    name = "path-sensitive resource pairing"
+
+    def check(self, ctx):
+        findings = []
+        if any(tok in ctx.source for tok in _FILE_TOKENS):
+            for funcdef in _candidate_functions(ctx):
+                findings.extend(self._check_function(ctx, funcdef))
+        if "make_async_copy" in ctx.source or (
+                ".start(" in ctx.source and ".wait(" in ctx.source):
+            findings.extend(self._check_dma_balance(ctx))
+        return findings
+
+    # -- RES001 / RES002 ----------------------------------------------
+
+    def _check_function(self, ctx, funcdef):
+        cfg = cfg_for(funcdef)
+        if len(cfg.nodes) > _MAX_CFG_NODES:
+            return
+        for spec in _collect_specs(cfg, funcdef):
+            if spec.kind == "ledger" and _ledger_escapes(cfg, spec):
+                continue
+            closes = _close_nodes(cfg, spec)
+            if spec.kind in ("lock", "cm") and not closes:
+                # acquire with no release anywhere: either LCK001's
+                # territory (pattern rule) or a handoff we cannot see;
+                # a path witness adds nothing — stay quiet.
+                if spec.kind == "lock":
+                    continue
+            prune = {spec.key}
+
+            def not_own_raise(edge, open_node=spec.open_node):
+                # if the open call itself raises, the resource was
+                # never acquired — that edge is not a leak path
+                return not (edge.src is open_node
+                            and edge.kind in ("raise", "except",
+                                              "finally"))
+
+            path = find_path(
+                cfg, spec.open_node, lambda n: n is cfg.exit,
+                avoid=closes, prune_none_of=prune,
+                edge_filter=not_own_raise)
+            if path is not None:
+                yield self._leak(ctx, funcdef, cfg, spec, path,
+                                 "RES001",
+                                 "can reach the function exit with no "
+                                 "%s on the path" % spec.closer,
+                                 "close/release on every branch (or "
+                                 "hand the resource to a with-block)")
+                continue
+            path = find_path(
+                cfg, spec.open_node, lambda n: n is cfg.raise_exit,
+                avoid=closes, prune_none_of=prune,
+                edge_filter=not_own_raise)
+            if path is not None:
+                yield self._leak(ctx, funcdef, cfg, spec, path,
+                                 "RES002",
+                                 "is closed on the normal path but "
+                                 "leaks when an exception escapes "
+                                 "before the %s" % spec.closer,
+                                 "move the %s into a finally (or use "
+                                 "a with-block)" % spec.closer)
+
+    def _leak(self, ctx, funcdef, cfg, spec, path, code, what, hint):
+        finding = ctx.finding(
+            code, "error", spec.open_node.stmt,
+            "%s opened in '%s' %s" % (spec.noun, funcdef.name, what),
+            hint=hint)
+        finding.witness = render_witness(ctx, spec.open_node, path)
+        return finding
+
+    # -- RES003: DMA start/wait path balance --------------------------
+
+    def _check_dma_balance(self, ctx):
+        findings = []
+        loop_bodies = set()
+        defs = {}
+        for node in ctx.nodes():
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, node)
+            elif isinstance(node, ast.Call):
+                name = qualname(node.func)
+                if name and name.rsplit(".", 1)[-1] in \
+                        _LOOP_WRAPPER_PARTS:
+                    for arg in node.args:
+                        inner = qualname(arg)
+                        if inner and "." not in inner:
+                            loop_bodies.add(inner)
+        for name in sorted(loop_bodies):
+            funcdef = defs.get(name)
+            if funcdef is None:
+                continue
+            findings.extend(self._balance_one(ctx, funcdef))
+        return findings
+
+    def _balance_one(self, ctx, funcdef):
+        cfg = cfg_for(funcdef)
+        if len(cfg.nodes) > _MAX_CFG_NODES:
+            return
+        # family -> {node: (starts, waits)}
+        families = {}
+        for node in cfg.stmt_nodes():
+            for call in node_calls(node):
+                func = call.func
+                if not isinstance(func, ast.Attribute) or \
+                        func.attr not in ("start", "wait"):
+                    continue
+                recv = func.value
+                key = expr_key(recv)
+                if isinstance(recv, ast.Call):
+                    inner = qualname(recv.func) or ""
+                    if "make_async_copy" not in inner:
+                        continue
+                    key = ast.dump(recv)
+                fam = families.setdefault(key, {})
+                s, w = fam.get(node, (0, 0))
+                if func.attr == "start":
+                    fam[node] = (s + 1, w)
+                else:
+                    fam[node] = (s, w + 1)
+        for key, sites in sorted(families.items()):
+            starts = sum(s for s, _ in sites.values())
+            waits = sum(w for _, w in sites.values())
+            if not starts or not waits:
+                continue    # one-sided prefetch idiom: PAL's call
+
+            def transfer(node, state, sites=sites):
+                s, w = sites.get(node, (0, 0))
+                delta = s - w
+                if not delta:
+                    return state
+                return frozenset(
+                    max(-3, min(3, d + delta)) for d in state)
+
+            exit_state = solve_forward(
+                cfg, frozenset([0]), transfer,
+                lambda a, b: a | b).get(cfg.exit, frozenset([0]))
+            if any(d != 0 for d in exit_state):
+                first = min(sites, key=lambda n: n.line)
+                yield ctx.finding(
+                    "RES003", "warning", first.stmt,
+                    "DMA start/wait on '%s' is unbalanced on some path "
+                    "through loop body '%s' (a branch skips one side)"
+                    % (key, funcdef.name),
+                    hint="start and wait the descriptor on every "
+                         "branch, or hoist the conditional out of "
+                         "the loop body")

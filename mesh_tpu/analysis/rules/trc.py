@@ -279,6 +279,16 @@ class TracerLeakRule(Rule):
                         and func.id in ("float", "int", "bool")
                         and len(node.args) == 1
                         and _is_dynamic(node.args[0], params)):
+                    arg = node.args[0]
+                    if isinstance(arg, ast.Name):
+                        # flow-sensitive suppression (FLW): a parameter
+                        # rebound to a proven host value on every path
+                        # reaching this call is not a tracer leak
+                        from .flw import all_host_redefined
+
+                        if all_host_redefined(funcdef, ctx.parents(),
+                                              node, arg.id, params):
+                            continue
                     findings.append(ctx.finding(
                         "TRC004", "warning", node,
                         "%s() on a traced value inside '%s' breaks "

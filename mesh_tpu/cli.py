@@ -764,6 +764,11 @@ def cmd_lint(args):
         sys.stdout.write("\n")
     else:
         print(report.render_human(verbose=args.verbose))
+    if args.profile:
+        # on machine-readable formats the table goes to stderr so
+        # stdout stays parseable (--json already embeds "profile")
+        print(report.render_profile(),
+              file=sys.stdout if fmt == "human" else sys.stderr)
     rc = report.rc
     if args.witness:
         rc = max(rc, _check_witness(engine, repo_root, args.witness,
@@ -1095,7 +1100,8 @@ def main():
                              "package)")
     p_lint.add_argument("--rules", default=None,
                         help="comma-separated rule-id filter "
-                             "(TRC,RCP,VMEM,LCK,KNB,OBS,LOK,PAL)")
+                             "(TRC,RCP,VMEM,LCK,KNB,OBS,LOK,PAL,"
+                             "RES,LED,FLW)")
     p_lint.add_argument("--changed", action="store_true",
                         help="lint only files touched vs git HEAD "
                              "(plus untracked) — `make lint-fast`")
@@ -1122,6 +1128,10 @@ def main():
                              "JSONL log against the static lock graph "
                              "and doc/concurrency.md (rc 1 on "
                              "contradiction)")
+    p_lint.add_argument("--profile", action="store_true",
+                        help="print per-phase (parse/CFG/dataflow) and "
+                             "per-rule wall time after the report — the "
+                             "gate-0 3s budget's attribution view")
     p_lint.add_argument("-v", "--verbose", action="store_true",
                         help="also list baseline-suppressed findings")
     p_lint.set_defaults(func=cmd_lint)

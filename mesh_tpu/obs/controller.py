@@ -207,7 +207,13 @@ class TunerController(object):
     def _settle_guard(self, guard, now, actions):
         """Judge a due hold-out window.  harvest_gates provenance
         semantics: stale/missing evidence must never read as an
-        improvement, so an unreadable after-window reverts."""
+        improvement, so an unreadable after-window reverts.
+
+        The caller has already popped the guard, so this is the only
+        chance to revert: the actuation sits in a ``finally`` so a
+        recorder or registry that raises mid-verdict can never leave an
+        unconfirmed knob value applied with no hold-out watching it.
+        """
         after_p99 = self._series.window_percentile(
             self.latency_metric, 0.99, guard["pivot_t"], now)
         before_p99 = guard["before_p99_s"]
@@ -219,21 +225,26 @@ class TunerController(object):
             "before_p99_s": before_p99, "after_p99_s": after_p99,
             "tol": tol, "holdout_s": now - guard["pivot_t"],
         }
-        self._recorder_ref().record(
-            "knob_ab", knob=guard["knob"], verdict=verdict, **evidence)
-        self._registry.counter(
-            "mesh_tpu_tuner_ab_total",
-            "shadow A/B hold-out verdicts",
-        ).inc(knob=guard["knob"], verdict=verdict)
-        if not confirmed:
-            event = tuning.actuate(
-                guard["knob"], guard["revert_to"],
-                reason="ab_guard: hold-out %s" % (
-                    "regressed past tolerance" if after_p99 is not None
-                    and before_p99 is not None else "evidence missing"),
-                evidence=evidence, action="revert", now=now)
-            if event:
-                actions.append(event)
+        try:
+            self._recorder_ref().record(
+                "knob_ab", knob=guard["knob"], verdict=verdict,
+                **evidence)
+            self._registry.counter(
+                "mesh_tpu_tuner_ab_total",
+                "shadow A/B hold-out verdicts",
+            ).inc(knob=guard["knob"], verdict=verdict)
+        finally:
+            if not confirmed:
+                event = tuning.actuate(
+                    guard["knob"], guard["revert_to"],
+                    reason="ab_guard: hold-out %s" % (
+                        "regressed past tolerance"
+                        if after_p99 is not None
+                        and before_p99 is not None
+                        else "evidence missing"),
+                    evidence=evidence, action="revert", now=now)
+                if event:
+                    actions.append(event)
 
     def _retune(self, now, actions):
         """Re-publish autotune's persisted calibrations into the

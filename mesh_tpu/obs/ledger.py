@@ -48,7 +48,8 @@ from .clock import monotonic
 from .metrics import REGISTRY
 
 __all__ = [
-    "LEDGER_STAGES", "RequestRecord", "LatencyLedger", "LEDGER",
+    "LEDGER_STAGES", "LEDGER_OUTCOMES", "RequestRecord", "LatencyLedger",
+    "LEDGER",
     "get_ledger", "ledger_enabled", "bind_current", "current_record",
     "LEDGER_ENV", "LEDGER_CAPACITY_ENV", "LEDGER_TAIL_ENV",
 ]
@@ -71,6 +72,14 @@ LEDGER_STAGES = (
 )
 
 _STAGE_INDEX = {name: i for i, name in enumerate(LEDGER_STAGES)}
+
+#: the outcome-label contract: every ``close()`` must carry one of
+#: these (``ok`` = served, ``cancelled`` = caller cancelled before
+#: dispatch, ``deadline`` = deadline expired, ``error`` = rung/store
+#: failure, ``shutdown`` = request dropped by a non-draining stop).
+#: The meshlint LED rule verifies close sites use only these labels
+#: and that each is documented in doc/observability.md.
+LEDGER_OUTCOMES = ("ok", "cancelled", "deadline", "error", "shutdown")
 
 
 def ledger_enabled():

@@ -377,28 +377,33 @@ def run_with_ladder(mesh, points, deadline, ladder=None, chunk=512,
             break
         slice_s = max(deadline.remaining(), hard_left / rungs_left)
         slice_s = max(min(slice_s, hard_left), _MIN_SLICE_S)
+        # the token MUST close exactly once however the attempt ends —
+        # a BaseException (interrupt, watchdog SystemExit) that skipped
+        # the old ``except Exception`` pairing would leak an in-flight
+        # dispatch in the health tracker forever
         token = health.dispatch_began(rung.name) if health else None
+        ok = False
         try:
             with obs_span("serve.attempt", rung=rung.name,
                           slice_ms=round(1e3 * slice_s, 1)):
                 result = rung.run(mesh, points, chunk, slice_s)
-            if health:
-                health.dispatch_finished(token, ok=True)
+            ok = True
             return result, retries
         except Exception as e:      # noqa: BLE001 — every rung failure falls through
-            if health:
-                health.dispatch_finished(token, ok=False)
             last_error = e
             retries += 1
             _retry_counter().inc(rung=rung.name,
                                  error=type(e).__name__)
             get_recorder().record("serve.retry", rung=rung.name,
                                   error=type(e).__name__)
-            if i + 1 < len(rungs):
-                backoff = min(_BACKOFF_BASE_S * (2 ** i), _BACKOFF_CAP_S,
-                              max(deadline.hard_remaining(), 0.0) * 0.1)
-                if backoff > 0:
-                    time.sleep(backoff)
+        finally:
+            if health:
+                health.dispatch_finished(token, ok=ok)
+        if i + 1 < len(rungs):
+            backoff = min(_BACKOFF_BASE_S * (2 ** i), _BACKOFF_CAP_S,
+                          max(deadline.hard_remaining(), 0.0) * 0.1)
+            if backoff > 0:
+                time.sleep(backoff)
     exc = DeadlineExceeded(
         "no rung answered within the hard budget (deadline %.3fs, "
         "elapsed %.3fs, retries %d)"

@@ -522,9 +522,14 @@ class QueryService(object):
                     if popped is None:
                         break
                     _tenant, req = popped
-                    if not req.future.cancel():
+                    cancelled = req.future.cancel()
+                    if not cancelled:
                         req.future.set_exception(EngineShutdown(
                             "serving tier stopped before dispatch"))
+                    if req.record is not None:
+                        get_ledger().close(
+                            req.record,
+                            outcome="cancelled" if cancelled else "shutdown")
             self._cond.notify_all()
         for worker in self._workers:
             worker.join(timeout=10)

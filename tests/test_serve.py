@@ -497,6 +497,42 @@ def test_service_stop_without_drain_fails_queued_futures():
         assert fut.cancelled() or fut.done()
 
 
+def test_service_stop_without_drain_closes_ledger_records():
+    """stop(drain=False) must also CLOSE each dropped request's ledger
+    record, not just complete its future — the record leak meshlint's
+    LED001 caught.  Outcome is `cancelled` when future.cancel() won,
+    `shutdown` when the request got EngineShutdown instead."""
+    from mesh_tpu.obs.ledger import get_ledger
+
+    ledger = get_ledger()
+    before = len(ledger.records())
+    svc = _service()
+    svc.hold()              # never released: all 3 die queued
+    futs = [svc.submit(_MESH, _PTS) for _ in range(3)]
+    svc.stop(drain=False, write_stats=False)
+    rows = ledger.records()[before:]
+    assert len(rows) == len(futs)
+    assert all(r["outcome"] in ("cancelled", "shutdown") for r in rows)
+    for fut in futs:
+        assert fut.cancelled() or fut.done()
+
+
+def test_ladder_base_exception_closes_health_token():
+    """A BaseException out of a rung (interrupt, a watchdog SystemExit)
+    bypasses the ladder's except-Exception fall-through — the health
+    dispatch token must still close (finally-paired), or the tracker
+    carries a forever-in-flight dispatch."""
+    mon, _clock = _monitor()
+
+    def fn(mesh, points, chunk, timeout):
+        raise KeyboardInterrupt()
+
+    with pytest.raises(KeyboardInterrupt):
+        run_with_ladder(_MESH, _PTS, Deadline(0.5),
+                        ladder=[Rung("intr", fn)], health=mon)
+    assert mon.snapshot()["inflight"] == 0
+
+
 def test_service_stats_sink_roundtrip(tmp_path):
     sink = str(tmp_path / "serve_stats.json")
     svc = _service(stats_path=sink)

@@ -275,6 +275,27 @@ def test_missing_holdout_evidence_never_reads_as_improvement():
     assert revert["evidence"]["after_p99_s"] is None
 
 
+def test_holdout_revert_survives_raising_recorder():
+    """step() pops the guard before judging, so _settle_guard is the
+    only chance to undo an unconfirmed widen: a telemetry sink that
+    dies mid-verdict must not eat the revert (it sits in a finally)."""
+    class _BoomRecorder(object):
+        def record(self, kind, **fields):
+            if kind == "knob_ab":
+                raise RuntimeError("telemetry sink down")
+            return get_recorder().record(kind, **fields)
+
+    loop = _Loop(recorder=_BoomRecorder())
+    loop.step(now=15.0)                            # widen 0 -> 1, guard
+    loop.step(now=30.0, latency_s=0.5)             # hold-out regresses
+    with pytest.raises(RuntimeError):
+        loop.step(now=45.0, latency_s=0.5)         # verdict emit dies
+    # ... but the unconfirmed widen was still reverted on the way out
+    assert tuning.get("coalesce_window_ms") == 0.0
+    assert _counter("mesh_tpu_tuner_changes_total",
+                    knob="coalesce_window_ms", action="revert") == 1
+
+
 def test_latency_shrink_cancels_pending_widen_guard():
     loop = _Loop()
     loop.step(now=15.0)                            # widen 0 -> 1, guard

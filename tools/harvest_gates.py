@@ -103,11 +103,13 @@ def harvest(logdir):
 
 def _lint_family_suffix(rec):
     """Per-family breakdown for the whole-program rule packs (LOK =
-    lock order, PAL = Pallas DMA) — the families whose findings mean a
-    deadlock or a chip hang rather than hygiene, so the gate row names
-    them explicitly."""
+    lock order, PAL = Pallas DMA) and the flow-sensitive layer (RES =
+    resource pairing, LED = ledger lifecycle, FLW = tracer/host-sync
+    upgrades) — the families whose findings mean a deadlock, a chip
+    hang, or a leaked record rather than hygiene, so the gate row
+    names them explicitly."""
     parts = []
-    for fam in ("LOK", "PAL"):
+    for fam in ("LOK", "PAL", "RES", "LED", "FLW"):
         new = sum(1 for f in (rec.get("findings") or [])
                   if str(f.get("rule", "")).startswith(fam))
         kept = sum(1 for f in (rec.get("suppressed") or [])
