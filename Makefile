@@ -41,7 +41,7 @@ perfcheck:
 	@echo "----- [ ${package_name} ] Chip-free perf gate (staged probe + CPU proxies)"
 	@PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
 		MESH_TPU_BENCH_PARTIAL=/tmp/mesh_tpu_perfcheck_partial.json \
-		python bench.py --stages probe,pallas_proxy,accel_proxy,accel_stream_proxy,store_cold_start,tuner_convergence > /tmp/mesh_tpu_perfcheck_bench.json || true
+		python bench.py --stages probe,pallas_proxy,accel_proxy,accel_stream_proxy,mxu_proxy,store_cold_start,tuner_convergence > /tmp/mesh_tpu_perfcheck_bench.json || true
 	@python -m mesh_tpu.cli perfcheck /tmp/mesh_tpu_perfcheck_bench.json
 
 proxy-golden:
@@ -62,6 +62,12 @@ accel-stream-golden:
 		python bench.py --stage accel_stream_proxy > benchmarks/accel_stream_golden.json
 	@cat benchmarks/accel_stream_golden.json
 
+mxu-golden:
+	@echo "----- [ ${package_name} ] Recording the MXU matmul-form CPU golden"
+	@PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+		python bench.py --stage mxu_proxy > benchmarks/mxu_golden.json
+	@cat benchmarks/mxu_golden.json
+
 store-golden:
 	@echo "----- [ ${package_name} ] Recording the store cold-start CPU golden"
 	@PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
@@ -72,6 +78,7 @@ tuner-golden:
 	@echo "----- [ ${package_name} ] Recording the tuner convergence golden"
 	@PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu MESH_TPU_TUNER=1 \
 		MESH_TPU_COALESCE_WINDOW_MS= MESH_TPU_ACCEL_MIN_FACES= \
+		MESH_TPU_MXU_CROSSOVER_FACES= \
 		MESH_TPU_BVH_STREAM_BUFFERS= MESH_TPU_SERVE_LADDER= \
 		python bench.py --stage tuner_convergence > benchmarks/tuner_golden.json
 	@cat benchmarks/tuner_golden.json
@@ -102,4 +109,4 @@ docs:
 clean:
 	@rm -rf build dist *.egg-info doc/_build
 
-.PHONY: all import_tests unit_tests tpu_tests tests lint lint-fast bench perfcheck proxy-golden accel-golden accel-stream-golden store-golden tuner-golden gates sweep sdist wheel documentation docs clean
+.PHONY: all import_tests unit_tests tpu_tests tests lint lint-fast bench perfcheck proxy-golden accel-golden accel-stream-golden mxu-golden store-golden tuner-golden gates sweep sdist wheel documentation docs clean

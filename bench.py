@@ -1255,6 +1255,181 @@ def accel_stream_proxy_stage(n_rep=1):
     }
 
 
+def mxu_proxy_stage(n_rep=5):
+    """Stage ``mxu_proxy``: the chip-free MXU-reformulation metric.
+    Runs the dot-product (matmul-form) closest-point kernel family in
+    interpret mode over a clustered surface-proximal workload on a
+    ~32k-face parametric sphere and reports the throughput ratio of the
+    VPU plane-walk kernel to the bf16-screen + f32-exact-repair MXU
+    pipeline — the number that says the reformulation still pays for
+    itself.  Deterministic (fixed mesh, fixed queries): the checksum
+    pins exactness, the repair rate pins the bf16 screen's pruning
+    power (graded upward by perfcheck: a screen that stops pruning is
+    a regression even if timing noise hides it), and the XLA cost
+    model's FLOPs on the staged G matmul pin the op mix.
+
+    Queries are CLUSTER-CONTIGUOUS surface-proximal patches (one
+    cluster per query tile): the bf16 screen bounds min distance per
+    (query tile, face tile) cell, so it only prunes when a query tile
+    is a spatially compact patch with a tight worst case — exactly the
+    scan-registration workload the rope kernels serve.  Volume-filling
+    ``randn`` queries would never prune and would pin nothing.
+
+    Bit-identity contracts enforced every run (RuntimeError = stage
+    FAIL, no tolerance): repair == dense-MXU on the proxy workload AND
+    on a degenerate (collapsed-face) mesh; the BVH leaf-visit form's
+    bf16 walk == its f32 walk; the streamed leaf-visit form == the
+    resident one.  Sizes are overridable via MESH_TPU_MXU_PROXY_FACES /
+    MESH_TPU_MXU_PROXY_QUERIES."""
+    import jax
+    import jax.numpy as jnp
+
+    from mesh_tpu.accel.pallas_bvh import closest_point_pallas_bvh_mxu
+    from mesh_tpu.accel.pallas_stream import (
+        closest_point_pallas_bvh_stream_mxu,
+    )
+    from mesh_tpu.query.autotune import _sphere_mesh
+    from mesh_tpu.query.pallas_closest import (
+        _mxu_staged_inputs,
+        closest_point_pallas,
+        closest_point_pallas_mxu,
+        closest_point_pallas_mxu_repair,
+    )
+    from mesh_tpu.sphere import _icosphere
+
+    tile_q, tile_f = 128, 2048
+    n_faces = knobs.get_int("MESH_TPU_MXU_PROXY_FACES", 32768)
+    n_q = knobs.get_int("MESH_TPU_MXU_PROXY_QUERIES", 512)
+    v, f = _sphere_mesh(n_faces)
+    rng = np.random.RandomState(0)
+    n_cl = max(n_q // tile_q, 1)
+    per = n_q // n_cl
+    dirs = rng.randn(n_cl, 3)
+    dirs /= np.linalg.norm(dirs, axis=1, keepdims=True)
+    pts = (np.repeat(dirs * 1.005, per, axis=0)
+           + 0.002 * rng.randn(n_cl * per, 3)).astype(np.float32)
+    n_q = pts.shape[0]
+    kw = dict(tile_q=tile_q, tile_f=tile_f, interpret=True,
+              assume_nondegenerate=True)
+
+    # best-of-N with the two kernels INTERLEAVED, so a load spike on the
+    # shared CPU penalizes both sides instead of biasing the ratio
+    vpu_fn = lambda: closest_point_pallas(v, f, pts, **kw)   # noqa: E731
+    rep_fn = lambda: closest_point_pallas_mxu_repair(        # noqa: E731
+        v, f, pts, **kw)
+    jax.block_until_ready(vpu_fn()["sqdist"])       # compile + warm
+    jax.block_until_ready(rep_fn()["sqdist"])
+    t_vpu = t_rep = np.inf
+    for _ in range(max(int(n_rep), 1)):
+        t0 = time.perf_counter()
+        jax.block_until_ready(vpu_fn()["sqdist"])
+        t_vpu = min(t_vpu, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        jax.block_until_ready(rep_fn()["sqdist"])
+        t_rep = min(t_rep, time.perf_counter() - t0)
+
+    dense = closest_point_pallas_mxu(v, f, pts, **kw)
+    repaired, stats = closest_point_pallas_mxu_repair(
+        v, f, pts, with_stats=True, **kw)
+    for key in ("face", "point", "sqdist"):
+        if not np.array_equal(np.asarray(dense[key]),
+                              np.asarray(repaired[key])):
+            raise RuntimeError(
+                "bf16-screen + f32-repair diverged from the dense MXU "
+                "kernel on %r — the exact-repair contract is broken"
+                % key)
+    checksum = float(jnp.sum(repaired["sqdist"])
+                     + jnp.sum(repaired["point"]))
+
+    # degenerate-mesh parity: collapse a face stripe to slivers/points
+    # and require repair == dense with the safe Ericson tail — the bf16
+    # envelope must stay conservative where conditioning is worst
+    vi, fi = _icosphere(2)
+    vi = np.asarray(vi, np.float32)
+    fi = np.array(fi, np.int32)
+    fi[::7, 2] = fi[::7, 1]
+    pts_d = np.asarray(rng.randn(128, 3) * 0.7, np.float32)
+    dense_d = closest_point_pallas_mxu(
+        vi, fi, pts_d, tile_q=64, tile_f=256, interpret=True)
+    rep_d = closest_point_pallas_mxu_repair(
+        vi, fi, pts_d, tile_q=64, tile_f=256, interpret=True)
+    for key in ("face", "point", "sqdist"):
+        if not np.array_equal(np.asarray(dense_d[key]),
+                              np.asarray(rep_d[key])):
+            raise RuntimeError(
+                "bf16-screen + f32-repair diverged from the dense MXU "
+                "kernel on %r over a DEGENERATE mesh — the certified "
+                "envelope is not conservative" % key)
+
+    # leaf-visit forms: the rope-walk MXU variants must agree bit for
+    # bit — bf16 screen vs f32 walk, and streamed vs resident
+    vb, fb = _icosphere(3)
+    vb = np.asarray(vb, np.float32)
+    fb = np.asarray(fb, np.int32)
+    pts_b = rng.randn(256, 3)
+    pts_b /= np.linalg.norm(pts_b, axis=1, keepdims=True)
+    pts_b *= 1.0 + 0.05 * rng.randn(256, 1)
+    pts_b = np.asarray(pts_b, np.float32)
+    b32 = closest_point_pallas_bvh_mxu(vb, fb, pts_b, interpret=True)
+    b16 = closest_point_pallas_bvh_mxu(
+        vb, fb, pts_b, interpret=True, use_bf16=True)
+    s32 = closest_point_pallas_bvh_stream_mxu(
+        vb, fb, pts_b, interpret=True, use_bf16=True)
+    for key in ("face", "point", "sqdist"):
+        if not np.array_equal(np.asarray(b32[key]),
+                              np.asarray(b16[key])):
+            raise RuntimeError(
+                "BVH MXU bf16 walk diverged from its f32 walk on %r "
+                "— the leaf-visit screen is not conservative" % key)
+        if not np.array_equal(np.asarray(b32[key]),
+                              np.asarray(s32[key])):
+            raise RuntimeError(
+                "streamed MXU rope kernel diverged from the resident "
+                "one on %r — the bit-identity contract is broken" % key)
+
+    # XLA cost model on the staged G matmul: the op-mix fingerprint of
+    # the dot-product reformulation (one (Q,3)x(3,4F) contraction per
+    # tile pair).  Deterministic; perfcheck grades it upward.
+    flops = None
+    try:
+        g_arr = _mxu_staged_inputs(v, f, tile_f)[2]
+        lowered = jax.jit(
+            lambda pp, gg: jax.lax.dot_general(
+                pp, gg, dimension_numbers=(((1,), (0,)), ((), ())),
+                precision=jax.lax.Precision.HIGHEST)
+        ).lower(jnp.asarray(pts), g_arr)
+        cost = lowered.compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        if cost and cost.get("flops"):
+            flops = float(cost["flops"])
+    except Exception as e:      # noqa: BLE001 — cost model is best-effort
+        log("hlo cost analysis unavailable: %s" % e)
+
+    repair_rate = stats["repaired"] / float(max(stats["screened"], 1))
+    return {
+        "metric": "mxu_proxy_speedup",
+        "value": round(t_vpu / t_rep, 3),
+        "unit": "vpu_time/mxu_repair_time",
+        "vs_baseline": None,
+        "interpret": True,
+        "queries": n_q,
+        "faces": int(f.shape[0]),
+        "tile_q": tile_q,
+        "tile_f": tile_f,
+        "vpu_seconds": round(t_vpu, 3),
+        "mxu_repair_seconds": round(t_rep, 3),
+        "screened": stats["screened"],
+        "repaired": stats["repaired"],
+        "repair_rate": round(repair_rate, 4),
+        "checksum": round(checksum, 4),
+        "hlo_cost": {"flops": flops},
+        "dense_match": True,
+        "degenerate_match": True,
+        "leaf_visit_match": True,
+    }
+
+
 def store_cold_start_stage(n_rep=2):
     """Stage ``store_cold_start``: the chip-free mesh-store metric.
     Ingests the same >=200k-face parametric sphere the accel stages
@@ -1528,6 +1703,12 @@ _STAGE_DEFS = OrderedDict((
     ("accel_stream_proxy", (accel_stream_proxy_stage, 300.0, False, False,
                             {"JAX_PLATFORMS": "cpu",
                              "PALLAS_AXON_POOL_IPS": ""})),
+    # the matmul-form kernel family's chip-free twin: dense bf16+repair
+    # timing plus three bit-identity contracts, all under the
+    # interpreter — generous budget for the ~32k-face compiles
+    ("mxu_proxy", (mxu_proxy_stage, 300.0, False, False,
+                   {"JAX_PLATFORMS": "cpu",
+                    "PALLAS_AXON_POOL_IPS": ""})),
     # chip-free like the other proxies; budget covers two host BVH
     # builds per rep plus the CPU traversal on the ~210k-face sphere
     ("store_cold_start", (store_cold_start_stage, 420.0, False, False,
@@ -1544,6 +1725,7 @@ _STAGE_DEFS = OrderedDict((
                             "MESH_TPU_TUNER": "1",
                             "MESH_TPU_COALESCE_WINDOW_MS": "",
                             "MESH_TPU_ACCEL_MIN_FACES": "",
+                            "MESH_TPU_MXU_CROSSOVER_FACES": "",
                             "MESH_TPU_BVH_STREAM_BUFFERS": "",
                             "MESH_TPU_SERVE_LADDER": ""})),
 ))
@@ -1651,6 +1833,9 @@ def run_staged(names=None):
     stream = results.get("accel_stream_proxy")
     if stream is not None and stream.ok:
         record["stream"] = stream.record
+    mxu_res = results.get("mxu_proxy")
+    if mxu_res is not None and mxu_res.ok:
+        record["mxu"] = mxu_res.record
     store_res = results.get("store_cold_start")
     if store_res is not None and store_res.ok:
         record["store"] = store_res.record

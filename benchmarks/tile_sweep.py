@@ -8,7 +8,9 @@ answer "are we leaving tile-shape performance on the table?" in ~a
 minute per kernel.
 
     python benchmarks/tile_sweep.py [--queries 262144] [--faces 13776]
-    python benchmarks/tile_sweep.py --mxu        # experimental MXU tile
+    python benchmarks/tile_sweep.py --mxu        # MXU dot-product tile;
+                                                 # best shape feeds the
+                                                 # mxu_crossover calib.
     python benchmarks/tile_sweep.py --tri-tri    # Möller + segment tiles
                                                  # at the config-4 shape
 
@@ -74,6 +76,19 @@ def _closest_point_sweep(args):
         args.reps, args.queries,
     )
     summary = {"best": best, "n_errors": n_errors}
+    if best is not None and args.mxu:
+        # feed the winning MXU tile shape into the persisted crossover
+        # calibration (query/autotune.py): the routed facades then pick
+        # MXU-vs-VPU from a measurement at the sweep's best shape, with
+        # the same env-override / corrupt-cache contract as the other
+        # calibrations
+        from mesh_tpu.query import autotune
+
+        try:
+            summary["mxu_crossover"] = autotune.calibrate_mxu_crossover(
+                tile_q=best["tile_q"], tile_f=best["tile_f"], save=True)
+        except Exception as e:
+            summary["mxu_crossover_error"] = str(e)[:120]
     if best is not None and not args.mxu:
         # quantify the round-4/5 variant family at the best tile shape —
         # each row is the on-chip evidence for (or against) one variant:
@@ -163,7 +178,9 @@ def main(argv=None):
     parser.add_argument("--faces", type=int, default=13776)
     parser.add_argument("--reps", type=int, default=5)
     parser.add_argument("--mxu", action="store_true",
-                        help="sweep the experimental MXU-fed tile instead")
+                        help="sweep the MXU dot-product tile instead and "
+                             "persist the mxu_crossover calibration at "
+                             "the best shape")
     parser.add_argument("--tri-tri", action="store_true", dest="tri_tri",
                         help="sweep the triangle-triangle tiles instead")
     args = parser.parse_args(argv)

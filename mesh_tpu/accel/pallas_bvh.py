@@ -41,13 +41,21 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .build import get_index
-from ..query.pallas_closest import N_FACE_ROWS, _sqdist_tile_fast, \
-    fast_tile_rows
+from ..query.pallas_closest import N_FACE_ROWS, N_FACE_ROWS_MXU, \
+    _mxu_face_inputs, _mxu_reach_row, _mxu_screen_tile, _sqdist_tile_fast, \
+    _sqdist_tile_mxu, fast_tile_rows
 from ..query.pallas_culled import _MARGIN, _pad_rows_edge, _tile_spheres
 from ..query.point_triangle import closest_point_on_triangle
 from ..utils.jax_compat import tpu_compiler_params
 
-__all__ = ["closest_point_pallas_bvh"]
+__all__ = ["closest_point_pallas_bvh", "closest_point_pallas_bvh_mxu"]
+
+#: VMEM rows of the MXU rope variant's side-car plane array: the 11 MXU
+#: planes plus the corner-a reach row the bf16 screen consumes.  The G
+#: dot-operand matrix rides separately as (3, 4*Fp) — 8 physical
+#: sublanes after padding, so the resident MXU footprint is
+#: (N_MXU_ROPE_ROWS + 8 * 4) f32 rows per padded face (vmem lint rule).
+N_MXU_ROPE_ROWS = N_FACE_ROWS_MXU + 1
 
 _SEED_SUB = 128     # sub-block size for the seed upper bound
 
@@ -256,3 +264,209 @@ def closest_point_pallas_bvh(v, f, points, tile_q=128, tile_f=256,
         v32, f32, pts32, arr["order"], arr["node_lo"], arr["node_hi"],
         arr["node_skip"], arr["node_leaf"], arr["center"],
         tile_q=int(tile_q), tile_f=int(tile_f), interpret=bool(interpret))
+
+
+# -- MXU leaf-visit variant ------------------------------------------------
+#
+# Same rope walk, same pruning, same accumulators — ONLY the leaf visit
+# differs: instead of the 19-plane VPU Ericson tile it slices the
+# pre-grouped G dot-operand matrix and the 11 MXU planes and runs the
+# matmul-form pair test (pallas_closest._sqdist_tile_mxu).  Face ids
+# therefore match the VPU rope kernel up to distance ties, and the
+# shared epilogue recomputes the winner exactly, so point/sqdist carry
+# the identical contract.  With ``use_bf16`` the visit first runs the
+# certified bf16 corner-distance screen against the tile's running best
+# (a true upper bound from the sphere seed onward); tiles the screen
+# proves empty skip the f32 matmul + Ericson tail entirely, and the
+# per-tile full-visit count lands in an SMEM output so the facade can
+# feed the repair series.  Skipping is conservative by the envelope
+# argument in pallas_closest (any face that could still IMPROVE the
+# strict-< merge survives), so results are bit-identical to the
+# ``use_bf16=False`` walk.
+
+
+def _mxu_rope_rows(tri_s, tile_f):
+    """MXU face-side operands in Morton order: the per-tile-grouped G
+    matrix (3, 4*Fp) and the (N_MXU_ROPE_ROWS, Fp) side-car of the 11
+    MXU planes plus the reach row."""
+    g, planes = _mxu_face_inputs(tri_s, tile_f)
+    reach = _mxu_reach_row(tri_s, tile_f)
+    rows = jnp.concatenate(list(planes) + [reach], axis=0)
+    return g, rows
+
+
+def _make_rope_kernel_mxu(tile_q, tile_f, n_nodes, use_bf16):
+    def kernel(qx, qy, qz, q3, qp2, seed, boxes, topo, g_all, mrows,
+               out_d, out_i, out_lv, out_rep):
+        # the box-prune arithmetic reads the same (TQ, 1) columns as the
+        # VPU kernel so the traversal order is literally identical; the
+        # (TQ, 3) block + its squared norm feed the matmul form
+        px, py, pz = qx[...], qy[...], qz[...]          # (TQ, 1)
+        p = q3[...]                                     # (TQ, 3)
+        p2 = qp2[...]                                   # (TQ, 1)
+
+        def cond(carry):
+            return carry[0] < n_nodes
+
+        def body(carry):
+            node, acc_d, acc_i, leaves, reps = carry
+            dx = jnp.maximum(
+                jnp.maximum(boxes[node, 0] - px, px - boxes[node, 3]), 0.0)
+            dy = jnp.maximum(
+                jnp.maximum(boxes[node, 1] - py, py - boxes[node, 4]), 0.0)
+            dz = jnp.maximum(
+                jnp.maximum(boxes[node, 2] - pz, pz - boxes[node, 5]), 0.0)
+            lb2 = jnp.min(dx * dx + dy * dy + dz * dz)  # tile lower bound
+            prune = lb2 * (1.0 - _MARGIN) > jnp.max(acc_d)
+            skip_to = topo[node, 0]
+            leaf_start = topo[node, 1]
+            is_leaf = leaf_start >= 0
+            take = jnp.logical_and(is_leaf, jnp.logical_not(prune))
+
+            def visit(args):
+                ad, ai, rp = args
+                # tile j's G block starts at column 4 * tile_f * j and
+                # leaf_start == tile_f * j, hence the 4x offset
+                g_blk = pl.load(
+                    g_all, (pl.ds(0, 3), pl.ds(leaf_start * 4, 4 * tile_f)))
+                planes = [
+                    pl.load(mrows, (pl.ds(k, 1), pl.ds(leaf_start, tile_f)))
+                    for k in range(N_FACE_ROWS_MXU)
+                ]
+
+                def full(args2):
+                    ad2, ai2, rp2 = args2
+                    d2 = _sqdist_tile_mxu(p, p2, g_blk, *planes)
+                    tile_min = jnp.min(d2, axis=1, keepdims=True)
+                    tile_arg = (jnp.argmin(d2, axis=1)
+                                .astype(jnp.int32)[:, None] + leaf_start)
+                    better = tile_min < ad2
+                    return (jnp.where(better, tile_min, ad2),
+                            jnp.where(better, tile_arg, ai2), rp2 + 1)
+
+                if not use_bf16:
+                    return full((ad, ai, rp))
+                reach = pl.load(
+                    mrows, (pl.ds(N_FACE_ROWS_MXU, 1),
+                            pl.ds(leaf_start, tile_f)))
+                # acc_d is a certified upper bound per query (seed is
+                # margin-inflated, merges only tighten it), so a tile
+                # with no survivor provably holds no improving face
+                survives = jnp.any(_mxu_screen_tile(
+                    p, p2, g_blk[:, 3 * tile_f:], planes[3],
+                    reach=reach, ub=ad))
+                return jax.lax.cond(
+                    survives, full, lambda args2: args2, (ad, ai, rp))
+
+            acc_d, acc_i, reps = jax.lax.cond(
+                take, visit, lambda args: args, (acc_d, acc_i, reps))
+            leaves = leaves + jnp.where(take, 1, 0)
+            node = jnp.where(jnp.logical_or(prune, is_leaf),
+                             skip_to, node + 1)
+            return node, acc_d, acc_i, leaves, reps
+
+        _node, acc_d, acc_i, leaves, reps = jax.lax.while_loop(
+            cond, body,
+            (jnp.int32(0), seed[...],
+             jnp.zeros((tile_q, 1), jnp.int32), jnp.int32(0),
+             jnp.int32(0)))
+        out_d[...] = acc_d
+        out_i[...] = acc_i
+        out_lv[0, 0] = leaves
+        out_rep[0, 0] = reps
+
+    return kernel
+
+
+@partial(jax.jit, static_argnames=("tile_q", "tile_f", "interpret",
+                                   "use_bf16"))
+def _pallas_bvh_run_mxu(v32, f, pts32, order_p, node_lo, node_hi,
+                        node_skip, node_leaf, center_b, tile_q, tile_f,
+                        interpret, use_bf16):
+    n_q = pts32.shape[0]
+    vc, pts, qorder, pts_s, seed, boxes, topo, _rows = _rope_operands(
+        v32, f, pts32, order_p, center_b, node_lo, node_hi, node_skip,
+        node_leaf, tile_q, tile_f)
+    # the 19 VPU rows are unused here (XLA drops them); the MXU operands
+    # come from the same Morton-ordered centered frame
+    tri_s = (v32 - center_b)[f][order_p]
+    g, mrows = _mxu_rope_rows(tri_s, tile_f)
+    p2 = jnp.sum(pts_s * pts_s, axis=-1, keepdims=True)
+    q_pad = pts_s.shape[0]
+    n_nodes = node_skip.shape[0]
+
+    n_tiles = q_pad // tile_q
+    qcol = pl.BlockSpec((tile_q, 1), lambda i: (i, 0))
+    full = lambda shape: pl.BlockSpec(shape, lambda i: (0, 0))  # noqa: E731
+    smem_full = lambda shape: pl.BlockSpec(                     # noqa: E731
+        shape, lambda i: (0, 0), memory_space=pltpu.SMEM)
+    smem_out = pl.BlockSpec((1, 1), lambda i: (i, 0),
+                            memory_space=pltpu.SMEM)
+
+    out_d, out_i, out_lv, out_rep = pl.pallas_call(
+        _make_rope_kernel_mxu(tile_q, tile_f, n_nodes, use_bf16),
+        grid=(n_tiles,),
+        in_specs=[
+            qcol, qcol, qcol,
+            pl.BlockSpec((tile_q, 3), lambda i: (i, 0)),
+            qcol, qcol,
+            smem_full(boxes.shape),
+            smem_full(topo.shape),
+            full(g.shape),
+            full(mrows.shape),
+        ],
+        out_specs=[
+            pl.BlockSpec((tile_q, 1), lambda i: (i, 0)),
+            pl.BlockSpec((tile_q, 1), lambda i: (i, 0)),
+            smem_out,
+            smem_out,
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((q_pad, 1), jnp.float32),
+            jax.ShapeDtypeStruct((q_pad, 1), jnp.int32),
+            jax.ShapeDtypeStruct((n_tiles, 1), jnp.int32),
+            jax.ShapeDtypeStruct((n_tiles, 1), jnp.int32),
+        ],
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(pts_s[:, 0:1], pts_s[:, 1:2], pts_s[:, 2:3], pts_s, p2, seed,
+      boxes, topo, g, mrows)
+
+    out = _rope_epilogue(out_i, out_lv, order_p, qorder, vc, f, pts,
+                         center_b, n_q, tile_q, tile_f)
+    out["mxu_screened"] = jnp.sum(out_lv[:, 0])
+    out["mxu_repaired"] = jnp.sum(out_rep[:, 0])
+    return out
+
+
+def closest_point_pallas_bvh_mxu(v, f, points, tile_q=128, tile_f=256,
+                                 interpret=False, index=None,
+                                 rebuild_mismatched=False, use_bf16=False,
+                                 with_stats=False):
+    """Closest point via the resident rope kernel with MXU leaf visits.
+    Identical traversal/result contract to ``closest_point_pallas_bvh``
+    (faces equal up to distance ties, winner recomputed exactly); the
+    leaf pair tests run in matmul form, optionally behind the certified
+    bf16 screen (``use_bf16`` — results stay bit-identical, screened
+    tiles merely skip the f32 work they provably cannot affect).
+
+    ``with_stats=True`` additionally returns ``{"screened", "repaired"}``
+    — taken leaf visits vs. visits that ran the full f32 tile (equal
+    when ``use_bf16=False``) — which the accel facade feeds into the
+    ``mesh_tpu_query_mxu_repair_total`` series."""
+    v32 = np.asarray(v, np.float32)
+    f32 = np.asarray(f, np.int32)
+    pts32 = np.asarray(points, np.float32).reshape(-1, 3)
+    index = _coarse_index(v32, f32, tile_f, index, rebuild_mismatched)
+    arr = index.arrays
+    out = dict(_pallas_bvh_run_mxu(
+        v32, f32, pts32, arr["order"], arr["node_lo"], arr["node_hi"],
+        arr["node_skip"], arr["node_leaf"], arr["center"],
+        tile_q=int(tile_q), tile_f=int(tile_f), interpret=bool(interpret),
+        use_bf16=bool(use_bf16)))
+    screened = int(out.pop("mxu_screened"))
+    repaired = int(out.pop("mxu_repaired"))
+    if with_stats:
+        return out, {"screened": screened, "repaired": repaired}
+    return out

@@ -59,11 +59,15 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .pallas_bvh import _coarse_index, _rope_epilogue, _rope_operands
-from ..query.pallas_closest import N_FACE_ROWS, _sqdist_tile_fast
+from ..query.pallas_closest import N_FACE_ROWS, N_FACE_ROWS_MXU, \
+    _mxu_plane_rows, _mxu_reach_row, _mxu_screen_tile, _pad_cols, \
+    _sqdist_tile_fast, _sqdist_tile_mxu
 from ..query.pallas_culled import _MARGIN
 from ..utils.jax_compat import tpu_compiler_params
 
-__all__ = ["closest_point_pallas_bvh_stream", "stream_vmem_bytes"]
+__all__ = ["closest_point_pallas_bvh_stream",
+           "closest_point_pallas_bvh_stream_mxu", "stream_vmem_bytes",
+           "stream_mxu_vmem_bytes"]
 
 #: f32 rows per leaf block (== pallas_closest.N_FACE_ROWS; restated as a
 #: literal so the static VMEM lint rule can price the scratch ring)
@@ -75,6 +79,16 @@ STREAM_ROWS = 19
 STREAM_ROW_PAD = 24
 
 assert STREAM_ROWS == N_FACE_ROWS
+
+#: f32 rows per MXU leaf block: the 12 dot-operand component rows
+#: (ab/ac/n/a x,y,z — the kernel reassembles them into the (3, 4*tile_f)
+#: G block with one lane-axis concat), the 11 MXU planes, and the reach
+#: row.  24 is already a whole (8, 128) f32 sublane quantum, so the MXU
+#: ring needs no extra pad rows (MXU_STREAM_ROW_PAD == MXU_STREAM_ROWS).
+MXU_STREAM_ROWS = 12 + N_FACE_ROWS_MXU + 1
+MXU_STREAM_ROW_PAD = 24
+
+assert MXU_STREAM_ROWS == MXU_STREAM_ROW_PAD
 
 
 def stream_vmem_bytes(tile_q, tile_f, n_buffers):
@@ -251,3 +265,243 @@ def closest_point_pallas_bvh_stream(v, f, points, tile_q=128, tile_f=256,
         arr["node_skip"], arr["node_leaf"], arr["center"],
         tile_q=int(tile_q), tile_f=int(tile_f),
         n_buffers=int(n_buffers), interpret=bool(interpret))
+
+
+# -- MXU leaf-visit variant ------------------------------------------------
+#
+# Same prefetch queue, same frozen-bound refill, same merge — only the
+# landed block's pair test changes: each ring slot carries the
+# MXU_STREAM_ROWS layout (12 dot-operand component rows + 11 planes +
+# reach) and the visit reassembles the (3, 4*tile_f) G block and runs
+# the matmul-form tile (pallas_closest._sqdist_tile_mxu).  Still ONE
+# dense row-slice DMA per leaf.  With ``use_bf16`` the certified screen
+# (pallas_bvh commentary) gates the f32 compute on already-landed bytes
+# — DMA traffic is unchanged, only the matmul + Ericson tail is skipped,
+# and results stay bit-identical to the unscreened MXU walk.
+
+
+def stream_mxu_vmem_bytes(tile_q, tile_f, n_buffers):
+    """Static VMEM footprint of one MXU streamed grid step in bytes:
+    the MXU leaf ring plus the query/accumulator columns (qx/qy/qz, the
+    (TQ, 3) matmul block, p2, seed, out_d/out_i)."""
+    ring = n_buffers * MXU_STREAM_ROW_PAD * tile_f * 4
+    cols = 10 * tile_q * 4
+    return ring + cols
+
+
+def _mxu_stream_rows(tri_s, tile_f):
+    """The (MXU_STREAM_ROWS, Fp) HBM rows array the MXU stream kernel
+    slices per leaf: ab/ac/n/a component rows (the G operands, one
+    lane-concat away from matmul form), the 11 MXU planes, and the
+    reach row — all in Morton face order."""
+    a = tri_s[:, 0]
+    ab = tri_s[:, 1] - a
+    ac = tri_s[:, 2] - a
+    n = jnp.cross(ab, ac)
+    comp = _pad_cols(
+        jnp.concatenate(
+            [jnp.transpose(x) for x in (ab, ac, n, a)], axis=0),
+        tile_f, 0.0)                                     # (12, Fp)
+    planes = _mxu_plane_rows(tri_s, tile_f)
+    reach = _mxu_reach_row(tri_s, tile_f)
+    return jnp.concatenate([comp] + list(planes) + [reach], axis=0)
+
+
+def _make_stream_kernel_mxu(tile_q, tile_f, n_nodes, n_buffers, use_bf16):
+    def kernel(qx, qy, qz, q3, qp2, seed, boxes, topo, rows_hbm,
+               out_d, out_i, out_lv, out_rep, buf, ring, sem):
+        px, py, pz = qx[...], qy[...], qz[...]          # (TQ, 1)
+        p = q3[...]                                     # (TQ, 3)
+        p2 = qp2[...]                                   # (TQ, 1)
+
+        def leaf_dma(slot, leaf_start):
+            return pltpu.make_async_copy(
+                rows_hbm.at[:, pl.ds(leaf_start, tile_f)],
+                buf.at[slot, pl.ds(0, MXU_STREAM_ROWS)], sem.at[slot])
+
+        def refill(node, head, count, bound):
+            def cond(carry):
+                nd, cnt = carry
+                return jnp.logical_and(nd < n_nodes, cnt < n_buffers)
+
+            def body(carry):
+                nd, cnt = carry
+                dx = jnp.maximum(
+                    jnp.maximum(boxes[nd, 0] - px, px - boxes[nd, 3]), 0.0)
+                dy = jnp.maximum(
+                    jnp.maximum(boxes[nd, 1] - py, py - boxes[nd, 4]), 0.0)
+                dz = jnp.maximum(
+                    jnp.maximum(boxes[nd, 2] - pz, pz - boxes[nd, 5]), 0.0)
+                lb2 = jnp.min(dx * dx + dy * dy + dz * dz)
+                prune = lb2 * (1.0 - _MARGIN) > bound
+                skip_to = topo[nd, 0]
+                leaf_start = topo[nd, 1]
+                is_leaf = leaf_start >= 0
+                take = jnp.logical_and(is_leaf, jnp.logical_not(prune))
+
+                @pl.when(take)
+                def _enqueue():
+                    slot = jax.lax.rem(head + cnt, n_buffers)
+                    ring[slot] = leaf_start
+                    leaf_dma(slot, leaf_start).start()
+
+                nd = jnp.where(jnp.logical_or(prune, is_leaf),
+                               skip_to, nd + 1)
+                return nd, cnt + jnp.where(take, 1, 0)
+
+            return jax.lax.while_loop(cond, body, (node, count))
+
+        seed0 = seed[...]
+        node0, count0 = refill(jnp.int32(0), jnp.int32(0), jnp.int32(0),
+                               jnp.max(seed0))
+
+        def cond(carry):
+            return carry[6] > 0                 # leaves still in flight
+
+        def body(carry):
+            node, acc_d, acc_i, leaves, reps, head, count = carry
+            leaf_start = ring[head]
+            leaf_dma(head, leaf_start).wait()
+            block = buf[head]                   # (24, tile_f)
+            g_blk = jnp.concatenate(
+                [block[0:3], block[3:6], block[6:9], block[9:12]],
+                axis=1)                         # (3, 4*tile_f): [ab|ac|n|a]
+            planes = [block[12 + k:13 + k, :]
+                      for k in range(N_FACE_ROWS_MXU)]
+
+            def full(args):
+                ad, ai, rp = args
+                d2 = _sqdist_tile_mxu(p, p2, g_blk, *planes)
+                tile_min = jnp.min(d2, axis=1, keepdims=True)
+                tile_arg = (jnp.argmin(d2, axis=1)
+                            .astype(jnp.int32)[:, None] + leaf_start)
+                better = tile_min < ad
+                return (jnp.where(better, tile_min, ad),
+                        jnp.where(better, tile_arg, ai), rp + 1)
+
+            if use_bf16:
+                survives = jnp.any(_mxu_screen_tile(
+                    p, p2, block[9:12], planes[3],
+                    reach=block[23:24, :], ub=acc_d))
+                acc_d, acc_i, reps = jax.lax.cond(
+                    survives, full, lambda args: args,
+                    (acc_d, acc_i, reps))
+            else:
+                acc_d, acc_i, reps = full((acc_d, acc_i, reps))
+            leaves = leaves + 1
+            head = jax.lax.rem(head + 1, n_buffers)
+            node, count = refill(node, head, count - 1, jnp.max(acc_d))
+            return node, acc_d, acc_i, leaves, reps, head, count
+
+        _nd, acc_d, acc_i, leaves, reps, _h, _c = jax.lax.while_loop(
+            cond, body,
+            (node0, seed0, jnp.zeros((tile_q, 1), jnp.int32),
+             jnp.int32(0), jnp.int32(0), jnp.int32(0), count0))
+        out_d[...] = acc_d
+        out_i[...] = acc_i
+        out_lv[0, 0] = leaves
+        out_rep[0, 0] = reps
+
+    return kernel
+
+
+@partial(jax.jit,
+         static_argnames=("tile_q", "tile_f", "n_buffers", "interpret",
+                          "use_bf16"))
+def _pallas_stream_run_mxu(v32, f, pts32, order_p, node_lo, node_hi,
+                           node_skip, node_leaf, center_b, tile_q=128,
+                           tile_f=256, n_buffers=2, interpret=False,
+                           use_bf16=False):
+    n_q = pts32.shape[0]
+    vc, pts, qorder, pts_s, seed, boxes, topo, _rows = _rope_operands(
+        v32, f, pts32, order_p, center_b, node_lo, node_hi, node_skip,
+        node_leaf, tile_q, tile_f)
+    tri_s = (v32 - center_b)[f][order_p]
+    mrows = _mxu_stream_rows(tri_s, tile_f)
+    p2 = jnp.sum(pts_s * pts_s, axis=-1, keepdims=True)
+    q_pad = pts_s.shape[0]
+    n_nodes = node_skip.shape[0]
+
+    n_tiles = q_pad // tile_q
+    qcol = pl.BlockSpec((tile_q, 1), lambda i: (i, 0))
+    smem_full = lambda shape: pl.BlockSpec(                     # noqa: E731
+        shape, lambda i: (0, 0), memory_space=pltpu.SMEM)
+    smem_out = pl.BlockSpec((1, 1), lambda i: (i, 0),
+                            memory_space=pltpu.SMEM)
+
+    out_d, out_i, out_lv, out_rep = pl.pallas_call(
+        _make_stream_kernel_mxu(tile_q, tile_f, n_nodes, n_buffers,
+                                use_bf16),
+        grid=(n_tiles,),
+        in_specs=[
+            qcol, qcol, qcol,
+            pl.BlockSpec((tile_q, 3), lambda i: (i, 0)),
+            qcol, qcol,
+            smem_full(boxes.shape),
+            smem_full(topo.shape),
+            pl.BlockSpec(memory_space=pltpu.ANY),   # rows stay in HBM
+        ],
+        out_specs=[
+            pl.BlockSpec((tile_q, 1), lambda i: (i, 0)),
+            pl.BlockSpec((tile_q, 1), lambda i: (i, 0)),
+            smem_out,
+            smem_out,
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((q_pad, 1), jnp.float32),
+            jax.ShapeDtypeStruct((q_pad, 1), jnp.int32),
+            jax.ShapeDtypeStruct((n_tiles, 1), jnp.int32),
+            jax.ShapeDtypeStruct((n_tiles, 1), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((n_buffers, MXU_STREAM_ROW_PAD, tile_f),
+                       jnp.float32),
+            pltpu.SMEM((n_buffers,), jnp.int32),
+            pltpu.SemaphoreType.DMA((n_buffers,)),
+        ],
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(pts_s[:, 0:1], pts_s[:, 1:2], pts_s[:, 2:3], pts_s, p2, seed,
+      boxes, topo, mrows)
+
+    out = _rope_epilogue(out_i, out_lv, order_p, qorder, vc, f, pts,
+                         center_b, n_q, tile_q, tile_f)
+    out["mxu_screened"] = jnp.sum(out_lv[:, 0])
+    out["mxu_repaired"] = jnp.sum(out_rep[:, 0])
+    return out
+
+
+def closest_point_pallas_bvh_stream_mxu(v, f, points, tile_q=128,
+                                        tile_f=256, n_buffers=2,
+                                        interpret=False, index=None,
+                                        rebuild_mismatched=False,
+                                        use_bf16=False, with_stats=False):
+    """Closest point via the streamed rope kernel with MXU leaf visits.
+    Same contract and constraints as ``closest_point_pallas_bvh_stream``
+    (bit-identical faces/points to the resident MXU walk, no face
+    ceiling); ``with_stats=True`` adds the ``{"screened", "repaired"}``
+    pair the repair series consumes, as in
+    ``closest_point_pallas_bvh_mxu``."""
+    if int(tile_f) % 128:
+        raise ValueError("streamed kernel needs tile_f %% 128 == 0 "
+                         "(got %d)" % tile_f)
+    if int(n_buffers) < 2:
+        raise ValueError("streamed kernel needs n_buffers >= 2 "
+                         "(got %d)" % n_buffers)
+    v32 = np.asarray(v, np.float32)
+    f32 = np.asarray(f, np.int32)
+    pts32 = np.asarray(points, np.float32).reshape(-1, 3)
+    index = _coarse_index(v32, f32, tile_f, index, rebuild_mismatched)
+    arr = index.arrays
+    out = dict(_pallas_stream_run_mxu(
+        v32, f32, pts32, arr["order"], arr["node_lo"], arr["node_hi"],
+        arr["node_skip"], arr["node_leaf"], arr["center"],
+        tile_q=int(tile_q), tile_f=int(tile_f),
+        n_buffers=int(n_buffers), interpret=bool(interpret),
+        use_bf16=bool(use_bf16)))
+    screened = int(out.pop("mxu_screened"))
+    repaired = int(out.pop("mxu_repaired"))
+    if with_stats:
+        return out, {"screened": screened, "repaired": repaired}
+    return out

@@ -360,13 +360,17 @@ def closest_faces_and_points_accel(v, f, points, kind=None, index=None,
         disagrees).
     :param with_stats: also return ``{"pair_tests", "fallback",
         "tight_frac", "kind", "backend"}`` — ``backend`` is ``"xla"``,
-        ``"pallas"`` (resident) or ``"pallas_stream"``.
+        ``"pallas"`` (resident), ``"pallas_stream"``, or their MXU
+        leaf-visit forms ``"pallas_mxu"`` / ``"pallas_stream_mxu"``
+        (MESH_TPU_MXU past the calibrated crossover).
     :param record: optional ``obs.ledger.RequestRecord``; the traversal
         stamps its ``device`` stage and backend onto it (the serving
         tier's accel rung threads the request's ledger record here).
     """
     from ..obs.trace import span as obs_span
-    from ..utils.dispatch import accel_kind, no_engine, pallas_default
+    from ..utils.dispatch import (
+        accel_kind, mxu_bf16_enabled, mxu_enabled, no_engine,
+        pallas_default)
 
     if kind is None:
         kind = index.kind if index is not None else accel_kind()
@@ -376,6 +380,13 @@ def closest_faces_and_points_accel(v, f, points, kind=None, index=None,
     backend = "xla"
     variant = (pallas_bvh_variant(n_faces)
                if kind == "bvh" and pallas_default() else None)
+    use_mxu = use_bf16 = False
+    if variant is not None and mxu_enabled():
+        from ..query.autotune import mxu_crossover_faces
+
+        if n_faces >= mxu_crossover_faces():
+            use_mxu = True
+            use_bf16 = mxu_bf16_enabled()
     tile_q = tile_f = n_buffers = None
     if variant == "stream":
         from ..query.autotune import stream_tile_params
@@ -399,9 +410,19 @@ def closest_faces_and_points_accel(v, f, points, kind=None, index=None,
 
             index = get_planner().accel_companion(v, f_np, kind=kind,
                                                   **params)
+    mxu_stats = None
     with obs_span("accel.traverse", kind=kind, faces=n_faces,
                   queries=n_queries) as sp:
-        if variant == "resident":
+        if variant == "resident" and use_mxu:
+            from .pallas_bvh import closest_point_pallas_bvh_mxu
+
+            backend = "pallas_mxu"
+            res, mxu_stats = closest_point_pallas_bvh_mxu(
+                np.asarray(v, np.float32), f_np.astype(np.int32),
+                np.asarray(points, np.float32).reshape(-1, 3),
+                index=index, rebuild_mismatched=True,
+                use_bf16=use_bf16, with_stats=True)
+        elif variant == "resident":
             from .pallas_bvh import closest_point_pallas_bvh
 
             backend = "pallas"
@@ -409,6 +430,16 @@ def closest_faces_and_points_accel(v, f, points, kind=None, index=None,
                 np.asarray(v, np.float32), f_np.astype(np.int32),
                 np.asarray(points, np.float32).reshape(-1, 3),
                 index=index, rebuild_mismatched=True)
+        elif variant == "stream" and use_mxu:
+            from .pallas_stream import closest_point_pallas_bvh_stream_mxu
+
+            backend = "pallas_stream_mxu"
+            res, mxu_stats = closest_point_pallas_bvh_stream_mxu(
+                np.asarray(v, np.float32), f_np.astype(np.int32),
+                np.asarray(points, np.float32).reshape(-1, 3),
+                tile_q=tile_q, tile_f=tile_f, n_buffers=n_buffers,
+                index=index, rebuild_mismatched=True,
+                use_bf16=use_bf16, with_stats=True)
         elif variant == "stream":
             from .pallas_stream import closest_point_pallas_bvh_stream
 
@@ -430,6 +461,12 @@ def closest_faces_and_points_accel(v, f, points, kind=None, index=None,
         record.stamp("device")
         record.set(backend=backend)
     _record_pair_tests(pairs, kind)
+    if mxu_stats is not None and use_bf16:
+        from ..query.culled import _record_mxu_repair
+
+        _record_mxu_repair(
+            mxu_stats["screened"], mxu_stats["repaired"],
+            "stream" if variant == "stream" else "bvh")
     if loose.size:
         from ..query.culled import _record_fallback
 

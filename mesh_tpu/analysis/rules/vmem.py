@@ -15,11 +15,15 @@ arithmetic over those; unresolvable specs are skipped, and the budget
 message says how many specs it could price.
 
 Pricing is of the *padded* physical footprint: the last two dims are
-rounded up to the (8, 128) f32 tile (matching what VMEM002/VMEM003
-warn about), and any leading dims multiply it — so a double-buffered
-DMA ring like ``pltpu.VMEM((n_buffers, rows, tile_f), f32)`` is
-charged ``n_buffers`` times its padded block, the way Mosaic actually
-allocates it.
+rounded up to the dtype's Mosaic tile — (8, 128) for 4-byte dtypes,
+(16, 128) for 2-byte (bf16 packs two values per sublane row), (32,
+128) for 1-byte — matching what VMEM002/VMEM003 warn about, and any
+leading dims multiply it: a double-buffered DMA ring like
+``pltpu.VMEM((n_buffers, rows, tile_f), f32)`` is charged
+``n_buffers`` times its padded block, the way Mosaic actually
+allocates it.  The dtype-aware sublane multiple matters for the MXU
+screen's bf16 operand scratch, whose sublane padding an f32-priced
+budget would under-charge by up to 2x.
 
 Codes:
 
@@ -55,16 +59,27 @@ def _last_part(name):
     return name.rsplit(".", 1)[-1] if name else None
 
 
+def _sublane_multiple(itemsize):
+    """Mosaic's minimum tile holds 8 rows of 4-byte lanes, and narrower
+    dtypes PACK: the physical tile is (8 * 4 / itemsize, 128), so bf16
+    tiles are (16, 128) and int8 (32, 128).  Pricing a bf16 scratch
+    with the f32 sublane multiple would under-charge its padding by up
+    to 2x — exactly the MXU screen's bf16 operand staging shape."""
+    return max(8, 32 // max(1, int(itemsize)))
+
+
 def _padded_bytes(dims, itemsize):
     """Physical footprint of one block: last two dims rounded up to the
-    (8, 128) tile (dims of 1 stay 1 — scalar rows/columns are exempt,
-    same as the VMEM002/VMEM003 checks), leading dims (buffer rings,
-    stacked scratch) multiplying the padded tile count."""
+    dtype's Mosaic tile — (8, 128) for f32, (16, 128) for 2-byte dtypes
+    (dims of 1 stay 1 — scalar rows/columns are exempt, same as the
+    VMEM002/VMEM003 checks), leading dims (buffer rings, stacked
+    scratch) multiplying the padded tile count."""
     padded = [int(d) for d in dims]
     if padded and padded[-1] > 1:
         padded[-1] = -(-padded[-1] // 128) * 128
     if len(padded) >= 2 and padded[-2] > 1:
-        padded[-2] = -(-padded[-2] // 8) * 8
+        sub = _sublane_multiple(itemsize)
+        padded[-2] = -(-padded[-2] // sub) * sub
     size = itemsize
     for d in padded:
         size *= d
@@ -126,7 +141,8 @@ class VmemBudgetRule(Rule):
                     unpriced += 1
                     continue
                 dims = [env.resolve(d) for d in shape.elts]
-                findings.extend(self._tiling_findings(ctx, spec, dims))
+                findings.extend(
+                    self._tiling_findings(ctx, spec, dims, itemsize))
                 if dims and all(isinstance(d, (int, float)) and d > 0
                                 for d in dims):
                     priced += 1
@@ -150,7 +166,7 @@ class VmemBudgetRule(Rule):
         return findings
 
     @staticmethod
-    def _tiling_findings(ctx, spec, dims):
+    def _tiling_findings(ctx, spec, dims, itemsize=_DEFAULT_ITEMSIZE):
         out = []
         if not dims:
             return out
@@ -159,16 +175,18 @@ class VmemBudgetRule(Rule):
             out.append(ctx.finding(
                 "VMEM002", "warning", spec,
                 "block lane dimension %d is not a multiple of 128: "
-                "Mosaic pads each (8, 128) f32 tile, wasting VMEM and "
-                "DMA bandwidth" % lane,
+                "Mosaic pads each (%d, 128) tile, wasting VMEM and "
+                "DMA bandwidth" % (lane, _sublane_multiple(itemsize)),
                 hint="pad the lane dim to 128 (mask the tail) or fold "
                      "the small axis into the sublane dim"))
         if len(dims) >= 2:
             sublane = dims[-2]
-            if isinstance(sublane, int) and sublane > 1 and sublane % 8:
+            sub = _sublane_multiple(itemsize)
+            if isinstance(sublane, int) and sublane > 1 and sublane % sub:
                 out.append(ctx.finding(
                     "VMEM003", "note", spec,
-                    "block sublane dimension %d is not a multiple of 8 "
-                    "(padded to the next (8, 128) f32 tile row)"
-                    % sublane))
+                    "block sublane dimension %d is not a multiple of "
+                    "%d for this %d-byte dtype (padded to the next "
+                    "(%d, 128) tile row)"
+                    % (sublane, sub, itemsize, sub)))
         return out

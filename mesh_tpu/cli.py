@@ -452,6 +452,10 @@ def cmd_perfcheck(args):
         args.tuner_golden or os.path.join(repo_root, "benchmarks",
                                           "tuner_golden.json"),
         "tuner golden")
+    mxu_golden = _load_optional(
+        args.mxu_golden or os.path.join(repo_root, "benchmarks",
+                                        "mxu_golden.json"),
+        "mxu golden")
     rc, lines = perfcheck(doc, baseline=baseline, proxy_golden=golden,
                           proxy_tol=args.proxy_tol,
                           headline_tol=args.headline_tol,
@@ -463,7 +467,9 @@ def cmd_perfcheck(args):
                           store_golden=store_golden,
                           store_tol=args.store_tol,
                           tuner_golden=tuner_golden,
-                          tuner_tol=args.tuner_tol)
+                          tuner_tol=args.tuner_tol,
+                          mxu_golden=mxu_golden,
+                          mxu_tol=args.mxu_tol)
     if args.json:
         json.dump({"rc": rc, "lines": lines}, sys.stdout, indent=2)
         sys.stdout.write("\n")
@@ -978,6 +984,15 @@ def main():
                              "0.6: disk + interpreter timing is noisy; "
                              "the band catches the side-car path losing "
                              "to rebuild)")
+    p_perf.add_argument("--mxu-golden", default=None,
+                        help="MXU proxy golden record (default: repo "
+                             "benchmarks/mxu_golden.json)")
+    p_perf.add_argument("--mxu-tol", type=float, default=0.2,
+                        help="allowed fractional drop of the MXU "
+                             "vpu/repair speedup vs the golden, and "
+                             "allowed fractional growth of the repair "
+                             "rate (default 0.2; the hard floor 1.5x "
+                             "and the exact checksum hold regardless)")
     p_perf.add_argument("--tuner-golden", default=None,
                         help="tuner convergence golden record (default: "
                              "repo benchmarks/tuner_golden.json)")

@@ -388,7 +388,8 @@ def read_bench_json(path):
 def extract_records(doc):
     """Normalize either bench JSON shape into ``{"headline": rec|None,
     "proxy": rec|None, "accel": rec|None, "stream": rec|None,
-    "store": rec|None, "tuner": rec|None, "stages": {...}|None}``.
+    "mxu": rec|None, "store": rec|None, "tuner": rec|None,
+    "stages": {...}|None}``.
 
     The headline slot is only filled by a FRESH measurement — a
     ``stale: true`` envelope (last-good value republished while the
@@ -399,6 +400,7 @@ def extract_records(doc):
     proxy = None
     accel = None
     stream = None
+    mxu = None
     store = None
     tuner = None
     stages = None
@@ -416,6 +418,9 @@ def extract_records(doc):
         st = stages.get("accel_stream_proxy") or {}
         if st.get("status") == "ok":
             stream = st.get("record")
+        mx = stages.get("mxu_proxy") or {}
+        if mx.get("status") == "ok":
+            mxu = mx.get("record")
         sc = stages.get("store_cold_start") or {}
         if sc.get("status") == "ok":
             store = sc.get("record")
@@ -434,6 +439,9 @@ def extract_records(doc):
         stm = doc.get("stream")
         if isinstance(stm, dict) and stm.get("value") is not None:
             stream = stm
+        mx = doc.get("mxu")
+        if isinstance(mx, dict) and mx.get("value") is not None:
+            mxu = mx
         sto = doc.get("store")
         if isinstance(sto, dict) and sto.get("value") is not None:
             store = sto
@@ -442,15 +450,15 @@ def extract_records(doc):
             tuner = tun
         stages = doc.get("stages")
     return {"headline": headline, "proxy": proxy, "accel": accel,
-            "stream": stream, "store": store, "tuner": tuner,
-            "stages": stages}
+            "stream": stream, "mxu": mxu, "store": store,
+            "tuner": tuner, "stages": stages}
 
 
 def perfcheck(doc, baseline=None, proxy_golden=None, proxy_tol=0.5,
               headline_tol=0.2, flops_tol=0.25, accel_golden=None,
               accel_tol=0.05, stream_golden=None, stream_tol=0.05,
               store_golden=None, store_tol=0.6, tuner_golden=None,
-              tuner_tol=0.25):
+              tuner_tol=0.25, mxu_golden=None, mxu_tol=0.2):
     """Compare a bench JSON against the last-good baseline and the
     committed proxy golden.  Returns ``(rc, lines)`` — rc 0 when nothing
     regressed beyond its tolerance band, 1 on regression (including a
@@ -480,6 +488,17 @@ def perfcheck(doc, baseline=None, proxy_golden=None, proxy_tol=0.5,
     rebuilding is a broken cold-start contract regardless of what the
     golden said.  Checksum drift is a hard FAIL (the side-car must be
     bit-identical to the built index's answers).
+
+    ``mxu_golden`` grades the mxu_proxy stage: its value is the
+    VPU-to-MXU-repair throughput ratio (>1 means the dot-product
+    reformulation wins).  The band floor is
+    ``max(golden * (1 - mxu_tol), 1.5)`` — interpreter timing is noisy,
+    but a reformulation that stops clearing 1.5x has lost its reason to
+    exist regardless of what the golden said.  Checksum drift is a hard
+    FAIL (the repair pipeline must return the dense kernel's exact
+    answers), and the repair RATE fails in the *upward* direction
+    (``> golden * (1 + mxu_tol)``: the bf16 screen stopped pruning,
+    which timing noise could otherwise hide).
 
     ``tuner_golden`` grades the tuner_convergence stage: its value is
     the closed-loop controller's STEPS-TO-CONVERGE on a deterministic
@@ -538,6 +557,57 @@ def perfcheck(doc, baseline=None, proxy_golden=None, proxy_tol=0.5,
             lines.append("note: %s record present but no golden to "
                          "compare against (record one: %s)"
                          % (slot, make_cmd))
+
+    mxu_gold = None
+    if mxu_golden:
+        mxu_gold = (extract_records(mxu_golden)["mxu"]
+                    or (mxu_golden
+                        if mxu_golden.get("value") is not None
+                        else None))
+    cand_mxu = recs["mxu"]
+    if mxu_gold is not None:
+        if cand_mxu is None:
+            rc = 1
+            lines.append(
+                "FAIL mxu: candidate carries no mxu_proxy record (a "
+                "golden exists — the chip-free matmul-form metric must "
+                "always be fresh)")
+        else:
+            floor = max(mxu_gold["value"] * (1.0 - mxu_tol), 1.5)
+            verdict = "ok" if cand_mxu["value"] >= floor else "FAIL"
+            if verdict == "FAIL":
+                rc = 1
+            lines.append(
+                "%s mxu proxy speedup (vpu/repair): %.3fx vs golden "
+                "%.3fx (floor %.3fx, tol %.0f%%, hard floor 1.5x)"
+                % (verdict, cand_mxu["value"], mxu_gold["value"],
+                   floor, 100 * mxu_tol))
+            cand_sum = cand_mxu.get("checksum")
+            gold_sum = mxu_gold.get("checksum")
+            if cand_sum is not None and gold_sum is not None:
+                same = abs(cand_sum - gold_sum) <= 1e-6 * max(
+                    1.0, abs(gold_sum))
+                if not same:
+                    rc = 1
+                lines.append(
+                    "%s mxu checksum: %.6f vs golden %.6f (exact)"
+                    % ("ok" if same else "FAIL", cand_sum, gold_sum))
+            cand_rate = cand_mxu.get("repair_rate")
+            gold_rate = mxu_gold.get("repair_rate")
+            if cand_rate is not None and gold_rate is not None:
+                # higher repair rate = weaker bf16 screen; fails upward
+                ceil = gold_rate * (1.0 + mxu_tol)
+                verdict = "ok" if cand_rate <= ceil else "FAIL"
+                if verdict == "FAIL":
+                    rc = 1
+                lines.append(
+                    "%s mxu repair rate: %.4f vs golden %.4f "
+                    "(ceiling %.4f, tol %.0f%%)"
+                    % (verdict, cand_rate, gold_rate, ceil,
+                       100 * mxu_tol))
+    elif cand_mxu is not None:
+        lines.append("note: mxu record present but no golden to "
+                     "compare against (record one: make mxu-golden)")
 
     store_gold = None
     if store_golden:

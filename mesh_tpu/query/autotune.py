@@ -39,6 +39,12 @@ DEFAULT_CROSSOVER = 32768
 # isn't guaranteed to pay for itself.
 ACCEL_DEFAULT_CROSSOVER = 131072
 
+# face count at which the MXU dot-product tile takes over from the VPU
+# tile once MESH_TPU_MXU opts the facades in.  Conservative default:
+# below this the matmul-form prologue (G layout + 11 planes) isn't
+# guaranteed to amortize against the 19-row VPU tile everywhere.
+MXU_DEFAULT_CROSSOVER = 8192
+
 # default (tile_q, tile_f, n_buffers) for the streamed rope kernel, and
 # the sweep calibrate_stream_tiles ranks: tile_f stays a multiple of 128
 # (DMA lane alignment) and n_buffers >= 2 (double buffering)
@@ -53,6 +59,7 @@ STREAM_SWEEP = (
 _measured = None
 _accel_measured = None
 _stream_measured = None
+_mxu_measured = None
 
 
 def _tuned(name):
@@ -143,6 +150,45 @@ def accel_crossover_faces():
     return _accel_measured
 
 
+def _mxu_cache_path():
+    return _cache_path().replace("crossover_", "mxu_crossover_")
+
+
+def mxu_crossover_faces():
+    """The face count at which the facades route the fast closest-point
+    tile to the MXU dot-product form (env override > tuned > cached
+    ``calibrate_mxu_crossover`` measurement > default).  Only consulted
+    when MESH_TPU_MXU is on; same resolution contract as
+    ``accel_crossover_faces``."""
+    env = knobs.raw("MESH_TPU_MXU_CROSSOVER_FACES")
+    if env:
+        value = knobs.get_int("MESH_TPU_MXU_CROSSOVER_FACES")
+        if value is not None:
+            return value
+        log.warning(
+            "ignoring malformed MESH_TPU_MXU_CROSSOVER_FACES=%r "
+            "(want an integer face count)", env,
+        )
+    tuned = _tuned("mxu_crossover")
+    if tuned is not None:
+        return int(tuned)
+    global _mxu_measured
+    if _mxu_measured is not None:
+        return _mxu_measured
+    try:
+        with open(_mxu_cache_path()) as fh:
+            value = int(json.load(fh)["mxu_crossover_faces"])
+        if value <= 0:
+            raise ValueError(value)
+        log.info("using measured mxu crossover %d from %s (delete the "
+                 "file or re-run calibrate_mxu_crossover() to "
+                 "re-measure)", value, _mxu_cache_path())
+        _mxu_measured = value
+    except (OSError, ValueError, KeyError, TypeError):
+        _mxu_measured = MXU_DEFAULT_CROSSOVER
+    return _mxu_measured
+
+
 def _stream_cache_path():
     return _cache_path().replace("crossover_", "stream_tiles_")
 
@@ -202,6 +248,8 @@ def retune_hooks():
             _accel_cache_path, "accel_min_faces", 1),
         "stream_n_buffers": lambda: _from_file(
             _stream_cache_path, "n_buffers", 2),
+        "mxu_crossover": lambda: _from_file(
+            _mxu_cache_path, "mxu_crossover_faces", 1),
     }
 
 
@@ -479,6 +527,80 @@ def calibrate_accel_crossover(ladder=(32768, 65536, 131072, 262144,
                         {"faces": n, "t_incumbent": ti, "t_accel": ta,
                          "variant": var}
                         for n, ti, ta, var in wins
+                    ],
+                    "n_queries": n_queries,
+                    "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                }, fh, indent=1)
+        except OSError:
+            pass
+    return crossover
+
+
+def calibrate_mxu_crossover(ladder=(2048, 8192, 32768, 131072),
+                            n_queries=1024, reps=3, tile_q=256,
+                            tile_f=2048, save=True):
+    """Measure where the MXU dot-product tile starts beating the 19-row
+    VPU tile on the live backend (``benchmarks/tile_sweep.py --mxu``
+    feeds it the best swept tile shape).
+
+    Mirrors ``calibrate_accel_crossover``: returns the smallest ladder F
+    where the MXU form wins and keeps winning (the facades route to MXU
+    iff ``F >= value`` and MESH_TPU_MXU is on), or 2x past the ladder
+    when the VPU tile always won.  Off-TPU both kernels run interpret
+    mode, so the result lands under the CPU device key and never leaks
+    onto a chip.  Persisted to the cache dir unless ``save=False`` or
+    the timings look unstable.
+    """
+    from .pallas_closest import closest_point_pallas, \
+        closest_point_pallas_mxu
+    from ..utils.dispatch import pallas_default
+
+    interpret = not pallas_default()
+    rng = np.random.RandomState(0)
+    pts = rng.randn(n_queries, 3).astype(np.float32)
+    wins = []
+    for n_f in ladder:
+        v, f = _sphere_mesh(n_f)
+        t_vpu = _time_best(lambda: closest_point_pallas(
+            v, f, pts, tile_q=tile_q, tile_f=tile_f, interpret=interpret,
+            assume_nondegenerate=True), reps)
+        t_mxu = _time_best(lambda: closest_point_pallas_mxu(
+            v, f, pts, tile_q=tile_q, tile_f=tile_f, interpret=interpret,
+            assume_nondegenerate=True), reps)
+        wins.append((f.shape[0], t_vpu, t_mxu))
+    check_f, check_t, _ = wins[len(wins) // 2]
+    v, f = _sphere_mesh(check_f)
+    recheck = _time_best(lambda: closest_point_pallas(
+        v, f, pts, tile_q=tile_q, tile_f=tile_f, interpret=interpret,
+        assume_nondegenerate=True), reps)
+    stable = max(check_t, recheck) <= 2.0 * min(check_t, recheck)
+    crossover = None
+    for i, (n_f, t_v, t_m) in enumerate(wins):
+        if t_m < t_v and all(tm < tv for _, tv, tm in wins[i:]):
+            crossover = n_f
+            break
+    if crossover is None:
+        crossover = 2 * wins[-1][0]   # the VPU tile won everywhere
+    global _mxu_measured
+    _mxu_measured = crossover
+    if not stable:
+        log.warning(
+            "calibrate_mxu_crossover: backend timings unstable (%.3fs vs "
+            "%.3fs at F=%d) — not persisting; using %d for this process "
+            "only", check_t, recheck, check_f, crossover,
+        )
+        save = False
+    if save:
+        try:
+            with open(_mxu_cache_path(), "w") as fh:
+                json.dump({
+                    "mxu_crossover_faces": crossover,
+                    "tile_q": tile_q,
+                    "tile_f": tile_f,
+                    "interpret": bool(interpret),
+                    "ladder": [
+                        {"faces": n, "t_vpu": tv, "t_mxu": tm}
+                        for n, tv, tm in wins
                     ],
                     "n_queries": n_queries,
                     "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),

@@ -36,6 +36,7 @@ from ..utils.dispatch import pallas_default
 
 _STRATEGY_COUNTER = None
 _FALLBACK_COUNTER = None
+_MXU_REPAIR_COUNTER = None
 
 
 def _record_strategy(path):
@@ -65,6 +66,26 @@ def _record_fallback(queries):
             "Loose-certificate queries re-run through exact brute force.",
         )
     _FALLBACK_COUNTER.inc(int(queries))
+
+
+def _record_mxu_repair(screened, repaired, kind):
+    """Count the bf16 first pass's screening outcomes per face tile
+    (``mesh_tpu_query_mxu_repair_total{kind=,outcome=}``): ``repaired``
+    tiles ran the f32 exact-repair matmul, ``skipped`` tiles were proven
+    empty by the certified bf16 bound.  A screen that stops pruning
+    (repair rate -> 1) or the arrival of the series at all is visible in
+    the registry, never silent (doc/observability.md)."""
+    global _MXU_REPAIR_COUNTER
+    if _MXU_REPAIR_COUNTER is None:
+        from ..obs.metrics import REGISTRY
+
+        _MXU_REPAIR_COUNTER = REGISTRY.counter(
+            "mesh_tpu_query_mxu_repair_total",
+            "bf16-screened MXU face tiles by repair outcome.",
+        )
+    _MXU_REPAIR_COUNTER.inc(int(repaired), kind=kind, outcome="repaired")
+    _MXU_REPAIR_COUNTER.inc(int(screened) - int(repaired), kind=kind,
+                            outcome="skipped")
 
 
 def triangle_bounds(v, f):
@@ -207,9 +228,37 @@ def closest_faces_and_points_auto(
         # grid (pallas_culled tile_variant="safe"), so the brute-vs-culled
         # crossover applies under the flag too — the escape hatch no
         # longer costs large-F meshes their tiling.
-        from ..utils.dispatch import tile_variant
+        from ..utils.dispatch import (
+            mxu_bf16_enabled, mxu_enabled, tile_variant)
 
         variant = tile_variant()
+        if (mxu_enabled() and variant == "fast"
+                and f.shape[0] <= brute_force_max_faces):
+            from .autotune import mxu_crossover_faces
+
+            if f.shape[0] >= mxu_crossover_faces():
+                # MESH_TPU_MXU + the calibrated crossover route the
+                # dense scan to the matmul-form tile; with the bf16
+                # first pass on, the repair outcome feeds its series.
+                # Off (the default) every path below is bit-identical
+                # to the pre-MXU routing.
+                _record_strategy("mxu")
+                if mxu_bf16_enabled():
+                    from .pallas_closest import \
+                        closest_point_pallas_mxu_repair
+
+                    res, stats = closest_point_pallas_mxu_repair(
+                        v32, f.astype(np.int32), pts32,
+                        assume_nondegenerate=nondegen, with_stats=True)
+                    _record_mxu_repair(
+                        stats["screened"], stats["repaired"], "dense")
+                else:
+                    from .pallas_closest import closest_point_pallas_mxu
+
+                    res = closest_point_pallas_mxu(
+                        v32, f.astype(np.int32), pts32,
+                        assume_nondegenerate=nondegen)
+                return {key: np.asarray(val) for key, val in res.items()}
         if f.shape[0] <= brute_force_max_faces:
             _record_strategy(
                 "pallas_safe" if variant == "safe" else "pallas_brute")

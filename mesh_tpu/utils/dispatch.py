@@ -19,7 +19,8 @@ from . import knobs
 
 __all__ = ["env_flag", "force_xla", "safe_tiles", "tile_variant",
            "pallas_default", "mesh_on_tpu", "no_engine", "vertex_chamfer",
-           "no_accel", "accel_kind", "bvh_stream_enabled",
+           "no_accel", "accel_kind", "mxu_enabled", "mxu_bf16_enabled",
+           "bvh_stream_enabled",
            "bvh_stream_force", "bvh_stream_buffers",
            "bvh_stream_vmem_budget"]
 
@@ -70,6 +71,22 @@ def accel_kind():
     uniform grid.  Unknown values fall back to bvh."""
     value = (knobs.get_str("MESH_TPU_ACCEL_KIND") or "").lower()
     return "grid" if value == "grid" else "bvh"
+
+
+def mxu_enabled():
+    """True when MESH_TPU_MXU opts the closest-point facades into the
+    MXU dot-product tile (matmul-form pair tests, f32 exact repair).
+    Off by default: the pre-MXU routing is bit-identical with the knob
+    unset.  Read per call like the other hatches."""
+    return env_flag("MESH_TPU_MXU")
+
+
+def mxu_bf16_enabled():
+    """True when MESH_TPU_MXU_BF16 additionally enables the bf16
+    first-pass survivor filter in front of the f32 exact-repair pass
+    (certified error envelope, doc/acceleration.md).  Only consulted on
+    paths already routed to the MXU tile."""
+    return env_flag("MESH_TPU_MXU_BF16")
 
 
 def bvh_stream_enabled():

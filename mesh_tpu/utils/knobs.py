@@ -104,6 +104,23 @@ ACCEL_MIN_FACES = _declare(
     "MESH_TPU_ACCEL_MIN_FACES", "int", None,
     "Face count at which the auto strategy switches to the spatial "
     "index (overrides the calibrated accel crossover).", "Dispatch")
+MXU = _declare(
+    "MESH_TPU_MXU", "flag", False,
+    "Route the closest-point facades to the MXU dot-product tile "
+    "(matmul-form pair tests with f32 exact repair) when the fast "
+    "variant is eligible; off (default) keeps the 19-row VPU tiles — "
+    "bit-identical to the pre-MXU paths.", "Dispatch")
+MXU_BF16 = _declare(
+    "MESH_TPU_MXU_BF16", "flag", False,
+    "With MESH_TPU_MXU: run the bf16 first-pass survivor filter before "
+    "the f32 exact-repair pass (certified error envelope, "
+    "doc/acceleration.md); off computes the MXU pass in f32 directly.",
+    "Dispatch")
+MXU_CROSSOVER_FACES = _declare(
+    "MESH_TPU_MXU_CROSSOVER_FACES", "int", None,
+    "Face count at which the MXU dot-product tile takes over from the "
+    "VPU tile (overrides the calibrated mxu crossover and pins the "
+    "`mxu_crossover` tunable; query/autotune.py).", "Dispatch")
 BVH_STREAM = _declare(
     "MESH_TPU_BVH_STREAM", "flag", True,
     "Streamed Pallas BVH kill switch: on (default) lets meshes whose "
@@ -333,6 +350,14 @@ STREAM_PROXY_QUERIES = _declare(
     "MESH_TPU_STREAM_PROXY_QUERIES", "int", None,
     "accel_stream_proxy bench stage: override the proxy query count "
     "(read by bench.py).", "Bench harness")
+MXU_PROXY_FACES = _declare(
+    "MESH_TPU_MXU_PROXY_FACES", "int", None,
+    "mxu_proxy bench stage: override the proxy mesh face count (read "
+    "by bench.py).", "Bench harness")
+MXU_PROXY_QUERIES = _declare(
+    "MESH_TPU_MXU_PROXY_QUERIES", "int", None,
+    "mxu_proxy bench stage: override the proxy query count (read by "
+    "bench.py).", "Bench harness")
 STORE_PROXY_FACES = _declare(
     "MESH_TPU_STORE_PROXY_FACES", "int", None,
     "store_cold_start bench stage: override the proxy mesh face count "

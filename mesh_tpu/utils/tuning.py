@@ -93,6 +93,13 @@ ACCEL_MIN_FACES = _declare_tunable(
     "Tuned override for the accel crossover face count "
     "(query/autotune.py consults it between the env pin and the "
     "measured cache); None falls through to the calibrated chain.")
+MXU_CROSSOVER = _declare_tunable(
+    "mxu_crossover", "int", None, 1024, 4194304, 8192,
+    "MESH_TPU_MXU_CROSSOVER_FACES",
+    "Tuned override for the MXU dot-product crossover face count "
+    "(query/autotune.py consults it between the env pin and the "
+    "measured cache; only routes when MESH_TPU_MXU is on); None falls "
+    "through to the calibrated chain.")
 STREAM_N_BUFFERS = _declare_tunable(
     "stream_n_buffers", "int", None, 2, 8, 1,
     "MESH_TPU_BVH_STREAM_BUFFERS",

@@ -476,6 +476,42 @@ def test_vmem001_prices_sublane_padding():
         """)
 
 
+def test_vmem_bf16_scratch_priced_at_16_128_tile():
+    # the bf16 tile is (16, 128) — two values pack per f32 sublane row —
+    # so a 24-row bf16 scratch pads to 32 rows: (2, 24, 135168) bf16 is
+    # ~12.4 MiB under f32-style (8, 128) pricing but ~16.5 MiB at the
+    # real (16, 128) tile -> over budget, plus the dtype-aware VMEM003
+    findings = _run(VmemBudgetRule(), """
+        import jax.experimental.pallas as pl
+        import jax.experimental.pallas.tpu as pltpu
+        import jax.numpy as jnp
+
+        def build(kernel):
+            return pl.pallas_call(
+                kernel,
+                scratch_shapes=[
+                    pltpu.VMEM((2, 24, 135168), jnp.bfloat16)],
+            )
+        """)
+    assert _codes(findings) == ["VMEM003", "VMEM001"]
+    assert "multiple of 16" in findings[0].message
+    assert "2-byte" in findings[0].message
+    # the fixture pair's passing half: the same ring aligned to the
+    # bf16 tile (2 * 32 * 131072 * 2 B = 16 MiB exactly) is clean
+    assert not _run(VmemBudgetRule(), """
+        import jax.experimental.pallas as pl
+        import jax.experimental.pallas.tpu as pltpu
+        import jax.numpy as jnp
+
+        def build(kernel):
+            return pl.pallas_call(
+                kernel,
+                scratch_shapes=[
+                    pltpu.VMEM((2, 32, 131072), jnp.bfloat16)],
+            )
+        """)
+
+
 def test_vmem002_lane_alignment():
     findings = _run(VmemBudgetRule(), """
         import jax.experimental.pallas as pl

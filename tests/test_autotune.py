@@ -15,8 +15,10 @@ from mesh_tpu.query.closest_point import closest_faces_and_points
 @pytest.fixture(autouse=True)
 def _fresh_state(monkeypatch, tmp_path):
     monkeypatch.setattr(autotune, "_measured", None)
+    monkeypatch.setattr(autotune, "_mxu_measured", None)
     monkeypatch.setattr(mesh_tpu, "mesh_package_cache_folder", str(tmp_path))
     monkeypatch.delenv("MESH_TPU_BRUTE_MAX_FACES", raising=False)
+    monkeypatch.delenv("MESH_TPU_MXU_CROSSOVER_FACES", raising=False)
     yield
 
 
@@ -108,6 +110,74 @@ def test_auto_uses_measured_crossover(monkeypatch):
     np.testing.assert_allclose(
         auto["sqdist"], np.asarray(ref["sqdist"]), atol=1e-6
     )
+
+
+def test_mxu_default_without_measurement():
+    assert autotune.mxu_crossover_faces() == autotune.MXU_DEFAULT_CROSSOVER
+
+
+def test_mxu_env_override_wins(monkeypatch):
+    monkeypatch.setenv("MESH_TPU_MXU_CROSSOVER_FACES", "4321")
+    assert autotune.mxu_crossover_faces() == 4321
+    # malformed pin: warn and fall through to the default
+    monkeypatch.setenv("MESH_TPU_MXU_CROSSOVER_FACES", "not-a-number")
+    assert autotune.mxu_crossover_faces() == autotune.MXU_DEFAULT_CROSSOVER
+
+
+def test_mxu_calibrate_persists_and_reloads(monkeypatch):
+    # per ladder point: (t_vpu, t_mxu); MXU loses at ladder[0], wins at
+    # ladder[1]; stability recheck agrees -> persist.  Crossover = the
+    # smallest MXU-winning F (ladder[1]'s actual face count).
+    monkeypatch.setattr(
+        autotune, "_time_best",
+        _deterministic_times([1.0, 2.0, 1.0, 0.5, 1.0]),
+    )
+    measured = autotune.calibrate_mxu_crossover(
+        ladder=(512, 1024), n_queries=64, reps=1
+    )
+    _, f1 = autotune._sphere_mesh(1024)
+    assert measured == f1.shape[0]
+    with open(autotune._mxu_cache_path()) as fh:
+        blob = json.load(fh)
+    assert blob["mxu_crossover_faces"] == measured
+    assert len(blob["ladder"]) == 2
+    # a fresh process (simulated by clearing the in-process cache) reads
+    # the persisted measurement back
+    monkeypatch.setattr(autotune, "_mxu_measured", None)
+    assert autotune.mxu_crossover_faces() == measured
+
+
+def test_mxu_unstable_backend_not_persisted(monkeypatch):
+    import os
+    monkeypatch.setattr(
+        autotune, "_time_best",
+        _deterministic_times([1.0, 2.0, 1.0, 0.5, 10.0]),
+    )
+    measured = autotune.calibrate_mxu_crossover(
+        ladder=(512, 1024), n_queries=64, reps=1
+    )
+    assert measured > 0
+    assert not os.path.exists(autotune._mxu_cache_path())
+
+
+def test_mxu_poisoned_cache_falls_back_to_default(monkeypatch):
+    import os
+    os.makedirs(os.path.dirname(autotune._mxu_cache_path()), exist_ok=True)
+    with open(autotune._mxu_cache_path(), "w") as fh:
+        fh.write('{"mxu_crossover_faces": null}')
+    assert autotune.mxu_crossover_faces() == autotune.MXU_DEFAULT_CROSSOVER
+
+
+def test_mxu_vpu_always_wins_returns_past_ladder(monkeypatch):
+    monkeypatch.setattr(
+        autotune, "_time_best",
+        _deterministic_times([0.5, 1.0, 0.5, 1.0, 0.5]),
+    )
+    measured = autotune.calibrate_mxu_crossover(
+        ladder=(512, 1024), n_queries=16, reps=1, save=False
+    )
+    _, f1 = autotune._sphere_mesh(1024)
+    assert measured == 2 * f1.shape[0]
 
 
 def test_brute_always_wins_returns_past_ladder(monkeypatch):

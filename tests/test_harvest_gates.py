@@ -124,3 +124,34 @@ def test_gate2b_wedged_vs_cpu_fallback_are_distinct(tmp_path):
     assert "knobs ignored" in table
     # the CPU-fallback line carries its (default-path) value, labelled
     assert "7.0 q/s is a default-path measurement" in table
+
+
+def test_gate2_mxu_row_grades_contract_not_just_speed(tmp_path):
+    # the MXU row is a correctness gate first: drifted bit-identity
+    # flags or a repair rate of 1.0 render as NOT AN IMPROVEMENT even
+    # with a great speedup; only a clean record gets the OK line
+    def _gate2(mxu):
+        d = tmp_path / ("gates_%d" % _gate2.n)
+        _gate2.n += 1
+        d.mkdir()
+        (d / "gate2.log").write_text(json.dumps(
+            {"metric": "m", "value": 5.0, "unit": "q/s",
+             "vs_baseline": 2.0, "mxu": mxu}) + "\n")
+        return harvest_gates.render_table(harvest_gates.harvest(str(d)))
+
+    _gate2.n = 0
+    good = {"value": 1.879, "checksum": 587.1954, "repair_rate": 0.2344,
+            "repaired": 15, "screened": 64, "dense_match": True,
+            "degenerate_match": True, "leaf_visit_match": True}
+    table = _gate2(good)
+    assert "gate 2 mxu: 1.879x vpu/repair OK" in table
+
+    table = _gate2(dict(good, degenerate_match=False))
+    assert "NOT AN IMPROVEMENT" in table and "bit-identity flags" in table
+
+    table = _gate2(dict(good, repair_rate=1.0))
+    assert "NOT AN IMPROVEMENT" in table and "prunes nothing" in table
+
+    table = _gate2(dict(good, checksum=None))
+    assert ("NOT AN IMPROVEMENT" in table
+            and "no speedup/checksum" in table)

@@ -160,7 +160,8 @@ class TestPallasClosestPoint:
 
 
 class TestMxuTile:
-    """Experimental MXU-fed tile (closest_point_pallas_mxu): same contract
+    """MXU-fed tile (closest_point_pallas_mxu, production-routed past the
+    MESH_TPU_MXU crossover — see tests/test_mxu.py): same contract
     as the production tile; face choice may differ only at exact-distance
     ties (the documented corner-derivation behavior)."""
 

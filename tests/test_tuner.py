@@ -31,7 +31,8 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _TUNER_ENV = (
     "MESH_TPU_TUNER", "MESH_TPU_TUNER_INTERVAL", "MESH_TPU_TUNER_AB_TOL",
     "MESH_TPU_KNOB_TAIL", "MESH_TPU_COALESCE_WINDOW_MS",
-    "MESH_TPU_ACCEL_MIN_FACES", "MESH_TPU_BVH_STREAM_BUFFERS",
+    "MESH_TPU_ACCEL_MIN_FACES", "MESH_TPU_MXU_CROSSOVER_FACES",
+    "MESH_TPU_BVH_STREAM_BUFFERS",
     "MESH_TPU_SERVE_LADDER", "MESH_TPU_RECORDER",
 )
 
@@ -333,11 +334,35 @@ def test_background_retune_publishes_calibrations():
     assert res["actions"] == []
 
 
+def test_mxu_crossover_retune_bounded_and_pinned(monkeypatch):
+    """The mxu_crossover tunable rides the standard retune path under
+    the fake clock: actuate clamps to the declared bounds, the audit
+    event lands, and the operator's env pin silently wins."""
+    def hook():
+        # below the declared 1024-face floor: actuate must clamp
+        return 512, {"source": "mxu_crossover_calib.json",
+                     "key": "mxu_crossover_faces"}
+
+    loop = _Loop(retune_fns={"mxu_crossover": hook}, retune_every=1)
+    loop.step(now=15.0, feed=False)
+    assert tuning.tuned_value("mxu_crossover") == 1024
+    (event,) = _knob_changes("mxu_crossover")
+    assert event["reason"] == "retune: autotune calibration"
+    assert event["evidence"]["key"] == "mxu_crossover_faces"
+    # the env pin beats the controller: actuation refused, pin read back
+    monkeypatch.setenv("MESH_TPU_MXU_CROSSOVER_FACES", "65536")
+    assert tuning.pinned("mxu_crossover")
+    assert tuning.actuate("mxu_crossover", 2048, reason="t") is None
+    assert tuning.tuned_value("mxu_crossover") is None
+    assert tuning.get("mxu_crossover") == 65536
+
+
 def test_autotune_retune_hooks_shape():
     from mesh_tpu.query.autotune import retune_hooks
 
     hooks = retune_hooks()
-    assert set(hooks) == {"accel_min_faces", "stream_n_buffers"}
+    assert set(hooks) == {"accel_min_faces", "mxu_crossover",
+                          "stream_n_buffers"}
     # with no persisted calibration each hook declines (None), which
     # the controller treats as "don't churn"
     for fn in hooks.values():
@@ -428,7 +453,8 @@ def test_tune_status_cli(tmp_path):
     status = json.loads(proc.stdout)
     rows = {r["knob"]: r for r in status["knobs"]}
     assert set(rows) == {"coalesce_window_ms", "accel_min_faces",
-                         "stream_n_buffers", "serve_pre_trip"}
+                         "mxu_crossover", "stream_n_buffers",
+                         "serve_pre_trip"}
     assert rows["coalesce_window_ms"]["pinned"]
     assert rows["coalesce_window_ms"]["value"] == 7.5
     assert not rows["serve_pre_trip"]["pinned"]

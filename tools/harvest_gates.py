@@ -194,6 +194,37 @@ def render_table(h):
                     "gate 2 accel: NOT AN IMPROVEMENT — %.1f pair "
                     "tests/query >= brute F=%d (index does not prune)" % (
                         ppq, faces))
+        # MXU matmul-form gate: the reformulation only counts as an
+        # improvement when the repair pipeline returned the dense
+        # kernel's exact answers (checksum/match flags) AND the bf16
+        # screen still prunes — a drifted checksum or a repair rate at
+        # 1.0 is a correctness/regression signal, never a perf win
+        mx = b.get("mxu")
+        if isinstance(mx, dict):
+            matches = [mx.get(k) for k in (
+                "dense_match", "degenerate_match", "leaf_visit_match")]
+            rate = mx.get("repair_rate")
+            if mx.get("value") is None or mx.get("checksum") is None:
+                lines.append(
+                    "gate 2 mxu: NOT AN IMPROVEMENT — mxu record carries "
+                    "no speedup/checksum to prove the repair contract")
+            elif not all(m is True for m in matches):
+                lines.append(
+                    "gate 2 mxu: NOT AN IMPROVEMENT — bit-identity flags "
+                    "%s (repair must equal the dense kernel exactly)"
+                    % json.dumps(dict(zip(
+                        ("dense", "degenerate", "leaf_visit"), matches))))
+            elif rate is None or rate >= 1.0:
+                lines.append(
+                    "gate 2 mxu: NOT AN IMPROVEMENT — repair rate %s "
+                    "(bf16 screen prunes nothing; perfcheck grades drift "
+                    "against benchmarks/mxu_golden.json)" % (rate,))
+            else:
+                lines.append(
+                    "gate 2 mxu: %.3fx vpu/repair OK — checksum %.6f, "
+                    "repair rate %.4f (%d/%d tiles)" % (
+                        mx["value"], mx["checksum"], rate,
+                        mx.get("repaired", -1), mx.get("screened", -1)))
     for b in h.get("bench_variants", ()):
         if b.get("value") is None:
             lines.append(
