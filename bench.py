@@ -1670,6 +1670,204 @@ def tuner_convergence_stage():
     return record
 
 
+def replay_proxy_stage():
+    """Stage ``replay_proxy``: the record/replay tier's chip-free
+    determinism metric.  Synthesizes the default adversarial mix
+    (stampede -> bucket ladder -> prune-defeat -> degenerate,
+    obs/replay.py, seeded so the trace is byte-stable), replays it TWICE
+    against a real QueryService running a plain-python ladder under a
+    fake clock/sleep pair, and fails in-stage unless the two runs'
+    admission-sequence checksums are identical — "same trace twice =>
+    same sequence", proven on every bench run.
+
+    The record's value is the trace's admission count and its checksum
+    is the admission-sequence hash; both are fully deterministic
+    (seeded generator + virtual time), so perfcheck grades them against
+    benchmarks/replay_golden.json with a zero-width band and fails hard
+    on checksum drift (a drifted checksum means replay stopped
+    reproducing the recorded workload — the entire contract).
+    """
+    from mesh_tpu.serve import (
+        HealthMonitor,
+        QueryService,
+        Rung,
+        ServeResult,
+        run_trace_replay,
+    )
+    from mesh_tpu.obs import replay as obs_replay
+
+    seed = knobs.get_int("MESH_TPU_REPLAY_PROXY_SEED")
+    trace = obs_replay.synth_mix(seed=7 if seed is None else seed)
+
+    faces = np.zeros((1, 4), np.uint32)
+    answer = np.zeros((4, 3), np.float64)
+
+    def _ok(mesh, points, chunk, timeout):
+        return ServeResult(faces, answer, "replay-ok", certified=True)
+
+    t = [0.0]
+    clock = lambda: t[0]                 # noqa: E731 — fake clock
+
+    def sleep(dt):
+        t[0] += max(dt, 0.0)
+
+    pts = np.zeros((4, 3), np.float32)
+    reports = []
+    for _ in range(2):
+        service = QueryService(workers=2, ladder=[Rung("replay-ok", _ok)],
+                               health=HealthMonitor(watchdog=False),
+                               max_queue_per_tenant=8192,
+                               default_deadline_s=30.0)
+        try:
+            reports.append(run_trace_replay(
+                service, object(), pts, trace, deadline_s=30.0,
+                clock=clock, sleep=sleep))
+        finally:
+            service.stop(write_stats=False)
+    first, second = reports
+    if first["checksum"] != second["checksum"]:
+        raise RuntimeError(
+            "replay determinism broken: the same trace produced two "
+            "different admission sequences (%.6f vs %.6f)"
+            % (first["checksum"], second["checksum"]))
+    expected = obs_replay.sequence_checksum(
+        obs_replay.admission_events(trace, deadline_s=30.0))
+    if first["checksum"] != expected:
+        raise RuntimeError(
+            "replay checksum %.6f does not match the trace's canonical "
+            "admission sequence %.6f" % (first["checksum"], expected))
+    return {
+        "metric": "replay_admissions",
+        "value": first["admissions"],
+        "unit": "admissions",
+        "vs_baseline": None,
+        "checksum": first["checksum"],
+        "source": trace["source"],
+        "trace_records": len(trace["records"]),
+        "paced_s": first["paced_s"],
+        "ok": first["ok"],
+        "shed": first["shed"],
+        "deadline_failures": first["deadline_failures"],
+        "double_run": "checksum_equal",
+    }
+
+
+def tuner_replay_stage():
+    """Stage ``tuner_replay``: the tuner's gym — the TunerController fed
+    a captured/synthesized traffic trace instead of the scripted burn
+    (ROADMAP "fleet-scale record/replay").  A stampede burst followed by
+    a long steady phase (obs/replay.py generators, seeded) is bucketed
+    into controller windows; each window's arrival rate derives the SLO
+    pressure and synthetic latency observations, so the controller works
+    the same decision loop as tuner_convergence but against a real
+    workload shape riding the trace schema.
+
+    Deterministic end to end (seeded trace, fake clock): the record's
+    value is steps-to-converge and its checksum hashes the decision
+    trajectory — rerunning the stage must reproduce both exactly, which
+    tests/test_replay.py pins.
+    """
+    from mesh_tpu.obs import replay as obs_replay
+    from mesh_tpu.obs.controller import LATENCY_METRIC, TunerController
+    from mesh_tpu.obs.metrics import Registry
+    from mesh_tpu.obs.recorder import FlightRecorder
+    from mesh_tpu.obs.series import WindowedSeries
+    from mesh_tpu.utils import tuning
+
+    trace = obs_replay.concat_traces([
+        obs_replay.synth_stampede(tenants=8, burst_every_s=0.2,
+                                  duration_s=60.0, seed=11),
+        obs_replay.synth_steady(rate_qps=2.0, duration_s=5400.0, seed=12),
+    ], gap_s=0.0, source="synth:tuner_gym")
+
+    tuning.reset()
+    t = [0.0]
+    clock = lambda: t[0]                 # noqa: E731 — fake clock
+    registry = Registry()
+    hist = registry.histogram(LATENCY_METRIC,
+                              "synthetic serve latency (replay gym)")
+    series = WindowedSeries(registry=registry, resolution_s=1.0,
+                            capacity=8192, clock=clock)
+    recorder = FlightRecorder(capacity=4096, registry=registry, clock=clock)
+
+    step_s = 15.0
+    records = trace["records"]
+    n_records = len(records)
+    # mean arrival rate over the whole trace: the overload threshold is
+    # 4x it, so the stampede windows read as pressure and steady doesn't
+    span_s = records[-1]["t"] if records else 1.0
+    mean_rate = n_records / max(span_s, 1e-9)
+
+    class _TraceMonitor(object):
+        """SLO pressure derived from the trace's windowed arrival rate."""
+
+        window_rate = 0.0
+
+        def burn_rates(self, now=None):
+            pressure = 1.2 if self.window_rate > 4.0 * mean_rate else 0.0
+            return [{"objective": "latency", "tenant": "replay",
+                     "rule": "fast_burn", "factor": 14.4,
+                     "long_burn": pressure * 14.4,
+                     "short_burn": pressure * 14.4,
+                     "pressure": pressure}]
+
+    monitor = _TraceMonitor()
+    ctrl = TunerController(series=series, monitor=monitor,
+                           registry=registry, recorder=recorder,
+                           clock=clock, ab_tol=0.2, holdout_s=30.0)
+    knob_order = [tun.name for tun in tuning.tunables()]
+    hi = tuning.lookup("coalesce_window_ms").hi
+    max_steps = 500
+    idx = 0
+    last_action_step = 0
+    n_actions = 0
+    checksum = 0.0
+    for step in range(1, max_steps + 1):
+        t[0] += step_s
+        # this window's slice of the trace (records run out -> calm tail)
+        window_count = 0
+        while idx < n_records and records[idx]["t"] <= t[0]:
+            window_count += 1
+            idx += 1
+        monitor.window_rate = window_count / step_s
+        overloaded = monitor.window_rate > 4.0 * mean_rate
+        latency_s = 0.5 if overloaded else 0.01
+        for _ in range(min(max(window_count, 8), 64)):
+            hist.observe(latency_s, tenant="replay")
+        series.tick(now=t[0])
+        result = ctrl.step(now=t[0])
+        for event in result["actions"]:
+            n_actions += 1
+            after = float(event["after"] or 0)
+            checksum += (n_actions
+                         * (knob_order.index(event["knob"]) + 1)
+                         * (1.0 + abs(after)))
+            last_action_step = step
+        quiet = 0 if result["actions"] else step - last_action_step
+        if tuning.get("coalesce_window_ms") >= hi and quiet >= 3:
+            break
+    else:
+        raise RuntimeError(
+            "tuner failed to converge on the replayed trace within %d "
+            "steps (coalesce=%s, last action at step %d)"
+            % (max_steps, tuning.get("coalesce_window_ms"),
+               last_action_step))
+    steady = {name: tuning.get(name) for name in knob_order}
+    record = {
+        "metric": "tuner_replay_steps",
+        "value": last_action_step,
+        "unit": "steps",
+        "vs_baseline": None,
+        "actions": n_actions,
+        "trace_records": n_records,
+        "source": trace["source"],
+        "steady_state": steady,
+        "checksum": round(checksum, 4),
+    }
+    tuning.reset()
+    return record
+
+
 #: declarative stage table: name -> (fn, default timeout_s,
 #: requires_backend, gate, extra child env).  Budgets bound a WEDGE —
 #: they are not measurements; override one with
@@ -1728,6 +1926,25 @@ _STAGE_DEFS = OrderedDict((
                             "MESH_TPU_MXU_CROSSOVER_FACES": "",
                             "MESH_TPU_BVH_STREAM_BUFFERS": "",
                             "MESH_TPU_SERVE_LADDER": ""})),
+    # chip-free: plain-python ladder + fake clock; the double replay of
+    # the seeded adversarial mix is fast, the budget bounds a wedge.
+    # MESH_TPU_REPLAY_TRACE is cleared so a capture knob in the caller's
+    # environment can't make the stage observe its own replay traffic.
+    ("replay_proxy", (replay_proxy_stage, 120.0, False, False,
+                      {"JAX_PLATFORMS": "cpu",
+                       "PALLAS_AXON_POOL_IPS": "",
+                       "MESH_TPU_REPLAY_TRACE": ""})),
+    # the tuner's gym: same env pins as tuner_convergence (tuner ON,
+    # knob pins cleared) driving the controller from a replayed trace
+    ("tuner_replay", (tuner_replay_stage, 120.0, False, False,
+                      {"JAX_PLATFORMS": "cpu",
+                       "PALLAS_AXON_POOL_IPS": "",
+                       "MESH_TPU_TUNER": "1",
+                       "MESH_TPU_COALESCE_WINDOW_MS": "",
+                       "MESH_TPU_ACCEL_MIN_FACES": "",
+                       "MESH_TPU_MXU_CROSSOVER_FACES": "",
+                       "MESH_TPU_BVH_STREAM_BUFFERS": "",
+                       "MESH_TPU_SERVE_LADDER": ""})),
 ))
 
 
@@ -1842,6 +2059,9 @@ def run_staged(names=None):
     tuner_res = results.get("tuner_convergence")
     if tuner_res is not None and tuner_res.ok:
         record["tuner"] = tuner_res.record
+    replay_res = results.get("replay_proxy")
+    if replay_res is not None and replay_res.ok:
+        record["replay"] = replay_res.record
     record["stages"] = OrderedDict(
         (n, r.to_json()) for n, r in results.items())
     record["bench_partial"] = partial_path

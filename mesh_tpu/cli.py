@@ -456,6 +456,10 @@ def cmd_perfcheck(args):
         args.mxu_golden or os.path.join(repo_root, "benchmarks",
                                         "mxu_golden.json"),
         "mxu golden")
+    replay_golden = _load_optional(
+        args.replay_golden or os.path.join(repo_root, "benchmarks",
+                                           "replay_golden.json"),
+        "replay golden")
     rc, lines = perfcheck(doc, baseline=baseline, proxy_golden=golden,
                           proxy_tol=args.proxy_tol,
                           headline_tol=args.headline_tol,
@@ -469,7 +473,9 @@ def cmd_perfcheck(args):
                           tuner_golden=tuner_golden,
                           tuner_tol=args.tuner_tol,
                           mxu_golden=mxu_golden,
-                          mxu_tol=args.mxu_tol)
+                          mxu_tol=args.mxu_tol,
+                          replay_golden=replay_golden,
+                          replay_tol=args.replay_tol)
     if args.json:
         json.dump({"rc": rc, "lines": lines}, sys.stdout, indent=2)
         sys.stdout.write("\n")
@@ -608,6 +614,120 @@ def cmd_prof(args):
                 print("prof diff: %s" % ("OK" if rc == 0 else "REGRESSION"))
     except prof.ProfError as exc:
         print("prof: %s" % exc, file=sys.stderr)
+        sys.exit(2)
+    sys.exit(rc)
+
+
+def cmd_replay(args):
+    """Record/replay tooling over ledger-derived traffic traces
+    (doc/observability.md "Record/replay"; no jax init).
+
+    ``replay run TRACE`` validates a trace file (captured via
+    MESH_TPU_REPLAY_TRACE / converted from a ledger dump or incident /
+    synthesized) and walks its admission sequence under a virtual clock,
+    printing the paced duration and the deterministic admission-sequence
+    checksum — run it twice, compare checksums, and "same trace ⇒ same
+    sequence" is machine-checked.  ``--wall`` paces on the real clock
+    instead (a dry-run rehearsal at ``--speed``).
+
+    ``replay diff A B`` attributes the p50/p99 latency delta between two
+    builds' replay evidence (replay reports with embedded stage stats,
+    ledger dumps, incidents — anything ``mesh-tpu prof`` loads) to named
+    ledger stages, and cross-checks admission-sequence checksums when
+    both sides carry one: comparing latency between two DIFFERENT
+    workloads is a category error, so a checksum mismatch fails before
+    any tolerance applies.
+
+    ``replay synth KIND`` emits an adversarial trace (stampede,
+    bucket_ladder, prune_defeat, degenerate, steady, mix) in the same
+    schema captured traffic uses.
+
+    Import discipline matches prof/serve-stats: json/os plus the
+    stdlib-only obs modules.  Exit codes: 0 ok, 1 regression /
+    checksum mismatch (diff only), 2 unreadable input.
+    """
+    import json
+
+    from mesh_tpu.obs import prof, replay
+
+    rc = 0
+    try:
+        if args.replay_command == "run":
+            trace = replay.load_trace(args.trace)
+            if args.wall:
+                from mesh_tpu.obs.clock import monotonic, sleep
+
+                report = replay.null_replay(trace, speed=args.speed,
+                                            clock=monotonic, sleep=sleep)
+            else:
+                report = replay.null_replay(trace, speed=args.speed)
+            if args.out:
+                with open(args.out, "w") as fh:
+                    json.dump(report, fh, indent=2, sort_keys=True)
+                    fh.write("\n")
+            if args.json:
+                json.dump(report, sys.stdout, indent=2, sort_keys=True)
+                sys.stdout.write("\n")
+            else:
+                print("replay run %s" % args.trace)
+                print("  source    %s" % report["source"])
+                print("  records   %d" % report["admissions"])
+                print("  paced_s   %.4f (speed %.2fx)"
+                      % (report["paced_s"], report["speed"]))
+                print("  checksum  %.6f" % report["checksum"])
+        elif args.replay_command == "diff":
+            a = prof.load(args.a)
+            b = prof.load(args.b)
+            rc, lines = prof.diff(a, b, tol=args.tol)
+            sums = []
+            for path in (args.a, args.b):
+                try:
+                    with open(path) as fh:
+                        doc = json.load(fh)
+                    sums.append(doc.get("checksum")
+                                if isinstance(doc, dict) else None)
+                except (OSError, ValueError):
+                    sums.append(None)
+            if sums[0] is not None and sums[1] is not None:
+                # CRC sums are exact integers: no relative tolerance,
+                # or drift at CRC magnitudes would pass unnoticed.
+                same = abs(sums[0] - sums[1]) <= 1e-6
+                if same:
+                    lines.append("ok   admission-sequence checksums "
+                                 "match (%.6f) — same workload on both "
+                                 "sides" % sums[0])
+                else:
+                    rc = 1
+                    lines.append(
+                        "FAIL admission-sequence checksum mismatch: "
+                        "%.6f vs %.6f — the two reports replayed "
+                        "DIFFERENT workloads; latency deltas above are "
+                        "not comparable" % (sums[0], sums[1]))
+            if args.json:
+                json.dump({"rc": rc, "lines": lines}, sys.stdout,
+                          indent=2)
+                sys.stdout.write("\n")
+            else:
+                print("replay diff %s -> %s" % (args.a, args.b))
+                for line in lines:
+                    print("  " + line)
+                print("replay diff: %s"
+                      % ("OK" if rc == 0 else "REGRESSION"))
+        else:                                   # synth
+            kw = {"seed": args.seed} if args.seed is not None else {}
+            trace = replay.synthesize(args.kind, **kw)
+            if args.out:
+                n = replay.write_trace(trace, args.out)
+                print("wrote %d records (%s) to %s"
+                      % (n, trace["source"], args.out))
+            else:
+                for line in replay.trace_lines(trace):
+                    print(line)
+    except replay.ReplayError as exc:
+        print("replay: %s" % exc, file=sys.stderr)
+        sys.exit(2)
+    except prof.ProfError as exc:
+        print("replay: %s" % exc, file=sys.stderr)
         sys.exit(2)
     sys.exit(rc)
 
@@ -1001,6 +1121,15 @@ def main():
                              "steps-to-converge vs the golden (default "
                              "0.25; the knob-trajectory checksum must "
                              "match exactly regardless)")
+    p_perf.add_argument("--replay-golden", default=None,
+                        help="replay determinism golden record (default: "
+                             "repo benchmarks/replay_golden.json)")
+    p_perf.add_argument("--replay-tol", type=float, default=0.0,
+                        help="allowed fractional drop of the replayed "
+                             "admission count vs the golden (default 0: "
+                             "the trace is synthesized deterministically; "
+                             "the admission-sequence checksum must match "
+                             "exactly regardless)")
     p_perf.add_argument("--json", action="store_true",
                         help="machine-readable {rc, lines} instead of the "
                              "summary")
@@ -1078,6 +1207,59 @@ def main():
     p_pdiff.add_argument("--json", action="store_true",
                          help="machine-readable {rc, lines}")
     p_pdiff.set_defaults(func=cmd_prof)
+
+    p_replay = sub.add_parser(
+        "replay",
+        help="record/replay: validate and pace traffic traces, diff two "
+             "builds' replay evidence, synthesize adversarial mixes "
+             "(no jax init)")
+    replay_sub = p_replay.add_subparsers(dest="replay_command",
+                                         required=True)
+    p_rrun = replay_sub.add_parser(
+        "run",
+        help="walk a trace's admission sequence under a virtual clock "
+             "and print its deterministic checksum")
+    p_rrun.add_argument("trace", help="trace file (captured, converted, "
+                                      "or synthesized)")
+    p_rrun.add_argument("--speed", type=float, default=1.0,
+                        help="time-warp factor (2.0 = replay twice as "
+                             "fast; checksum is unaffected)")
+    p_rrun.add_argument("--wall", action="store_true",
+                        help="pace on the real clock instead of virtual "
+                             "time (a wall-clock rehearsal)")
+    p_rrun.add_argument("--out", default=None,
+                        help="also write the replay report JSON here")
+    p_rrun.add_argument("--json", action="store_true",
+                        help="machine-readable report instead of the "
+                             "summary")
+    p_rrun.set_defaults(func=cmd_replay)
+    p_rdiff = replay_sub.add_parser(
+        "diff",
+        help="attribute the p50/p99 delta between two builds' replay "
+             "evidence to ledger stages; exit 1 on regression or "
+             "admission-checksum mismatch")
+    p_rdiff.add_argument("a", help="baseline replay evidence (report "
+                                   "with stage stats, ledger JSONL, "
+                                   "incident, bench JSON)")
+    p_rdiff.add_argument("b", help="candidate replay evidence")
+    p_rdiff.add_argument("--tol", type=float, default=0.2,
+                         help="allowed fractional total-latency growth "
+                              "before rc 1 (default 0.2)")
+    p_rdiff.add_argument("--json", action="store_true",
+                         help="machine-readable {rc, lines}")
+    p_rdiff.set_defaults(func=cmd_replay)
+    p_rsynth = replay_sub.add_parser(
+        "synth",
+        help="emit an adversarial workload trace in the capture schema")
+    p_rsynth.add_argument("kind",
+                          help="generator: stampede, bucket_ladder, "
+                               "prune_defeat, degenerate, steady, mix")
+    p_rsynth.add_argument("--seed", type=int, default=None,
+                          help="generator seed (deterministic for a "
+                               "given seed)")
+    p_rsynth.add_argument("--out", default=None,
+                          help="trace file to write (default: stdout)")
+    p_rsynth.set_defaults(func=cmd_replay)
 
     p_tune = sub.add_parser(
         "tune",

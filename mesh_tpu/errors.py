@@ -37,7 +37,14 @@ class DeadlineExceeded(MeshError, TimeoutError):
 
     Raised by the engine executor when a queued request's deadline passes
     before dispatch, and by the serving tier when every degradation rung
-    failed inside the request's hard time budget (doc/serving.md)."""
+    failed inside the request's hard time budget (doc/serving.md).
+
+    ``rung`` carries the last rung attempted before the budget ran out
+    (None when the request never reached the ladder — e.g. it expired in
+    the queue), so load reports and replay tallies keep rung provenance
+    for failures, not just successes."""
+
+    rung = None
 
 
 class StoreError(MeshError):

@@ -66,6 +66,19 @@ from .perf import (  # noqa: F401
     reap_child,
     run_stages,
 )
+from .replay import (  # noqa: F401
+    TRACE_SCHEMA,
+    ReplayError,
+    TraceWriter,
+    admission_events,
+    load_trace,
+    null_replay,
+    sequence_checksum,
+    synthesize,
+    trace_from_incident,
+    trace_from_ledger,
+    write_trace,
+)
 from .recorder import (  # noqa: F401
     RECORDER,
     FlightRecorder,
@@ -119,6 +132,9 @@ __all__ = [
     "monotonic", "wall",
     "StageSpec", "StageResult", "call_with_timeout", "reap_child",
     "run_stages", "perfcheck",
+    "TRACE_SCHEMA", "ReplayError", "TraceWriter", "load_trace",
+    "write_trace", "trace_from_ledger", "trace_from_incident",
+    "admission_events", "sequence_checksum", "null_replay", "synthesize",
 ]
 
 

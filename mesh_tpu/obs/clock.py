@@ -18,7 +18,7 @@ import time
 
 from ..utils.knobs import flag as _knob_flag
 
-__all__ = ["monotonic", "wall", "enabled", "env_flag", "OBS_ENV"]
+__all__ = ["monotonic", "wall", "sleep", "enabled", "env_flag", "OBS_ENV"]
 
 #: the observability master gate (spans; metrics counters stay always-on
 #: because the engine's pre-existing stats contract depends on them)
@@ -29,6 +29,10 @@ monotonic = time.perf_counter
 
 #: wall clock for event timestamps (exporters)
 wall = time.time
+
+#: pacing sleep (loadgen/replay); aliased here so fake-clock tests swap
+#: clock and sleep as one pair instead of patching ``time`` piecemeal
+sleep = time.sleep
 
 
 def env_flag(name):

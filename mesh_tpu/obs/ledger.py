@@ -48,10 +48,11 @@ from .clock import monotonic
 from .metrics import REGISTRY
 
 __all__ = [
-    "LEDGER_STAGES", "LEDGER_OUTCOMES", "RequestRecord", "LatencyLedger",
-    "LEDGER",
+    "LEDGER_STAGES", "LEDGER_OUTCOMES", "LEDGER_SCHEMA",
+    "RequestRecord", "LatencyLedger", "LEDGER",
     "get_ledger", "ledger_enabled", "bind_current", "current_record",
     "LEDGER_ENV", "LEDGER_CAPACITY_ENV", "LEDGER_TAIL_ENV",
+    "REPLAY_TRACE_ENV",
 ]
 
 #: kill switch: set to 0/false/no/off to disable record creation
@@ -62,6 +63,14 @@ LEDGER_CAPACITY_ENV = "MESH_TPU_LEDGER_CAPACITY"
 
 #: how many ring-tail records ride along in flight-recorder incidents
 LEDGER_TAIL_ENV = "MESH_TPU_LEDGER_TAIL"
+
+#: stream every close into a replayable trace at this path (obs/replay)
+REPLAY_TRACE_ENV = "MESH_TPU_REPLAY_TRACE"
+
+#: dumped-row schema version, stamped into every ``dump_jsonl`` line so
+#: readers (obs/prof.py, obs/replay.py) can refuse rows from a future
+#: shape instead of misparsing them; bump on incompatible row changes
+LEDGER_SCHEMA = 1
 
 #: stage names in request order; each is stamped when that stage ENDS
 #: (the record's open time is the admit stamp).  The meshlint OBS rule
@@ -171,6 +180,22 @@ class LatencyLedger(object):
         self._capacity = capacity
         self._lock = threading.Lock()
         self._ring = deque(maxlen=capacity or _ring_capacity())
+        self._listeners = []
+
+    # -- close listeners -----------------------------------------------
+
+    def add_listener(self, fn):
+        """Register ``fn(row)`` to observe every closed row (trace
+        capture, tests).  Listener failures are swallowed: observers
+        must never be able to fail a request that already served."""
+        with self._lock:
+            self._listeners.append(fn)
+        return fn
+
+    def remove_listener(self, fn):
+        with self._lock:
+            if fn in self._listeners:
+                self._listeners.remove(fn)
 
     # -- lifecycle of one record ---------------------------------------
 
@@ -203,6 +228,19 @@ class LatencyLedger(object):
         row = record.to_dict()
         with self._lock:
             self._ring.append(row)
+            listeners = tuple(self._listeners)
+        for fn in listeners:
+            try:
+                fn(row)
+            except Exception:           # noqa: BLE001 — observers can't fail serving
+                pass
+        trace_path = knobs.get_str(REPLAY_TRACE_ENV)
+        if trace_path:
+            from .replay import capture_row
+            try:
+                capture_row(row, trace_path)
+            except Exception:           # noqa: BLE001 — capture can't fail serving
+                pass
         return row
 
     # -- consumption ---------------------------------------------------
@@ -232,12 +270,15 @@ class LatencyLedger(object):
 
     def dump_jsonl(self, path, n=None):
         """Write the newest ``n`` rows (default: everything retained) as
-        JSON lines — the ``mesh-tpu prof diff`` input format.  Returns
-        the row count written."""
+        JSON lines — the ``mesh-tpu prof diff`` input format.  Each line
+        is stamped with ``schema`` = :data:`LEDGER_SCHEMA` (the in-ring
+        rows stay unstamped; the version belongs to the file format).
+        Returns the row count written."""
         rows = self.records() if n is None else self.tail(n)
         with open(path, "w") as fh:
             for row in rows:
-                fh.write(json.dumps(row, sort_keys=True))
+                fh.write(json.dumps(dict(row, schema=LEDGER_SCHEMA),
+                                    sort_keys=True))
                 fh.write("\n")
         return len(rows)
 

@@ -389,7 +389,7 @@ def extract_records(doc):
     """Normalize either bench JSON shape into ``{"headline": rec|None,
     "proxy": rec|None, "accel": rec|None, "stream": rec|None,
     "mxu": rec|None, "store": rec|None, "tuner": rec|None,
-    "stages": {...}|None}``.
+    "replay": rec|None, "stages": {...}|None}``.
 
     The headline slot is only filled by a FRESH measurement — a
     ``stale: true`` envelope (last-good value republished while the
@@ -403,6 +403,7 @@ def extract_records(doc):
     mxu = None
     store = None
     tuner = None
+    replay = None
     stages = None
     if doc.get("kind") == "bench_partial":
         stages = doc.get("stages") or {}
@@ -427,6 +428,9 @@ def extract_records(doc):
         tc = stages.get("tuner_convergence") or {}
         if tc.get("status") == "ok":
             tuner = tc.get("record")
+        rp = stages.get("replay_proxy") or {}
+        if rp.get("status") == "ok":
+            replay = rp.get("record")
     else:
         if doc.get("value") is not None and not doc.get("stale"):
             headline = doc
@@ -448,17 +452,21 @@ def extract_records(doc):
         tun = doc.get("tuner")
         if isinstance(tun, dict) and tun.get("value") is not None:
             tuner = tun
+        rp = doc.get("replay")
+        if isinstance(rp, dict) and rp.get("value") is not None:
+            replay = rp
         stages = doc.get("stages")
     return {"headline": headline, "proxy": proxy, "accel": accel,
             "stream": stream, "mxu": mxu, "store": store,
-            "tuner": tuner, "stages": stages}
+            "tuner": tuner, "replay": replay, "stages": stages}
 
 
 def perfcheck(doc, baseline=None, proxy_golden=None, proxy_tol=0.5,
               headline_tol=0.2, flops_tol=0.25, accel_golden=None,
               accel_tol=0.05, stream_golden=None, stream_tol=0.05,
               store_golden=None, store_tol=0.6, tuner_golden=None,
-              tuner_tol=0.25, mxu_golden=None, mxu_tol=0.2):
+              tuner_tol=0.25, mxu_golden=None, mxu_tol=0.2,
+              replay_golden=None, replay_tol=0.0):
     """Compare a bench JSON against the last-good baseline and the
     committed proxy golden.  Returns ``(rc, lines)`` — rc 0 when nothing
     regressed beyond its tolerance band, 1 on regression (including a
@@ -508,6 +516,15 @@ def perfcheck(doc, baseline=None, proxy_golden=None, proxy_tol=0.5,
     deterministic (fake clock, synthetic load) and drift is a hard
     FAIL — a changed checksum means the controller made *different
     decisions*, which no steps tolerance can excuse.
+
+    ``replay_golden`` grades the replay_proxy stage: its value is the
+    ADMISSION COUNT of the synthesized adversarial trace replayed twice
+    under a fake clock.  Both the count and the admission-sequence
+    checksum are fully deterministic (seeded generators, virtual time),
+    so the band is exact by default (``replay_tol`` 0) and checksum
+    drift is a hard FAIL — a changed checksum means record/replay no
+    longer reproduces the same admission sequence, which is the entire
+    contract (doc/observability.md "Record/replay").
     """
     lines = []
     rc = 0
@@ -686,6 +703,55 @@ def perfcheck(doc, baseline=None, proxy_golden=None, proxy_tol=0.5,
     elif cand_tuner is not None:
         lines.append("note: tuner record present but no golden to "
                      "compare against (record one: make tuner-golden)")
+
+    replay_gold = None
+    if replay_golden:
+        replay_gold = (extract_records(replay_golden)["replay"]
+                       or (replay_golden
+                           if replay_golden.get("value") is not None
+                           else None))
+    cand_replay = recs["replay"]
+    if replay_gold is not None:
+        if cand_replay is None:
+            rc = 1
+            lines.append(
+                "FAIL replay: candidate carries no replay_proxy record "
+                "(a golden exists — the chip-free replay-determinism "
+                "metric must always be fresh)")
+        else:
+            floor = replay_gold["value"] * (1.0 - replay_tol)
+            verdict = "ok" if cand_replay["value"] >= floor else "FAIL"
+            if verdict == "FAIL":
+                rc = 1
+            lines.append(
+                "%s replay admissions: %d vs golden %d (floor %.1f, "
+                "tol %.0f%%)"
+                % (verdict, cand_replay["value"], replay_gold["value"],
+                   floor, 100 * replay_tol))
+            cand_sum = cand_replay.get("checksum")
+            gold_sum = replay_gold.get("checksum")
+            if cand_sum is None:
+                rc = 1
+                lines.append(
+                    "FAIL replay: candidate record carries no "
+                    "admission-sequence checksum — determinism "
+                    "unproven")
+            elif gold_sum is not None:
+                # CRC-style sums are exact integers: a relative
+                # tolerance (the float-accumulation idiom above) would
+                # swallow real drift at CRC magnitudes, so compare to
+                # within float-representation noise only.
+                same = abs(cand_sum - gold_sum) <= 1e-6
+                if not same:
+                    rc = 1
+                lines.append(
+                    "%s replay admission-sequence checksum: %.6f vs "
+                    "golden %.6f (exact — drift means replay no longer "
+                    "reproduces the same sequence)"
+                    % ("ok" if same else "FAIL", cand_sum, gold_sum))
+    elif cand_replay is not None:
+        lines.append("note: replay record present but no golden to "
+                     "compare against (record one: make replay-golden)")
 
     golden_rec = None
     if proxy_golden:

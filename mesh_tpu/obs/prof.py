@@ -26,7 +26,7 @@ serve-stats/incidents/perfcheck.
 import json
 import math
 
-from .ledger import LEDGER_STAGES
+from .ledger import LEDGER_SCHEMA, LEDGER_STAGES
 from .series import quantile_from_cumulative
 
 __all__ = [
@@ -57,6 +57,20 @@ def stats_from_records(rows):
     per-request total quantiles, and a backend histogram."""
     per_stage, totals, backends = {}, [], {}
     for row in rows:
+        schema = row.get("schema")
+        if schema is not None:
+            # dump_jsonl stamps each line with its row-format version;
+            # accept anything up to ours, refuse rows from the future
+            try:
+                schema = int(schema)
+            except (TypeError, ValueError):
+                raise ProfError("unparseable ledger row schema %r"
+                                % (schema,))
+            if schema > LEDGER_SCHEMA:
+                raise ProfError(
+                    "ledger row schema %d is newer than supported %d — "
+                    "upgrade before profiling this dump"
+                    % (schema, LEDGER_SCHEMA))
         stages = row.get("stages")
         if not isinstance(stages, dict):
             continue

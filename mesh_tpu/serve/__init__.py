@@ -44,7 +44,12 @@ from .health import (  # noqa: F401
     STATE_NAMES,
     HealthMonitor,
 )
-from .loadgen import percentile, run_closed_loop, run_open_loop  # noqa: F401
+from .loadgen import (  # noqa: F401
+    percentile,
+    run_closed_loop,
+    run_open_loop,
+    run_trace_replay,
+)
 from .service import (  # noqa: F401
     QueryService,
     ServeResponse,
@@ -58,6 +63,6 @@ __all__ = [
     "Deadline", "Rung", "ServeResult", "call_with_timeout",
     "default_ladder", "run_with_ladder",
     "HealthMonitor", "HEALTHY", "DEGRADED", "DRAINING", "STATE_NAMES",
-    "percentile", "run_closed_loop", "run_open_loop",
+    "percentile", "run_closed_loop", "run_open_loop", "run_trace_replay",
     "ServeRejected", "DeadlineExceeded", "EngineShutdown",
 ]

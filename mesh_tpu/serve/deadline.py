@@ -409,4 +409,5 @@ def run_with_ladder(mesh, points, deadline, ladder=None, chunk=512,
         "elapsed %.3fs, retries %d)"
         % (deadline.seconds, deadline.elapsed(), retries))
     exc.__cause__ = last_error
+    exc.rung = rung.name if retries else rungs[0].name
     raise exc

@@ -1,6 +1,6 @@
 """Load generation for the serving tier (bench.py --serve-load).
 
-Two generator shapes, because they answer different questions:
+Three generator shapes, because they answer different questions:
 
 - **closed loop** (``run_closed_loop``): N client threads, each issuing
   the next request the moment the previous one answers.  Concurrency is
@@ -12,19 +12,32 @@ Two generator shapes, because they answer different questions:
   rate is fixed, concurrency floats — this exposes queueing collapse and
   shed behavior that a closed loop structurally cannot (a closed loop
   slows its own arrivals when the service slows; real traffic does not).
+- **trace replay** (``run_trace_replay``): submissions paced by a
+  recorded trace (obs/replay.py) — the exact admission sequence of a
+  captured incident or a synthesized adversarial mix, inter-arrival gaps
+  and tenant/deadline/priority spread included, optionally time-warped
+  by ``speed``.  The report carries a deterministic admission-sequence
+  checksum, so "same trace twice ⇒ same sequence" is machine-checkable.
 
-Both return one JSON-able report: latency percentiles over *successful*
-responses, goodput (ok responses per wall second), shed rate (rejected +
-shed / issued), deadline-miss rate, and per-rung answer counts — the
-serving acceptance numbers, straight off the wire.
+All three return one JSON-able report: latency percentiles over
+*successful* responses, goodput (ok responses per *paced* second — the
+window requests were issued in; future-collection wait is reported
+separately as ``wall_s``), shed rate (rejected + shed / issued),
+deadline-miss rate, and per-rung answer counts — the serving acceptance
+numbers, straight off the wire.
+
+Pacing loops take an injectable ``clock``/``sleep`` pair (defaulting to
+the obs/clock aliases) so open-loop and replay runs are fake-clock
+deterministic in tests, the same discipline the SLO and tuner tests use.
 """
 
 import threading
 
 from ..errors import DeadlineExceeded, ServeRejected
-from ..obs.clock import monotonic
+from ..obs.clock import monotonic, sleep as _sleep
 
-__all__ = ["percentile", "run_closed_loop", "run_open_loop"]
+__all__ = ["percentile", "run_closed_loop", "run_open_loop",
+           "run_trace_replay"]
 
 
 def percentile(values, q):
@@ -61,6 +74,7 @@ class _Tally(object):
         self.approximate = 0
         self.retries = 0
         self.rungs = {}
+        self.failed_rungs = {}          # last rung attempted on deadline
 
     def record_response(self, response):
         with self.lock:
@@ -79,10 +93,21 @@ class _Tally(object):
                 self.shed += 1
             elif isinstance(error, DeadlineExceeded):
                 self.deadline += 1
+                rung = getattr(error, "rung", None)
+                if rung:
+                    self.failed_rungs[rung] = \
+                        self.failed_rungs.get(rung, 0) + 1
             else:
                 self.errors += 1
 
-    def report(self, wall_s):
+    def report(self, paced_s, wall_s=None):
+        """``paced_s`` is the submission window (arrivals were paced over
+        it — the goodput denominator); ``wall_s`` additionally includes
+        the post-pacing future-collection wait.  Folding collection wait
+        into the goodput denominator deflated open-loop goodput_qps by
+        however long the slowest straggler took to answer."""
+        if wall_s is None:
+            wall_s = paced_s
         with self.lock:
             issued = (self.ok + self.shed + self.deadline + self.errors)
             lat = list(self.latencies_s)
@@ -92,8 +117,10 @@ class _Tally(object):
                 "shed": self.shed,
                 "deadline_failures": self.deadline,
                 "errors": self.errors,
+                "paced_s": round(paced_s, 4),
                 "wall_s": round(wall_s, 4),
-                "goodput_qps": round(self.ok / wall_s, 2) if wall_s else 0.0,
+                "goodput_qps": round(self.ok / paced_s, 2)
+                if paced_s else 0.0,
                 "shed_rate": round(self.shed / issued, 4) if issued else 0.0,
                 "deadline_miss_rate": round(
                     (self.misses + self.deadline) / issued, 4)
@@ -101,6 +128,7 @@ class _Tally(object):
                 "approximate": self.approximate,
                 "retries": self.retries,
                 "rungs": dict(self.rungs),
+                "failed_rungs": dict(self.failed_rungs),
                 "p50_ms": round(1e3 * percentile(lat, 50), 3),
                 "p95_ms": round(1e3 * percentile(lat, 95), 3),
                 "p99_ms": round(1e3 * percentile(lat, 99), 3),
@@ -145,32 +173,109 @@ def run_closed_loop(service, mesh, points, clients=4, requests_per_client=32,
 
 
 def run_open_loop(service, mesh, points, rate_qps=50.0, duration_s=2.0,
-                  tenant="open-loop", deadline_s=None, collect_timeout_s=30.0):
+                  tenant="open-loop", deadline_s=None, collect_timeout_s=30.0,
+                  clock=None, sleep=None):
     """Paced async submissions at ``rate_qps`` for ``duration_s``; futures
-    are collected afterwards so slow service never slows arrivals."""
-    import time
-
+    are collected afterwards so slow service never slows arrivals.  Pass
+    a fake ``clock``/``sleep`` pair for deterministic pacing in tests."""
+    clock = monotonic if clock is None else clock
+    sleep = _sleep if sleep is None else sleep
     interval = 1.0 / float(rate_qps)
     tally = _Tally()
     futures = []
-    t0 = monotonic()
+    t0 = clock()
     t_next = t0
     while t_next - t0 < duration_s:
-        wait = t_next - monotonic()
+        wait = t_next - clock()
         if wait > 0:
-            time.sleep(wait)
+            sleep(wait)
         try:
             futures.append(service.submit(mesh, points, tenant=tenant,
                                           deadline_s=deadline_s))
         except Exception as e:          # noqa: BLE001 — tallied, not raised
             tally.record_error(e)
         t_next += interval
+    paced_s = clock() - t0
     for fut in futures:
         try:
             tally.record_response(fut.result(timeout=collect_timeout_s))
         except Exception as e:          # noqa: BLE001 — tallied, not raised
             tally.record_error(e)
-    report = tally.report(monotonic() - t0)
+    report = tally.report(paced_s, wall_s=clock() - t0)
     report["loop"] = "open"
     report["rate_qps"] = float(rate_qps)
+    return report
+
+
+def run_trace_replay(service, mesh, points, trace, speed=1.0,
+                     deadline_s=None, collect_timeout_s=30.0,
+                     clock=None, sleep=None):
+    """Open-loop replay of a recorded trace: every record is submitted at
+    its captured admit offset (divided by ``speed``) with its captured
+    tenant/priority/deadline, so the admission sequence — inter-arrival
+    gaps, tenant mix, deadline spread — is the trace's, not a synthetic
+    rate's.
+
+    ``trace`` is a dict from ``obs.replay.load_trace`` (or any
+    synthesizer).  ``mesh`` is the target for every request; a record's
+    captured ``store_key`` takes precedence when ``mesh`` is None, so an
+    incident trace replays against the store artifacts it named.
+    ``deadline_s`` overrides every record's captured deadline (that IS a
+    different workload, and the checksum says so); ``speed`` repaces the
+    same sequence and leaves the checksum unchanged.
+
+    The report is the standard loadgen report plus ``admissions`` and
+    ``checksum`` — the canonical admission-sequence hash from
+    ``obs.replay.sequence_checksum``, equal across runs of the same
+    trace (and equal to the null replay's, service or no service).
+    """
+    from ..obs.metrics import REGISTRY
+    from ..obs.replay import ReplayError, admission_events, \
+        sequence_checksum
+
+    if speed <= 0:
+        raise ReplayError("replay speed must be > 0 (got %s)" % speed)
+    clock = monotonic if clock is None else clock
+    sleep = _sleep if sleep is None else sleep
+    m_requests = REGISTRY.counter(
+        "mesh_tpu_replay_requests_total",
+        "trace-replay admissions by tenant and trace source")
+    m_lag = REGISTRY.histogram(
+        "mesh_tpu_replay_lag_seconds",
+        "how far behind its trace offset each replayed admission ran")
+    events = admission_events(trace, deadline_s=deadline_s)
+    source = trace.get("source", "unknown")
+    tally = _Tally()
+    futures = []
+    t0 = clock()
+    for rec in trace["records"]:
+        target = t0 + float(rec["t"]) / speed
+        wait = target - clock()
+        if wait > 0:
+            sleep(wait)
+        m_requests.inc(tenant=rec.get("tenant", "default"), source=source)
+        m_lag.observe(max(clock() - target, 0.0))
+        target_mesh = mesh if mesh is not None else rec.get("store_key")
+        deadline = deadline_s if deadline_s is not None \
+            else rec.get("deadline_s")
+        try:
+            futures.append(service.submit(
+                target_mesh, points,
+                tenant=rec.get("tenant", "default"),
+                priority=int(rec.get("priority") or 0),
+                deadline_s=deadline))
+        except Exception as e:          # noqa: BLE001 — tallied, not raised
+            tally.record_error(e)
+    paced_s = clock() - t0
+    for fut in futures:
+        try:
+            tally.record_response(fut.result(timeout=collect_timeout_s))
+        except Exception as e:          # noqa: BLE001 — tallied, not raised
+            tally.record_error(e)
+    report = tally.report(paced_s, wall_s=clock() - t0)
+    report["loop"] = "replay"
+    report["source"] = source
+    report["speed"] = float(speed)
+    report["admissions"] = len(events)
+    report["checksum"] = sequence_checksum(events)
     return report

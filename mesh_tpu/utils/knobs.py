@@ -212,6 +212,12 @@ LEDGER_TAIL = _declare(
     "MESH_TPU_LEDGER_TAIL", "int", 32,
     "How many newest ledger records ride along in each flight-recorder "
     "incident dump (min 1).", "Observability")
+REPLAY_TRACE = _declare(
+    "MESH_TPU_REPLAY_TRACE", "path", None,
+    "Stream every ledger close into a replayable traffic trace at this "
+    "JSONL path (obs/replay.py schema v1: relative admit offsets + "
+    "tenant/op/bucket/deadline/priority/store-key provenance; replay "
+    "with `mesh-tpu replay run`).", "Observability")
 LOCK_WITNESS = _declare(
     "MESH_TPU_LOCK_WITNESS", "flag", False,
     "Wrap every threading.Lock/RLock/Condition created by mesh_tpu "
@@ -366,6 +372,11 @@ STORE_PROXY_QUERIES = _declare(
     "MESH_TPU_STORE_PROXY_QUERIES", "int", None,
     "store_cold_start bench stage: override the proxy query count "
     "(read by bench.py).", "Bench harness")
+REPLAY_PROXY_SEED = _declare(
+    "MESH_TPU_REPLAY_PROXY_SEED", "int", None,
+    "replay_proxy bench stage: override the synthesized adversarial-mix "
+    "trace seed (read by bench.py; changing it is expected to change "
+    "the committed golden checksum).", "Bench harness")
 
 
 # -- accessors -------------------------------------------------------------

@@ -1,0 +1,522 @@
+"""Record/replay acceptance: traces, checksums, shadow diff, synthesis.
+
+The contract under test (doc/observability.md "Record/replay"):
+
+- a trace round-trips through write/load byte-faithfully and refuses
+  schemas newer than the reader;
+- ledger dumps, live ledgers, and schema>=2 incidents all convert to
+  replayable traces with rebased admit offsets;
+- the SAME trace replayed twice — against real QueryServices under a
+  fake clock — produces the SAME admission-sequence checksum, equal to
+  the trace's canonical sequence hash, invariant to ``speed`` but NOT
+  to a deadline override;
+- ``mesh-tpu replay diff`` attributes a fault-injected dispatch
+  slowdown to the 'dispatch' stage with rc 1;
+- the perfcheck replay band hard-fails on checksum drift or a missing
+  checksum;
+- the MESH_TPU_REPLAY_TRACE knob streams ledger closes into a capture
+  file with no code changes;
+- the committed benchmarks/replay_golden.json matches what the
+  replay_proxy stage produces today.
+
+Everything here is jax-free and fake-clocked — the whole module runs
+in seconds on a machine that has never seen a TPU.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from mesh_tpu.obs import prof, replay
+from mesh_tpu.obs.ledger import LEDGER_SCHEMA, LatencyLedger
+from mesh_tpu.obs.metrics import Registry
+from mesh_tpu.serve import (
+    HealthMonitor,
+    QueryService,
+    Rung,
+    ServeResult,
+    run_trace_replay,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# helpers
+
+
+class FakeClock(object):
+    def __init__(self, t=100.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += float(dt)
+        return self.t
+
+
+def _fake_pair():
+    """A (clock, sleep) pair over shared virtual time."""
+    t = [0.0]
+
+    def clock():
+        return t[0]
+
+    def sleep(dt):
+        t[0] += max(dt, 0.0)
+
+    return clock, sleep
+
+
+def _plain_service(**kw):
+    faces = np.zeros((1, 4), np.uint32)
+    answer = np.zeros((4, 3), np.float64)
+
+    def _ok(mesh, points, chunk, timeout):
+        return ServeResult(faces, answer, "replay-ok", certified=True)
+
+    kw.setdefault("workers", 2)
+    kw.setdefault("ladder", [Rung("replay-ok", _ok)])
+    kw.setdefault("health", HealthMonitor(watchdog=False))
+    kw.setdefault("max_queue_per_tenant", 8192)
+    kw.setdefault("default_deadline_s", 30.0)
+    return QueryService(**kw)
+
+
+_PTS = np.zeros((4, 3), np.float32)
+
+
+def _ledger_rows(n=3, t0=500.0, dispatch_s=0.002):
+    """Synthetic closed ledger rows via a private fake-clock ledger."""
+    led = LatencyLedger(capacity=64, registry=Registry(),
+                       clock=(clk := FakeClock(t0)))
+    for i in range(n):
+        rec = led.open(tenant="t%d" % (i % 2), op="closest_point",
+                       bucket=256, q=100 + i, deadline_s=0.5, priority=0)
+        clk.advance(0.001)
+        rec.stamp("queue")
+        clk.advance(dispatch_s)
+        rec.stamp("dispatch")
+        clk.advance(0.003)
+        rec.stamp("device")
+        clk.advance(0.0005)
+        led.close(rec, backend="xla")
+        clk.advance(0.05)
+    return led
+
+
+def _run_cli(*argv):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    return subprocess.run(
+        [sys.executable, "-m", "mesh_tpu.cli"] + list(argv),
+        capture_output=True, text=True, timeout=180, env=env, cwd=_REPO)
+
+
+# ---------------------------------------------------------------------------
+# trace files: round-trip and refusal
+
+
+def test_trace_round_trip(tmp_path):
+    trace = replay.synth_stampede(seed=3)
+    path = str(tmp_path / "trace.jsonl")
+    n = replay.write_trace(trace, path)
+    assert n == len(trace["records"]) > 0
+    loaded = replay.load_trace(path)
+    assert loaded["source"] == trace["source"]
+    assert loaded["records"] == trace["records"]
+    # and the identity that makes diffs meaningful: the checksum survives
+    assert replay.sequence_checksum(replay.admission_events(loaded)) == \
+        replay.sequence_checksum(replay.admission_events(trace))
+
+
+def test_load_trace_refuses_future_schema(tmp_path):
+    path = str(tmp_path / "future.jsonl")
+    with open(path, "w") as fh:
+        fh.write(json.dumps({"kind": "mesh_tpu_trace",
+                             "schema": replay.TRACE_SCHEMA + 1,
+                             "source": "future"}) + "\n")
+        fh.write(json.dumps({"t": 0.0}) + "\n")
+    with pytest.raises(replay.ReplayError, match="newer than supported"):
+        replay.load_trace(path)
+
+
+def test_load_trace_refuses_garbage(tmp_path):
+    headerless = str(tmp_path / "no_header.jsonl")
+    with open(headerless, "w") as fh:
+        fh.write(json.dumps({"t": 0.0}) + "\n")
+    with pytest.raises(replay.ReplayError, match="not a trace file"):
+        replay.load_trace(headerless)
+    with pytest.raises(replay.ReplayError, match="cannot read"):
+        replay.load_trace(str(tmp_path / "missing.jsonl"))
+    malformed = str(tmp_path / "malformed.jsonl")
+    with open(malformed, "w") as fh:
+        fh.write(json.dumps({"kind": "mesh_tpu_trace", "schema": 1,
+                             "source": "x"}) + "\n")
+        fh.write("{not json\n")
+    with pytest.raises(replay.ReplayError, match="malformed"):
+        replay.load_trace(malformed)
+
+
+# ---------------------------------------------------------------------------
+# converters: ledger dumps, live ledgers, incidents
+
+
+def test_trace_from_ledger_rebases_offsets(tmp_path):
+    led = _ledger_rows(n=3, t0=500.0)
+    trace = replay.trace_from_ledger(led)
+    offsets = [rec["t"] for rec in trace["records"]]
+    # monotonic-clock origin (t=500) never leaks into the trace
+    assert offsets[0] == 0.0
+    assert offsets == sorted(offsets)
+    assert all(t < 10.0 for t in offsets)
+    assert trace["records"][0]["tenant"] == "t0"
+    assert trace["records"][0]["deadline_s"] == 0.5
+    # a dump_jsonl file converts identically (schema stamp and all)
+    dump = str(tmp_path / "ledger.jsonl")
+    led.dump_jsonl(dump)
+    from_file = replay.trace_from_ledger(dump)
+    assert [r["t"] for r in from_file["records"]] == offsets
+
+
+def test_trace_from_ledger_requires_admit_stamps():
+    with pytest.raises(replay.ReplayError, match="no ledger rows"):
+        replay.trace_from_ledger([{"tenant": "x"}], name="empty")
+
+
+def test_trace_from_incident_schema_gate():
+    led = _ledger_rows(n=2)
+    doc = {"kind": "incident", "schema_version": 3, "reason": "slo_fast_burn",
+           "ledger": led.records()}
+    trace = replay.trace_from_incident(doc)
+    assert trace["source"] == "incident:slo_fast_burn"
+    assert len(trace["records"]) == 2
+    with pytest.raises(replay.ReplayError, match="schema_version"):
+        replay.trace_from_incident({"kind": "incident", "schema_version": 1})
+    with pytest.raises(replay.ReplayError, match="not an incident"):
+        replay.trace_from_incident({"kind": "metrics"})
+
+
+# ---------------------------------------------------------------------------
+# satellite: dump_jsonl schema stamp, prof accepts-and-checks
+
+
+def test_dump_jsonl_stamps_schema_and_prof_accepts(tmp_path):
+    led = _ledger_rows(n=2)
+    path = str(tmp_path / "dump.jsonl")
+    led.dump_jsonl(path)
+    with open(path) as fh:
+        rows = [json.loads(ln) for ln in fh]
+    assert all(row["schema"] == LEDGER_SCHEMA for row in rows)
+    # the in-ring rows stay unstamped: the version belongs to the file
+    assert all("schema" not in row for row in led.records())
+    stats = prof.load(path)
+    assert stats["stages"]["dispatch"]["count"] == 2
+
+
+def test_prof_refuses_newer_ledger_schema(tmp_path):
+    led = _ledger_rows(n=2)
+    path = str(tmp_path / "future.jsonl")
+    with open(path, "w") as fh:
+        for row in led.records():
+            fh.write(json.dumps(dict(row, schema=LEDGER_SCHEMA + 1)) + "\n")
+    with pytest.raises(prof.ProfError, match="newer than supported"):
+        prof.load(path)
+
+
+# ---------------------------------------------------------------------------
+# determinism: same trace twice => same admission sequence
+
+
+def test_live_replay_checksum_deterministic():
+    trace = replay.synth_mix(seed=7)
+    clock, sleep = _fake_pair()
+    reports = []
+    for _ in range(2):
+        service = _plain_service()
+        try:
+            reports.append(run_trace_replay(
+                service, object(), _PTS, trace, deadline_s=30.0,
+                clock=clock, sleep=sleep))
+        finally:
+            service.stop(write_stats=False)
+    first, second = reports
+    assert first["checksum"] == second["checksum"]
+    assert first["checksum"] == replay.sequence_checksum(
+        replay.admission_events(trace, deadline_s=30.0))
+    assert first["admissions"] == len(trace["records"])
+    assert first["ok"] == len(trace["records"])
+    assert first["shed"] == 0 and first["deadline_failures"] == 0
+
+
+def test_checksum_speed_invariant_deadline_sensitive():
+    trace = replay.synth_stampede(seed=5)
+    base = replay.null_replay(trace)
+    warped = replay.null_replay(trace, speed=4.0)
+    # time-warp repaces the same sequence: shorter window, same identity
+    assert warped["checksum"] == base["checksum"]
+    assert warped["paced_s"] == pytest.approx(base["paced_s"] / 4.0,
+                                              abs=1e-3)
+    # a deadline override IS a different workload, and the checksum says so
+    overridden = replay.null_replay(trace, deadline_s=30.0)
+    assert overridden["checksum"] != base["checksum"]
+    with pytest.raises(replay.ReplayError, match="speed"):
+        replay.null_replay(trace, speed=0.0)
+
+
+def test_replay_moves_metrics_and_store_keys():
+    trace = {"schema": 1, "source": "synth:test", "records": [
+        {"t": 0.0, "tenant": "a", "priority": 0, "deadline_s": 5.0,
+         "store_key": "sha256:abc"},
+        {"t": 0.01, "tenant": "b", "priority": 1, "deadline_s": 5.0},
+    ]}
+    seen = []
+
+    class _Future(object):
+        def result(self, timeout=None):
+            import types
+            return types.SimpleNamespace(
+                latency_s=0.001, rung="ok", retries=0,
+                deadline_missed=False, approximate=False)
+
+    class _Spy(object):
+        def submit(self, mesh, points, **kw):
+            seen.append((mesh, kw["tenant"], kw["priority"]))
+            return _Future()
+
+    clock, sleep = _fake_pair()
+    report = run_trace_replay(_Spy(), None, _PTS, trace,
+                              clock=clock, sleep=sleep)
+    # mesh=None lets the captured store_key route through the store path
+    assert seen == [("sha256:abc", "a", 0), (None, "b", 1)]
+    assert report["loop"] == "replay" and report["source"] == "synth:test"
+    from mesh_tpu.obs.metrics import REGISTRY
+    counter = REGISTRY.get("mesh_tpu_replay_requests_total")
+    assert counter is not None
+    assert counter.value(tenant="a", source="synth:test") >= 1
+
+
+# ---------------------------------------------------------------------------
+# synthesis
+
+
+def test_synth_generators_deterministic_and_sorted():
+    for kind in sorted(replay.SYNTH_KINDS):
+        a = replay.synthesize(kind)
+        b = replay.synthesize(kind)
+        assert a == b, "synth %r is not deterministic" % kind
+        offsets = [rec["t"] for rec in a["records"]]
+        assert offsets == sorted(offsets)
+        assert a["records"], "synth %r emitted an empty trace" % kind
+        assert a["source"].startswith("synth:")
+    with pytest.raises(replay.ReplayError, match="unknown synth kind"):
+        replay.synthesize("nope")
+    # the adversarial shapes carry their regeneration tags
+    assert all(r["shape"] == "volume_fill"
+               for r in replay.synth_prune_defeat()["records"])
+    assert all(r["shape"] == "degenerate_mesh"
+               for r in replay.synth_degenerate()["records"])
+    # stampede: every tenant admits within 1 ms of its burst instant
+    burst = [r for r in replay.synth_stampede(tenants=4)["records"]
+             if r["t"] < 0.002]
+    assert len({r["tenant"] for r in burst}) == 4
+
+
+# ---------------------------------------------------------------------------
+# shadow diff: fault-injected dispatch slowdown attributed with rc 1
+
+
+def _shadow_report(trace, dispatch_s, path):
+    def model(rec, d=dispatch_s):
+        return {"queue": 0.001, "dispatch": d, "device": 0.003,
+                "respond": 0.0005}
+    report = replay.null_replay(trace)
+    replay.attach_stage_stats(report, replay.shadow_rows(trace, model))
+    with open(path, "w") as fh:
+        json.dump(report, fh)
+    return report
+
+
+def test_replay_diff_attributes_dispatch_slowdown(tmp_path):
+    trace = replay.synth_stampede(seed=9)
+    a = str(tmp_path / "base.json")
+    b = str(tmp_path / "slow.json")
+    _shadow_report(trace, 0.002, a)
+    _shadow_report(trace, 0.052, b)     # fault-injected +50 ms dispatch
+    proc = _run_cli("replay", "diff", a, b, "--json")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    fail_lines = [ln for ln in doc["lines"] if "'dispatch'" in ln]
+    assert fail_lines, doc["lines"]
+    assert any("checksums match" in ln for ln in doc["lines"])
+
+
+def test_replay_diff_checksum_mismatch_fails(tmp_path):
+    a = str(tmp_path / "a.json")
+    b = str(tmp_path / "b.json")
+    _shadow_report(replay.synth_stampede(seed=9), 0.002, a)
+    _shadow_report(replay.synth_steady(seed=1), 0.002, b)
+    proc = _run_cli("replay", "diff", a, b, "--json")
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)
+    assert any("DIFFERENT workloads" in ln for ln in doc["lines"])
+
+
+def test_shadow_rows_refuse_unknown_stage():
+    trace = replay.synth_steady(duration_s=0.2)
+    with pytest.raises(replay.ReplayError, match="unknown stage"):
+        replay.shadow_rows(trace, lambda rec: {"warp_drive": 1.0})
+
+
+# ---------------------------------------------------------------------------
+# CLI rc matrix
+
+
+def test_replay_cli_run_and_synth(tmp_path):
+    trace_path = str(tmp_path / "mix.jsonl")
+    proc = _run_cli("replay", "synth", "stampede", "--out", trace_path)
+    assert proc.returncode == 0, proc.stderr
+    run1 = _run_cli("replay", "run", trace_path, "--json")
+    run2 = _run_cli("replay", "run", trace_path, "--json", "--speed", "3")
+    assert run1.returncode == 0 and run2.returncode == 0
+    r1, r2 = json.loads(run1.stdout), json.loads(run2.stdout)
+    # twice-replayed trace: same checksum, machine-checked (speed-warped)
+    assert r1["checksum"] == r2["checksum"]
+    assert r2["paced_s"] < r1["paced_s"]
+
+
+def test_replay_cli_unreadable_is_rc2(tmp_path):
+    bad = str(tmp_path / "bad.jsonl")
+    with open(bad, "w") as fh:
+        fh.write("this is not a trace\n")
+    assert _run_cli("replay", "run", bad).returncode == 2
+    assert _run_cli("replay", "run",
+                    str(tmp_path / "missing.jsonl")).returncode == 2
+    assert _run_cli("replay", "synth", "nope").returncode == 2
+
+
+# ---------------------------------------------------------------------------
+# perfcheck replay band
+
+
+def _band(cand_replay, gold):
+    from mesh_tpu.obs.perf import perfcheck
+    doc = {"replay": cand_replay} if cand_replay is not None else \
+        {"metric": "x", "value": None, "unit": None, "vs_baseline": None}
+    return perfcheck(doc, replay_golden=gold)
+
+
+def test_perfcheck_replay_band():
+    gold = {"metric": "replay_admissions", "value": 250,
+            "checksum": 3558183080.0}
+    rc, lines = _band(dict(gold), gold)
+    assert rc == 0
+    assert any("ok replay admissions" in ln for ln in lines)
+    # a candidate with no replay record at all is a hard FAIL
+    rc, lines = _band(None, gold)
+    assert rc == 1
+    assert any("FAIL replay" in ln for ln in lines)
+    # checksum drift is a hard FAIL even with the value in band
+    rc, lines = _band(dict(gold, checksum=gold["checksum"] + 1), gold)
+    assert rc == 1
+    assert any("FAIL replay admission-sequence checksum" in ln
+               for ln in lines)
+    # a candidate that cannot prove determinism is a hard FAIL
+    rc, lines = _band({"metric": "replay_admissions", "value": 250}, gold)
+    assert rc == 1
+    assert any("determinism unproven" in ln for ln in lines)
+    # admission count below the floor fails
+    rc, _ = _band(dict(gold, value=100), gold)
+    assert rc == 1
+    # record with no golden: informational note, rc 0
+    from mesh_tpu.obs.perf import perfcheck
+    rc, lines = perfcheck({"replay": dict(gold)})
+    assert rc == 0
+    assert any("make replay-golden" in ln for ln in lines)
+
+
+# ---------------------------------------------------------------------------
+# capture knob and listeners
+
+
+def test_capture_knob_streams_closes(tmp_path, monkeypatch):
+    path = str(tmp_path / "capture.jsonl")
+    monkeypatch.setenv("MESH_TPU_REPLAY_TRACE", path)
+    try:
+        _ledger_rows(n=3)
+    finally:
+        replay.reset_capture()
+        monkeypatch.delenv("MESH_TPU_REPLAY_TRACE")
+    trace = replay.load_trace(path)
+    assert trace["source"] == "capture"
+    assert len(trace["records"]) == 3
+    assert trace["records"][0]["t"] == 0.0
+
+
+def test_trace_writer_listener(tmp_path):
+    path = str(tmp_path / "listener.jsonl")
+    led = LatencyLedger(capacity=16, registry=Registry(),
+                       clock=(clk := FakeClock()))
+    with replay.TraceWriter(path, source="live") as writer:
+        led.add_listener(writer.observe)
+        for _ in range(2):
+            rec = led.open(tenant="w")
+            clk.advance(0.01)
+            led.close(rec)
+        led.remove_listener(writer.observe)
+        rec = led.open(tenant="w")
+        led.close(rec)
+    assert writer.written == 2
+    assert len(replay.load_trace(path)["records"]) == 2
+
+
+def test_listener_exceptions_are_swallowed():
+    led = LatencyLedger(capacity=16, registry=Registry(),
+                       clock=FakeClock())
+
+    def bomb(row):
+        raise RuntimeError("observer crash")
+
+    led.add_listener(bomb)
+    row = led.close(led.open(tenant="x"))   # must not raise
+    assert row["outcome"] == "ok"
+
+
+# ---------------------------------------------------------------------------
+# golden acceptance: the committed artifact matches today's build
+
+
+def _bench_stage(stage):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+               MESH_TPU_REPLAY_TRACE="")
+    return subprocess.run(
+        [sys.executable, "bench.py", "--stage", stage],
+        capture_output=True, text=True, timeout=180, env=env, cwd=_REPO)
+
+
+def test_replay_proxy_stage_matches_golden():
+    proc = _bench_stage("replay_proxy")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    record = json.loads(proc.stdout)
+    with open(os.path.join(_REPO, "benchmarks", "replay_golden.json")) as fh:
+        golden = json.load(fh)
+    assert record["value"] == golden["value"]
+    assert record["checksum"] == golden["checksum"]
+    assert record["double_run"] == "checksum_equal"
+
+
+def test_tuner_replay_stage_deterministic():
+    a = _bench_stage("tuner_replay")
+    assert a.returncode == 0, a.stderr[-2000:]
+    rec_a = json.loads(a.stdout)
+    b = _bench_stage("tuner_replay")
+    assert b.returncode == 0, b.stderr[-2000:]
+    rec_b = json.loads(b.stdout)
+    assert rec_a["value"] == rec_b["value"]
+    assert rec_a["checksum"] == rec_b["checksum"]
+    assert rec_a["source"] == "synth:tuner_gym"

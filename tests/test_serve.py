@@ -653,6 +653,61 @@ def test_open_loop_report_shape():
     assert report["requests"] >= 10
     assert report["ok"] + report["shed"] + report["errors"] \
         + report["deadline_failures"] == report["requests"]
+    # paced/wall split: collection adds wall time, never paced time
+    assert report["wall_s"] >= report["paced_s"] > 0
+
+
+def test_open_loop_goodput_over_paced_window():
+    """Goodput's denominator is the paced submission window, NOT paced
+    plus the straggler-collection wait — folding the collect tail in
+    deflated open-loop goodput by however long the slowest future took
+    to answer.  Pinned under a fake clock: 10 paced submissions over
+    0.9 s, then each future takes a fake second to collect."""
+    import types
+
+    from mesh_tpu.serve import run_open_loop
+
+    t = [0.0]
+
+    class _SlowFuture(object):
+        def result(self, timeout=None):
+            t[0] += 1.0         # straggler: a full fake second each
+            return types.SimpleNamespace(
+                latency_s=1.0, rung="ok", retries=0,
+                deadline_missed=False, approximate=False)
+
+    class _StubService(object):
+        def submit(self, *a, **kw):
+            return _SlowFuture()
+
+    # duration 0.95 keeps the last tick off the float-accumulation edge
+    report = run_open_loop(
+        _StubService(), _MESH, _PTS, rate_qps=10.0, duration_s=0.95,
+        clock=lambda: t[0], sleep=lambda dt: t.__setitem__(0, t[0] + dt))
+    # submissions at t = 0.0, 0.1, ..., 0.9; collection then burns 10 s
+    assert report["ok"] == 10
+    assert report["paced_s"] == pytest.approx(0.9)
+    assert report["wall_s"] == pytest.approx(10.9)
+    assert report["goodput_qps"] == pytest.approx(10 / 0.9, abs=0.01)
+
+
+def test_loadgen_failed_rungs_provenance():
+    """A DeadlineExceeded raised by ladder exhaustion carries the last
+    rung attempted, and the loadgen report surfaces the histogram under
+    ``failed_rungs`` — 'which rung was failing' survives into the
+    error-path report instead of flattening to a bare count."""
+    from mesh_tpu.serve import run_closed_loop
+
+    svc = _service(ladder=[_failing_rung("r1"), _failing_rung("r2")],
+                   default_deadline_s=0.2)
+    try:
+        report = run_closed_loop(svc, _MESH, _PTS, clients=1,
+                                 requests_per_client=3)
+    finally:
+        svc.stop(write_stats=False)
+    assert report["deadline_failures"] == 3
+    assert report["failed_rungs"] == {"r2": 3}
+    assert report["rungs"] == {}
 
 
 # ---------------------------------------------------------------------------

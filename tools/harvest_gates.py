@@ -225,6 +225,29 @@ def render_table(h):
                     "repair rate %.4f (%d/%d tiles)" % (
                         mx["value"], mx["checksum"], rate,
                         mx.get("repaired", -1), mx.get("screened", -1)))
+        # record/replay gate: replay only counts as an improvement when
+        # the double-run admission-sequence checksum is present — a
+        # missing checksum means determinism is unproven, and perfcheck
+        # fails hard on drift against benchmarks/replay_golden.json
+        rp = b.get("replay")
+        if isinstance(rp, dict):
+            if rp.get("value") is None or rp.get("checksum") is None:
+                lines.append(
+                    "gate 2 replay: NOT AN IMPROVEMENT — replay record "
+                    "carries no admissions/checksum to prove the "
+                    "same-trace-same-sequence contract")
+            elif rp.get("double_run") != "checksum_equal":
+                lines.append(
+                    "gate 2 replay: NOT AN IMPROVEMENT — double-run "
+                    "verdict %r (the same trace must replay to an "
+                    "identical admission sequence)" % (
+                        rp.get("double_run"),))
+            else:
+                lines.append(
+                    "gate 2 replay: %d admissions OK — checksum %.6f "
+                    "double-run equal (perfcheck grades drift against "
+                    "benchmarks/replay_golden.json)" % (
+                        rp["value"], rp["checksum"]))
     for b in h.get("bench_variants", ()):
         if b.get("value") is None:
             lines.append(
