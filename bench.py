@@ -27,6 +27,7 @@ import json
 import os
 import sys
 import time
+import zlib
 from collections import OrderedDict
 
 import numpy as np
@@ -1752,6 +1753,347 @@ def replay_proxy_stage():
     }
 
 
+def fleet_proxy_stage():
+    """Stage ``fleet_proxy``: the fleet fabric's chip-free contract run —
+    a 3-replica fleet of real QueryServices on plain-python ladders
+    behind a FleetRouter, proving on every bench run (doc/fleet.md):
+
+    - **affinity**: 16 distinct topology digests x 8 rounds through the
+      router; every digest must land on exactly its ring primary, so the
+      affinity fraction is 1.0 and the warm-hit rate (requests after a
+      replica first saw a digest) is deterministic — both graded against
+      benchmarks/fleet_golden.json.
+    - **minimal remap**: draining one replica must move ONLY its own
+      digests (remap_moved_frac of everyone else's == 0.0, asserted
+      in-stage).
+    - **spill-under-stampede**: a held primary with a 1-deep tenant
+      queue must spill the overflow request to the ring's second choice
+      (exactly one spill, served by the sibling — asserted in-stage,
+      exact-matched by perfcheck).
+    - **replay determinism through the router**: the seeded adversarial
+      mix replayed twice (fresh fleet each run, fake clock) must
+      reproduce both the admission-sequence checksum and the per-replica
+      ``replica_checksums``; their combined CRC is the record's checksum
+      (hard-fail on drift).
+    - **AOT tier**: three child processes against one throwaway store —
+      cache-cold compile, warm start (must load from ``<store>/aot/xla``:
+      ``mesh_tpu_xla_cache_hits_total >= 1`` and a smaller ``compile``
+      ledger-stage), and a corrupted-executable start (the tier must
+      quarantine via the corruption funnel and recompile, never crash).
+    """
+    import shutil
+    import subprocess
+    import tempfile
+
+    from mesh_tpu.fleet import FleetRouter
+    from mesh_tpu.obs import replay as obs_replay
+    from mesh_tpu.obs.metrics import REGISTRY
+    from mesh_tpu.serve import (
+        HealthMonitor,
+        QueryService,
+        Rung,
+        ServeResult,
+        run_trace_replay,
+    )
+
+    seed = knobs.get_int("MESH_TPU_FLEET_PROXY_SEED")
+    seed = 7 if seed is None else seed
+
+    faces = np.zeros((1, 4), np.uint32)
+    answer = np.zeros((4, 3), np.float64)
+    pts = np.zeros((4, 3), np.float32)
+
+    class _Digest(object):
+        """A mesh stand-in that is nothing but its routing identity."""
+
+        def __init__(self, key):
+            self.topology_key = key
+
+    served = {}                         # replica -> digest -> count
+    first_seen = []                     # (replica, digest) warm/cold order
+
+    def _make_replica(name, **kw):
+        def _ok(mesh, points, chunk, timeout):
+            digest = getattr(mesh, "topology_key", str(mesh))
+            counts = served.setdefault(name, {})
+            if digest not in counts:
+                first_seen.append((name, digest))
+            counts[digest] = counts.get(digest, 0) + 1
+            return ServeResult(faces, answer, "fleet-ok", certified=True)
+
+        kw.setdefault("workers", 2)
+        kw.setdefault("max_queue_per_tenant", 1024)
+        return QueryService(ladder=[Rung("fleet-ok", _ok)],
+                            health=HealthMonitor(watchdog=False),
+                            default_deadline_s=30.0, **kw)
+
+    # -- phase 1+2: affinity, then minimal remap under drain -----------
+    router = FleetRouter()
+    replicas = {}
+    for i in range(3):
+        name = "replica-%d" % i
+        replicas[name] = _make_replica(name)
+        router.add_replica(name, replicas[name])
+    digests = ["fleet-digest-%02d" % i for i in range(16)]
+    meshes = {d: _Digest(d) for d in digests}
+    try:
+        primaries = {}
+        for d in digests:
+            _key, order = router.plan("closest_point", meshes[d], pts)
+            primaries[d] = order[0]
+        futures = [router.submit(meshes[d], pts, tenant="affinity",
+                                 deadline_s=30.0)
+                   for _ in range(8) for d in digests]
+        for fut in futures:
+            fut.result(timeout=60.0)
+        total = len(futures)
+        on_primary = 0
+        for d in digests:
+            owners = [n for n, counts in served.items() if d in counts]
+            if len(owners) != 1:
+                raise RuntimeError(
+                    "affinity broken: digest %s served by %s (want "
+                    "exactly its primary %s)" % (d, owners, primaries[d]))
+            on_primary += served[owners[0]][d] if owners[0] == \
+                primaries[d] else 0
+        affinity = on_primary / float(total)
+        if affinity != 1.0:
+            raise RuntimeError(
+                "affinity %.4f != 1.0: some digest left its ring "
+                "primary without a membership change" % affinity)
+        warm_hit_rate = (total - len(first_seen)) / float(total)
+
+        victim = primaries[digests[0]]
+        others = {d: p for d, p in primaries.items() if p != victim}
+        own = [d for d, p in primaries.items() if p == victim]
+        replicas[victim].health.begin_drain()
+        moved_other = sum(
+            1 for d, p in others.items()
+            if router.plan("closest_point", meshes[d], pts)[1][0] != p)
+        moved_own = sum(
+            1 for d in own
+            if router.plan("closest_point", meshes[d], pts)[1][0]
+            != victim)
+        if moved_other:
+            raise RuntimeError(
+                "draining %s remapped %d/%d digests owned by OTHER "
+                "replicas — consistent hashing must move only the "
+                "drained replica's keys" % (victim, moved_other,
+                                            len(others)))
+        if own and moved_own != len(own):
+            raise RuntimeError(
+                "draining %s left %d/%d of its own digests mapped to it"
+                % (victim, len(own) - moved_own, len(own)))
+    finally:
+        router.stop(write_stats=False)
+
+    # -- phase 3: spill to the ring sibling on queue_full --------------
+    spill_router = FleetRouter()
+    spill_replicas = {}
+    for name in ("spill-a", "spill-b"):
+        spill_replicas[name] = _make_replica(name, workers=1,
+                                             max_queue_per_tenant=1)
+        spill_router.add_replica(name, spill_replicas[name])
+    try:
+        mesh = _Digest("fleet-spill-digest")
+        _key, order = spill_router.plan("closest_point", mesh, pts)
+        primary, sibling = order[0], order[1]
+        spill_replicas[primary].hold()
+        try:
+            queued = spill_router.submit(mesh, pts, tenant="stampede",
+                                         deadline_s=30.0)
+            spilled = spill_router.submit(mesh, pts, tenant="stampede",
+                                          deadline_s=30.0)
+        finally:
+            spill_replicas[primary].release()
+        queued.result(timeout=60.0)
+        spilled.result(timeout=60.0)
+        spills = int(REGISTRY.counter(
+            "mesh_tpu_fleet_spill_total").value(replica=primary))
+        sibling_served = served.get(sibling, {}).get(
+            "fleet-spill-digest", 0)
+        if spills != 1 or sibling_served != 1:
+            raise RuntimeError(
+                "spill contract broken: %d spill(s) off %s, sibling %s "
+                "served %d (want exactly one overflow landing on the "
+                "ring's second choice)" % (spills, primary, sibling,
+                                           sibling_served))
+    finally:
+        spill_router.stop(write_stats=False)
+
+    # -- phase 4: trace replay through the router, twice ---------------
+    trace = obs_replay.synth_mix(seed=seed)
+    t = [0.0]
+    clock = lambda: t[0]                 # noqa: E731 — fake clock
+
+    def sleep(dt):
+        t[0] += max(dt, 0.0)
+
+    reports = []
+    for _ in range(2):
+        replay_router = FleetRouter()
+        for i in range(3):
+            name = "replay-%d" % i
+            replay_router.add_replica(
+                name, _make_replica(name, max_queue_per_tenant=8192))
+        try:
+            reports.append(run_trace_replay(
+                replay_router, _Digest("fleet-replay-digest"), pts, trace,
+                deadline_s=30.0, clock=clock, sleep=sleep))
+        finally:
+            replay_router.stop(write_stats=False)
+    first, second = reports
+    if first["checksum"] != second["checksum"] \
+            or first["replica_checksums"] != second["replica_checksums"]:
+        raise RuntimeError(
+            "fleet replay determinism broken: same trace + same "
+            "membership produced different admission placement "
+            "(%s vs %s)" % (first["replica_checksums"],
+                            second["replica_checksums"]))
+    combined = float(zlib.crc32(json.dumps(
+        first["replica_checksums"], sort_keys=True,
+        separators=(",", ":")).encode("utf-8")))
+
+    # -- phase 5: persistent AOT executable tier (child processes) -----
+    child_src = r"""
+import json, os, sys, time
+root = sys.argv[1]
+os.environ["MESH_TPU_STORE_DIR"] = root
+from mesh_tpu.store import get_store
+from mesh_tpu.store.aot import enable_aot_tier
+cache_dir = enable_aot_tier(store=get_store(), min_compile_secs=0.0)
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+w = jnp.asarray(np.random.RandomState(0).randn(128, 128), jnp.float32)
+def f(x):
+    def body(c, _):
+        return jnp.tanh(c @ w + 0.01 * c), None
+    out, _ = lax.scan(body, x, None, length=48)
+    return out
+x = jnp.ones((128, 128), jnp.float32)
+from mesh_tpu.obs.ledger import get_ledger
+ledger = get_ledger()
+rec = ledger.open(op="aot_probe", backend="xla")
+t0 = time.perf_counter()
+jax.jit(f).lower(x).compile()
+compile_s = time.perf_counter() - t0
+if rec is not None:
+    rec.stamp("compile")
+    ledger.close(rec, outcome="ok")
+from mesh_tpu import obs
+snap = obs.metrics_snapshot()
+def total(name):
+    return sum(s.get("value", 0)
+               for s in (snap.get(name) or {}).get("series", []))
+stage_s = sum(
+    s.get("sum", 0.0)
+    for s in (snap.get("mesh_tpu_request_stage_seconds") or {}).get(
+        "series", [])
+    if (s.get("labels") or {}).get("stage") == "compile")
+print(json.dumps({
+    "cache_dir": cache_dir,
+    "compile_s": compile_s,
+    "compile_stage_s": stage_s,
+    "xla_hits": total("mesh_tpu_xla_cache_hits_total"),
+    "xla_misses": total("mesh_tpu_xla_cache_misses_total"),
+    "corrupt": total("mesh_tpu_store_corrupt_total"),
+}))
+"""
+    tmp_root = tempfile.mkdtemp(prefix="mesh_tpu_fleet_bench.")
+    script = os.path.join(tmp_root, "aot_probe.py")
+    store_root = os.path.join(tmp_root, "store")
+    with open(script, "w") as fh:
+        fh.write(child_src)
+
+    def _aot_child(label):
+        env = dict(os.environ)
+        env.update({"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+                    "MESH_TPU_FLEET_AOT": "1",
+                    "MESH_TPU_NO_XLA_CACHE": ""})
+        # the probe script lives under /tmp, so the repo checkout is not
+        # on its sys.path the way `python -m` launches get it
+        env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.run(
+            [sys.executable, script, store_root], env=env,
+            capture_output=True, text=True, timeout=150)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                "aot %s child failed rc=%d: %s"
+                % (label, proc.returncode, proc.stderr.strip()[-2000:]))
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
+    try:
+        cold = _aot_child("cold")
+        warm = _aot_child("warm")
+        if cold["cache_dir"] is None or warm["cache_dir"] is None:
+            raise RuntimeError("aot tier did not come up (cache_dir "
+                               "None): %s / %s" % (cold, warm))
+        if warm["xla_hits"] < 1:
+            raise RuntimeError(
+                "aot warm start compiled from scratch (hits=%s, "
+                "misses=%s) — the persistent executable tier is not "
+                "being read" % (warm["xla_hits"], warm["xla_misses"]))
+        if not warm["compile_stage_s"] < cold["compile_stage_s"]:
+            raise RuntimeError(
+                "aot warm compile stage %.3fs is not under the cold "
+                "%.3fs — no measured compile skip"
+                % (warm["compile_stage_s"], cold["compile_stage_s"]))
+        # corrupt one cached executable: the next start must quarantine
+        # through the corruption funnel and recompile, never crash
+        xla_dir = cold["cache_dir"]
+        # skip jax's -atime LRU markers: they are not indexed (they
+        # mutate on every read), so corrupting one proves nothing
+        victims = sorted(
+            os.path.join(dp, n)
+            for dp, _dirs, names in os.walk(xla_dir) for n in names
+            if not n.endswith("-atime"))
+        if not victims:
+            raise RuntimeError("aot cache dir %s is empty after a "
+                               "persisted compile" % xla_dir)
+        with open(victims[0], "r+b") as fh:
+            fh.write(b"\x00corrupt\x00")
+        recovered = _aot_child("corrupt")
+        if recovered["corrupt"] < 1 or recovered["xla_misses"] < 1:
+            raise RuntimeError(
+                "aot corruption fallback broken: corrupt=%s misses=%s "
+                "(want the funnel to count the quarantine and a fresh "
+                "compile to land)" % (recovered["corrupt"],
+                                      recovered["xla_misses"]))
+        aot = {
+            "cold_compile_s": round(cold["compile_s"], 3),
+            "warm_compile_s": round(warm["compile_s"], 3),
+            "cold_stage_s": round(cold["compile_stage_s"], 3),
+            "warm_stage_s": round(warm["compile_stage_s"], 3),
+            "speedup": round(
+                cold["compile_stage_s"]
+                / max(warm["compile_stage_s"], 1e-9), 3),
+            "warm_hits": int(warm["xla_hits"]),
+            "quarantine_ok": True,
+            "quarantine_recompiles": int(recovered["xla_misses"]),
+        }
+    finally:
+        shutil.rmtree(tmp_root, ignore_errors=True)
+
+    return {
+        "metric": "fleet_affinity",
+        "value": affinity,
+        "unit": "affinity_frac",
+        "vs_baseline": None,
+        "replicas": 3,
+        "digests": len(digests),
+        "requests": total,
+        "warm_hit_rate": round(warm_hit_rate, 4),
+        "remap_moved_frac": 0.0,
+        "spills": spills,
+        "checksum": combined,
+        "replay_admissions": first["admissions"],
+        "replay_checksum": first["checksum"],
+        "aot": aot,
+    }
+
+
 def tuner_replay_stage():
     """Stage ``tuner_replay``: the tuner's gym — the TunerController fed
     a captured/synthesized traffic trace instead of the scripted burn
@@ -1934,6 +2276,20 @@ _STAGE_DEFS = OrderedDict((
                       {"JAX_PLATFORMS": "cpu",
                        "PALLAS_AXON_POOL_IPS": "",
                        "MESH_TPU_REPLAY_TRACE": ""})),
+    # chip-free fleet contract run: real services on fake ladders behind
+    # the router (fake-clocked replay), plus three short jax-on-CPU
+    # children for the AOT tier.  Fleet knobs are pinned ON and the XLA
+    # cache opt-out cleared so the caller's environment can't turn the
+    # very features under test off.
+    ("fleet_proxy", (fleet_proxy_stage, 300.0, False, False,
+                     {"JAX_PLATFORMS": "cpu",
+                      "PALLAS_AXON_POOL_IPS": "",
+                      "MESH_TPU_FLEET": "1",
+                      "MESH_TPU_FLEET_SPILL": "1",
+                      "MESH_TPU_FLEET_VNODES": "",
+                      "MESH_TPU_FLEET_AOT": "1",
+                      "MESH_TPU_NO_XLA_CACHE": "",
+                      "MESH_TPU_REPLAY_TRACE": ""})),
     # the tuner's gym: same env pins as tuner_convergence (tuner ON,
     # knob pins cleared) driving the controller from a replayed trace
     ("tuner_replay", (tuner_replay_stage, 120.0, False, False,
@@ -2062,6 +2418,9 @@ def run_staged(names=None):
     replay_res = results.get("replay_proxy")
     if replay_res is not None and replay_res.ok:
         record["replay"] = replay_res.record
+    fleet_res = results.get("fleet_proxy")
+    if fleet_res is not None and fleet_res.ok:
+        record["fleet"] = fleet_res.record
     record["stages"] = OrderedDict(
         (n, r.to_json()) for n, r in results.items())
     record["bench_partial"] = partial_path

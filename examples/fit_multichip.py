@@ -46,7 +46,9 @@ def main():
 
     model = synthetic_body_model(seed=0)
     batch = mesh.shape["dp"] * 2
-    n_scan = mesh.shape["sp"] * 512
+    # sp*256 keeps the scan axis shardable while staying inside the
+    # 600s example-test budget on a 1-core CPU box (sp*512 blew it)
+    n_scan = mesh.shape["sp"] * 256
 
     # ground truth scans: posed bodies with random shapes + noise
     rng = np.random.RandomState(3)

@@ -17,6 +17,8 @@ Subcommands:
     init; gate 0 of tools/run_tpu_gates.sh)
   tune — inspect the adaptive tuner: `tune status` knob table and
     `tune history` audited knob_change trail (no jax init)
+  fleet — fleet status from per-replica serve-stats sinks: ring
+    membership, health, queue depth, cache hit rates (no jax init)
 
 Examples:
   meshviewer view body.ply
@@ -36,6 +38,8 @@ Examples:
   mesh-tpu lint --rules VMEM,TRC mesh_tpu/query
   mesh-tpu tune status
   mesh-tpu tune history incident-...-slo_fast_burn-001.json
+  mesh-tpu fleet status
+  mesh-tpu fleet status /shared/fleet/replica-*.json --json
 """
 
 import argparse
@@ -460,6 +464,10 @@ def cmd_perfcheck(args):
         args.replay_golden or os.path.join(repo_root, "benchmarks",
                                            "replay_golden.json"),
         "replay golden")
+    fleet_golden = _load_optional(
+        args.fleet_golden or os.path.join(repo_root, "benchmarks",
+                                          "fleet_golden.json"),
+        "fleet golden")
     rc, lines = perfcheck(doc, baseline=baseline, proxy_golden=golden,
                           proxy_tol=args.proxy_tol,
                           headline_tol=args.headline_tol,
@@ -475,7 +483,9 @@ def cmd_perfcheck(args):
                           mxu_golden=mxu_golden,
                           mxu_tol=args.mxu_tol,
                           replay_golden=replay_golden,
-                          replay_tol=args.replay_tol)
+                          replay_tol=args.replay_tol,
+                          fleet_golden=fleet_golden,
+                          fleet_tol=args.fleet_tol)
     if args.json:
         json.dump({"rc": rc, "lines": lines}, sys.stdout, indent=2)
         sys.stdout.write("\n")
@@ -571,6 +581,121 @@ def cmd_store(args):
         print("store: %s" % exc, file=sys.stderr)
         sys.exit(2)
     sys.exit(rc)
+
+
+def cmd_fleet(args):
+    """Fleet-level view over per-replica serve-stats sinks (no jax init).
+
+    ``fleet status`` reads one sink file per replica — either named
+    positionally or every ``*.json`` under ``--dir`` (default:
+    MESH_TPU_FLEET_STATS_DIR) — and prints ring membership, per-replica
+    health, queue depths, request outcomes, and plan/page cache hit
+    rates.  The sink files ARE the fleet wire format: each replica's
+    ``QueryService.write_stats()`` output, so this works across
+    processes and hosts with nothing but a shared directory.
+
+    Same import discipline as serve-stats/incidents: json/os plus the
+    stdlib-only fleet helpers — no jax, no backend initialization.
+    Exit codes: 0 at least one readable sink, 2 none readable.
+    """
+    import json
+
+    from mesh_tpu.fleet.coordinator import read_sink
+    from mesh_tpu.fleet.ring import HashRing
+
+    def _hit_rate(metrics, hits_name, misses_name):
+        def total(name):
+            metric = metrics.get(name) or {}
+            return sum(s.get("value", 0) for s in metric.get("series", []))
+        hits, misses = total(hits_name), total(misses_name)
+        return (hits / (hits + misses)) if (hits + misses) else None
+
+    def _outcomes(metrics):
+        out = {}
+        metric = metrics.get("mesh_tpu_serve_requests_total") or {}
+        for series in metric.get("series", []):
+            outcome = (series.get("labels") or {}).get("outcome", "?")
+            out[outcome] = out.get(outcome, 0) + series.get("value", 0)
+        return out
+
+    paths = list(args.sinks or [])
+    directory = None
+    if not paths:
+        from mesh_tpu.utils import knobs
+
+        directory = os.path.expanduser(
+            args.dir or knobs.get_str("MESH_TPU_FLEET_STATS_DIR"))
+        try:
+            paths = sorted(
+                os.path.join(directory, name)
+                for name in os.listdir(directory) if name.endswith(".json"))
+        except OSError:
+            paths = []
+    rows = []
+    for path in paths:
+        name = os.path.splitext(os.path.basename(path))[0]
+        sink = read_sink(path)
+        if sink is None:
+            rows.append({"replica": name, "path": path, "readable": False})
+            continue
+        health = sink.get("health") or {}
+        metrics = sink.get("metrics") or {}
+        state = health.get("state", "?")
+        rows.append({
+            "replica": name,
+            "path": path,
+            "readable": True,
+            "written_utc": sink.get("written_utc"),
+            "health": state,
+            "in_ring": str(state).lower() != "draining",
+            "inflight": health.get("inflight"),
+            "queues": sink.get("queues") or {},
+            "outcomes": _outcomes(metrics),
+            "plan_cache_hit_rate": _hit_rate(
+                metrics, "mesh_tpu_engine_plan_hits_total",
+                "mesh_tpu_engine_plan_misses_total"),
+            "page_cache_hit_rate": _hit_rate(
+                metrics, "mesh_tpu_store_page_cache_hits_total",
+                "mesh_tpu_store_page_cache_misses_total"),
+        })
+    readable = [r for r in rows if r["readable"]]
+    ring = HashRing(sorted(r["replica"] for r in readable if r["in_ring"]))
+    doc = {
+        "dir": directory,
+        "replicas": rows,
+        "ring": {"members": ring.members(), "vnodes": ring.vnodes},
+    }
+    if args.json:
+        json.dump(doc, sys.stdout, indent=2, sort_keys=True)
+        sys.stdout.write("\n")
+    else:
+        where = directory or "%d sink file(s)" % len(paths)
+        if not rows:
+            print("fleet status: no replica sinks in %s (each replica's "
+                  "QueryService.stop()/write_stats() writes one)" % where)
+        else:
+            print("fleet status (%s): %d replica(s), %d in ring"
+                  % (where, len(rows), len(ring)))
+            for row in rows:
+                if not row["readable"]:
+                    print("  %-12s UNREADABLE (%s)"
+                          % (row["replica"], row["path"]))
+                    continue
+                outcomes = row["outcomes"]
+                tag = " ".join("%s=%d" % kv for kv in sorted(outcomes.items()))
+                caches = []
+                for key, label in (("plan_cache_hit_rate", "plan"),
+                                   ("page_cache_hit_rate", "page")):
+                    if row[key] is not None:
+                        caches.append("%s=%.1f%%" % (label, 100 * row[key]))
+                print("  %-12s %-9s %s queue=%s %s%s  (%s)"
+                      % (row["replica"], row["health"],
+                         "in-ring " if row["in_ring"] else "EJECTED ",
+                         sum((row["queues"] or {}).values()),
+                         tag or "no-traffic",
+                         (" " + " ".join(caches)) if caches else "",
+                         row["written_utc"]))
+    sys.exit(0 if readable else 2)
 
 
 def cmd_prof(args):
@@ -1130,6 +1255,16 @@ def main():
                              "the trace is synthesized deterministically; "
                              "the admission-sequence checksum must match "
                              "exactly regardless)")
+    p_perf.add_argument("--fleet-golden", default=None,
+                        help="fleet fabric golden record (default: repo "
+                             "benchmarks/fleet_golden.json)")
+    p_perf.add_argument("--fleet-tol", type=float, default=0.05,
+                        help="allowed fractional drop of the fleet "
+                             "routing-affinity and warm-hit-rate vs the "
+                             "golden (default 0.05; the 0.95 affinity "
+                             "hard floor, the exact spill count, and "
+                             "the exact replica-admission checksum hold "
+                             "regardless)")
     p_perf.add_argument("--json", action="store_true",
                         help="machine-readable {rc, lines} instead of the "
                              "summary")
@@ -1179,6 +1314,27 @@ def main():
     p_sgc.add_argument("--json", action="store_true",
                        help="machine-readable {root, deleted, dry_run}")
     p_sgc.set_defaults(func=cmd_store)
+
+    p_fleet = sub.add_parser(
+        "fleet",
+        help="fleet status from per-replica serve-stats sinks "
+             "(no jax init)")
+    fleet_sub = p_fleet.add_subparsers(dest="fleet_command", required=True)
+    p_fstat = fleet_sub.add_parser(
+        "status",
+        help="ring membership, per-replica health/queues/outcomes and "
+             "plan/page cache hit rates (exit 2 when no sink is "
+             "readable)")
+    p_fstat.add_argument("sinks", nargs="*",
+                         help="replica sink files (default: every *.json "
+                              "under --dir)")
+    p_fstat.add_argument("--dir", default=None,
+                         help="sink directory (default: "
+                              "MESH_TPU_FLEET_STATS_DIR or "
+                              "~/.mesh_tpu/fleet)")
+    p_fstat.add_argument("--json", action="store_true",
+                         help="machine-readable {dir, replicas, ring}")
+    p_fstat.set_defaults(func=cmd_fleet)
 
     p_prof = sub.add_parser(
         "prof",

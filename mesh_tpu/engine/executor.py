@@ -288,6 +288,10 @@ class EngineExecutor(object):
                 if req.record is not None:
                     # the batching window just closed for this group
                     req.record.stamp("coalesce", drained)
+            if self._shard_eligible(group, op):
+                self._dispatch_sharded(group[0])
+                STATS.record_coalesced(len(group))
+                return
             planner = get_planner()
             with obs_span("engine.stack", meshes=len(group)):
                 v, f = stack_mesh_batch([req.mesh for req in group])
@@ -328,6 +332,57 @@ class EngineExecutor(object):
                 req.future.set_result((normals_all[i], faces, pts_out))
             else:
                 req.future.set_result((faces, pts_out))
+
+
+    @staticmethod
+    def _shard_eligible(group, op):
+        """Sharded big-batch lane (doc/fleet.md): a single oversized
+        closest-point request rides parallel/sharding.py's dp-sharded
+        plan instead of the single-device bucket ladder.  Off unless
+        the ``shard_min_q`` tunable is set (env pin
+        MESH_TPU_FLEET_SHARD_MIN_Q wins) AND the MESH_TPU_FLEET_SHARD
+        kill switch is on — the default is today's static path,
+        bit-identically."""
+        if op != "closest_point" or len(group) != 1:
+            return False
+        min_q = tuning.get("shard_min_q")
+        if min_q is None or group[0].points.shape[0] < min_q:
+            return False
+        from ..utils import knobs
+
+        return knobs.flag("MESH_TPU_FLEET_SHARD")
+
+    def _dispatch_sharded(self, req):
+        """One request through the query-sharded plan.  Per-query
+        independence makes the result bit-identical to the single-device
+        path (pinned by test); the ledger record skips the pad/compile
+        stamps (no bucket padding here — absent stages are legal) and
+        carries ``backend="xla_sharded"`` so the stage histogram splits
+        the lanes."""
+        from ..parallel.sharding import (
+            make_device_mesh, sharded_closest_faces_and_points,
+        )
+
+        q = req.points.shape[0]
+        with obs_span("engine.shard_dispatch", op=req.op, q=q):
+            if req.record is not None:
+                req.record.set(op=req.op, bucket=q,
+                               backend="xla_sharded")
+            res = sharded_closest_faces_and_points(
+                req.mesh.v, req.mesh.f, req.points,
+                mesh=make_device_mesh(), chunk=req.chunk)
+            if req.record is not None:
+                req.record.stamp("device")
+        from ..obs.metrics import REGISTRY
+
+        REGISTRY.counter(
+            "mesh_tpu_fleet_shard_dispatches_total",
+            "Coalesced closest-point batches routed through the "
+            "dp-sharded big-batch lane (parallel/sharding.py).",
+        ).inc()
+        faces = np.asarray(res["face"]).astype(np.uint32)[None, :]
+        req.future.set_result(
+            (faces, np.asarray(res["point"], np.float64)))
 
 
 class OrderedGroups(object):

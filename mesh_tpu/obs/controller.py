@@ -59,9 +59,13 @@ class TunerController(object):
                  recorder=None, clock=monotonic, ab_tol=None,
                  holdout_s=30.0, pressure_high=0.5, pressure_low=0.1,
                  latency_metric=LATENCY_METRIC, retune_fns=None,
-                 retune_every=8):
+                 retune_every=8, coordinator=None):
         self._series = series if series is not None else get_series()
         self._monitor = monitor
+        # optional fleet arbitration (fleet/coordinator.py): widens ask
+        # grant_widen() first so N replicas don't all widen into the
+        # same fleet-wide fast burn
+        self._coordinator = coordinator
         self._registry = registry if registry is not None else REGISTRY
         self._recorder = recorder
         self._clock = clock
@@ -188,6 +192,9 @@ class TunerController(object):
             self.latency_metric, 0.99, window_s=self.holdout_s, now=now)
         if before_p99 is None:
             return     # no traffic: nothing to optimize, don't churn
+        if self._coordinator is not None and \
+                not self._coordinator.grant_widen(now=now):
+            return     # fleet arbitration: another replica holds the slot
         event = tuning.actuate(
             "coalesce_window_ms", cur + tun.step,
             reason="throughput_mode: widen coalescing "

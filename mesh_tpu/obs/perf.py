@@ -389,7 +389,7 @@ def extract_records(doc):
     """Normalize either bench JSON shape into ``{"headline": rec|None,
     "proxy": rec|None, "accel": rec|None, "stream": rec|None,
     "mxu": rec|None, "store": rec|None, "tuner": rec|None,
-    "replay": rec|None, "stages": {...}|None}``.
+    "replay": rec|None, "fleet": rec|None, "stages": {...}|None}``.
 
     The headline slot is only filled by a FRESH measurement — a
     ``stale: true`` envelope (last-good value republished while the
@@ -404,6 +404,7 @@ def extract_records(doc):
     store = None
     tuner = None
     replay = None
+    fleet = None
     stages = None
     if doc.get("kind") == "bench_partial":
         stages = doc.get("stages") or {}
@@ -431,6 +432,9 @@ def extract_records(doc):
         rp = stages.get("replay_proxy") or {}
         if rp.get("status") == "ok":
             replay = rp.get("record")
+        fl = stages.get("fleet_proxy") or {}
+        if fl.get("status") == "ok":
+            fleet = fl.get("record")
     else:
         if doc.get("value") is not None and not doc.get("stale"):
             headline = doc
@@ -455,10 +459,14 @@ def extract_records(doc):
         rp = doc.get("replay")
         if isinstance(rp, dict) and rp.get("value") is not None:
             replay = rp
+        fl = doc.get("fleet")
+        if isinstance(fl, dict) and fl.get("value") is not None:
+            fleet = fl
         stages = doc.get("stages")
     return {"headline": headline, "proxy": proxy, "accel": accel,
             "stream": stream, "mxu": mxu, "store": store,
-            "tuner": tuner, "replay": replay, "stages": stages}
+            "tuner": tuner, "replay": replay, "fleet": fleet,
+            "stages": stages}
 
 
 def perfcheck(doc, baseline=None, proxy_golden=None, proxy_tol=0.5,
@@ -466,7 +474,8 @@ def perfcheck(doc, baseline=None, proxy_golden=None, proxy_tol=0.5,
               accel_tol=0.05, stream_golden=None, stream_tol=0.05,
               store_golden=None, store_tol=0.6, tuner_golden=None,
               tuner_tol=0.25, mxu_golden=None, mxu_tol=0.2,
-              replay_golden=None, replay_tol=0.0):
+              replay_golden=None, replay_tol=0.0,
+              fleet_golden=None, fleet_tol=0.05):
     """Compare a bench JSON against the last-good baseline and the
     committed proxy golden.  Returns ``(rc, lines)`` — rc 0 when nothing
     regressed beyond its tolerance band, 1 on regression (including a
@@ -525,6 +534,20 @@ def perfcheck(doc, baseline=None, proxy_golden=None, proxy_tol=0.5,
     drift is a hard FAIL — a changed checksum means record/replay no
     longer reproduces the same admission sequence, which is the entire
     contract (doc/observability.md "Record/replay").
+
+    ``fleet_golden`` grades the fleet_proxy stage (doc/fleet.md): its
+    value is the routing AFFINITY fraction (requests landing on their
+    digest's ring primary) with a floor of
+    ``max(golden * (1 - fleet_tol), 0.95)`` — under stable membership
+    the ring is deterministic, so anything off 1.0 is a routing bug,
+    and 0.95 is the hard floor no golden can excuse.  The warm-hit
+    rate gets the same one-sided band; the spill count is exact-matched
+    (the stampede scenario is deterministic); the combined per-replica
+    admission checksum is a hard FAIL on drift (placement stopped
+    reproducing); and the AOT tier must show ``warm_hits >= 1`` plus a
+    compile-stage speedup >= ``max(golden * 0.4, 1.0)`` (wide band —
+    disk + interpreter timing — but a warm start that does not beat a
+    cold compile is a broken executable tier regardless).
     """
     lines = []
     rc = 0
@@ -752,6 +775,98 @@ def perfcheck(doc, baseline=None, proxy_golden=None, proxy_tol=0.5,
     elif cand_replay is not None:
         lines.append("note: replay record present but no golden to "
                      "compare against (record one: make replay-golden)")
+
+    fleet_gold = None
+    if fleet_golden:
+        fleet_gold = (extract_records(fleet_golden)["fleet"]
+                      or (fleet_golden
+                          if fleet_golden.get("value") is not None
+                          else None))
+    cand_fleet = recs["fleet"]
+    if fleet_gold is not None:
+        if cand_fleet is None:
+            rc = 1
+            lines.append(
+                "FAIL fleet: candidate carries no fleet_proxy record "
+                "(a golden exists — the chip-free fleet-fabric contract "
+                "must always be fresh)")
+        else:
+            floor = max(fleet_gold["value"] * (1.0 - fleet_tol), 0.95)
+            verdict = "ok" if cand_fleet["value"] >= floor else "FAIL"
+            if verdict == "FAIL":
+                rc = 1
+            lines.append(
+                "%s fleet routing affinity: %.4f vs golden %.4f "
+                "(floor %.4f, tol %.0f%%, hard floor 0.95)"
+                % (verdict, cand_fleet["value"], fleet_gold["value"],
+                   floor, 100 * fleet_tol))
+            cand_warm = cand_fleet.get("warm_hit_rate")
+            gold_warm = fleet_gold.get("warm_hit_rate")
+            if cand_warm is not None and gold_warm is not None:
+                floor = gold_warm * (1.0 - fleet_tol)
+                verdict = "ok" if cand_warm >= floor else "FAIL"
+                if verdict == "FAIL":
+                    rc = 1
+                lines.append(
+                    "%s fleet warm-hit rate: %.4f vs golden %.4f "
+                    "(floor %.4f, tol %.0f%%)"
+                    % (verdict, cand_warm, gold_warm, floor,
+                       100 * fleet_tol))
+            cand_spills = cand_fleet.get("spills")
+            gold_spills = fleet_gold.get("spills")
+            if cand_spills is not None and gold_spills is not None:
+                # the stampede scenario is deterministic: spill count
+                # drift means admission behavior changed, exact match
+                same = cand_spills == gold_spills
+                if not same:
+                    rc = 1
+                lines.append(
+                    "%s fleet spills under stampede: %d vs golden %d "
+                    "(exact)" % ("ok" if same else "FAIL", cand_spills,
+                                 gold_spills))
+            cand_sum = cand_fleet.get("checksum")
+            gold_sum = fleet_gold.get("checksum")
+            if cand_sum is None:
+                rc = 1
+                lines.append(
+                    "FAIL fleet: candidate record carries no combined "
+                    "replica-admission checksum — placement determinism "
+                    "unproven")
+            elif gold_sum is not None:
+                # CRC-exact, same rationale as the replay checksum
+                same = abs(cand_sum - gold_sum) <= 1e-6
+                if not same:
+                    rc = 1
+                lines.append(
+                    "%s fleet replica-admission checksum: %.6f vs "
+                    "golden %.6f (exact — drift means the router "
+                    "stopped reproducing placement)"
+                    % ("ok" if same else "FAIL", cand_sum, gold_sum))
+            aot = cand_fleet.get("aot") or {}
+            gold_aot = fleet_gold.get("aot") or {}
+            warm_hits = aot.get("warm_hits")
+            if warm_hits is not None:
+                verdict = "ok" if warm_hits >= 1 else "FAIL"
+                if verdict == "FAIL":
+                    rc = 1
+                lines.append(
+                    "%s fleet aot warm start: %d executable cache "
+                    "hit(s) (need >= 1 — the second process must load, "
+                    "not recompile)" % (verdict, warm_hits))
+            cand_speed = aot.get("speedup")
+            gold_speed = gold_aot.get("speedup")
+            if cand_speed is not None and gold_speed is not None:
+                floor = max(gold_speed * 0.4, 1.0)
+                verdict = "ok" if cand_speed >= floor else "FAIL"
+                if verdict == "FAIL":
+                    rc = 1
+                lines.append(
+                    "%s fleet aot compile-stage speedup (cold/warm): "
+                    "%.2fx vs golden %.2fx (floor %.2fx, hard floor "
+                    "1.0x)" % (verdict, cand_speed, gold_speed, floor))
+    elif cand_fleet is not None:
+        lines.append("note: fleet record present but no golden to "
+                     "compare against (record one: make fleet-golden)")
 
     golden_rec = None
     if proxy_golden:

@@ -278,4 +278,10 @@ def run_trace_replay(service, mesh, points, trace, speed=1.0,
     report["speed"] = float(speed)
     report["admissions"] = len(events)
     report["checksum"] = sequence_checksum(events)
+    # fleet target (duck-typed): a FleetRouter also reports which
+    # replica each admission landed on, as per-replica checksums — same
+    # trace + same membership must reproduce them (the fleet golden
+    # pins this)
+    if hasattr(service, "admission_checksums"):
+        report["replica_checksums"] = service.admission_checksums()
     return report

@@ -548,10 +548,14 @@ class QueryService(object):
         health state."""
         from ..obs.metrics import REGISTRY
 
+        # fleet status reads cache effectiveness off this sink too, so
+        # the page-cache and engine plan series ride along with serve.*
         series = {
             name: REGISTRY.get(name).snapshot()
             for name in REGISTRY.names()
             if name.startswith("mesh_tpu_serve")
+            or name.startswith("mesh_tpu_store_page_cache")
+            or name.startswith("mesh_tpu_engine_plan")
             or name == "mesh_tpu_request_stage_seconds"
         }
         return {

@@ -1,0 +1,253 @@
+"""Persistent AOT executable tier: the XLA compilation cache homed
+inside the content-addressed store (doc/fleet.md, doc/store.md).
+
+A replica's cold start already skips BVH builds by loading accel
+side-cars off the store; the compile analog is this tier.  Layout
+under the store root::
+
+    <root>/aot/xla/...        JAX's persistent compilation cache
+                              (content-keyed executables, jax-owned)
+    <root>/aot/index.json     schema + jax version + per-file CRC/bytes
+
+``enable_aot_tier()`` points ``utils/compilation_cache`` at
+``<root>/aot/xla`` so every sufficiently-slow compile lands next to
+the side-cars it serves, and a second process's cold start loads the
+executable from disk instead of recompiling — the ``compile``
+ledger-stage delta and ``mesh_tpu_xla_cache_hits_total`` are the
+evidence, graded by the ``fleet_proxy`` perfcheck band.
+
+The cached executables are jax-owned opaque bytes, so the store audits
+them the way it audits everything else: ``index_aot()`` snapshots the
+tier into a CRC'd index (written stage-then-``os.replace`` atomic, the
+side-car discipline), ``verify_aot()`` re-checks it for ``mesh-tpu
+store verify``, and **enable-time validation quarantines instead of
+crashing** — a schema/jax-version mismatch clears the whole tier, a
+CRC-drifted file is deleted individually; either way the next compile
+is fresh and the observation lands in the one-incident corruption
+funnel (``mesh_tpu_store_corrupt_total{what=aot_meta|aot_version|
+aot_crc}``, store.report_corrupt).  Files newer than the index (this
+process's own compiles) are not findings; they are indexed at the next
+``enable_aot_tier()``/``index_aot()``.
+
+Opt out with ``MESH_TPU_FLEET_AOT=0`` (the compilation cache then
+stays wherever ``MESH_TPU_XLA_CACHE`` points).  Stdlib-only; jax is
+only touched by the underlying compilation-cache shim.
+"""
+
+import json
+import logging
+import os
+import shutil
+
+from ..utils import knobs
+from .blocks import file_crc32
+
+__all__ = [
+    "AOT_SCHEMA_VERSION", "aot_dir", "aot_xla_dir", "aot_index_path",
+    "enable_aot_tier", "index_aot", "verify_aot",
+]
+
+_log = logging.getLogger(__name__)
+
+#: aot/index.json schema (bump on breaking shape changes)
+AOT_SCHEMA_VERSION = 1
+
+
+def aot_dir(store):
+    return os.path.join(store.root, "aot")
+
+
+def aot_xla_dir(store):
+    return os.path.join(aot_dir(store), "xla")
+
+
+def aot_index_path(store):
+    return os.path.join(aot_dir(store), "index.json")
+
+
+def _jax_version():
+    try:
+        import jax
+
+        return jax.__version__
+    except Exception:
+        return None
+
+
+def _scan(store):
+    """relpath -> absolute path for every cached executable file.
+
+    ``*-atime`` entries are jax's LRU access-time markers, rewritten on
+    every cache *read* — content-stable CRCs don't exist for them, so
+    they stay out of the index (and therefore out of verify/quarantine).
+    """
+    base = aot_xla_dir(store)
+    out = {}
+    for dirpath, _dirs, files in os.walk(base):
+        for name in files:
+            if name.endswith("-atime"):
+                continue
+            path = os.path.join(dirpath, name)
+            out[os.path.relpath(path, base)] = path
+    return out
+
+
+def _read_index(store):
+    """(index dict, problem) — problem is a string when the index file
+    exists but cannot be trusted; (None, None) when absent."""
+    path = aot_index_path(store)
+    if not os.path.isfile(path):
+        return None, None
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            index = json.load(fh)
+    except (OSError, ValueError) as exc:
+        return None, "aot index unreadable: %s" % exc
+    if index.get("schema_version") != AOT_SCHEMA_VERSION:
+        return index, ("aot index schema %r != %d"
+                       % (index.get("schema_version"), AOT_SCHEMA_VERSION))
+    return index, None
+
+
+def index_aot(store):
+    """Snapshot the tier into ``aot/index.json`` (atomic replace) and
+    return the index dict.  Call after compiles have landed (enable
+    does it for the previous process's output)."""
+    files = {}
+    for rel, path in sorted(_scan(store).items()):
+        try:
+            files[rel] = {"crc32": file_crc32(path),
+                          "bytes": int(os.path.getsize(path))}
+        except OSError:
+            continue            # racing eviction: skip, not fatal
+    index = {
+        "schema_version": AOT_SCHEMA_VERSION,
+        "jax_version": _jax_version(),
+        "files": files,
+    }
+    os.makedirs(aot_dir(store), exist_ok=True)
+    tmp = aot_index_path(store) + ".tmp.%d" % os.getpid()
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(index, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, aot_index_path(store))
+    return index
+
+
+def verify_aot(store):
+    """Problem strings (empty = clean) for the AOT tier: readable
+    index, every indexed file present with its recorded CRC.  Read-only
+    (``mesh-tpu store verify`` surfaces these; quarantine happens at
+    enable time).  Each finding is counted + flight-recorded through
+    the store corruption funnel."""
+    from .store import report_corrupt
+
+    index, meta_problem = _read_index(store)
+    if meta_problem:
+        report_corrupt("aot_meta", "aot", meta_problem)
+        return ["aot: %s" % meta_problem]
+    if index is None:
+        # no index at all: a fresh tier that was never enabled/indexed,
+        # not corruption (enable_aot_tier writes the first index)
+        return []
+    problems = []
+    current = _jax_version()
+    recorded = index.get("jax_version")
+    if recorded and current and recorded != current:
+        detail = ("aot tier compiled under jax %s, running %s"
+                  % (recorded, current))
+        report_corrupt("aot_version", "aot", detail)
+        problems.append("aot: %s" % detail)
+    base = aot_xla_dir(store)
+    for rel, entry in sorted(index.get("files", {}).items()):
+        path = os.path.join(base, rel)
+        if not os.path.isfile(path):
+            detail = "aot file %s missing" % rel
+            report_corrupt("aot_crc", "aot", detail)
+            problems.append("aot: %s" % detail)
+            continue
+        actual = file_crc32(path)
+        if actual != entry.get("crc32"):
+            detail = ("aot file %s CRC mismatch (%s vs %s)"
+                      % (rel, actual, entry.get("crc32")))
+            report_corrupt("aot_crc", "aot", detail)
+            problems.append("aot: %s" % detail)
+    return problems
+
+
+def _quarantine(store, index, meta_problem):
+    """Enable-time validation: never let a bad cached executable reach
+    XLA.  Meta/schema/version problems clear the whole tier; CRC drift
+    deletes the drifted file.  Either way the next compile is fresh —
+    the corruption funnel records it, nothing crashes."""
+    from .store import report_corrupt
+
+    base = aot_xla_dir(store)
+    if meta_problem:
+        report_corrupt("aot_meta", "aot", meta_problem)
+        shutil.rmtree(base, ignore_errors=True)
+        try:
+            os.remove(aot_index_path(store))
+        except OSError:
+            pass
+        return
+    if index is None:
+        return
+    current = _jax_version()
+    recorded = index.get("jax_version")
+    if recorded and current and recorded != current:
+        detail = ("aot tier compiled under jax %s, running %s; "
+                  "clearing for fresh compiles" % (recorded, current))
+        report_corrupt("aot_version", "aot", detail)
+        shutil.rmtree(base, ignore_errors=True)
+        try:
+            os.remove(aot_index_path(store))
+        except OSError:
+            pass
+        return
+    for rel, entry in sorted(index.get("files", {}).items()):
+        path = os.path.join(base, rel)
+        if not os.path.isfile(path):
+            continue            # evicted/missing: jax just recompiles
+        try:
+            drifted = file_crc32(path) != entry.get("crc32")
+        except OSError:
+            drifted = True
+        if drifted:
+            detail = "aot file %s CRC drift; deleting" % rel
+            report_corrupt("aot_crc", "aot", detail)
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+
+def enable_aot_tier(store=None, min_compile_secs=1.0):
+    """Home the persistent XLA compilation cache at ``<store>/aot/xla``.
+
+    Validates (and quarantines) whatever a previous process left,
+    refreshes the index over the survivors, then points
+    ``utils/compilation_cache`` at the tier.  Gated by
+    ``MESH_TPU_FLEET_AOT``; returns the cache dir in use or None
+    (disabled / cache unavailable).  Never raises.
+    """
+    if not knobs.flag("MESH_TPU_FLEET_AOT"):
+        return None
+    try:
+        if store is None:
+            from .store import get_store
+
+            store = get_store()
+        index, meta_problem = _read_index(store)
+        _quarantine(store, index, meta_problem)
+        os.makedirs(aot_xla_dir(store), exist_ok=True)
+        index_aot(store)
+    except Exception as exc:    # the tier must never break real work
+        _log.warning("aot tier unavailable: %s", exc)
+        return None
+    from ..utils.compilation_cache import (
+        enable_persistent_compilation_cache,
+    )
+
+    return enable_persistent_compilation_cache(
+        path=aot_xla_dir(store), min_compile_secs=min_compile_secs)

@@ -86,7 +86,8 @@ def _metrics():
             "mesh_tpu_store_corrupt_total",
             "Store reads that failed digest/CRC verification (label: what "
             "— block_crc / block_read / manifest / sidecar_digest / "
-            "sidecar_crc / sidecar_meta)."),
+            "sidecar_crc / sidecar_meta / aot_meta / aot_version / "
+            "aot_crc)."),
         "gc": REGISTRY.counter(
             "mesh_tpu_store_gc_deleted_total",
             "Objects deleted by the size-budgeted LRU gc."),
@@ -480,6 +481,12 @@ class MeshStore(object):
         with obs_span("store.verify", objects=len(digests)):
             for d in digests:
                 problems.extend(self._verify_one(d, deep))
+            if digest is None:
+                # whole-store verify also audits the AOT executable
+                # tier (store/aot.py) living next to the objects
+                from . import aot as aot_mod
+
+                problems.extend(aot_mod.verify_aot(self))
         return problems
 
     def _verify_one(self, digest, deep):

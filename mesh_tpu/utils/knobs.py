@@ -316,6 +316,52 @@ STORE_GC_MB = _declare(
     "MeshStore.gc: least-recently-used objects are deleted until the "
     "corpus fits.", "Store")
 
+# -- fleet -----------------------------------------------------------------
+
+FLEET = _declare(
+    "MESH_TPU_FLEET", "flag", True,
+    "Fleet router kill switch (mesh_tpu/fleet/router.py): on (default) "
+    "routes by (op, topology digest, shape bucket) over the hash ring; "
+    "off makes FleetRouter.submit a direct pass-through to its first "
+    "replica — with one replica, bit-identical to calling the service.",
+    "Fleet")
+FLEET_SPILL = _declare(
+    "MESH_TPU_FLEET_SPILL", "flag", True,
+    "Spill-to-sibling admission: a primary replica rejecting with "
+    "`queue_full` spills the request to the ring's second choice (one "
+    "hop); off propagates the rejection exactly like a standalone "
+    "service.", "Fleet")
+FLEET_VNODES = _declare(
+    "MESH_TPU_FLEET_VNODES", "int", 64,
+    "Virtual nodes per replica on the consistent-hash ring (placement "
+    "evenness vs lookup size; changing it remaps keys).", "Fleet")
+FLEET_AOT = _declare(
+    "MESH_TPU_FLEET_AOT", "flag", True,
+    "Persistent AOT executable tier (store/aot.py): on (default) homes "
+    "the XLA compilation cache under `<store>/aot/` with a CRC'd index "
+    "audited by `mesh-tpu store verify`, so replica cold start skips "
+    "compiles; off leaves the compilation cache wherever "
+    "MESH_TPU_XLA_CACHE points.", "Fleet")
+FLEET_SHARD = _declare(
+    "MESH_TPU_FLEET_SHARD", "flag", True,
+    "Sharded big-batch lane kill switch: on (default) lets the engine "
+    "route single-mesh closest-point dispatches at or above the "
+    "`shard_min_q` tunable through parallel/sharding.py's dp-sharded "
+    "plan (bit-identical results); off pins the single-device path. "
+    "The lane is also off while `shard_min_q` is unset (its default).",
+    "Fleet")
+FLEET_SHARD_MIN_Q = _declare(
+    "MESH_TPU_FLEET_SHARD_MIN_Q", "int", None,
+    "Hard pin for the `shard_min_q` tunable: query count at which a "
+    "coalesced closest-point batch takes the sharded big-batch lane; "
+    "setting it disables tuner actuation for the threshold "
+    "(utils/tuning.py).", "Fleet")
+FLEET_STATS_DIR = _declare(
+    "MESH_TPU_FLEET_STATS_DIR", "path", "~/.mesh_tpu/fleet",
+    "Directory `mesh-tpu fleet status` scans for per-replica serve-stats "
+    "sink files (each replica writes its own via MESH_TPU_SERVE_STATS).",
+    "Fleet")
+
 # -- bench harness ---------------------------------------------------------
 
 BENCH_FAULT = _declare(
@@ -377,6 +423,11 @@ REPLAY_PROXY_SEED = _declare(
     "replay_proxy bench stage: override the synthesized adversarial-mix "
     "trace seed (read by bench.py; changing it is expected to change "
     "the committed golden checksum).", "Bench harness")
+FLEET_PROXY_SEED = _declare(
+    "MESH_TPU_FLEET_PROXY_SEED", "int", None,
+    "fleet_proxy bench stage: override the synthesized mixed-digest "
+    "trace seed (read by bench.py; changing it is expected to change "
+    "the committed golden checksums).", "Bench harness")
 
 
 # -- accessors -------------------------------------------------------------

@@ -105,6 +105,13 @@ STREAM_N_BUFFERS = _declare_tunable(
     "MESH_TPU_BVH_STREAM_BUFFERS",
     "Tuned override for the streamed-BVH leaf-ring buffer count; None "
     "falls through to the calibrated chain.")
+SHARD_MIN_Q = _declare_tunable(
+    "shard_min_q", "int", None, 1024, 1048576, 4096,
+    "MESH_TPU_FLEET_SHARD_MIN_Q",
+    "Query count at which the engine routes a single-mesh closest-point "
+    "dispatch through the dp-sharded big-batch lane "
+    "(parallel/sharding.py; also gated by MESH_TPU_FLEET_SHARD); None "
+    "(default) keeps the lane off — the static single-device path.")
 SERVE_PRE_TRIP = _declare_tunable(
     "serve_pre_trip", "int", 0, 0, 1, 1,
     "MESH_TPU_SERVE_LADDER",

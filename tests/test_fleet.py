@@ -1,0 +1,917 @@
+"""Fleet serving fabric acceptance (doc/fleet.md).
+
+The contract under test:
+
+- the consistent-hash ring is deterministic across instances and
+  processes, and removing a member remaps ONLY that member's keys;
+- the routing key family (op | topology digest | shape bucket) matches
+  the engine's plan-cache identity — ROUTER_Q_LADDER is pinned equal
+  to engine.Q_LADDER;
+- the router gives perfect digest affinity under stable membership,
+  spills exactly one hop on queue_full (and only then), ejects
+  DRAINING replicas without touching the survivors' keys, propagates
+  every other rejection unchanged, and logs a deterministic per-replica
+  admission checksum;
+- MESH_TPU_FLEET=0 is a direct pass-through to the first replica (no
+  fleet series, no admission log);
+- routing paths stay ledger-clean: a router rejection closes/opens no
+  ledger rows, a served request closes exactly one (LED001);
+- trace replay through the router is deterministic (same trace + same
+  membership => same replica_checksums);
+- the coordinator's sink aggregation sums counters per label set and
+  merges histograms bucket-wise; step() is fake-clock deterministic,
+  shrink/release actuate through the audited tuning path, and
+  grant_widen arbitrates (cooldown + pressure deny) with every verdict
+  audited;
+- the AOT tier indexes/verifies/quarantines through the store
+  corruption funnel and never crashes;
+- the sharded big-batch lane is bit-identical to the single-device
+  path and counted, and stays off by default;
+- `mesh-tpu fleet status` reads sinks jax-free with rc 0/2;
+- the perfcheck fleet band hard-fails on affinity loss, spill drift,
+  and checksum drift/absence.
+
+Everything except the shard-lane test is jax-free and fake-clocked.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from mesh_tpu.errors import ServeRejected
+from mesh_tpu.fleet import (
+    FleetCoordinator,
+    FleetRouter,
+    HashRing,
+    aggregate_sinks,
+    read_sink,
+    routing_key,
+    shape_bucket,
+    topology_digest,
+)
+from mesh_tpu.fleet.router import ROUTER_Q_LADDER
+from mesh_tpu.obs.ledger import get_ledger
+from mesh_tpu.obs.metrics import REGISTRY, Registry
+from mesh_tpu.obs.slo import SLO
+from mesh_tpu.serve import (
+    HealthMonitor,
+    QueryService,
+    Rung,
+    ServeResult,
+    run_trace_replay,
+)
+from mesh_tpu.utils import tuning
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_PTS = np.zeros((4, 3), np.float32)
+_FACES = np.zeros((1, 4), np.uint32)
+_ANSWER = np.zeros((4, 3), np.float64)
+
+
+# ---------------------------------------------------------------------------
+# helpers
+
+
+class FakeClock(object):
+    def __init__(self, t=100.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += float(dt)
+        return self.t
+
+
+class FakeRecorder(object):
+    def __init__(self):
+        self.events = []
+
+    def record(self, kind, **fields):
+        self.events.append((kind, fields))
+
+    def trigger(self, *args, **kwargs):
+        return None
+
+
+class _Digest(object):
+    """A mesh stand-in that is nothing but its routing identity."""
+
+    def __init__(self, key):
+        self.topology_key = key
+
+
+def _replica(name, served=None, **kw):
+    """A real QueryService on a plain-python ladder that tallies which
+    digest each replica answered (the bench stage's idiom)."""
+
+    def _ok(mesh, points, chunk, timeout):
+        if served is not None:
+            digest = getattr(mesh, "topology_key", str(mesh))
+            counts = served.setdefault(name, {})
+            counts[digest] = counts.get(digest, 0) + 1
+        return ServeResult(_FACES, _ANSWER, "fleet-ok", certified=True)
+
+    kw.setdefault("workers", 2)
+    kw.setdefault("max_queue_per_tenant", 1024)
+    return QueryService(ladder=[Rung("fleet-ok", _ok)],
+                        health=HealthMonitor(watchdog=False),
+                        default_deadline_s=30.0, **kw)
+
+
+def _run_cli(*argv):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    return subprocess.run(
+        [sys.executable, "-m", "mesh_tpu.cli"] + list(argv),
+        capture_output=True, text=True, timeout=180, env=env, cwd=_REPO)
+
+
+@pytest.fixture
+def fleet_env(monkeypatch):
+    """Clean fleet/tuner env + tuned state on both sides of a test."""
+    for var in ("MESH_TPU_FLEET", "MESH_TPU_FLEET_SPILL",
+                "MESH_TPU_FLEET_VNODES", "MESH_TPU_FLEET_AOT",
+                "MESH_TPU_FLEET_SHARD", "MESH_TPU_FLEET_SHARD_MIN_Q",
+                "MESH_TPU_TUNER", "MESH_TPU_SERVE_LADDER",
+                "MESH_TPU_COALESCE_WINDOW_MS"):
+        monkeypatch.delenv(var, raising=False)
+    tuning.reset()
+    yield monkeypatch
+    tuning.reset()
+
+
+# ---------------------------------------------------------------------------
+# hash ring
+
+
+def test_ring_deterministic_across_instances():
+    members = ["r0", "r1", "r2", "r3"]
+    a = HashRing(members)
+    b = HashRing(list(members))
+    keys = ["key-%03d" % i for i in range(100)]
+    assert [a.lookup(k) for k in keys] == [b.lookup(k) for k in keys]
+    for k in keys[:10]:
+        order = a.choices(k)
+        assert order[0] == a.lookup(k)
+        assert sorted(order) == sorted(members)      # full dedup'd walk
+        assert len(set(order)) == len(order)
+
+
+def test_ring_removal_remaps_only_victims_keys():
+    members = ["r0", "r1", "r2", "r3"]
+    ring = HashRing(members)
+    keys = ["digest-%04d" % i for i in range(200)]
+    before = {k: ring.lookup(k) for k in keys}
+    victim = "r2"
+    ring.remove(victim)
+    for k in keys:
+        after = ring.lookup(k)
+        if before[k] == victim:
+            assert after != victim                   # victim's keys move
+        else:
+            assert after == before[k]                # nobody else's do
+    # distribution sanity: every survivor still owns something
+    owners = {ring.lookup(k) for k in keys}
+    assert owners == {"r0", "r1", "r3"}
+
+
+def test_ring_add_idempotent_and_remove_unknown():
+    ring = HashRing(["a", "b"])
+    ring.add("a")
+    assert len(ring) == 2 and ring.members() == ["a", "b"]
+    ring.remove("nope")                              # no-op, no raise
+    assert "a" in ring and "nope" not in ring
+    ring.remove("a")
+    ring.remove("b")
+    assert ring.lookup("anything") is None
+    assert ring.choices("anything") == []
+
+
+# ---------------------------------------------------------------------------
+# routing key family
+
+
+def test_shape_bucket_edges():
+    assert shape_bucket(1) == ROUTER_Q_LADDER[0]
+    assert shape_bucket(ROUTER_Q_LADDER[0]) == ROUTER_Q_LADDER[0]
+    assert shape_bucket(ROUTER_Q_LADDER[0] + 1) == ROUTER_Q_LADDER[1]
+    top = ROUTER_Q_LADDER[-1]
+    assert shape_bucket(top) == top
+    assert shape_bucket(top + 1) == 2 * top
+    for bad in (0, -3):
+        with pytest.raises(ValueError):
+            shape_bucket(bad)
+
+
+def test_router_ladder_pinned_to_engine():
+    """The router restates the engine Q_LADDER to stay jax-free at
+    import; the two tables (and their bucket arithmetic) must agree."""
+    from mesh_tpu import engine
+
+    assert tuple(ROUTER_Q_LADDER) == tuple(engine.Q_LADDER)
+    for q in (1, 31, 32, 33, 500, 16384, 16385, 40000):
+        assert shape_bucket(q) == engine.bucket_size(q, engine.Q_LADDER)
+
+
+def test_topology_digest_chain():
+    assert topology_digest("9ad31c55-v10-f20") == "9ad31c55-v10-f20"
+    assert topology_digest(_Digest("my-key")) == "my-key"
+
+    class _Raw(object):
+        f = np.asarray([[0, 1, 2], [2, 1, 3]], np.int32)
+
+    d = topology_digest(_Raw())
+    assert d.startswith("crc32:") and d == topology_digest(_Raw())
+
+    class _Other(object):
+        f = np.asarray([[0, 1, 3]], np.int32)
+
+    assert topology_digest(_Other()) != d
+
+
+def test_routing_key_shape():
+    key = routing_key("closest_point", _Digest("dg"), np.zeros((100, 3)))
+    assert key == "closest_point|dg|128"
+
+
+# ---------------------------------------------------------------------------
+# router: affinity, determinism, kill switch
+
+
+def test_affinity_and_checksum_determinism(fleet_env):
+    digests = ["aff-digest-%02d" % i for i in range(8)]
+
+    def _run():
+        served = {}
+        router = FleetRouter(recorder=FakeRecorder())
+        for i in range(3):
+            name = "aff-%d" % i
+            router.add_replica(name, _replica(name, served))
+        try:
+            primaries = {
+                d: router.plan("closest_point", _Digest(d), _PTS)[1][0]
+                for d in digests}
+            futures = [router.submit(_Digest(d), _PTS, tenant="t")
+                       for _ in range(4) for d in digests]
+            for fut in futures:
+                fut.result(timeout=60.0)
+            return served, primaries, router.admission_checksums()
+        finally:
+            router.stop(write_stats=False)
+
+    served, primaries, sums = _run()
+    # every digest was answered by exactly its ring primary, every time
+    for d in digests:
+        owners = [n for n, counts in served.items() if d in counts]
+        assert owners == [primaries[d]]
+        assert served[primaries[d]][d] == 4
+    # same membership + same submit sequence => same checksums
+    _, _, sums2 = _run()
+    assert sums == sums2 and set(sums) == {"aff-0", "aff-1", "aff-2"}
+
+
+def test_kill_switch_is_direct_passthrough(fleet_env):
+    served = {}
+    router = FleetRouter(recorder=FakeRecorder())
+    for name in ("ks-first", "ks-second"):
+        router.add_replica(name, _replica(name, served))
+    try:
+        # find a digest whose ring primary is NOT the first replica
+        digest = next(
+            d for d in ("ks-d%02d" % i for i in range(64))
+            if router.plan("closest_point", _Digest(d), _PTS)[1][0]
+            != "ks-first")
+        fleet_env.setenv("MESH_TPU_FLEET", "0")
+        router.submit(_Digest(digest), _PTS).result(timeout=60.0)
+        assert digest in served.get("ks-first", {})      # ring bypassed
+        assert "ks-second" not in served
+        # nothing logged: no key, no ring, no fleet bookkeeping
+        rows = {r["replica"]: r for r in router.status()["replicas"]}
+        assert rows["ks-first"]["admitted"] == 0
+        assert rows["ks-second"]["admitted"] == 0
+    finally:
+        router.stop(write_stats=False)
+
+
+def test_spill_one_hop_on_queue_full(fleet_env):
+    served = {}
+    router = FleetRouter(recorder=FakeRecorder())
+    for name in ("sp-a", "sp-b"):
+        router.add_replica(
+            name, _replica(name, served, workers=1, max_queue_per_tenant=1))
+    try:
+        mesh = _Digest("spill-digest")
+        _key, order = router.plan("closest_point", mesh, _PTS)
+        primary, sibling = order[0], order[1]
+        spills0 = REGISTRY.counter("mesh_tpu_fleet_spill_total").value(
+            replica=primary)
+        services = router.replicas()
+        services[primary].hold()            # fence: queue state is exact
+        try:
+            queued = router.submit(mesh, _PTS, tenant="st")   # fills q=1
+            spilled = router.submit(mesh, _PTS, tenant="st")  # overflows
+        finally:
+            services[primary].release()
+        queued.result(timeout=60.0)
+        spilled.result(timeout=60.0)
+        assert served[sibling]["spill-digest"] == 1       # one hop, landed
+        assert served[primary]["spill-digest"] == 1
+        assert REGISTRY.counter("mesh_tpu_fleet_spill_total").value(
+            replica=primary) - spills0 == 1
+    finally:
+        router.stop(write_stats=False)
+
+
+def test_spill_disabled_propagates_queue_full(fleet_env):
+    fleet_env.setenv("MESH_TPU_FLEET_SPILL", "0")
+    router = FleetRouter(recorder=FakeRecorder())
+    for name in ("nsp-a", "nsp-b"):
+        router.add_replica(
+            name, _replica(name, workers=1, max_queue_per_tenant=1))
+    try:
+        mesh = _Digest("nospill-digest")
+        primary = router.plan("closest_point", mesh, _PTS)[1][0]
+        services = router.replicas()
+        services[primary].hold()
+        try:
+            router.submit(mesh, _PTS, tenant="st")
+            with pytest.raises(ServeRejected) as exc:
+                router.submit(mesh, _PTS, tenant="st")
+            assert exc.value.reason == "queue_full"
+        finally:
+            services[primary].release()
+    finally:
+        router.stop(write_stats=False)
+
+
+def test_non_queue_full_rejection_never_spills(fleet_env):
+    """Any rejection other than queue_full propagates unchanged even
+    with a sibling available — the router adds no admission policy."""
+
+    class _Rejecting(object):
+        health = None
+
+        def submit(self, *a, **kw):
+            raise ServeRejected("shed", retry_after=1.0,
+                                reason="low_priority")
+
+        def stop(self, drain=True, write_stats=True):
+            return None
+
+    served = {}
+    router = FleetRouter(recorder=FakeRecorder())
+    router.add_replica("rej-a", _Rejecting())
+    router.add_replica("rej-b", _replica("rej-b", served))
+    try:
+        # find a digest whose primary is the rejecting replica
+        digest = next(
+            d for d in ("rej-d%02d" % i for i in range(64))
+            if router.plan("closest_point", _Digest(d), _PTS)[1][0]
+            == "rej-a")
+        with pytest.raises(ServeRejected) as exc:
+            router.submit(_Digest(digest), _PTS)
+        assert exc.value.reason == "low_priority"
+        assert served == {}                      # sibling never consulted
+    finally:
+        router.stop(write_stats=False)
+
+
+def test_drain_ejects_without_moving_survivor_keys(fleet_env):
+    router = FleetRouter(recorder=FakeRecorder())
+    replicas = {}
+    for i in range(3):
+        name = "ej-%d" % i
+        replicas[name] = _replica(name)
+        router.add_replica(name, replicas[name])
+    try:
+        digests = ["ej-digest-%02d" % i for i in range(30)]
+        before = {
+            d: router.plan("closest_point", _Digest(d), _PTS)[1][0]
+            for d in digests}
+        victim = before[digests[0]]
+        replicas[victim].health.begin_drain()
+        for d in digests:
+            after = router.plan("closest_point", _Digest(d), _PTS)[1][0]
+            if before[d] == victim:
+                assert after != victim           # ejected from the order
+            else:
+                assert after == before[d]        # survivors untouched
+        status = router.status()
+        rows = {r["replica"]: r for r in status["replicas"]}
+        assert rows[victim]["in_ring"] and not rows[victim]["eligible"]
+    finally:
+        router.stop(write_stats=False)
+
+
+def test_remove_replica_returns_live_service(fleet_env):
+    served = {}
+    router = FleetRouter(recorder=FakeRecorder())
+    router.add_replica("rm-a", _replica("rm-a", served))
+    router.add_replica("rm-b", _replica("rm-b", served))
+    service = router.remove_replica("rm-a")
+    try:
+        assert service is not None
+        # NOT stopped: the owner drains it — it still serves directly
+        service.submit(_Digest("direct"), _PTS).result(timeout=60.0)
+        assert served["rm-a"]["direct"] == 1
+        assert list(router.replicas()) == ["rm-b"]
+        with pytest.raises(ValueError):
+            router.add_replica("rm-b", service)  # dup name refused
+    finally:
+        service.stop(write_stats=False)
+        router.stop(write_stats=False)
+
+
+def test_empty_fleet_rejects(fleet_env):
+    router = FleetRouter(recorder=FakeRecorder())
+    with pytest.raises(ServeRejected) as exc:
+        router.submit(_Digest("dg"), _PTS)
+    assert exc.value.reason == "draining"
+
+
+def test_router_paths_are_ledger_clean(fleet_env):
+    """LED001 in vivo: a router rejection leaves no ledger rows at all;
+    a served request closes exactly one (opened by the replica)."""
+    router = FleetRouter(recorder=FakeRecorder())
+    replica = _replica("led-a")
+    router.add_replica("led-a", replica)
+    try:
+        replica.health.begin_drain()             # every submit rejects
+        with pytest.raises(ServeRejected):
+            router.submit(_Digest("led-dg"), _PTS, tenant="led-reject")
+        rows = get_ledger().records()
+        assert not any(r.get("tenant") == "led-reject" for r in rows)
+
+        # recover is not modeled — use a fresh admitting replica
+        router.remove_replica("led-a")
+        router.add_replica("led-b", _replica("led-b"))
+        n = 4
+        futures = [router.submit(_Digest("led-dg"), _PTS,
+                                 tenant="led-serve") for _ in range(n)]
+        for fut in futures:
+            fut.result(timeout=60.0)
+        rows = get_ledger().records()
+        closed = [r for r in rows if r.get("tenant") == "led-serve"]
+        assert len(closed) == n                  # one close per admission
+    finally:
+        router.stop(write_stats=False)
+
+
+# ---------------------------------------------------------------------------
+# trace replay through the router
+
+
+def test_trace_replay_through_router_is_deterministic(fleet_env):
+    from mesh_tpu.obs import replay as obs_replay
+
+    trace = obs_replay.synth_stampede(seed=11)
+    reports = []
+    for _ in range(2):
+        t = [0.0]
+
+        def sleep(dt):
+            t[0] += max(dt, 0.0)
+
+        router = FleetRouter(recorder=FakeRecorder())
+        for i in range(3):
+            name = "rp-%d" % i
+            router.add_replica(
+                name, _replica(name, max_queue_per_tenant=8192))
+        try:
+            reports.append(run_trace_replay(
+                router, _Digest("replay-digest"), _PTS, trace,
+                deadline_s=30.0, clock=lambda: t[0], sleep=sleep))
+        finally:
+            router.stop(write_stats=False)
+    first, second = reports
+    assert first["checksum"] == second["checksum"]
+    assert first["replica_checksums"] == second["replica_checksums"]
+    assert set(first["replica_checksums"]) == {"rp-0", "rp-1", "rp-2"}
+
+
+# ---------------------------------------------------------------------------
+# coordinator: sink aggregation
+
+
+def test_aggregate_sinks_sums_counters_per_label_set():
+    sink_a = {"metrics": {
+        "mesh_tpu_serve_requests_total": {"type": "counter", "help": "h",
+            "series": [
+                {"labels": {"tenant": "t", "outcome": "ok"}, "value": 10},
+                {"labels": {"tenant": "u", "outcome": "ok"}, "value": 1},
+            ]}}}
+    sink_b = {"metrics": {
+        "mesh_tpu_serve_requests_total": {"type": "counter", "help": "h",
+            "series": [
+                {"labels": {"outcome": "ok", "tenant": "t"}, "value": 5},
+            ]}}}
+    agg = aggregate_sinks([sink_a, None, sink_b, {}])
+    series = agg["mesh_tpu_serve_requests_total"]["series"]
+    by_tenant = {s["labels"]["tenant"]: s["value"] for s in series}
+    assert by_tenant == {"t": 15, "u": 1}
+
+
+def test_aggregate_sinks_merges_histograms_bucketwise():
+    mk = lambda count, total, lo, hi, b1, binf: {            # noqa: E731
+        "type": "histogram", "help": "h", "series": [{
+            "labels": {"tenant": "t"}, "count": count, "sum": total,
+            "min": lo, "max": hi,
+            "buckets": [[0.1, b1], ["+Inf", binf]]}]}
+    agg = aggregate_sinks([
+        {"metrics": {"mesh_tpu_serve_latency_seconds":
+                     mk(4, 1.0, 0.01, 0.9, 3, 4)}},
+        {"metrics": {"mesh_tpu_serve_latency_seconds":
+                     mk(6, 2.0, 0.005, 0.5, 5, 6)}},
+    ])
+    row = agg["mesh_tpu_serve_latency_seconds"]["series"][0]
+    assert row["count"] == 10 and row["sum"] == 3.0
+    assert row["min"] == 0.005 and row["max"] == 0.9
+    assert row["buckets"] == [[0.1, 8], ["+Inf", 10]]
+
+
+def test_read_sink_paths_and_callables(tmp_path):
+    path = tmp_path / "sink.json"
+    path.write_text('{"health": {"state": "HEALTHY"}}')
+    assert read_sink(str(path))["health"]["state"] == "HEALTHY"
+    assert read_sink(str(tmp_path / "absent.json")) is None
+    (tmp_path / "garbage.json").write_text("{nope")
+    assert read_sink(str(tmp_path / "garbage.json")) is None
+    assert read_sink(lambda: {"queues": {}}) == {"queues": {}}
+
+    def _boom():
+        raise RuntimeError("replica gone")
+
+    assert read_sink(_boom) is None
+
+
+# ---------------------------------------------------------------------------
+# coordinator: fake-clock decisions, audit, arbitration
+
+
+def _sink_state(good, total):
+    return {"metrics": {
+        "mesh_tpu_serve_requests_total": {
+            "type": "counter", "help": "",
+            "series": [{"labels": {"tenant": "t"}, "value": total}]},
+        "mesh_tpu_serve_good_total": {
+            "type": "counter", "help": "",
+            "series": [{"labels": {"tenant": "t"}, "value": good}]},
+    }}
+
+
+def _drive_coordinator(recorder):
+    """One deterministic shrink->release episode; returns (decisions,
+    coordinator, registry)."""
+    clock = FakeClock(100.0)
+    state = {"good": 0, "total": 0}
+    registry = Registry()
+    coord = FleetCoordinator(
+        {"replica-a": lambda: _sink_state(state["good"], state["total"]),
+         "replica-b": lambda: _sink_state(0, 0)},
+        objectives=[SLO("availability", "availability", 0.999)],
+        clock=clock, recorder=recorder, registry=registry)
+    decisions = [coord.step()["decision"]]           # no traffic: hold
+    clock.advance(60.0)
+    state.update(good=50, total=100)                 # 50% bad: fast burn
+    decisions.append(coord.step()["decision"])
+    clock.advance(10.0)
+    decisions.append(coord.step()["decision"])       # still burning
+    clock.advance(3640.0)                            # bad ages out of 1h
+    state.update(good=1050, total=1100)              # good-only since
+    decisions.append(coord.step()["decision"])
+    return decisions, coord, registry
+
+
+def test_coordinator_shrink_release_audited(fleet_env):
+    fleet_env.setenv("MESH_TPU_TUNER", "1")
+    recorder = FakeRecorder()
+    decisions, coord, registry = _drive_coordinator(recorder)
+    assert decisions == ["hold", "shrink", "shrink", "release"]
+    assert tuning.get("serve_pre_trip") == 0         # released again
+    # the actuations went through the audited knob path
+    reasons = [e["reason"] for e in tuning.history_tail()
+               if e.get("knob") == "serve_pre_trip"]
+    assert any("fleet" in r for r in reasons)
+    # every decision flight-recorded + counted on the private registry
+    kinds = [k for k, _ in recorder.events]
+    assert kinds.count("fleet_decision") == 4
+    dec_counter = registry.counter(
+        "mesh_tpu_fleet_coordinator_decisions_total")
+    assert dec_counter.value(decision="shrink") == 2
+    assert dec_counter.value(decision="release") == 1
+    assert registry.gauge("mesh_tpu_fleet_sinks_readable").value() == 2
+    # grant_widen is denied while the last observed pressure was high:
+    # rewind to the shrink state via a fresh episode stopping mid-burn
+    status = coord.status()
+    assert status["pre_tripped"] is False
+
+
+def test_coordinator_decisions_are_deterministic(fleet_env):
+    fleet_env.setenv("MESH_TPU_TUNER", "1")
+    first, _, _ = _drive_coordinator(FakeRecorder())
+    tuning.reset()
+    second, _, _ = _drive_coordinator(FakeRecorder())
+    assert first == second
+
+
+def test_coordinator_disabled_without_tuner(fleet_env):
+    fleet_env.setenv("MESH_TPU_TUNER", "0")
+    coord = FleetCoordinator({}, clock=FakeClock(),
+                             recorder=FakeRecorder(), registry=Registry())
+    assert coord.step() == {"decision": "disabled", "actions": []}
+
+
+def test_grant_widen_cooldown_and_pressure(fleet_env):
+    fleet_env.setenv("MESH_TPU_TUNER", "1")
+    clock = FakeClock(0.0)
+    recorder = FakeRecorder()
+    registry = Registry()
+    coord = FleetCoordinator({}, clock=clock, recorder=recorder,
+                             registry=registry, widen_cooldown_s=30.0)
+    assert coord.grant_widen(replica="r0") is True
+    clock.advance(10.0)
+    assert coord.grant_widen(replica="r1") is False  # cooldown
+    clock.advance(25.0)
+    assert coord.grant_widen(replica="r1") is True   # cooldown elapsed
+    grants = registry.counter("mesh_tpu_fleet_widen_grants_total")
+    assert grants.value(outcome="granted") == 2
+    assert grants.value(outcome="denied") == 1
+    reasons = [f["reason"] for k, f in recorder.events
+               if k == "fleet_widen"]
+    assert reasons == ["granted", "cooldown", "granted"]
+
+
+def test_grant_widen_denied_under_fleet_pressure(fleet_env):
+    fleet_env.setenv("MESH_TPU_TUNER", "1")
+    clock = FakeClock(100.0)
+    state = {"good": 0, "total": 0}
+    recorder = FakeRecorder()
+    coord = FleetCoordinator(
+        {"replica-a": lambda: _sink_state(state["good"], state["total"])},
+        objectives=[SLO("availability", "availability", 0.999)],
+        clock=clock, recorder=recorder, registry=Registry())
+    coord.step()
+    clock.advance(60.0)
+    state.update(good=50, total=100)
+    assert coord.step()["decision"] == "shrink"      # pressure is high now
+    clock.advance(100.0)
+    assert coord.grant_widen(replica="r0") is False
+    reasons = [f["reason"] for k, f in recorder.events
+               if k == "fleet_widen"]
+    assert reasons == ["fleet_pressure"]
+
+
+# ---------------------------------------------------------------------------
+# AOT executable tier (no compiles: pure file/CRC contract)
+
+
+@pytest.fixture
+def aot_store(tmp_path):
+    from mesh_tpu.store.store import MeshStore
+
+    store = MeshStore(root=str(tmp_path / "store"))
+    from mesh_tpu.store import aot
+
+    os.makedirs(aot.aot_xla_dir(store), exist_ok=True)
+    yield store, aot
+    # enable_aot_tier repoints the process-wide jax compilation cache;
+    # put it back on the conftest-shared dir for the rest of the run
+    from mesh_tpu.utils.compilation_cache import (
+        enable_persistent_compilation_cache,
+    )
+
+    enable_persistent_compilation_cache()
+
+
+def _seed_tier(store, aot, names=("a.bin", "sub/b.bin")):
+    base = aot.aot_xla_dir(store)
+    for i, rel in enumerate(names):
+        path = os.path.join(base, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "wb") as fh:
+            fh.write(b"executable-%d" % i * 64)
+    return aot.index_aot(store)
+
+
+def test_aot_index_verify_roundtrip(aot_store):
+    store, aot = aot_store
+    index = _seed_tier(store, aot)
+    assert index["schema_version"] == aot.AOT_SCHEMA_VERSION
+    assert set(index["files"]) == {"a.bin", os.path.join("sub", "b.bin")}
+    assert aot.verify_aot(store) == []
+    # the store-level audit folds the tier in
+    assert store.verify() == []
+
+
+def test_aot_verify_detects_drift_and_missing(aot_store):
+    store, aot = aot_store
+    _seed_tier(store, aot)
+    base = aot.aot_xla_dir(store)
+    with open(os.path.join(base, "a.bin"), "wb") as fh:
+        fh.write(b"bitflip")
+    os.remove(os.path.join(base, "sub", "b.bin"))
+    corrupt0 = REGISTRY.counter("mesh_tpu_store_corrupt_total").value(
+        what="aot_crc")
+    problems = aot.verify_aot(store)
+    assert len(problems) == 2
+    assert any("CRC mismatch" in p for p in problems)
+    assert any("missing" in p for p in problems)
+    # every finding went through the store corruption funnel
+    assert REGISTRY.counter("mesh_tpu_store_corrupt_total").value(
+        what="aot_crc") - corrupt0 == 2
+    assert store.verify() != []
+
+
+def test_aot_fresh_tier_is_not_corruption(aot_store):
+    store, aot = aot_store
+    assert aot.verify_aot(store) == []               # no index: fresh
+
+
+def test_aot_enable_quarantines_crc_drift(aot_store, fleet_env):
+    store, aot = aot_store
+    _seed_tier(store, aot)
+    base = aot.aot_xla_dir(store)
+    with open(os.path.join(base, "a.bin"), "wb") as fh:
+        fh.write(b"bitflip")
+    cache_dir = aot.enable_aot_tier(store=store, min_compile_secs=0.0)
+    assert cache_dir == base
+    assert not os.path.exists(os.path.join(base, "a.bin"))   # deleted
+    assert os.path.exists(os.path.join(base, "sub", "b.bin"))  # kept
+    # index re-snapshotted over the survivors
+    index, problem = aot._read_index(store)
+    assert problem is None
+    assert set(index["files"]) == {os.path.join("sub", "b.bin")}
+    assert aot.verify_aot(store) == []
+
+
+def test_aot_enable_clears_tier_on_schema_mismatch(aot_store, fleet_env):
+    store, aot = aot_store
+    _seed_tier(store, aot)
+    bad = {"schema_version": aot.AOT_SCHEMA_VERSION + 99, "files": {}}
+    with open(aot.aot_index_path(store), "w") as fh:
+        json.dump(bad, fh)
+    corrupt0 = REGISTRY.counter("mesh_tpu_store_corrupt_total").value(
+        what="aot_meta")
+    cache_dir = aot.enable_aot_tier(store=store, min_compile_secs=0.0)
+    assert cache_dir == aot.aot_xla_dir(store)
+    # the whole tier was cleared; nothing crashed
+    assert os.listdir(aot.aot_xla_dir(store)) == []
+    assert REGISTRY.counter("mesh_tpu_store_corrupt_total").value(
+        what="aot_meta") - corrupt0 == 1
+    assert aot.verify_aot(store) == []               # fresh index, clean
+
+
+def test_aot_enable_respects_kill_switch(aot_store, fleet_env):
+    store, aot = aot_store
+    fleet_env.setenv("MESH_TPU_FLEET_AOT", "0")
+    _seed_tier(store, aot)
+    base = aot.aot_xla_dir(store)
+    with open(os.path.join(base, "a.bin"), "wb") as fh:
+        fh.write(b"bitflip")
+    assert aot.enable_aot_tier(store=store) is None
+    # disabled = untouched: no quarantine, no index refresh
+    assert os.path.exists(os.path.join(base, "a.bin"))
+
+
+# ---------------------------------------------------------------------------
+# sharded big-batch lane (the one jax-compiling test here)
+
+
+def test_shard_lane_bit_identical_and_counted(fleet_env):
+    # the lane lives in the EngineExecutor drain loop, so drive the
+    # executor path directly (the jax-level facade bypasses coalescing)
+    from mesh_tpu import Mesh, engine
+    from mesh_tpu.sphere import _icosphere
+
+    v, f = _icosphere(2)
+    mesh = Mesh(v=v, f=f)
+    pts = np.asarray(np.random.RandomState(9).randn(1500, 3), np.float32)
+    counter = REGISTRY.counter("mesh_tpu_fleet_shard_dispatches_total")
+
+    def _run():
+        return engine.submit("closest_point", mesh, pts).result(timeout=120.0)
+
+    # default: shard_min_q unset => lane off, nothing counted
+    n0 = counter.value()
+    faces_off, points_off = _run()
+    assert counter.value() == n0
+
+    # kill switch beats the pin: still the single-device path
+    fleet_env.setenv("MESH_TPU_FLEET_SHARD_MIN_Q", "1024")
+    fleet_env.setenv("MESH_TPU_FLEET_SHARD", "0")
+    faces_kill, points_kill = _run()
+    assert counter.value() == n0
+    assert np.array_equal(faces_kill, faces_off)
+    assert np.array_equal(points_kill, points_off)
+
+    # lane on: counted, and bit-identical to the single-device path
+    fleet_env.delenv("MESH_TPU_FLEET_SHARD")
+    faces_on, points_on = _run()
+    assert counter.value() == n0 + 1
+    assert np.array_equal(faces_on, faces_off)
+    assert np.array_equal(points_on, points_off)
+
+    # below the threshold the lane never engages
+    base_small = counter.value()
+    engine.submit("closest_point", mesh, pts[:600]).result(timeout=120.0)
+    assert counter.value() == base_small
+
+
+# ---------------------------------------------------------------------------
+# mesh-tpu fleet status (jax-free CLI)
+
+
+def test_cli_fleet_status(tmp_path, fleet_env):
+    sink_dir = tmp_path / "sinks"
+    sink_dir.mkdir()
+    healthy = _replica("cli-healthy")
+    draining = _replica("cli-draining")
+    try:
+        healthy.submit(_Digest("cli-dg"), _PTS, tenant="t").result(
+            timeout=60.0)
+        healthy.write_stats(str(sink_dir / "replica-a.json"))
+        draining.health.begin_drain()
+        draining.write_stats(str(sink_dir / "replica-b.json"))
+    finally:
+        healthy.stop(write_stats=False)
+        draining.stop(write_stats=False)
+    (sink_dir / "replica-c.json").write_text("{truncated")
+
+    proc = _run_cli("fleet", "status", "--dir", str(sink_dir), "--json")
+    assert proc.returncode == 0, proc.stderr
+    doc = json.loads(proc.stdout)
+    rows = {r["replica"]: r for r in doc["replicas"]}
+    assert rows["replica-a"]["readable"] and rows["replica-a"]["in_ring"]
+    assert rows["replica-a"]["health"] == "healthy"
+    assert rows["replica-b"]["health"] == "draining"
+    assert not rows["replica-b"]["in_ring"]
+    assert not rows["replica-c"]["readable"]
+    assert doc["ring"]["members"] == ["replica-a"]
+
+    # no readable sink at all: rc 2
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert _run_cli("fleet", "status", "--dir", str(empty)).returncode == 2
+
+
+# ---------------------------------------------------------------------------
+# perfcheck fleet band
+
+
+_FLEET_GOLD = {
+    "metric": "fleet_affinity", "value": 1.0, "warm_hit_rate": 0.875,
+    "spills": 1, "checksum": 123456.0,
+    "aot": {"warm_hits": 2, "speedup": 3.0},
+}
+
+
+def _fleet_band(cand, gold=_FLEET_GOLD):
+    from mesh_tpu.obs.perf import perfcheck
+
+    doc = {"fleet": cand} if cand is not None else \
+        {"metric": "x", "value": None, "unit": None, "vs_baseline": None}
+    return perfcheck(doc, fleet_golden={"fleet": dict(gold)})
+
+
+def test_perfcheck_fleet_band():
+    rc, lines = _fleet_band(dict(_FLEET_GOLD))
+    assert rc == 0
+    assert any("ok fleet routing affinity" in ln for ln in lines)
+    # a candidate with no fleet record at all is a hard FAIL
+    rc, lines = _fleet_band(None)
+    assert rc == 1
+    assert any("FAIL fleet" in ln for ln in lines)
+    # affinity below the 0.95 hard floor fails regardless of tolerance
+    rc, _ = _fleet_band(dict(_FLEET_GOLD, value=0.9))
+    assert rc == 1
+    # spill drift is exact-matched
+    rc, lines = _fleet_band(dict(_FLEET_GOLD, spills=2))
+    assert rc == 1
+    assert any("FAIL fleet spills" in ln for ln in lines)
+    # checksum drift is a hard FAIL even with everything else in band
+    rc, lines = _fleet_band(dict(_FLEET_GOLD, checksum=123457.0))
+    assert rc == 1
+    assert any("FAIL fleet replica-admission checksum" in ln
+               for ln in lines)
+    # a candidate that cannot prove determinism is a hard FAIL
+    no_sum = dict(_FLEET_GOLD)
+    del no_sum["checksum"]
+    rc, lines = _fleet_band(no_sum)
+    assert rc == 1
+    assert any("determinism unproven" in ln for ln in lines)
+    # AOT warm start must actually hit the executable cache
+    rc, _ = _fleet_band(dict(_FLEET_GOLD, aot={"warm_hits": 0,
+                                               "speedup": 3.0}))
+    assert rc == 1
+    # record with no golden: informational note, rc 0
+    from mesh_tpu.obs.perf import perfcheck
+
+    rc, lines = perfcheck({"fleet": dict(_FLEET_GOLD)})
+    assert rc == 0
+    assert any("make fleet-golden" in ln for ln in lines)

@@ -505,12 +505,15 @@ def test_service_stop_without_drain_closes_ledger_records():
     from mesh_tpu.obs.ledger import get_ledger
 
     ledger = get_ledger()
-    before = len(ledger.records())
     svc = _service()
     svc.hold()              # never released: all 3 die queued
-    futs = [svc.submit(_MESH, _PTS) for _ in range(3)]
+    futs = [svc.submit(_MESH, _PTS, tenant="stop-no-drain")
+            for _ in range(3)]
     svc.stop(drain=False, write_stats=False)
-    rows = ledger.records()[before:]
+    # filter by tenant, not a len() offset: the ledger is a bounded ring
+    # and earlier tests may have filled it to capacity
+    rows = [r for r in ledger.records()
+            if r.get("tenant") == "stop-no-drain"]
     assert len(rows) == len(futs)
     assert all(r["outcome"] in ("cancelled", "shutdown") for r in rows)
     for fut in futs:
