@@ -2094,6 +2094,137 @@ print(json.dumps({
     }
 
 
+def anim_proxy_stage(n_rep=3):
+    """Stage ``anim_proxy``: the dynamic-mesh tier's chip-free metric
+    (doc/animation.md).  Builds one BVH over a parametric sphere, then
+    deforms it through a deterministic sinusoidal animation and times,
+    per frame, the frozen-order refit (anim/refit.py) against a full
+    host rebuild of the same deformed geometry.  The reported value is
+    the rebuild/refit speedup (>1 means skipping the Morton re-sort +
+    preorder scatter pays), graded by ``mesh-tpu perfcheck`` against
+    benchmarks/anim_golden.json with a hard 1.0x floor.
+
+    Exactness is enforced in-stage, not just graded: (a) refitting the
+    *keyframe* geometry must reproduce the build boxes bit for bit
+    (the inflation ratio's 1.0 anchor), (b) every frame's traversal
+    through the refit index must return answers bit-identical to a
+    traversal through the fresh rebuild, and (c) the Pallas leaf-box
+    kernel (accel/pallas_refit.py, interpret mode) must match the host
+    leaf stage bitwise on a small mesh.  The checksum accumulates every
+    frame's refit-index answers, so perfcheck catches silent traversal
+    drift.  Sizes are overridable via MESH_TPU_ANIM_PROXY_FACES /
+    MESH_TPU_ANIM_PROXY_FRAMES / MESH_TPU_ANIM_PROXY_QUERIES."""
+    import jax
+    import jax.numpy as jnp
+
+    from mesh_tpu.accel.build import build_bvh
+    from mesh_tpu.accel.pallas_refit import leaf_boxes_pallas
+    from mesh_tpu.accel.traverse import bvh_closest_point
+    from mesh_tpu.anim.refit import box_measure, refit_bvh, refit_leaf_boxes
+    from mesh_tpu.query.autotune import _sphere_mesh
+
+    n_faces = knobs.get_int("MESH_TPU_ANIM_PROXY_FACES", 50000)
+    n_frames = knobs.get_int("MESH_TPU_ANIM_PROXY_FRAMES", 8)
+    n_q = knobs.get_int("MESH_TPU_ANIM_PROXY_QUERIES", 64)
+
+    v, f = _sphere_mesh(n_faces)
+    rng = np.random.RandomState(0)
+    pts = rng.randn(n_q, 3)
+    pts /= np.linalg.norm(pts, axis=1, keepdims=True)
+    pts *= 1.0 + 0.05 * rng.randn(n_q, 1)
+    pts = np.asarray(pts, np.float32)
+
+    base = build_bvh(v, f)
+
+    # contract (a): refit of the keyframe reproduces the build boxes
+    # bitwise — the 1.0 anchor of the inflation ratio
+    r0, _info = refit_bvh(base, v, f)
+    for key in ("node_lo", "node_hi"):
+        if not np.array_equal(np.asarray(base.arrays[key]),
+                              np.asarray(r0.arrays[key])):
+            raise RuntimeError(
+                "refit of the keyframe geometry diverged from the build "
+                "boxes on %r — the inflation anchor is broken" % key)
+
+    # contract (c): the Pallas leaf-box kernel is the host stage's
+    # bitwise twin (interpret mode — chip-free)
+    sv, sf = _sphere_mesh(2000)
+    small = build_bvh(sv, sf)
+    sm = small.meta
+    vc = np.asarray(sv, np.float32) - np.asarray(small.arrays["center"])
+    tri_s = vc[np.asarray(sf, np.int32)][np.asarray(small.arrays["order"])]
+    lo_h, hi_h = refit_leaf_boxes(
+        tri_s, int(sm["n_leaves"]), int(sm["leaf_size"]))
+    lo_p, hi_p = leaf_boxes_pallas(
+        tri_s, int(sm["n_leaves"]), int(sm["leaf_size"]), interpret=True)
+    if not (np.array_equal(lo_h, np.asarray(lo_p))
+            and np.array_equal(hi_h, np.asarray(hi_p))):
+        raise RuntimeError(
+            "Pallas leaf-box kernel (interpret) diverged bitwise from "
+            "the host leaf stage — the refit kernel contract is broken")
+
+    # warm the traversal plan once; digest+meta are the plan's static
+    # identity, so the refit indices below reuse this compile
+    warm = bvh_closest_point(v, f, pts, index=base)
+    jax.block_until_ready(warm["sqdist"])
+
+    best_refit = 0.0
+    best_rebuild = 0.0
+    checksum = 0.0
+    inflation_max = 1.0
+    frames = 0
+    for k in range(max(int(n_frames), 1)):
+        ph = 2.0 * np.pi * (k + 1.0) / (n_frames + 1.0)
+        amp = 0.04 * (k + 1.0) / max(n_frames, 1)
+        v2 = np.asarray(
+            v * (1.0 + amp * np.sin(ph + 3.0 * v[:, 2:3])), np.float32)
+
+        bf = np.inf
+        bb = np.inf
+        for _ in range(max(int(n_rep), 1)):
+            t0 = time.perf_counter()
+            refit, info = refit_bvh(base, v2, f)
+            bf = min(bf, time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            fresh = build_bvh(v2, f)
+            bb = min(bb, time.perf_counter() - t0)
+        best_refit += bf
+        best_rebuild += bb
+        inflation_max = max(
+            inflation_max,
+            info["box_measure"] / max(box_measure(
+                fresh.arrays["node_lo"], fresh.arrays["node_hi"]), 1e-30))
+
+        # contract (b): the refit index answers bit-identically to the
+        # fresh rebuild of the same deformed geometry
+        out_r = bvh_closest_point(v2, f, pts, index=refit)
+        out_b = bvh_closest_point(v2, f, pts, index=fresh)
+        jax.block_until_ready((out_r["sqdist"], out_b["sqdist"]))
+        for key in ("face", "point", "sqdist"):
+            if not np.array_equal(np.asarray(out_r[key]),
+                                  np.asarray(out_b[key])):
+                raise RuntimeError(
+                    "frame %d: refit-index traversal diverged from the "
+                    "fresh rebuild on %r — the refit exactness contract "
+                    "is broken" % (k, key))
+        checksum += float(jnp.sum(out_r["sqdist"]) + jnp.sum(out_r["point"]))
+        frames += 1
+
+    return {
+        "metric": "anim_refit_speedup",
+        "value": round(best_rebuild / best_refit, 3),
+        "unit": "rebuild_over_refit",
+        "vs_baseline": None,
+        "faces": int(f.shape[0]),
+        "frames": frames,
+        "queries": n_q,
+        "refit_seconds": round(best_refit, 4),
+        "rebuild_seconds": round(best_rebuild, 4),
+        "inflation_max": round(inflation_max, 4),
+        "checksum": round(checksum, 4),
+    }
+
+
 def tuner_replay_stage():
     """Stage ``tuner_replay``: the tuner's gym — the TunerController fed
     a captured/synthesized traffic trace instead of the scripted burn
@@ -2290,6 +2421,14 @@ _STAGE_DEFS = OrderedDict((
                       "MESH_TPU_FLEET_AOT": "1",
                       "MESH_TPU_NO_XLA_CACHE": "",
                       "MESH_TPU_REPLAY_TRACE": ""})),
+    # the dynamic-mesh tier's chip-free metric: host refit vs rebuild
+    # timing plus three bit-identity contracts (keyframe anchor, per-
+    # frame traversal, Pallas leaf kernel in interpret mode).  ANIM is
+    # pinned ON so a caller's kill switch can't hollow out the stage.
+    ("anim_proxy", (anim_proxy_stage, 300.0, False, False,
+                    {"JAX_PLATFORMS": "cpu",
+                     "PALLAS_AXON_POOL_IPS": "",
+                     "MESH_TPU_ANIM": "1"})),
     # the tuner's gym: same env pins as tuner_convergence (tuner ON,
     # knob pins cleared) driving the controller from a replayed trace
     ("tuner_replay", (tuner_replay_stage, 120.0, False, False,
@@ -2421,6 +2560,9 @@ def run_staged(names=None):
     fleet_res = results.get("fleet_proxy")
     if fleet_res is not None and fleet_res.ok:
         record["fleet"] = fleet_res.record
+    anim_res = results.get("anim_proxy")
+    if anim_res is not None and anim_res.ok:
+        record["anim"] = anim_res.record
     record["stages"] = OrderedDict(
         (n, r.to_json()) for n, r in results.items())
     record["bench_partial"] = partial_path

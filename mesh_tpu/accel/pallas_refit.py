@@ -1,0 +1,102 @@
+"""Pallas TPU kernel: per-leaf AABB recompute for the BVH refit path.
+
+The anim refit (mesh_tpu/anim/refit.py) recomputes node boxes over the
+frozen Morton order each frame.  The only O(F) stage is the leaf box
+pass — min/max over every ``leaf_size * 3`` corner block — and that is
+a pure VPU row reduction, so it runs on device: corners arrive as
+three ``(n_leaves, leaf_size * 3)`` coordinate planes (the same
+Morton-ordered centered frame the rope kernels walk), each program
+reduces a tile of leaf rows, and the outputs are the ``(n_leaves, 3)``
+leaf ``lo`` / ``hi`` the host-side level reduction + preorder scatter
+consume.  min/max over f32 lattice values is exact, so the kernel is
+bit-identical to the numpy twin (``refit_leaf_boxes``) — the anim
+bench stage and tests/test_anim.py assert it, interpret-mode, on every
+run.
+
+The internal-level reduction (log2 depth pairwise min/max over at most
+``n_leaves`` rows) and the preorder scatter are a few microseconds of
+host work on arrays that already exist — not worth a kernel; keeping
+them beside the builder's identical code is what guarantees layout
+identity (doc/animation.md).
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+import numpy as np
+
+from ..utils.jax_compat import tpu_compiler_params
+
+__all__ = ["leaf_boxes_pallas"]
+
+
+def _make_leaf_box_kernel():
+    def kernel(xs, ys, zs, lo, hi):
+        x, y, z = xs[...], ys[...], zs[...]          # (TL, L3)
+        lo[...] = jnp.concatenate(
+            [jnp.min(x, axis=1, keepdims=True),
+             jnp.min(y, axis=1, keepdims=True),
+             jnp.min(z, axis=1, keepdims=True)], axis=1)
+        hi[...] = jnp.concatenate(
+            [jnp.max(x, axis=1, keepdims=True),
+             jnp.max(y, axis=1, keepdims=True),
+             jnp.max(z, axis=1, keepdims=True)], axis=1)
+
+    return kernel
+
+
+@partial(jax.jit, static_argnames=("n_leaves", "leaf_size", "tile_l",
+                                   "interpret"))
+def _leaf_boxes_run(tri_s, n_leaves, leaf_size, tile_l, interpret):
+    corners = jnp.asarray(tri_s, jnp.float32).reshape(
+        n_leaves, leaf_size * 3, 3)
+    xs = corners[:, :, 0]
+    ys = corners[:, :, 1]
+    zs = corners[:, :, 2]
+    l3 = leaf_size * 3
+
+    n_tiles = n_leaves // tile_l
+    row_tile = pl.BlockSpec((tile_l, l3), lambda i: (i, 0))
+    out_tile = pl.BlockSpec((tile_l, 3), lambda i: (i, 0))
+    lo, hi = pl.pallas_call(
+        _make_leaf_box_kernel(),
+        grid=(n_tiles,),
+        in_specs=[row_tile, row_tile, row_tile],
+        out_specs=[out_tile, out_tile],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_leaves, 3), jnp.float32),
+            jax.ShapeDtypeStruct((n_leaves, 3), jnp.float32),
+        ],
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(xs, ys, zs)
+    return lo, hi
+
+
+def leaf_boxes_pallas(tri_s, n_leaves, leaf_size, tile_l=None,
+                      interpret=False):
+    """Leaf AABBs of the Morton-ordered corner blocks via the Pallas
+    row-reduction kernel.  ``tri_s`` is the ``(Fp, 3, 3)`` centered
+    Morton-ordered triangle array (the builder's / refitter's frame);
+    returns ``(lo, hi)`` as ``(n_leaves, 3)`` f32 — bit-identical to
+    ``mesh_tpu.anim.refit.refit_leaf_boxes``."""
+    n_leaves = int(n_leaves)
+    leaf_size = int(leaf_size)
+    if tile_l is None:
+        tile_l = min(n_leaves, 128)
+    tile_l = int(tile_l)
+    while n_leaves % tile_l:
+        tile_l //= 2                    # n_leaves is a power of two
+    tile_l = max(tile_l, 1)
+    tri_s = np.asarray(tri_s, np.float32)
+    if tri_s.shape[0] != n_leaves * leaf_size:
+        raise ValueError(
+            "tri_s has %d faces, layout says %d leaves x %d"
+            % (tri_s.shape[0], n_leaves, leaf_size))
+    return _leaf_boxes_run(tri_s, n_leaves, leaf_size, tile_l,
+                           bool(interpret))

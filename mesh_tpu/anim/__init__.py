@@ -1,0 +1,33 @@
+"""Dynamic meshes: animation-rate BVH refit and avatar stream sessions.
+
+The workload this package serves deforms a *fixed-topology* mesh every
+frame (SMPL / FLAME / MANO body pipelines): the face buffer never
+changes, only the vertex positions.  ``mesh_tpu/anim`` exploits that
+end to end (doc/animation.md):
+
+- :mod:`mesh_tpu.anim.refit` — bottom-up AABB refit over the frozen
+  Morton order and preorder+skip rope layout of an existing
+  :class:`~mesh_tpu.accel.build.AccelIndex`, with a tracked
+  box-inflation ratio that trips a full rebuild through the digest
+  cache when the frozen order decays past the
+  ``anim_refit_max_inflation`` tunable.
+- :mod:`mesh_tpu.anim.session` — serve-side avatar sessions: one
+  pinned topology digest, plan, refit state, and fleet routing key
+  per client; per-frame vertex deltas + queries at animation rate.
+
+The vertex-delta store tier rides in :mod:`mesh_tpu.store.deltas`
+(keyframe + uint16-quantized per-frame deltas), and the chip-free
+``anim_proxy`` bench stage grades refit-vs-rebuild speedup against
+``benchmarks/anim_golden.json``.
+
+``MESH_TPU_ANIM=0`` is the kill switch: sessions fall back to a cold
+``get_index`` build per frame — bit-identical to the pre-anim path.
+"""
+
+from .refit import RefitState, box_measure, refit_bvh, refit_max_inflation
+from .session import AvatarSession, SessionClosed
+
+__all__ = [
+    "AvatarSession", "RefitState", "SessionClosed", "box_measure",
+    "refit_bvh", "refit_max_inflation",
+]

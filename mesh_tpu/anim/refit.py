@@ -1,0 +1,273 @@
+"""Animation-rate BVH refit over the frozen rope layout.
+
+``build_bvh`` (accel/build.py) Morton-sorts faces once and lays the
+complete tree out in preorder with skip ropes.  For a deforming
+fixed-topology mesh the sort and the layout stay *valid* frame after
+frame — only the boxes go stale.  ``refit_bvh`` therefore recomputes
+node AABBs bottom-up from the deformed vertices over the SAME frozen
+order, preorder positions, and centered build frame, and returns a new
+:class:`~mesh_tpu.accel.build.AccelIndex` that shares every other
+array, the digest, and the meta of the base index — so every frame of
+a session reuses one compiled traversal plan instead of paying a host
+sort + digest + build per frame.
+
+Exactness is unconditional: refit boxes are true f32 min/max bounds of
+the deformed triangles (exact lattice operations, no rounding), so the
+rope walk prunes conservatively and the dense winner recompute in
+``accel/traverse.py`` returns the true closest point — the same
+conservative-certificate + dense-repair contract as a fresh build.
+What decays is *pruning efficiency*: as triangles migrate, boxes of
+the frozen Morton blocks inflate and overlap.  The certified quality
+bound is the tracked **box-inflation ratio**
+
+    inflation = box_measure(refit boxes) / box_measure(fresh boxes)
+
+where the reference is captured at the last (re)build — refitting the
+build geometry reproduces the build boxes exactly, so the ratio starts
+at 1.0 by construction and grows only with real layout decay.  When it
+crosses the ``anim_refit_max_inflation`` tunable (utils/tuning.py,
+pinned by ``MESH_TPU_ANIM_REFIT_MAX_INFLATION``),
+:meth:`RefitState.advance` trips a rebuild through the existing
+digest-keyed ``get_index`` cache and re-anchors the reference.  The
+bound governs performance only, never correctness (doc/animation.md
+derives it).
+"""
+
+import threading
+
+import numpy as np
+
+from ..accel.build import AccelIndex, get_index
+
+__all__ = [
+    "RefitState", "box_measure", "refit_bvh", "refit_leaf_boxes",
+    "refit_max_inflation",
+]
+
+def _metrics():
+    from ..obs.metrics import REGISTRY
+
+    return {
+        "refits": REGISTRY.counter(
+            "mesh_tpu_anim_refits_total",
+            "Frames answered by a frozen-order BVH refit (no host "
+            "rebuild)."),
+        "rebuilds": REGISTRY.counter(
+            "mesh_tpu_anim_rebuilds_total",
+            "Refit frames that tripped a full rebuild through the "
+            "digest cache (label: reason — inflation)."),
+        "inflation": REGISTRY.gauge(
+            "mesh_tpu_anim_inflation_ratio",
+            "Latest refit-vs-rebuild box-inflation ratio (1.0 = "
+            "fresh-build quality)."),
+    }
+
+
+def refit_max_inflation():
+    """The effective refit/rebuild crossover: box-inflation ratio past
+    which :meth:`RefitState.advance` trips a rebuild.  A bounded
+    tunable (``anim_refit_max_inflation``) with the standard env pin
+    and A/B-guarded actuation path."""
+    from ..utils import tuning
+
+    return float(tuning.get("anim_refit_max_inflation"))
+
+
+def refit_leaf_boxes(tri_s, n_leaves, leaf_size):
+    """Per-leaf AABBs of the Morton-ordered corner blocks — the numpy
+    twin of the Pallas leaf-box kernel (accel/pallas_refit.py), and
+    literally the builder's leaf stage over a frozen order."""
+    blocks = np.asarray(tri_s, np.float32).reshape(
+        n_leaves, leaf_size * 3, 3)
+    return blocks.min(axis=1), blocks.max(axis=1)
+
+
+def _level_boxes(lo_leaf, hi_leaf):
+    """Internal levels bottom-up by pairwise min/max — bitwise the
+    builder's reduction (build_bvh), just starting from refit leaves."""
+    lo_levels = [np.asarray(lo_leaf, np.float32)]
+    hi_levels = [np.asarray(hi_leaf, np.float32)]
+    while lo_levels[-1].shape[0] > 1:
+        lo_levels.append(
+            np.minimum(lo_levels[-1][0::2], lo_levels[-1][1::2]))
+        hi_levels.append(
+            np.maximum(hi_levels[-1][0::2], hi_levels[-1][1::2]))
+    lo_levels.reverse()
+    hi_levels.reverse()
+    return lo_levels, hi_levels
+
+
+def _preorder_positions(depth):
+    """The builder's level-by-level preorder scatter positions: level
+    ``l``'s nodes land at ``pre`` computed by the same recurrence as
+    build_bvh (pre(left) = pre(parent) + 1, pre(right) = pre(left) +
+    subtree) — layout identity is what makes refit boxes drop into the
+    frozen skip/leaf arrays unchanged."""
+    positions = []
+    pre = np.zeros(1, np.int64)
+    for level in range(depth + 1):
+        positions.append(pre)
+        if level == depth:
+            break
+        subtree = (1 << (depth - level)) - 1
+        pre_l = pre + 1
+        pre_r = pre_l + subtree
+        pre = np.stack([pre_l, pre_r], axis=1).reshape(-1)
+    return positions
+
+
+def box_measure(node_lo, node_hi):
+    """Summed surface area of every node box (f64): the scalar the
+    inflation ratio compares.  Surface area is the standard BVH quality
+    functional (SAH): expected traversal cost is proportional to the
+    summed area of the boxes a ray/query can intersect."""
+    ext = np.maximum(
+        np.asarray(node_hi, np.float64) - np.asarray(node_lo, np.float64),
+        0.0)
+    return float(2.0 * np.sum(
+        ext[:, 0] * ext[:, 1] + ext[:, 1] * ext[:, 2]
+        + ext[:, 0] * ext[:, 2]))
+
+
+def refit_bvh(index, v, f, kernel="host", interpret=False):
+    """Refit ``index`` (a ``kind="bvh"`` :class:`AccelIndex`) to the
+    deformed vertices ``v`` over the same faces ``f``.
+
+    Returns ``(refit_index, info)``.  The refit index shares the frozen
+    ``order`` / ``node_skip`` / ``node_leaf`` / ``center`` arrays, the
+    base digest, and the meta of ``index`` — two consequences: the
+    compiled traversal plan is reused across frames (digest + meta are
+    the plan's static identity), and only ``node_lo`` / ``node_hi`` are
+    fresh.  The centered build frame is the FROZEN one (``center`` is
+    an array of the base index, not recomputed), so boxes, queries,
+    and prune slack stay in one coordinate system.
+
+    ``kernel="pallas"`` computes the leaf boxes with the on-device
+    Pallas kernel (accel/pallas_refit.py; ``interpret=True`` runs it
+    chip-free) — bit-identical to the host path, which the anim bench
+    stage asserts.  ``info`` carries ``box_measure`` for the caller's
+    inflation tracking.
+    """
+    if index.kind != "bvh":
+        raise ValueError("refit_bvh needs a bvh index, got %r" % index.kind)
+    meta = index.meta
+    arr = index.arrays
+    leaf_size = int(meta["leaf_size"])
+    n_leaves = int(meta["n_leaves"])
+    depth = int(meta["depth"])
+    n_nodes = int(meta["n_nodes"])
+
+    v32 = np.asarray(v, np.float32)
+    fi = np.asarray(f, np.int32)
+    center = np.asarray(arr["center"], np.float32)
+    order_p = np.asarray(arr["order"])
+    vc = v32 - center                       # frozen build frame
+    tri_s = vc[fi][order_p]                 # (Fp, 3, 3), frozen order
+
+    if kernel == "pallas":
+        from ..accel.pallas_refit import leaf_boxes_pallas
+
+        lo_leaf, hi_leaf = leaf_boxes_pallas(
+            tri_s, n_leaves, leaf_size, interpret=interpret)
+        lo_leaf = np.asarray(lo_leaf)
+        hi_leaf = np.asarray(hi_leaf)
+    elif kernel == "host":
+        lo_leaf, hi_leaf = refit_leaf_boxes(tri_s, n_leaves, leaf_size)
+    else:
+        raise ValueError("unknown refit kernel %r (host|pallas)" % kernel)
+
+    lo_levels, hi_levels = _level_boxes(lo_leaf, hi_leaf)
+    node_lo = np.empty((n_nodes, 3), np.float32)
+    node_hi = np.empty((n_nodes, 3), np.float32)
+    for level, pre in enumerate(_preorder_positions(depth)):
+        node_lo[pre] = lo_levels[level]
+        node_hi[pre] = hi_levels[level]
+
+    refit = AccelIndex(
+        index.kind, index.digest,
+        arrays={
+            "order": arr["order"],
+            "node_lo": node_lo,
+            "node_hi": node_hi,
+            "node_skip": arr["node_skip"],
+            "node_leaf": arr["node_leaf"],
+            "center": arr["center"],
+        },
+        meta=dict(meta),
+    )
+    return refit, {"box_measure": box_measure(node_lo, node_hi)}
+
+
+class RefitState(object):
+    """Per-session refit bookkeeping: the live index, the fresh-build
+    reference measure, and the tracked inflation ratio.
+
+    :meth:`advance` is the one per-frame entry point: refit to the new
+    vertices, compare against the reference captured at the last
+    (re)build, and — past :func:`refit_max_inflation` — trip a rebuild
+    through the digest-keyed ``get_index`` cache instead.  Thread-safe
+    under its own lock (a session serializes frames anyway; the lock
+    covers concurrent stat readers)."""
+
+    def __init__(self, index, f, kernel="host"):
+        self._lock = threading.Lock()
+        self.index = index
+        self.f = np.asarray(f, np.int32)
+        self.kernel = kernel
+        self.leaf_size = int(index.meta["leaf_size"])
+        self.ref_measure = max(
+            box_measure(index.arrays["node_lo"], index.arrays["node_hi"]),
+            1e-30)
+        self.inflation = 1.0
+        self.refits = 0
+        self.rebuilds = 0
+
+    def advance(self, v_new, max_inflation=None):
+        """Move the state to the deformed vertices; returns
+        ``(index, action)`` with ``action`` in ``("refit", "rebuild")``.
+        A rebuild resets the inflation reference to the fresh boxes."""
+        if max_inflation is None:
+            max_inflation = refit_max_inflation()
+        metrics = _metrics()
+        with self._lock:
+            base = self.index
+            ref_measure = self.ref_measure
+        # The heavy work — refit, and on a trip the host rebuild (which
+        # reaches store/side-car I/O) — runs OUTSIDE the lock: a session
+        # serializes its frames, so `base` cannot change under us, and
+        # concurrent stat readers never block behind a build.
+        refit, info = refit_bvh(base, v_new, self.f, kernel=self.kernel)
+        inflation = info["box_measure"] / ref_measure
+        if inflation > max_inflation:
+            # frozen-order quality decayed past the crossover: pay
+            # one host rebuild (digest-cached — replaying the same
+            # frame sequence rebuilds nothing) and re-anchor
+            rebuilt = get_index(v_new, self.f, kind="bvh",
+                                leaf_size=self.leaf_size)
+            measure = max(box_measure(
+                rebuilt.arrays["node_lo"],
+                rebuilt.arrays["node_hi"]), 1e-30)
+            with self._lock:
+                self.index = rebuilt
+                self.ref_measure = measure
+                self.inflation = 1.0
+                self.rebuilds += 1
+            metrics["rebuilds"].inc(reason="inflation")
+            metrics["inflation"].set(1.0)
+            return rebuilt, "rebuild"
+        with self._lock:
+            self.index = refit
+            self.inflation = float(inflation)
+            self.refits += 1
+        metrics["refits"].inc()
+        metrics["inflation"].set(float(inflation))
+        return refit, "refit"
+
+    def stats(self):
+        with self._lock:
+            return {
+                "refits": self.refits,
+                "rebuilds": self.rebuilds,
+                "inflation": self.inflation,
+                "ref_measure": self.ref_measure,
+            }

@@ -1,0 +1,274 @@
+"""Avatar stream sessions: per-client dynamic-mesh serving state.
+
+A session pins everything that is invariant across an avatar's frames —
+topology digest, faces, keyframe vertices, the base BVH plan, the
+:class:`~mesh_tpu.anim.refit.RefitState`, and (on first query) the
+fleet routing key — so the per-frame work is exactly: apply the vertex
+delta, refit the frozen-layout BVH (or trip a rebuild past the
+inflation bound), and run the exact traversal against the reused
+compiled plan.  Because the routing key embeds the topology digest,
+``fleet/router.py`` gives every frame of a session replica affinity
+for free: the replica that built the plan keeps the session.
+
+Each frame carries one ledger record (``op="anim_frame"``, tenant =
+session id) with the new ``refit`` stage stamped between ``page_in``
+and ``device`` — `mesh-tpu prof` breaks anim traffic down by refit vs
+traversal cost like any other request.  Deadline-missed frames close
+``deadline`` and count ``mesh_tpu_anim_frame_deadline_miss_total``;
+a non-draining ``stop()`` (client gone) closes in-flight frames
+``cancelled``, so the ledger leaks nothing (LED001).
+
+Kill switch: ``MESH_TPU_ANIM=0`` makes every frame rebuild cold
+through the digest-keyed ``get_index`` — bit-identical to the
+pre-anim path (no refit stage, no refit arrays).
+"""
+
+import itertools
+import threading
+
+import numpy as np
+
+from ..errors import MeshError
+from ..obs.clock import monotonic
+from ..obs.ledger import get_ledger
+from ..obs.recorder import get_recorder
+from ..obs.trace import span as obs_span
+from ..utils import knobs
+from .refit import RefitState
+
+__all__ = ["AvatarSession", "SessionClosed"]
+
+_SESSION_SEQ = itertools.count(1)
+
+
+class SessionClosed(MeshError):
+    """The avatar session was stopped; frames are no longer accepted."""
+
+
+def _metrics():
+    from ..obs.metrics import REGISTRY
+
+    return {
+        "sessions": REGISTRY.gauge(
+            "mesh_tpu_anim_sessions",
+            "Open avatar stream sessions."),
+        "frames": REGISTRY.counter(
+            "mesh_tpu_anim_frames_total",
+            "Session frames served (label: action — refit / "
+            "rebuild / cold)."),
+        "miss": REGISTRY.counter(
+            "mesh_tpu_anim_frame_deadline_miss_total",
+            "Session frames that finished after their per-frame "
+            "deadline (label: tenant)."),
+    }
+
+
+class AvatarSession(object):
+    """One client's animated-mesh stream over a fixed topology.
+
+    Construct from a live keyframe mesh (``AvatarSession(mesh)``) or a
+    store key (``AvatarSession(digest=...)`` — the keyframe pages in
+    through the store).  Per frame, :meth:`frame` accepts either a
+    vertex *delta* against the keyframe or absolute vertices, plus an
+    optional query batch, and returns the query result dict with
+    ``action`` (``refit`` / ``rebuild`` / ``cold``) and timing
+    provenance.  Thread-safe; frames of one session serialize on the
+    session lock (streams are ordered).
+    """
+
+    def __init__(self, mesh=None, digest=None, store=None, session_id=None,
+                 leaf_size=None, kernel="host"):
+        from ..accel.build import get_index, topology_digest
+
+        if mesh is None and digest is None:
+            raise ValueError("AvatarSession needs a keyframe mesh "
+                             "or a store digest")
+        if mesh is None:
+            from ..store import get_store
+
+            stored = (store or get_store()).open(digest, tier="exact")
+            v_key = np.asarray(stored.v, np.float32)
+            faces = np.asarray(stored.f, np.int32)
+        else:
+            v_key = np.asarray(mesh.v, np.float32)
+            faces = np.asarray(mesh.f, np.int32)
+            digest = topology_digest(v_key, faces)
+        self.digest = digest
+        self.v_key = v_key
+        self.f = faces
+        self.session_id = session_id or ("avatar-%d" % next(_SESSION_SEQ))
+        self.leaf_size = leaf_size
+        params = {} if leaf_size is None else {"leaf_size": int(leaf_size)}
+        base = get_index(v_key, faces, kind="bvh", **params)
+        self.refit_state = RefitState(base, faces, kernel=kernel)
+        self.routing_key = None       # pinned on the first queried frame
+        self._cond = threading.Condition()
+        self._closed = False
+        self._held = 0
+        self._frame_seq = itertools.count()
+        self._inflight = {}           # frame no -> RequestRecord
+        self.frames = 0
+        self.deadline_misses = 0
+        _metrics()["sessions"].inc(1)
+        get_recorder().record("anim.session_open", session=self.session_id,
+                              digest=self.digest,
+                              n_faces=int(faces.shape[0]))
+
+    # -- per-frame ----------------------------------------------------
+
+    def _vertices(self, delta, vertices):
+        if (delta is None) == (vertices is None):
+            raise ValueError("frame() wants exactly one of delta= / "
+                             "vertices=")
+        if delta is not None:
+            delta = np.asarray(delta, np.float32)
+            if delta.shape != self.v_key.shape:
+                raise ValueError("delta shape %s != keyframe %s"
+                                 % (delta.shape, self.v_key.shape))
+            return self.v_key + delta
+        vertices = np.asarray(vertices, np.float32)
+        if vertices.shape != self.v_key.shape:
+            raise ValueError("vertices shape %s != keyframe %s"
+                             % (vertices.shape, self.v_key.shape))
+        return vertices
+
+    def frame(self, delta=None, vertices=None, points=None,
+              deadline_s=None):
+        """Serve one animation frame: apply the vertex update, refit
+        (or rebuild, or — anim off — cold-build) the index, and answer
+        the optional query batch exactly.
+
+        Returns a dict: ``action``, ``index``, ``inflation``, and —
+        when ``points`` were given — the facade-convention ``faces`` /
+        ``points`` / ``sqdist`` arrays plus ``deadline_missed``."""
+        from ..accel.build import get_index
+        from ..accel.traverse import closest_faces_and_points_accel
+
+        with self._cond:
+            if self._closed:
+                raise SessionClosed("session %s is stopped"
+                                    % self.session_id)
+            frame_no = next(self._frame_seq)
+            rec = get_ledger().open(
+                tenant=self.session_id, op="anim_frame", frame=frame_no,
+                digest=self.digest,
+                deadline_s=(None if deadline_s is None
+                            else float(deadline_s)))
+            if rec is not None:
+                self._inflight[frame_no] = rec
+        t0 = monotonic()
+        out = {"frame": frame_no, "action": None, "inflation": None}
+        try:
+            with obs_span("anim.frame", session=self.session_id,
+                          frame=frame_no):
+                v_new = self._vertices(delta, vertices)
+                if rec is not None:
+                    rec.stamp("queue")
+                if not knobs.flag("MESH_TPU_ANIM"):
+                    # kill switch: the pre-anim path, bit for bit — a
+                    # cold digest-keyed build, no refit arrays, no
+                    # refit ledger stage
+                    params = ({} if self.leaf_size is None
+                              else {"leaf_size": int(self.leaf_size)})
+                    index = get_index(v_new, self.f, kind="bvh", **params)
+                    action = "cold"
+                else:
+                    index, action = self.refit_state.advance(v_new)
+                    if rec is not None:
+                        rec.stamp("refit")
+                    out["inflation"] = self.refit_state.inflation
+                out["action"] = action
+                out["index"] = index
+                _metrics()["frames"].inc(action=action)
+                if points is not None:
+                    res = closest_faces_and_points_accel(
+                        v_new, self.f, points, index=index, record=rec)
+                    out.update(faces=res["face"], points=res["point"],
+                               sqdist=res["sqdist"])
+                    if self.routing_key is None:
+                        from ..fleet.router import routing_key
+
+                        self.routing_key = routing_key(
+                            "anim_frame", self.digest, points)
+        except SessionClosed:
+            raise
+        except Exception as e:          # noqa: BLE001 — outcome must close
+            self._finish(frame_no, rec, "error", error=type(e).__name__)
+            raise
+        latency = monotonic() - t0
+        out["latency_s"] = latency
+        missed = deadline_s is not None and latency > float(deadline_s)
+        out["deadline_missed"] = missed
+        if missed:
+            self.deadline_misses += 1
+            _metrics()["miss"].inc(tenant=self.session_id)
+        self._finish(frame_no, rec, "deadline" if missed else "ok")
+        self.frames += 1
+        return out
+
+    def _finish(self, frame_no, rec, outcome, **meta):
+        # the in-flight entry is popped by whoever closes the record —
+        # this frame on the serve path, stop(drain=False) on teardown —
+        # so a record is closed exactly once (LED001)
+        with self._cond:
+            while self._held and not self._closed:
+                self._cond.wait()
+            if rec is not None:
+                rec = self._inflight.pop(frame_no, None)
+            if rec is None:
+                return
+        get_ledger().close(rec, outcome=outcome, **meta)
+
+    # -- fences (tests) ------------------------------------------------
+
+    def hold(self):
+        """Fence frame finalization: frames compute but park before
+        closing their ledger record until :meth:`release` (lets tests
+        stop() a session with a deterministically in-flight frame)."""
+        with self._cond:
+            self._held += 1
+
+    def release(self):
+        with self._cond:
+            self._held = max(0, self._held - 1)
+            self._cond.notify_all()
+
+    # -- lifecycle -----------------------------------------------------
+
+    def stats(self):
+        s = self.refit_state.stats()
+        s.update(session=self.session_id, digest=self.digest,
+                 frames=self.frames,
+                 deadline_misses=self.deadline_misses,
+                 routing_key=self.routing_key)
+        return s
+
+    def stop(self, drain=True):
+        """End the session.  ``drain=True`` waits for in-flight frames
+        to finish; ``drain=False`` (client gone) closes any in-flight
+        frame's ledger record with outcome ``cancelled`` immediately —
+        nothing leaks open."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            if drain:
+                while self._inflight and not self._held:
+                    self._cond.wait(timeout=0.1)
+            pending = list(self._inflight.items())
+            self._inflight.clear()
+            self._cond.notify_all()
+        ledger = get_ledger()
+        for _frame_no, rec in pending:
+            ledger.close(rec, outcome="cancelled")
+        _metrics()["sessions"].inc(-1)
+        get_recorder().record("anim.session_close", session=self.session_id,
+                              frames=self.frames,
+                              cancelled=len(pending))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

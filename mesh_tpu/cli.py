@@ -468,6 +468,10 @@ def cmd_perfcheck(args):
         args.fleet_golden or os.path.join(repo_root, "benchmarks",
                                           "fleet_golden.json"),
         "fleet golden")
+    anim_golden = _load_optional(
+        args.anim_golden or os.path.join(repo_root, "benchmarks",
+                                         "anim_golden.json"),
+        "anim golden")
     rc, lines = perfcheck(doc, baseline=baseline, proxy_golden=golden,
                           proxy_tol=args.proxy_tol,
                           headline_tol=args.headline_tol,
@@ -485,7 +489,9 @@ def cmd_perfcheck(args):
                           replay_golden=replay_golden,
                           replay_tol=args.replay_tol,
                           fleet_golden=fleet_golden,
-                          fleet_tol=args.fleet_tol)
+                          fleet_tol=args.fleet_tol,
+                          anim_golden=anim_golden,
+                          anim_tol=args.anim_tol)
     if args.json:
         json.dump({"rc": rc, "lines": lines}, sys.stdout, indent=2)
         sys.stdout.write("\n")
@@ -1265,6 +1271,14 @@ def main():
                              "hard floor, the exact spill count, and "
                              "the exact replica-admission checksum hold "
                              "regardless)")
+    p_perf.add_argument("--anim-golden", default=None,
+                        help="anim refit golden record (default: repo "
+                             "benchmarks/anim_golden.json)")
+    p_perf.add_argument("--anim-tol", type=float, default=0.2,
+                        help="allowed fractional drop of the anim "
+                             "refit-vs-rebuild speedup vs the golden "
+                             "(default 0.2; the 1.0x hard floor and the "
+                             "exact traversal checksum hold regardless)")
     p_perf.add_argument("--json", action="store_true",
                         help="machine-readable {rc, lines} instead of the "
                              "summary")
@@ -1409,7 +1423,8 @@ def main():
         help="emit an adversarial workload trace in the capture schema")
     p_rsynth.add_argument("kind",
                           help="generator: stampede, bucket_ladder, "
-                               "prune_defeat, degenerate, steady, mix")
+                               "prune_defeat, degenerate, steady, anim, "
+                               "mix")
     p_rsynth.add_argument("--seed", type=int, default=None,
                           help="generator seed (deterministic for a "
                                "given seed)")

@@ -76,8 +76,8 @@ LEDGER_SCHEMA = 1
 #: (the record's open time is the admit stamp).  The meshlint OBS rule
 #: checks every name here is documented in doc/observability.md.
 LEDGER_STAGES = (
-    "queue", "page_in", "coalesce", "pad", "compile", "dispatch", "device",
-    "respond",
+    "queue", "page_in", "refit", "coalesce", "pad", "compile", "dispatch",
+    "device", "respond",
 )
 
 _STAGE_INDEX = {name: i for i, name in enumerate(LEDGER_STAGES)}

@@ -389,7 +389,8 @@ def extract_records(doc):
     """Normalize either bench JSON shape into ``{"headline": rec|None,
     "proxy": rec|None, "accel": rec|None, "stream": rec|None,
     "mxu": rec|None, "store": rec|None, "tuner": rec|None,
-    "replay": rec|None, "fleet": rec|None, "stages": {...}|None}``.
+    "replay": rec|None, "fleet": rec|None, "anim": rec|None,
+    "stages": {...}|None}``.
 
     The headline slot is only filled by a FRESH measurement — a
     ``stale: true`` envelope (last-good value republished while the
@@ -405,6 +406,7 @@ def extract_records(doc):
     tuner = None
     replay = None
     fleet = None
+    anim = None
     stages = None
     if doc.get("kind") == "bench_partial":
         stages = doc.get("stages") or {}
@@ -435,6 +437,9 @@ def extract_records(doc):
         fl = stages.get("fleet_proxy") or {}
         if fl.get("status") == "ok":
             fleet = fl.get("record")
+        an = stages.get("anim_proxy") or {}
+        if an.get("status") == "ok":
+            anim = an.get("record")
     else:
         if doc.get("value") is not None and not doc.get("stale"):
             headline = doc
@@ -462,11 +467,14 @@ def extract_records(doc):
         fl = doc.get("fleet")
         if isinstance(fl, dict) and fl.get("value") is not None:
             fleet = fl
+        an = doc.get("anim")
+        if isinstance(an, dict) and an.get("value") is not None:
+            anim = an
         stages = doc.get("stages")
     return {"headline": headline, "proxy": proxy, "accel": accel,
             "stream": stream, "mxu": mxu, "store": store,
             "tuner": tuner, "replay": replay, "fleet": fleet,
-            "stages": stages}
+            "anim": anim, "stages": stages}
 
 
 def perfcheck(doc, baseline=None, proxy_golden=None, proxy_tol=0.5,
@@ -475,7 +483,8 @@ def perfcheck(doc, baseline=None, proxy_golden=None, proxy_tol=0.5,
               store_golden=None, store_tol=0.6, tuner_golden=None,
               tuner_tol=0.25, mxu_golden=None, mxu_tol=0.2,
               replay_golden=None, replay_tol=0.0,
-              fleet_golden=None, fleet_tol=0.05):
+              fleet_golden=None, fleet_tol=0.05,
+              anim_golden=None, anim_tol=0.2):
     """Compare a bench JSON against the last-good baseline and the
     committed proxy golden.  Returns ``(rc, lines)`` — rc 0 when nothing
     regressed beyond its tolerance band, 1 on regression (including a
@@ -548,6 +557,17 @@ def perfcheck(doc, baseline=None, proxy_golden=None, proxy_tol=0.5,
     compile-stage speedup >= ``max(golden * 0.4, 1.0)`` (wide band —
     disk + interpreter timing — but a warm start that does not beat a
     cold compile is a broken executable tier regardless).
+
+    ``anim_golden`` grades the anim_proxy stage (doc/animation.md): its
+    value is the refit-over-rebuild SPEEDUP per animation frame (>1
+    means skipping the Morton re-sort pays).  The band floor is
+    ``max(golden * (1 - anim_tol), 1.0)`` — interpreter timing is
+    noisy, but a refit that loses to rebuilding from scratch is a
+    broken animation tier regardless of what the golden said.  The
+    traversal checksum covers every frame's query answers through the
+    refit index and drift is a hard FAIL — refit boxes are allowed to
+    be looser than fresh-build boxes, the *answers* are not allowed to
+    differ by one ulp.
     """
     lines = []
     rc = 0
@@ -867,6 +887,51 @@ def perfcheck(doc, baseline=None, proxy_golden=None, proxy_tol=0.5,
     elif cand_fleet is not None:
         lines.append("note: fleet record present but no golden to "
                      "compare against (record one: make fleet-golden)")
+
+    anim_gold = None
+    if anim_golden:
+        anim_gold = (extract_records(anim_golden)["anim"]
+                     or (anim_golden
+                         if anim_golden.get("value") is not None
+                         else None))
+    cand_anim = recs["anim"]
+    if anim_gold is not None:
+        if cand_anim is None:
+            rc = 1
+            lines.append(
+                "FAIL anim: candidate carries no anim_proxy record (a "
+                "golden exists — the chip-free refit-vs-rebuild metric "
+                "must always be fresh)")
+        else:
+            floor = max(anim_gold["value"] * (1.0 - anim_tol), 1.0)
+            verdict = "ok" if cand_anim["value"] >= floor else "FAIL"
+            if verdict == "FAIL":
+                rc = 1
+            lines.append(
+                "%s anim refit speedup (rebuild/refit): %.3fx vs "
+                "golden %.3fx (floor %.3fx, tol %.0f%%, hard floor "
+                "1.0x)" % (verdict, cand_anim["value"],
+                           anim_gold["value"], floor, 100 * anim_tol))
+            cand_sum = cand_anim.get("checksum")
+            gold_sum = anim_gold.get("checksum")
+            if cand_sum is None:
+                rc = 1
+                lines.append(
+                    "FAIL anim: candidate record carries no traversal "
+                    "checksum — refit exactness unproven")
+            elif gold_sum is not None:
+                same = abs(cand_sum - gold_sum) <= 1e-6 * max(
+                    1.0, abs(gold_sum))
+                if not same:
+                    rc = 1
+                lines.append(
+                    "%s anim traversal checksum: %.6f vs golden %.6f "
+                    "(exact — drift means the refit index answered "
+                    "differently from a fresh build)"
+                    % ("ok" if same else "FAIL", cand_sum, gold_sum))
+    elif cand_anim is not None:
+        lines.append("note: anim record present but no golden to "
+                     "compare against (record one: make anim-golden)")
 
     golden_rec = None
     if proxy_golden:

@@ -46,7 +46,8 @@ __all__ = [
     "load_trace", "write_trace", "trace_lines",
     "admission_events", "sequence_checksum", "null_replay",
     "shadow_rows", "attach_stage_stats",
-    "synthesize", "SYNTH_KINDS", "synth_steady", "synth_stampede",
+    "synthesize", "SYNTH_KINDS", "synth_anim", "synth_steady",
+    "synth_stampede",
     "synth_bucket_ladder", "synth_prune_defeat", "synth_degenerate",
     "synth_mix", "concat_traces", "capture_row", "reset_capture",
 ]
@@ -540,6 +541,31 @@ def synth_degenerate(rate_qps=10.0, duration_s=2.0, q=256,
     return _mk_trace(records, "synth:degenerate")
 
 
+def synth_anim(sessions=6, hz=30.0, frames=90, q=128, seed=5):
+    """Avatar-stream traffic: ``sessions`` fixed-topology streams each
+    admitting one frame per ``1/hz`` with a hard per-frame deadline of
+    exactly the frame budget — the periodic deadline-hard arrival
+    process animated meshes present (serve/loadgen.run_periodic is the
+    live twin of this trace).  Streams are phase-offset within one
+    frame interval, so ticks interleave instead of stampeding; the
+    ``anim_periodic`` shape tag tells replay harnesses to regenerate
+    per-frame vertex deltas to match (doc/animation.md)."""
+    rng = random.Random(seed)
+    interval = 1.0 / float(hz)
+    records = []
+    for s in range(int(sessions)):
+        phase = rng.random() * interval
+        for k in range(int(frames)):
+            records.append({
+                "t": phase + k * interval,
+                "tenant": "avatar-%d" % s,
+                "op": "anim_frame", "q": int(q),
+                "deadline_s": float(interval), "priority": 0,
+                "shape": "anim_periodic", "frame": k,
+            })
+    return _mk_trace(records, "synth:anim")
+
+
 def concat_traces(traces, gap_s=0.5, source=None):
     """Compose traces end to end (each shifted past the previous one's
     last admission plus ``gap_s``) — how adversarial mixes are built
@@ -576,6 +602,7 @@ SYNTH_KINDS = {
     "bucket_ladder": synth_bucket_ladder,
     "prune_defeat": synth_prune_defeat,
     "degenerate": synth_degenerate,
+    "anim": synth_anim,
     "mix": synth_mix,
 }
 

@@ -14,8 +14,9 @@ package makes MANY streams safe to run against it:
 - **health** — ``HealthMonitor``: a non-blocking dispatch-latency
   watchdog driving the load-shed state machine
   healthy -> degraded -> draining (liveness/readiness for probes);
-- **loadgen** — closed- and open-loop load generation reporting
-  p50/p95/p99, goodput, shed rate, deadline-miss rate
+- **loadgen** — closed-, open-, periodic-, and replay-loop load
+  generation reporting p50/p95/p99, goodput, shed rate, deadline-miss
+  rate, and (periodic) the deadline-hard frame-miss rate
   (bench.py --serve-load, guarded by tests/test_bench_guard.py).
 
 Everything records into the obs registry (``serve.*`` span names,
@@ -48,6 +49,7 @@ from .loadgen import (  # noqa: F401
     percentile,
     run_closed_loop,
     run_open_loop,
+    run_periodic,
     run_trace_replay,
 )
 from .service import (  # noqa: F401
@@ -63,6 +65,7 @@ __all__ = [
     "Deadline", "Rung", "ServeResult", "call_with_timeout",
     "default_ladder", "run_with_ladder",
     "HealthMonitor", "HEALTHY", "DEGRADED", "DRAINING", "STATE_NAMES",
-    "percentile", "run_closed_loop", "run_open_loop", "run_trace_replay",
+    "percentile", "run_closed_loop", "run_open_loop", "run_periodic",
+    "run_trace_replay",
     "ServeRejected", "DeadlineExceeded", "EngineShutdown",
 ]

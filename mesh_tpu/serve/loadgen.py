@@ -1,6 +1,6 @@
 """Load generation for the serving tier (bench.py --serve-load).
 
-Three generator shapes, because they answer different questions:
+Four generator shapes, because they answer different questions:
 
 - **closed loop** (``run_closed_loop``): N client threads, each issuing
   the next request the moment the previous one answers.  Concurrency is
@@ -18,6 +18,12 @@ Three generator shapes, because they answer different questions:
   and tenant/deadline/priority spread included, optionally time-warped
   by ``speed``.  The report carries a deterministic admission-sequence
   checksum, so "same trace twice ⇒ same sequence" is machine-checkable.
+- **periodic** (``run_periodic``): N avatar-stream sessions each
+  submitting at a fixed frame rate (30–60 Hz) with a hard per-frame
+  deadline (default: the frame budget, ``1/hz``).  Arrivals are
+  phase-staggered and deadline-hard — a frame that misses its budget is
+  *lost*, not late — so the headline number is ``frame_miss_rate``, the
+  animation-serving acceptance metric (doc/animation.md).
 
 All three return one JSON-able report: latency percentiles over
 *successful* responses, goodput (ok responses per *paced* second — the
@@ -37,7 +43,7 @@ from ..errors import DeadlineExceeded, ServeRejected
 from ..obs.clock import monotonic, sleep as _sleep
 
 __all__ = ["percentile", "run_closed_loop", "run_open_loop",
-           "run_trace_replay"]
+           "run_periodic", "run_trace_replay"]
 
 
 def percentile(values, q):
@@ -204,6 +210,71 @@ def run_open_loop(service, mesh, points, rate_qps=50.0, duration_s=2.0,
     report = tally.report(paced_s, wall_s=clock() - t0)
     report["loop"] = "open"
     report["rate_qps"] = float(rate_qps)
+    return report
+
+
+def run_periodic(service, mesh, points, sessions=4, hz=30.0,
+                 frames_per_session=30, deadline_s=None, tenant_fn=None,
+                 priority=0, collect_timeout_s=30.0, clock=None,
+                 sleep=None):
+    """Deadline-hard periodic arrivals: ``sessions`` avatar streams,
+    each submitting one frame every ``1/hz`` seconds with a hard
+    per-frame deadline (default: exactly the frame budget ``1/hz``).
+
+    Sessions are phase-staggered across one frame interval (session
+    ``i`` starts at ``i/(sessions*hz)``), so a frame tick never lands
+    every stream on the queue at once — the arrival process real
+    multi-avatar traffic presents.  Arrivals are open-loop: a slow
+    service cannot slow the frame clock, it can only miss deadlines.
+    The report adds ``frame_miss_rate`` (deadline failures + late
+    responses, over frames issued) — the animation acceptance number —
+    plus the pacing parameters.  Fake ``clock``/``sleep`` make it
+    deterministic in tests, like the other paced loops."""
+    clock = monotonic if clock is None else clock
+    sleep = _sleep if sleep is None else sleep
+    hz = float(hz)
+    if hz <= 0:
+        raise ValueError("hz must be > 0 (got %s)" % hz)
+    interval = 1.0 / hz
+    if deadline_s is None:
+        deadline_s = interval
+    if tenant_fn is None:
+        def tenant_fn(i):
+            return "avatar-%d" % i
+    # merged (offset, tenant) schedule, one entry per frame
+    schedule = sorted(
+        (s * interval / max(sessions, 1) + k * interval, tenant_fn(s))
+        for s in range(sessions) for k in range(frames_per_session))
+    tally = _Tally()
+    futures = []
+    t0 = clock()
+    for offset, tenant in schedule:
+        wait = t0 + offset - clock()
+        if wait > 0:
+            sleep(wait)
+        try:
+            futures.append(service.submit(mesh, points, tenant=tenant,
+                                          priority=priority,
+                                          deadline_s=deadline_s))
+        except Exception as e:          # noqa: BLE001 — tallied, not raised
+            tally.record_error(e)
+    paced_s = clock() - t0
+    for fut in futures:
+        try:
+            tally.record_response(fut.result(timeout=collect_timeout_s))
+        except Exception as e:          # noqa: BLE001 — tallied, not raised
+            tally.record_error(e)
+    report = tally.report(paced_s, wall_s=clock() - t0)
+    report["loop"] = "periodic"
+    report["sessions"] = int(sessions)
+    report["hz"] = hz
+    report["frames_per_session"] = int(frames_per_session)
+    # deadline-hard framing: a shed, errored, expired, or late frame is
+    # a LOST frame — only on-time ok responses render
+    with tally.lock:
+        lost = tally.shed + tally.errors + tally.deadline + tally.misses
+    issued = report["requests"]
+    report["frame_miss_rate"] = round(lost / issued, 4) if issued else 0.0
     return report
 
 

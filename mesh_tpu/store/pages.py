@@ -35,13 +35,15 @@ def _metrics():
 
 
 class PageCache(object):
-    """Byte-budgeted LRU of StoredMesh objects keyed by digest."""
+    """Byte-budgeted LRU of StoredMesh objects keyed by
+    ``(digest, tier)`` — exact, compact, and anim delta-frame tiers of
+    one digest are independent pages."""
 
     def __init__(self, budget_bytes=None, store=None):
         self._budget = budget_bytes
         self._store = store
         self._lock = threading.Lock()
-        self._cache = OrderedDict()          # digest -> StoredMesh
+        self._cache = OrderedDict()          # (digest, tier) -> StoredMesh
         self._bytes = 0
 
     @property
@@ -65,10 +67,11 @@ class PageCache(object):
         admission already happened, the serve tier maps these to a
         request error."""
         hits, misses, gauge = _metrics()
+        key = (digest, tier)
         with self._lock:
-            mesh = self._cache.get(digest)
-            if mesh is not None and mesh.tier == tier:
-                self._cache.move_to_end(digest)
+            mesh = self._cache.get(key)
+            if mesh is not None:
+                self._cache.move_to_end(key)
                 hits.inc()
                 return mesh, "resident"
         misses.inc()
@@ -76,10 +79,10 @@ class PageCache(object):
             mesh = self._get_store().open(digest, tier=tier)
         nbytes = mesh.nbytes()
         with self._lock:
-            prev = self._cache.pop(digest, None)
+            prev = self._cache.pop(key, None)
             if prev is not None:
                 self._bytes -= prev.nbytes()
-            self._cache[digest] = mesh
+            self._cache[key] = mesh
             self._bytes += nbytes
             budget = self.budget_bytes
             while self._bytes > budget and len(self._cache) > 1:
@@ -94,9 +97,9 @@ class PageCache(object):
                 self._cache.clear()
                 self._bytes = 0
             else:
-                old = self._cache.pop(digest, None)
-                if old is not None:
-                    self._bytes -= old.nbytes()
+                # every resident tier/frame of the digest goes at once
+                for key in [k for k in self._cache if k[0] == digest]:
+                    self._bytes -= self._cache.pop(key).nbytes()
             _metrics()[2].set(float(self._bytes))
 
     def info(self):
@@ -105,7 +108,7 @@ class PageCache(object):
                 "entries": len(self._cache),
                 "bytes": int(self._bytes),
                 "budget_bytes": self.budget_bytes,
-                "digests": list(self._cache),
+                "digests": sorted({k[0] for k in self._cache}),
             }
 
 

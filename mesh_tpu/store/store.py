@@ -2,11 +2,12 @@
 
 Layout under one root (``MESH_TPU_STORE_DIR``)::
 
-    <root>/objects/<digest>/manifest.json      object manifest (schema 1)
+    <root>/objects/<digest>/manifest.json      object manifest (schema 2)
     <root>/objects/<digest>/exact/v_0000.npy   chunked exact-tier blocks
     <root>/objects/<digest>/compact/v_0000.npy quantized uint16 blocks
     <root>/objects/<digest>/sidecar/<tag>/     serialized AccelIndex
     <root>/objects/<digest>/last_used          LRU touch file (gc order)
+    <root>/sequences/<digest>/<seq>/           anim delta frames (deltas.py)
     <root>/tmp/<digest>.<pid>.<n>/             staging (same filesystem)
 
 Publishing is write-then-rename: an object is staged complete under
@@ -41,8 +42,9 @@ __all__ = [
     "MANIFEST_SCHEMA_VERSION",
 ]
 
-#: manifest.json schema (bump on breaking shape changes)
-MANIFEST_SCHEMA_VERSION = 1
+#: manifest.json schema (bump on breaking shape changes); 2 adds the
+#: ``anim_sequence`` manifest family under ``sequences/`` (store/deltas.py)
+MANIFEST_SCHEMA_VERSION = 2
 
 _STAGE_LOCK = threading.Lock()
 _STAGE_SEQ = [0]
@@ -77,7 +79,8 @@ def _metrics():
         "ingest": REGISTRY.counter(
             "mesh_tpu_store_ingest_total",
             "Meshes published into the store (label: tier — exact objects "
-            "always, compact when the quantized tier is written)."),
+            "always, compact when the quantized tier is written, anim per "
+            "delta sequence)."),
         "dedupe": REGISTRY.counter(
             "mesh_tpu_store_dedupe_total",
             "Ingests that found the digest already published (no bytes "
@@ -90,7 +93,8 @@ def _metrics():
             "aot_crc)."),
         "gc": REGISTRY.counter(
             "mesh_tpu_store_gc_deleted_total",
-            "Objects deleted by the size-budgeted LRU gc."),
+            "Objects and anim sequences deleted by the size-budgeted LRU "
+            "gc."),
         "sidecar_writes": REGISTRY.counter(
             "mesh_tpu_store_sidecar_writes_total",
             "AccelIndex side-cars persisted next to store objects "
@@ -374,10 +378,21 @@ class MeshStore(object):
         """A :class:`StoredMesh` for ``digest``.  ``tier="exact"`` is a
         bit-identical (mmap-backed when single-block) view; ``compact``
         dequantizes the uint16 tier to float32 within the manifest's
-        stated tolerance.  Every block CRC is checked unless
+        stated tolerance; ``anim:<sequence>:<frame>`` reconstructs one
+        animation frame from the keyframe plus its quantized delta
+        (store/deltas.py).  Every block CRC is checked unless
         ``MESH_TPU_STORE_VERIFY`` (or ``verify=``) turns it off."""
         if verify is None:
             verify = knobs.flag("MESH_TPU_STORE_VERIFY")
+        if isinstance(tier, str) and tier.startswith("anim:"):
+            from . import deltas as deltas_mod
+
+            t0 = monotonic()
+            with obs_span("store.open", digest=digest, tier=tier):
+                mesh = deltas_mod.open_frame(self, digest, tier,
+                                             verify=verify, mmap=mmap)
+            _metrics()["open_hist"].observe(monotonic() - t0, tier="anim")
+            return mesh
         t0 = monotonic()
         with obs_span("store.open", digest=digest, tier=tier):
             manifest = self.manifest(digest)
@@ -407,7 +422,9 @@ class MeshStore(object):
                 verts = (np.concatenate(parts, axis=0) if parts
                          else np.zeros((0, 3), np.float32))
             else:
-                raise StoreError("unknown tier %r (exact|compact)" % tier)
+                raise StoreError(
+                    "unknown tier %r (exact|compact|anim:<seq>:<frame>)"
+                    % tier)
         self._touch(digest)
         _metrics()["open_hist"].observe(monotonic() - t0, tier=tier)
         return StoredMesh(verts, faces, digest, tier, manifest)
@@ -464,7 +481,129 @@ class MeshStore(object):
         return int(total)
 
     def total_bytes(self):
-        return int(sum(self.object_bytes(d) for d in self.ls()))
+        return int(sum(self.object_bytes(d) for d in self.ls())
+                   + sum(self.sequence_bytes(d, s)
+                         for d, s in self.list_sequences()))
+
+    # -- anim sequences (codec lives in deltas.py) ---------------------
+
+    @property
+    def sequences_dir(self):
+        return os.path.join(self.root, "sequences")
+
+    def sequence_dir(self, digest, sequence_id):
+        from . import deltas as deltas_mod
+
+        self._check_key(digest)
+        deltas_mod.check_sequence_id(sequence_id)
+        return os.path.join(self.sequences_dir, digest, sequence_id)
+
+    def list_sequences(self, digest=None):
+        """Published ``(digest, sequence_id)`` pairs, oldest-LRU
+        first (restricted to one keyframe digest when given)."""
+        try:
+            digests = [digest] if digest else sorted(
+                os.listdir(self.sequences_dir))
+        except FileNotFoundError:
+            return []
+        out = []
+        for d in digests:
+            base = os.path.join(self.sequences_dir, d)
+            try:
+                names = sorted(os.listdir(base))
+            except OSError:
+                continue
+            out.extend(
+                (d, s) for s in names
+                if os.path.isfile(os.path.join(base, s, "manifest.json")))
+        out.sort(key=lambda ds: self._seq_last_used(*ds))
+        return out
+
+    def sequence_manifest(self, digest, sequence_id, missing_ok=False):
+        """The parsed sequence manifest; StoreError when absent (None
+        with ``missing_ok``), StoreCorrupt (counted + flight-recorded)
+        when unreadable or key-drifted."""
+        path = os.path.join(self.sequence_dir(digest, sequence_id),
+                            "manifest.json")
+        if not os.path.isfile(path):
+            if missing_ok:
+                return None
+            raise StoreError("no sequence %s/%s in store %s"
+                             % (digest, sequence_id, self.root))
+        try:
+            with open(path) as fh:
+                manifest = json.load(fh)
+        except (OSError, ValueError) as exc:
+            report_corrupt("manifest", digest, str(exc))
+            raise StoreCorrupt(
+                "sequence %s/%s manifest unreadable: %s"
+                % (digest, sequence_id, exc), what="manifest",
+                digest=digest)
+        if (manifest.get("kind") != "anim_sequence"
+                or manifest.get("digest") != digest
+                or manifest.get("sequence_id") != sequence_id):
+            detail = ("manifest says %s/%s kind %r"
+                      % (manifest.get("digest"),
+                         manifest.get("sequence_id"),
+                         manifest.get("kind")))
+            report_corrupt("manifest", digest, detail)
+            raise StoreCorrupt(
+                "sequence %s/%s manifest drift (%s)"
+                % (digest, sequence_id, detail), what="manifest",
+                digest=digest)
+        return manifest
+
+    def _publish_sequence(self, stage, digest, sequence_id):
+        dest = self.sequence_dir(digest, sequence_id)
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        try:
+            os.rename(stage, dest)
+        except OSError:
+            # publish race: the sequence is keyed by name, both writers
+            # quantized against the same published keyframe — adopt
+            if self.sequence_manifest(digest, sequence_id,
+                                      missing_ok=True) is None:
+                raise
+        self._touch_sequence(digest, sequence_id)
+
+    def _touch_sequence(self, digest, sequence_id):
+        try:
+            path = os.path.join(self.sequence_dir(digest, sequence_id),
+                                "last_used")
+            with open(path, "a"):
+                os.utime(path, None)
+        except OSError:
+            pass
+
+    def _seq_last_used(self, digest, sequence_id):
+        for name in ("last_used", "manifest.json"):
+            try:
+                return os.path.getmtime(os.path.join(
+                    self.sequence_dir(digest, sequence_id), name))
+            except OSError:
+                continue
+        return 0.0
+
+    def sequence_bytes(self, digest, sequence_id):
+        total = 0
+        for dirpath, _dirs, files in os.walk(
+                self.sequence_dir(digest, sequence_id)):
+            for name in files:
+                try:
+                    total += os.path.getsize(os.path.join(dirpath, name))
+                except OSError:
+                    pass
+        return int(total)
+
+    def delete_sequence(self, digest, sequence_id):
+        shutil.rmtree(self.sequence_dir(digest, sequence_id),
+                      ignore_errors=True)
+        # drop the now-empty per-digest directory so ls-style scans of
+        # sequences/ stay proportional to live sequences
+        try:
+            os.rmdir(os.path.join(self.sequences_dir, digest))
+        except OSError:
+            pass
 
     def verify(self, digest=None, deep=True):
         """Verify one object (or every object): block CRCs, manifest
@@ -479,8 +618,13 @@ class MeshStore(object):
         digests = [digest] if digest else self.ls()
         problems = []
         with obs_span("store.verify", objects=len(digests)):
+            from . import deltas as deltas_mod
+
             for d in digests:
                 problems.extend(self._verify_one(d, deep))
+                for _d, seq in self.list_sequences(d):
+                    problems.extend(
+                        deltas_mod.verify_sequence(self, d, seq))
             if digest is None:
                 # whole-store verify also audits the AOT executable
                 # tier (store/aot.py) living next to the objects
@@ -548,25 +692,62 @@ class MeshStore(object):
         shutil.rmtree(self.object_dir(digest), ignore_errors=True)
 
     def gc(self, budget_bytes=None, dry_run=False):
-        """Size-budgeted LRU gc: delete least-recently-used objects
-        until the corpus fits ``budget_bytes`` (default knob
-        ``MESH_TPU_STORE_GC_MB``).  Returns the deleted digests."""
+        """Size-budgeted, sequence-aware LRU gc: delete least-recently-
+        used objects AND anim sequences until the corpus fits
+        ``budget_bytes`` (default knob ``MESH_TPU_STORE_GC_MB``).
+
+        A keyframe object is never removed while delta sequences still
+        depend on it — evicting the base would orphan every frame — so
+        pinned objects are skipped and whole sequences go oldest-first
+        instead; once a digest's last sequence is gone the keyframe
+        becomes evictable again (same call, second pass).  Returns the
+        deleted keys: digests for objects, ``digest/sequence_id`` for
+        sequences."""
         if budget_bytes is None:
             budget_bytes = int(
                 knobs.get_float("MESH_TPU_STORE_GC_MB") * 1024 * 1024)
         deleted = []
         with obs_span("store.gc", budget_bytes=int(budget_bytes)) as sp:
-            order = self.ls()                     # oldest-LRU first
-            sizes = {d: self.object_bytes(d) for d in order}
-            total = sum(sizes.values())
-            for digest in order:
+            dependents = {}
+            candidates = []
+            for d, s in self.list_sequences():    # oldest-LRU first
+                dependents[d] = dependents.get(d, 0) + 1
+                candidates.append((self._seq_last_used(d, s), d, s,
+                                   self.sequence_bytes(d, s)))
+            for d in self.ls():
+                candidates.append((self._last_used(d), d, None,
+                                   self.object_bytes(d)))
+            candidates.sort(key=lambda c: c[0])
+            total = sum(c[3] for c in candidates)
+
+            def _evict(digest, seq, size):
+                if not dry_run:
+                    if seq is None:
+                        self.delete(digest)
+                    else:
+                        self.delete_sequence(digest, seq)
+                    _metrics()["gc"].inc()
+                deleted.append(digest if seq is None
+                               else "%s/%s" % (digest, seq))
+                return size
+
+            pinned = []
+            for _t, digest, seq, size in candidates:
                 if total <= budget_bytes:
                     break
-                if not dry_run:
-                    self.delete(digest)
-                    _metrics()["gc"].inc()
-                deleted.append(digest)
-                total -= sizes[digest]
+                if seq is None and dependents.get(digest):
+                    pinned.append((digest, size))
+                    continue
+                total -= _evict(digest, seq, size)
+                if seq is not None:
+                    dependents[digest] -= 1
+            # keyframes whose sequences all died above are fair game now
+            for digest, size in pinned:
+                if total <= budget_bytes:
+                    break
+                if dependents.get(digest):
+                    continue
+                total -= _evict(digest, None, size)
             if not dry_run:
                 _metrics()["bytes"].set(float(total))
             sp.set(deleted=len(deleted), remaining_bytes=int(total))

@@ -362,6 +362,22 @@ FLEET_STATS_DIR = _declare(
     "sink files (each replica writes its own via MESH_TPU_SERVE_STATS).",
     "Fleet")
 
+# -- animation -------------------------------------------------------------
+
+ANIM = _declare(
+    "MESH_TPU_ANIM", "flag", True,
+    "Dynamic-mesh subsystem kill switch (mesh_tpu/anim/): on (default) "
+    "avatar sessions answer each frame with a frozen-order BVH refit "
+    "(rebuild only on inflation trips); off rebuilds the index cold per "
+    "frame through get_index — bit-identical to the pre-anim path.",
+    "Animation")
+ANIM_REFIT_MAX_INFLATION = _declare(
+    "MESH_TPU_ANIM_REFIT_MAX_INFLATION", "float", None,
+    "Hard pin for the `anim_refit_max_inflation` tunable: box-inflation "
+    "ratio past which a session's refit trips a full rebuild; setting "
+    "it disables tuner actuation for the threshold (utils/tuning.py).",
+    "Animation")
+
 # -- bench harness ---------------------------------------------------------
 
 BENCH_FAULT = _declare(
@@ -428,6 +444,18 @@ FLEET_PROXY_SEED = _declare(
     "fleet_proxy bench stage: override the synthesized mixed-digest "
     "trace seed (read by bench.py; changing it is expected to change "
     "the committed golden checksums).", "Bench harness")
+ANIM_PROXY_FACES = _declare(
+    "MESH_TPU_ANIM_PROXY_FACES", "int", None,
+    "anim_proxy bench stage: override the proxy mesh face count (read "
+    "by bench.py).", "Bench harness")
+ANIM_PROXY_FRAMES = _declare(
+    "MESH_TPU_ANIM_PROXY_FRAMES", "int", None,
+    "anim_proxy bench stage: override the deformation-loop frame count "
+    "(read by bench.py).", "Bench harness")
+ANIM_PROXY_QUERIES = _declare(
+    "MESH_TPU_ANIM_PROXY_QUERIES", "int", None,
+    "anim_proxy bench stage: override the per-frame query count (read "
+    "by bench.py).", "Bench harness")
 
 
 # -- accessors -------------------------------------------------------------

@@ -112,6 +112,15 @@ SHARD_MIN_Q = _declare_tunable(
     "dispatch through the dp-sharded big-batch lane "
     "(parallel/sharding.py; also gated by MESH_TPU_FLEET_SHARD); None "
     "(default) keeps the lane off — the static single-device path.")
+ANIM_REFIT_MAX_INFLATION = _declare_tunable(
+    "anim_refit_max_inflation", "float", 1.5, 1.05, 4.0, 0.05,
+    "MESH_TPU_ANIM_REFIT_MAX_INFLATION",
+    "Refit/rebuild crossover for avatar sessions (mesh_tpu/anim/): the "
+    "box-inflation ratio (refit boxes vs the fresh boxes captured at "
+    "the last rebuild) past which a frame pays a full host rebuild "
+    "through the digest cache.  1.5 (default) tolerates moderate "
+    "deformation; lower rebuilds more eagerly (better pruning, more "
+    "host work), higher stretches the frozen Morton order further.")
 SERVE_PRE_TRIP = _declare_tunable(
     "serve_pre_trip", "int", 0, 0, 1, 1,
     "MESH_TPU_SERVE_LADDER",

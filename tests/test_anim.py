@@ -1,0 +1,389 @@
+"""mesh_tpu.anim: dynamic meshes — refit, delta tier, avatar sessions.
+
+The load-bearing claims under test (ISSUE 19 acceptance):
+
+- a frozen-order refit answers queries BIT-IDENTICALLY to a fresh
+  rebuild of the same deformed geometry — on smooth deforms and on
+  degenerate collapses (exact distances either way);
+- refitting the keyframe geometry reproduces the build boxes bit for
+  bit, so the inflation ratio anchors at exactly 1.0;
+- the box-inflation bound deterministically trips a rebuild through
+  the digest-keyed cache on an adversarial stretch, and the
+  ``MESH_TPU_ANIM_REFIT_MAX_INFLATION`` pin moves the crossover;
+- the delta tier's manifest tolerance is a TRUE reconstruction bound,
+  frame by frame, block by block;
+- a session teardown without drain closes the in-flight frame's ledger
+  record with outcome ``cancelled`` (the LED001 contract, same shape
+  as the PR 14 serve stop-leak regression);
+- ``MESH_TPU_ANIM=0`` serves frames through the cold pre-anim path
+  (action ``cold``, no ``refit`` stage stamped, same answers).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from mesh_tpu import obs                                   # noqa: E402
+from mesh_tpu.accel.build import (                         # noqa: E402
+    build_bvh,
+    clear_index_cache,
+    get_index,
+)
+from mesh_tpu.accel.traverse import bvh_closest_point      # noqa: E402
+from mesh_tpu.anim import (                                # noqa: E402
+    AvatarSession,
+    RefitState,
+    SessionClosed,
+    box_measure,
+    refit_bvh,
+    refit_max_inflation,
+)
+from mesh_tpu.obs.ledger import get_ledger                 # noqa: E402
+from mesh_tpu.sphere import _icosphere                     # noqa: E402
+from mesh_tpu.store import MeshStore, clear_page_cache     # noqa: E402
+from mesh_tpu.store import deltas                          # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_caches():
+    clear_index_cache()
+    clear_page_cache()
+    yield
+    clear_index_cache()
+    clear_page_cache()
+
+
+@pytest.fixture
+def store(tmp_path, monkeypatch):
+    root = str(tmp_path / "store")
+    monkeypatch.setenv("MESH_TPU_STORE_DIR", root)
+    return MeshStore(root)
+
+
+def _sphere(subdiv=2):
+    v, f = _icosphere(subdiv)
+    return np.asarray(v, np.float32), np.asarray(f, np.int32)
+
+
+def _queries(n=48, seed=0):
+    rng = np.random.RandomState(seed)
+    pts = rng.randn(n, 3)
+    pts /= np.linalg.norm(pts, axis=1, keepdims=True)
+    pts *= 1.0 + 0.1 * rng.randn(n, 1)
+    return np.asarray(pts, np.float32)
+
+
+def _deform(v, seed, amp=0.05):
+    rng = np.random.RandomState(seed)
+    return np.asarray(v + amp * rng.randn(*v.shape), np.float32)
+
+
+# ---------------------------------------------------------------------------
+# refit: exactness and the inflation anchor
+
+
+class TestRefitExactness:
+
+    def test_keyframe_refit_reproduces_build_boxes_bitwise(self):
+        v, f = _sphere()
+        base = build_bvh(v, f)
+        refit, info = refit_bvh(base, v, f)
+        for key in ("node_lo", "node_hi"):
+            assert np.array_equal(np.asarray(base.arrays[key]),
+                                  np.asarray(refit.arrays[key]))
+        # shared-layout arrays are the SAME objects, not copies — that
+        # identity is what keeps the compiled plan reused across frames
+        for key in ("order", "node_skip", "node_leaf", "center"):
+            assert refit.arrays[key] is base.arrays[key]
+        assert refit.digest == base.digest
+        assert info["box_measure"] == pytest.approx(box_measure(
+            base.arrays["node_lo"], base.arrays["node_hi"]))
+
+    @pytest.mark.parametrize("seed", [1, 2, 3])
+    def test_random_deform_traversal_bit_identical_to_rebuild(self, seed):
+        v, f = _sphere()
+        base = build_bvh(v, f)
+        v2 = _deform(v, seed)
+        refit, _ = refit_bvh(base, v2, f)
+        fresh = build_bvh(v2, f)
+        pts = _queries(seed=seed)
+        out_r = bvh_closest_point(v2, f, pts, index=refit)
+        out_b = bvh_closest_point(v2, f, pts, index=fresh)
+        for key in ("face", "point", "sqdist"):
+            assert np.array_equal(np.asarray(out_r[key]),
+                                  np.asarray(out_b[key])), key
+
+    @pytest.mark.parametrize("mode", ["collapse", "planar", "needle"])
+    def test_degenerate_deform_distances_stay_exact(self, mode):
+        """Degenerate deforms (all vertices coincident, flattened to a
+        plane, stretched to a needle) massively inflate the frozen-order
+        boxes — pruning decays, EXACTNESS must not.  Closest faces can
+        legitimately tie under a collapse, so the bitwise claim is on
+        the squared distances (the min over an identical multiset)."""
+        v, f = _sphere()
+        base = build_bvh(v, f)
+        if mode == "collapse":
+            v2 = np.zeros_like(v)
+        elif mode == "planar":
+            v2 = v.copy()
+            v2[:, 2] = 0.0
+        else:
+            v2 = v * np.asarray([[1e3, 1e-3, 1e-3]], np.float32)
+        refit, _ = refit_bvh(base, v2, f)
+        fresh = build_bvh(v2, f)
+        pts = _queries()
+        out_r = bvh_closest_point(v2, f, pts, index=refit)
+        out_b = bvh_closest_point(v2, f, pts, index=fresh)
+        assert np.array_equal(np.asarray(out_r["sqdist"]),
+                              np.asarray(out_b["sqdist"]))
+
+    def test_refit_rejects_non_bvh_index(self):
+        v, f = _sphere(1)
+        grid = get_index(v, f, kind="grid")
+        with pytest.raises(ValueError, match="bvh"):
+            refit_bvh(grid, v, f)
+
+
+# ---------------------------------------------------------------------------
+# the inflation bound and its rebuild trip
+
+
+class TestInflationTrip:
+
+    def test_adversarial_stretch_trips_rebuild_deterministically(self):
+        v, f = _sphere()
+        state = RefitState(build_bvh(v, f), f)
+        obs.reset()
+        # frame 1: a gentle deform refits and tracks a finite ratio
+        _idx, action = state.advance(_deform(v, 7, amp=0.01))
+        assert action == "refit"
+        assert state.inflation >= 1.0
+        # frame 2: an adversarial 20x stretch inflates the frozen-order
+        # boxes far past any sane crossover — must rebuild and re-anchor
+        stretched = np.asarray(v * 20.0, np.float32)
+        idx, action = state.advance(stretched, max_inflation=1.5)
+        assert action == "rebuild"
+        assert state.inflation == 1.0
+        assert state.rebuilds == 1 and state.refits == 1
+        from mesh_tpu.obs.metrics import REGISTRY
+
+        assert REGISTRY.get("mesh_tpu_anim_rebuilds_total").value(
+            reason="inflation") == 1
+        # the rebuilt index IS the digest-cache entry for the stretched
+        # geometry: replaying the frame rebuilds nothing
+        assert idx is get_index(stretched, f, kind="bvh",
+                                leaf_size=state.leaf_size)
+        # and refitting from the re-anchored reference is clean again
+        _idx, action = state.advance(
+            np.asarray(stretched * 1.001, np.float32), max_inflation=1.5)
+        assert action == "refit"
+
+    def test_env_pin_moves_the_crossover(self, monkeypatch):
+        monkeypatch.setenv("MESH_TPU_ANIM_REFIT_MAX_INFLATION", "3.5")
+        assert refit_max_inflation() == pytest.approx(3.5)
+        v, f = _sphere(1)
+        state = RefitState(build_bvh(v, f), f)
+        # a pin high above the measured ratio keeps even a big deform
+        # on the refit path
+        monkeypatch.setenv("MESH_TPU_ANIM_REFIT_MAX_INFLATION", "4.0")
+        _idx, action = state.advance(np.asarray(v * 1.5, np.float32))
+        assert action == "refit"
+
+
+# ---------------------------------------------------------------------------
+# delta tier: the manifest tolerance is a true bound
+
+
+class TestDeltaTrueBound:
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_manifest_tolerance_bounds_reconstruction(self, store, seed):
+        rng = np.random.default_rng(seed)
+        v, f = _sphere()
+        scale = float(rng.uniform(0.01, 50.0))
+        v = np.asarray(v * scale, np.float32)
+        digest = store.ingest(v, f)
+        frames = [np.asarray(v + rng.normal(
+            scale=0.05 * scale, size=v.shape), np.float32)
+            for _ in range(4)]
+        manifest = deltas.write_sequence(store, digest, "walk", frames)
+        assert manifest["schema_version"] == 2
+        assert manifest["kind"] == "anim_sequence"
+        for block in manifest["blocks"]:
+            k = block["frame"]
+            got, faces, _m = deltas.read_frame(store, digest, "walk", k)
+            assert np.array_equal(faces, f)
+            err = float(np.max(np.abs(
+                got.astype(np.float64) - frames[k].astype(np.float64))))
+            assert err <= block["tolerance"], \
+                "frame %d: %.3g > stated %.3g" % (
+                    k, err, block["tolerance"])
+        assert deltas.sequence_tolerance(manifest) == pytest.approx(
+            max(b["tolerance"] for b in manifest["blocks"]))
+        assert store.verify(digest) == []
+
+    def test_anim_tier_opens_through_the_store(self, store):
+        v, f = _sphere(1)
+        digest = store.ingest(v, f)
+        frames = [np.asarray(v * 1.01, np.float32)]
+        deltas.write_sequence(store, digest, "wave", frames)
+        mesh = store.open(digest, tier="anim:wave:0")
+        assert mesh.tier == "anim:wave:0"
+        tol = deltas.sequence_tolerance(
+            store.sequence_manifest(digest, "wave"))
+        assert float(np.max(np.abs(
+            mesh.v.astype(np.float64)
+            - frames[0].astype(np.float64)))) <= tol
+
+
+# ---------------------------------------------------------------------------
+# avatar sessions
+
+
+class TestAvatarSession:
+
+    def test_frame_refits_and_answers_exactly(self):
+        v, f = _sphere()
+        from mesh_tpu import Mesh
+
+        pts = _queries()
+        with AvatarSession(Mesh(v=v, f=f)) as sess:
+            v2 = _deform(v, 11)
+            out = sess.frame(vertices=v2, points=pts)
+            assert out["action"] == "refit"
+            assert out["inflation"] >= 1.0
+            fresh = build_bvh(v2, f)
+            ref = bvh_closest_point(v2, f, pts, index=fresh)
+            for key in ("points", "sqdist"):
+                ref_key = "point" if key == "points" else key
+                assert np.array_equal(np.asarray(out[key]),
+                                      np.asarray(ref[ref_key])), key
+            assert sess.routing_key is not None
+            row = [r for r in get_ledger().records()
+                   if r.get("tenant") == sess.session_id][-1]
+            assert row["outcome"] == "ok"
+            assert "refit" in row["stages"]
+
+    def test_delta_and_vertices_are_exclusive(self):
+        v, f = _sphere(1)
+        from mesh_tpu import Mesh
+
+        with AvatarSession(Mesh(v=v, f=f)) as sess:
+            with pytest.raises(ValueError, match="exactly one"):
+                sess.frame()
+            with pytest.raises(ValueError, match="exactly one"):
+                sess.frame(delta=np.zeros_like(v), vertices=v)
+            with pytest.raises(ValueError, match="shape"):
+                sess.frame(delta=np.zeros((3, 3), np.float32))
+
+    def test_kill_switch_serves_cold_frames(self, monkeypatch):
+        monkeypatch.setenv("MESH_TPU_ANIM", "0")
+        v, f = _sphere()
+        from mesh_tpu import Mesh
+
+        pts = _queries()
+        with AvatarSession(Mesh(v=v, f=f)) as sess:
+            v2 = _deform(v, 13)
+            out = sess.frame(vertices=v2, points=pts)
+            assert out["action"] == "cold"
+            assert out["inflation"] is None
+            # the cold path is the pre-anim path bit for bit: a digest-
+            # keyed get_index build, traversed exactly
+            ref = bvh_closest_point(
+                v2, f, pts, index=get_index(v2, f, kind="bvh"))
+            assert np.array_equal(np.asarray(out["sqdist"]),
+                                  np.asarray(ref["sqdist"]))
+            row = [r for r in get_ledger().records()
+                   if r.get("tenant") == sess.session_id][-1]
+            assert "refit" not in row["stages"]
+
+    def test_stop_without_drain_closes_ledger_record_cancelled(self):
+        """Teardown leak regression, the AvatarSession twin of
+        test_serve.py::test_service_stop_without_drain_closes_ledger_
+        records: a client that vanishes mid-frame must leave the frame's
+        ledger record CLOSED with outcome ``cancelled`` (LED001), never
+        dangling open."""
+        v, f = _sphere(1)
+        from mesh_tpu import Mesh
+
+        sess = AvatarSession(Mesh(v=v, f=f),
+                             session_id="anim-stop-no-drain")
+        sess.hold()             # park the frame before record close
+        done = threading.Event()
+
+        def run():
+            sess.frame(vertices=_deform(v, 17), points=_queries(8))
+            done.set()
+
+        t = threading.Thread(target=run)
+        t.start()
+        # deterministic: wait until the frame is computed and parked
+        for _ in range(2000):
+            if sess._inflight:
+                break
+            t.join(0.005)
+        assert sess._inflight, "frame never reached the hold fence"
+        sess.stop(drain=False)
+        t.join(10.0)
+        assert done.is_set()
+        rows = [r for r in get_ledger().records()
+                if r.get("tenant") == "anim-stop-no-drain"]
+        assert len(rows) == 1
+        assert rows[0]["outcome"] == "cancelled"
+        with pytest.raises(SessionClosed):
+            sess.frame(vertices=v)
+
+    def test_deadline_miss_counts_and_closes_deadline(self):
+        v, f = _sphere(1)
+        from mesh_tpu import Mesh
+
+        with AvatarSession(Mesh(v=v, f=f)) as sess:
+            out = sess.frame(vertices=_deform(v, 19), points=_queries(8),
+                             deadline_s=1e-9)
+            assert out["deadline_missed"]
+            assert sess.deadline_misses == 1
+            row = [r for r in get_ledger().records()
+                   if r.get("tenant") == sess.session_id][-1]
+            assert row["outcome"] == "deadline"
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: a multi-frame stream off the store (minutes-scale on CPU)
+
+
+@pytest.mark.slow
+def test_session_stream_from_store_end_to_end(store):
+    """Full avatar stream: keyframe ingested, deltas published to the
+    sequence tier, session opened from the digest, every frame decoded
+    from the store and served with answers bit-identical to a fresh
+    rebuild of the decoded geometry, metrics and stats consistent."""
+    v, f = _sphere(3)
+    digest = store.ingest(v, f)
+    rng = np.random.default_rng(23)
+    frames = [np.asarray(v * (1.0 + 0.02 * (k + 1))
+                         + rng.normal(scale=0.01, size=v.shape),
+                         np.float32)
+              for k in range(6)]
+    deltas.write_sequence(store, digest, "run", frames)
+    pts = _queries(64, seed=5)
+    with AvatarSession(digest=digest, store=store) as sess:
+        for k in range(len(frames)):
+            decoded, _faces, _m = deltas.read_frame(store, digest,
+                                                    "run", k)
+            out = sess.frame(vertices=decoded, points=pts)
+            assert out["action"] in ("refit", "rebuild")
+            fresh = build_bvh(decoded, f)
+            ref = bvh_closest_point(decoded, f, pts, index=fresh)
+            assert np.array_equal(np.asarray(out["sqdist"]),
+                                  np.asarray(ref["sqdist"])), (
+                "frame %d diverged" % k)
+        stats = sess.stats()
+        assert stats["frames"] == len(frames)
+        assert stats["refits"] + stats["rebuilds"] >= len(frames)
+        assert stats["routing_key"] is not None
+    rows = [r for r in get_ledger().records()
+            if r.get("tenant") == sess.session_id]
+    assert rows and all(r["outcome"] == "ok" for r in rows)

@@ -694,6 +694,89 @@ def test_open_loop_goodput_over_paced_window():
     assert report["goodput_qps"] == pytest.approx(10 / 0.9, abs=0.01)
 
 
+def test_periodic_loop_phase_stagger_and_deadline_default():
+    """run_periodic is open-loop frame pacing: sessions are staggered
+    across one frame interval (a tick never lands every stream at
+    once), every submit carries the hard per-frame deadline (default
+    exactly the 1/hz frame budget), and the report adds the
+    deadline-hard framing fields.  Pinned under a fake clock."""
+    import types
+
+    from mesh_tpu.serve import run_periodic
+
+    t = [0.0]
+    seen = []
+
+    class _Future(object):
+        def result(self, timeout=None):
+            return types.SimpleNamespace(
+                latency_s=0.01, rung="ok", retries=0,
+                deadline_missed=False, approximate=False)
+
+    class _StubService(object):
+        def submit(self, mesh, points, tenant=None, priority=0,
+                   deadline_s=None):
+            seen.append((round(t[0], 6), tenant, deadline_s))
+            return _Future()
+
+    report = run_periodic(
+        _StubService(), _MESH, _PTS, sessions=2, hz=10.0,
+        frames_per_session=3,
+        clock=lambda: t[0], sleep=lambda dt: t.__setitem__(0, t[0] + dt))
+    # session 0 ticks at 0.0/0.1/0.2, session 1 phase-shifted by half an
+    # interval at 0.05/0.15/0.25 — merged in arrival order
+    assert [(off, ten) for off, ten, _ in seen] == [
+        (0.0, "avatar-0"), (0.05, "avatar-1"),
+        (0.1, "avatar-0"), (0.15, "avatar-1"),
+        (0.2, "avatar-0"), (0.25, "avatar-1")]
+    assert all(d == pytest.approx(0.1) for _, _, d in seen)
+    assert report["loop"] == "periodic"
+    assert report["sessions"] == 2 and report["hz"] == 10.0
+    assert report["frames_per_session"] == 3
+    assert report["requests"] == 6 and report["ok"] == 6
+    assert report["frame_miss_rate"] == 0.0
+    assert report["paced_s"] == pytest.approx(0.25)
+
+
+def test_periodic_loop_counts_lost_frames():
+    """A shed, errored, expired, or late frame is a LOST frame: the
+    miss rate folds every failure mode in, not just deadline raises."""
+    import types
+
+    from mesh_tpu.errors import ServeRejected
+    from mesh_tpu.serve import run_periodic
+
+    t = [0.0]
+    calls = [0]
+
+    class _Future(object):
+        def __init__(self, late):
+            self.late = late
+
+        def result(self, timeout=None):
+            return types.SimpleNamespace(
+                latency_s=0.5 if self.late else 0.01, rung="ok",
+                retries=0, deadline_missed=self.late,
+                approximate=False)
+
+    class _FlakyService(object):
+        def submit(self, mesh, points, **kw):
+            calls[0] += 1
+            if calls[0] == 1:
+                raise ServeRejected("full", retry_after=0.1)
+            return _Future(late=(calls[0] == 2))
+
+    report = run_periodic(
+        _FlakyService(), _MESH, _PTS, sessions=1, hz=10.0,
+        frames_per_session=4,
+        clock=lambda: t[0], sleep=lambda dt: t.__setitem__(0, t[0] + dt))
+    # 4 issued: 1 shed at submit, 1 answered late, 2 on time
+    assert report["requests"] == 4
+    assert report["shed"] == 1
+    assert report["deadline_miss_rate"] == pytest.approx(0.25)
+    assert report["frame_miss_rate"] == pytest.approx(0.5)
+
+
 def test_loadgen_failed_rungs_provenance():
     """A DeadlineExceeded raised by ladder exhaustion carries the last
     rung attempted, and the loadgen report surfaces the histogram under

@@ -534,6 +534,78 @@ class TestGC:
         assert store.gc(budget_bytes=1 << 40) == []
 
 
+class TestSequenceGC:
+    """Sequence-aware gc: a keyframe object is never evicted while
+    delta sequences still depend on it (orphaned frames would be
+    undecodable) — whole sequences go oldest-first instead, and a
+    keyframe freed by its last sequence's eviction is collectable in
+    the SAME call (second pass)."""
+
+    def _mesh(self, seed):
+        return _soup(seed, n_v=400, n_f=800)
+
+    def _with_sequence(self, store, seed=70, seq="walk", n_frames=3):
+        from mesh_tpu.store import deltas
+
+        v, f = self._mesh(seed)
+        digest = store.ingest(v, f)
+        frames = [np.asarray(v + 0.01 * (k + 1), np.float32)
+                  for k in range(n_frames)]
+        deltas.write_sequence(store, digest, seq, frames)
+        return digest
+
+    def test_keyframe_pinned_while_sequence_lives(self, store):
+        d_key = self._with_sequence(store)          # oldest object
+        v2, f2 = self._mesh(71)
+        d_plain = store.ingest(v2, f2)
+        store._touch(d_plain)                       # plain is newest
+        # budget forces eviction but fits the keyframe alone: the LRU-
+        # oldest keyframe must be SKIPPED (pinned), the sequence and the
+        # plain object evicted instead
+        budget = store.object_bytes(d_key) + 1
+        deleted = store.gc(budget_bytes=budget)
+        assert deleted == ["%s/walk" % d_key, d_plain]
+        assert store.ls() == [d_key]
+        assert store.list_sequences() == []
+        assert store.verify() == []
+
+    def test_keyframe_collected_after_sequences_in_same_call(self, store):
+        d_key = self._with_sequence(store, seed=72)
+        obs.reset()
+        deleted = store.gc(budget_bytes=0)
+        # one call drains everything — sequence first, then the freshly
+        # unpinned keyframe in the second pass
+        assert deleted == ["%s/walk" % d_key, d_key]
+        assert store.ls() == [] and store.list_sequences() == []
+        assert _counter("mesh_tpu_store_gc_deleted_total") == 2
+
+    def test_multiple_sequences_all_must_die_first(self, store):
+        from mesh_tpu.store import deltas
+
+        v, f = self._mesh(73)
+        d_key = store.ingest(v, f)
+        for seq in ("walk", "run"):
+            deltas.write_sequence(
+                store, d_key, seq,
+                [np.asarray(v + 0.01, np.float32)])
+        deleted = store.gc(budget_bytes=0)
+        assert deleted[-1] == d_key
+        assert set(deleted[:-1]) == {"%s/walk" % d_key, "%s/run" % d_key}
+
+    def test_dry_run_reports_sequences_without_deleting(self, store):
+        d_key = self._with_sequence(store, seed=74)
+        would = store.gc(budget_bytes=0, dry_run=True)
+        assert would == ["%s/walk" % d_key, d_key]
+        assert store.ls() == [d_key]
+        assert [s for _d, s in store.list_sequences()] == ["walk"]
+
+    def test_total_bytes_includes_sequences(self, store):
+        d_key = self._with_sequence(store, seed=75)
+        assert store.total_bytes() == (
+            store.object_bytes(d_key)
+            + store.sequence_bytes(d_key, "walk"))
+
+
 # ---------------------------------------------------------------------------
 # page cache
 

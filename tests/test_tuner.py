@@ -454,7 +454,8 @@ def test_tune_status_cli(tmp_path):
     rows = {r["knob"]: r for r in status["knobs"]}
     assert set(rows) == {"coalesce_window_ms", "accel_min_faces",
                          "mxu_crossover", "stream_n_buffers",
-                         "serve_pre_trip", "shard_min_q"}
+                         "serve_pre_trip", "shard_min_q",
+                         "anim_refit_max_inflation"}
     assert rows["coalesce_window_ms"]["pinned"]
     assert rows["coalesce_window_ms"]["value"] == 7.5
     assert not rows["serve_pre_trip"]["pinned"]

@@ -248,6 +248,29 @@ def render_table(h):
                     "double-run equal (perfcheck grades drift against "
                     "benchmarks/replay_golden.json)" % (
                         rp["value"], rp["checksum"]))
+        # dynamic-mesh gate: refit only counts as an improvement when it
+        # actually beats rebuilding (>= 1.0x) AND the record carries the
+        # traversal checksum proving exactness — perfcheck grades drift
+        # against benchmarks/anim_golden.json
+        an = b.get("anim")
+        if isinstance(an, dict):
+            if an.get("value") is None or an.get("checksum") is None:
+                lines.append(
+                    "gate 2 anim: NOT AN IMPROVEMENT — anim record "
+                    "carries no speedup/checksum to prove the refit "
+                    "exactness contract")
+            elif an["value"] < 1.0:
+                lines.append(
+                    "gate 2 anim: NOT AN IMPROVEMENT — refit speedup "
+                    "%.3fx < 1.0x (frozen-order refit loses to a full "
+                    "rebuild)" % an["value"])
+            else:
+                lines.append(
+                    "gate 2 anim: %.3fx rebuild/refit OK — checksum "
+                    "%.6f over %s frames (max inflation %s; perfcheck "
+                    "grades drift against benchmarks/anim_golden.json)"
+                    % (an["value"], an["checksum"], an.get("frames"),
+                       an.get("inflation_max")))
     for b in h.get("bench_variants", ()):
         if b.get("value") is None:
             lines.append(
