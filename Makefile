@@ -41,7 +41,7 @@ perfcheck:
 	@echo "----- [ ${package_name} ] Chip-free perf gate (staged probe + CPU proxies)"
 	@PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
 		MESH_TPU_BENCH_PARTIAL=/tmp/mesh_tpu_perfcheck_partial.json \
-		python bench.py --stages probe,pallas_proxy,accel_proxy,accel_stream_proxy,mxu_proxy,store_cold_start,tuner_convergence,replay_proxy,fleet_proxy,anim_proxy > /tmp/mesh_tpu_perfcheck_bench.json || true
+		python bench.py --stages probe,pallas_proxy,accel_proxy,accel_stream_proxy,mxu_proxy,store_cold_start,tuner_convergence,replay_proxy,fleet_proxy,anim_proxy,trace_proxy > /tmp/mesh_tpu_perfcheck_bench.json || true
 	@python -m mesh_tpu.cli perfcheck /tmp/mesh_tpu_perfcheck_bench.json
 
 proxy-golden:
@@ -104,6 +104,17 @@ anim-golden:
 		python bench.py --stage anim_proxy > benchmarks/anim_golden.json
 	@cat benchmarks/anim_golden.json
 
+trace-golden:
+	@echo "----- [ ${package_name} ] Recording the request-identity join golden"
+	@PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu MESH_TPU_OBS=1 \
+		MESH_TPU_TRACE_CONTEXT=1 MESH_TPU_TRACE_TAIL=256 \
+		MESH_TPU_TRACE_RESERVOIR= MESH_TPU_FLEET=1 \
+		MESH_TPU_FLEET_SPILL=1 MESH_TPU_FLEET_VNODES= \
+		MESH_TPU_LEDGER=1 MESH_TPU_LEDGER_CAPACITY= \
+		MESH_TPU_REPLAY_TRACE= \
+		python bench.py --stage trace_proxy > benchmarks/trace_golden.json
+	@cat benchmarks/trace_golden.json
+
 gates:
 	@bash tools/run_tpu_gates.sh
 
@@ -130,4 +141,4 @@ docs:
 clean:
 	@rm -rf build dist *.egg-info doc/_build
 
-.PHONY: all import_tests unit_tests tpu_tests tests lint lint-fast bench perfcheck proxy-golden accel-golden accel-stream-golden mxu-golden store-golden tuner-golden replay-golden fleet-golden anim-golden gates sweep sdist wheel documentation docs clean
+.PHONY: all import_tests unit_tests tpu_tests tests lint lint-fast bench perfcheck proxy-golden accel-golden accel-stream-golden mxu-golden store-golden tuner-golden replay-golden fleet-golden anim-golden trace-golden gates sweep sdist wheel documentation docs clean

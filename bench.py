@@ -2341,6 +2341,195 @@ def tuner_replay_stage():
     return record
 
 
+def trace_proxy_stage():
+    """Stage ``trace_proxy``: the request-identity join's chip-free
+    contract run (doc/observability.md "Request identity") — a
+    3-replica in-process fleet serves the seeded adversarial mix while
+    a tenant-hash rung forces a deterministic subset of requests to
+    miss their deadline or fail in-ladder, proving on every bench run:
+
+    - **identity**: every admitted request's ledger row carries the
+      router-minted ``request_id`` plus its routing key and replica.
+    - **tail sampling**: every deadline-missed/errored request keeps a
+      retained span tree, and each retained tree is connected (exactly
+      one root) even though its spans cross the submit -> worker
+      thread hop.
+    - **join determinism**: the join checksum — computed over
+      run-stable facts (replica, tenant, seq, outcome, stage names,
+      retained span shapes), never over the wall-clock-derived
+      request ids themselves — reproduces across a double run with
+      fresh fleets (hard-fail on drift; graded against
+      benchmarks/trace_golden.json).
+    """
+    from mesh_tpu import obs
+    from mesh_tpu.errors import DeadlineExceeded
+    from mesh_tpu.fleet import FleetRouter
+    from mesh_tpu.obs import replay as obs_replay
+    from mesh_tpu.serve import (
+        HealthMonitor,
+        QueryService,
+        Rung,
+        ServeResult,
+    )
+
+    seed = knobs.get_int("MESH_TPU_TRACE_PROXY_SEED")
+    trace = obs_replay.synth_mix(seed=7 if seed is None else seed)
+
+    faces = np.zeros((1, 4), np.uint32)
+    answer = np.zeros((4, 3), np.float64)
+    pts = np.zeros((4, 3), np.float32)
+
+    class _Digest(object):
+        """A mesh stand-in that is nothing but its routing identity."""
+
+        def __init__(self, key):
+            self.topology_key = key
+
+    def _tenant_bucket(tenant):
+        return zlib.crc32(str(tenant).encode("utf-8")) % 7
+
+    def _make_replica():
+        def _rung(mesh, points, chunk, timeout):
+            # outcome by tenant hash (the tenant rides the routing
+            # digest): deterministic misses/errors forced IN-LADDER so
+            # the request's span tree exists when the ledger closes
+            tenant = getattr(mesh, "topology_key", "")[len("trace-"):]
+            bucket = _tenant_bucket(tenant)
+            if bucket in (1, 2):
+                raise DeadlineExceeded(
+                    "forced in-ladder deadline miss (trace_proxy)")
+            if bucket == 0:
+                raise RuntimeError(
+                    "forced in-ladder failure (trace_proxy)")
+            return ServeResult(faces, answer, "trace-ok", certified=True)
+
+        # drain_after is pinned unreachable: the forced failures MUST
+        # NOT escalate a replica to DRAINING, or ring ejection would
+        # make placement timing-dependent and break the join checksum
+        # (DEGRADED is fine — it does not change ring membership, and
+        # the two rungs are identical so a one-rung-down start is
+        # behavior-identical)
+        return QueryService(ladder=[Rung("trace-hi", _rung),
+                                    Rung("trace-lo", _rung)],
+                            health=HealthMonitor(watchdog=False,
+                                                 drain_after=10 ** 9),
+                            default_deadline_s=30.0, workers=2,
+                            max_queue_per_tenant=8192)
+
+    def _run():
+        obs.reset()
+        router = FleetRouter()
+        for i in range(3):
+            router.add_replica("trace-%d" % i, _make_replica())
+        meshes = {}
+        futures = []
+        try:
+            for rec in trace["records"]:
+                tenant = rec.get("tenant", "default")
+                mesh = meshes.setdefault(tenant,
+                                         _Digest("trace-" + tenant))
+                futures.append(router.submit(
+                    mesh, pts, tenant=tenant,
+                    priority=int(rec.get("priority") or 0),
+                    deadline_s=30.0))
+            for fut in futures:
+                try:
+                    fut.result(timeout=60.0)
+                except Exception:   # noqa: BLE001 — forced outcomes
+                    pass
+        finally:
+            router.stop(write_stats=False)
+        rows = list(obs.get_ledger().records())
+        tail = {e["request_id"]: e
+                for e in obs.get_trace_tail().retained()}
+        return rows, tail
+
+    def _join_facts(rows, tail):
+        """Run-stable join facts: request ids are minted from wall
+        admission times so the ids themselves never enter the
+        checksum — (replica, tenant, seq, outcome, stages) identifies
+        a row across runs, and retained miss/error span shapes ride
+        along."""
+        for row in rows:
+            if not row.get("request_id"):
+                raise RuntimeError(
+                    "identity broken: a ledger row closed without a "
+                    "request_id (tenant=%s outcome=%s)"
+                    % (row.get("tenant"), row.get("outcome")))
+        row_facts = sorted(
+            [str(row.get("replica")), str(row.get("tenant")),
+             int(row.get("seq", -1)), str(row["outcome"]),
+             sorted(row.get("stages") or ())]
+            for row in rows)
+        span_facts = []
+        n_tail = 0
+        for row in rows:
+            if row["outcome"] not in ("deadline", "error"):
+                continue
+            entry = tail.get(row["request_id"])
+            if entry is None or not entry.get("spans"):
+                raise RuntimeError(
+                    "tail-sampling guarantee broken: %s request %s "
+                    "(tenant=%s) kept no span tree"
+                    % (row["outcome"], row["request_id"],
+                       row.get("tenant")))
+            spans = entry["spans"]
+            ids = {s.get("span_id") for s in spans}
+            roots = [s for s in spans if s.get("parent_id") not in ids]
+            if len(roots) != 1:
+                raise RuntimeError(
+                    "retained span tree for %s is not connected: %d "
+                    "roots over %d spans (parent linkage lost across "
+                    "the thread hop?)"
+                    % (row["request_id"], len(roots), len(spans)))
+            n_tail += 1
+            span_facts.append(
+                [str(row.get("tenant")), int(row.get("seq", -1)),
+                 str(row["outcome"]),
+                 sorted({str(s.get("name")) for s in spans}),
+                 len(roots)])
+        span_facts.sort()
+        checksum = float(zlib.crc32(json.dumps(
+            [row_facts, span_facts], sort_keys=True,
+            separators=(",", ":")).encode("utf-8")))
+        return checksum, n_tail
+
+    results = []
+    for _ in range(2):
+        rows, tail = _run()
+        if len(rows) != len(trace["records"]):
+            raise RuntimeError(
+                "join incomplete: %d ledger rows for %d submitted "
+                "requests (every admission must close exactly one row)"
+                % (len(rows), len(trace["records"])))
+        results.append(_join_facts(rows, tail) + (len(rows),))
+    (checksum, n_tail, n_rows), (checksum2, n_tail2, _) = results
+    if checksum != checksum2 or n_tail != n_tail2:
+        raise RuntimeError(
+            "join determinism broken: double run produced different "
+            "join evidence (checksum %.6f/%d vs %.6f/%d)"
+            % (checksum, n_tail, checksum2, n_tail2))
+    forced = sum(1 for rec in trace["records"]
+                 if _tenant_bucket(rec.get("tenant", "default")) in
+                 (0, 1, 2))
+    if n_tail != forced:
+        raise RuntimeError(
+            "tail retention drifted: %d retained miss/error trees for "
+            "%d forced outcomes" % (n_tail, forced))
+    return {
+        "metric": "trace_requests_joined",
+        "value": n_rows,
+        "unit": "requests",
+        "vs_baseline": None,
+        "checksum": checksum,
+        "tail_retained": n_tail,
+        "replicas": 3,
+        "source": trace["source"],
+        "trace_records": len(trace["records"]),
+        "double_run": "checksum_equal",
+    }
+
+
 #: declarative stage table: name -> (fn, default timeout_s,
 #: requires_backend, gate, extra child env).  Budgets bound a WEDGE —
 #: they are not measurements; override one with
@@ -2429,6 +2618,25 @@ _STAGE_DEFS = OrderedDict((
                     {"JAX_PLATFORMS": "cpu",
                      "PALLAS_AXON_POOL_IPS": "",
                      "MESH_TPU_ANIM": "1"})),
+    # chip-free request-identity join: plain-python ladders behind the
+    # router, forced in-ladder misses/errors by tenant hash.  OBS and
+    # the trace context are pinned ON (the stage IS those features),
+    # the tail ring is sized to hold every forced outcome, and the
+    # ledger/capture knobs are cleared so the caller's environment
+    # can't shrink the evidence under test.
+    ("trace_proxy", (trace_proxy_stage, 180.0, False, False,
+                     {"JAX_PLATFORMS": "cpu",
+                      "PALLAS_AXON_POOL_IPS": "",
+                      "MESH_TPU_OBS": "1",
+                      "MESH_TPU_TRACE_CONTEXT": "1",
+                      "MESH_TPU_TRACE_TAIL": "256",
+                      "MESH_TPU_TRACE_RESERVOIR": "",
+                      "MESH_TPU_FLEET": "1",
+                      "MESH_TPU_FLEET_SPILL": "1",
+                      "MESH_TPU_FLEET_VNODES": "",
+                      "MESH_TPU_LEDGER": "1",
+                      "MESH_TPU_LEDGER_CAPACITY": "",
+                      "MESH_TPU_REPLAY_TRACE": ""})),
     # the tuner's gym: same env pins as tuner_convergence (tuner ON,
     # knob pins cleared) driving the controller from a replayed trace
     ("tuner_replay", (tuner_replay_stage, 120.0, False, False,
@@ -2563,6 +2771,9 @@ def run_staged(names=None):
     anim_res = results.get("anim_proxy")
     if anim_res is not None and anim_res.ok:
         record["anim"] = anim_res.record
+    trace_res = results.get("trace_proxy")
+    if trace_res is not None and trace_res.ok:
+        record["trace"] = trace_res.record
     record["stages"] = OrderedDict(
         (n, r.to_json()) for n, r in results.items())
     record["bench_partial"] = partial_path

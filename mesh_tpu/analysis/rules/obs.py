@@ -29,6 +29,15 @@ Codes:
   tuple in obs/ledger.py) is absent from doc/observability.md — the
   stage vocabulary is the ``mesh-tpu prof`` CLI's user-facing contract,
   so every name must appear in the doc as a backticked literal.
+- OBS006 (error): a metric mutator (``inc``/``observe``) called with a
+  label VALUE that is provably unbounded — an f-string, a %-formatted
+  string, a ``str()``/``.format()`` call, or a name ending in
+  ``request_id`` / ``digest`` / ``store_key`` / ``routing_key``.
+  Bounded label values (tenant, stage, outcome, replica) are fine;
+  per-request identity belongs in histogram **exemplars** (the
+  sanctioned ``exemplar=`` keyword is exempt, doc/observability.md
+  "Request identity") — as a label value it makes every request its
+  own series and explodes registry cardinality.
 """
 
 import ast
@@ -40,6 +49,14 @@ from ..engine import Finding, Rule
 _SERIES_FUNCS = {"counter", "gauge", "histogram"}
 _SPAN_FUNCS = {"span", "timed_span", "obs_span"}
 _LABEL_MUTATORS = {"inc", "dec", "set", "set_max", "observe"}
+#: mutators checked for unbounded label VALUES (OBS006) — ``set`` is
+#: deliberately absent: ``span.set(request_id=...)`` is the sanctioned
+#: span-tagging idiom and spans are bounded by the tracer ring
+_VALUE_MUTATORS = {"inc", "observe"}
+#: terminal identifier names that are per-request/per-object identity —
+#: unbounded by construction (tenant/session ids are admission-bounded
+#: and deliberately NOT here)
+_IDENTITY_NAMES = {"request_id", "digest", "store_key", "routing_key"}
 _CLOCK_FUNCS = {"time.time", "time.perf_counter", "time.monotonic",
                 "time.process_time"}
 
@@ -188,6 +205,27 @@ class ObservabilityHygieneRule(Rule):
                             hint="spell the label names out "
                                  "(.%s(tenant=t) is fine — values may "
                                  "vary, names must not)" % last))
+            if (not series_exempt and last in _VALUE_MUTATORS
+                    and isinstance(node.func, ast.Attribute)):
+                for kw in node.keywords:
+                    if kw.arg is None or kw.arg == "exemplar":
+                        # **kwargs is OBS003's territory; exemplar= is
+                        # the sanctioned per-request identity path
+                        continue
+                    why = _unbounded_label_value(kw.value)
+                    if why:
+                        findings.append(ctx.finding(
+                            "OBS006", "error", node,
+                            "unbounded label value (%s) for label "
+                            "'%s' in .%s(): every distinct value "
+                            "becomes its own series" % (why, kw.arg,
+                                                        last),
+                            hint="per-request identity goes in "
+                                 "exemplars (.observe(v, exemplar="
+                                 "ctx.request_id)) or span attrs, "
+                                 "never in a label value; keep label "
+                                 "values bounded (tenant, stage, "
+                                 "outcome, replica)"))
             if (not clock_exempt and func_name in _CLOCK_FUNCS):
                 findings.append(ctx.finding(
                     "OBS004", "warning", node,
@@ -232,3 +270,30 @@ def _static_label_keys(node):
     return (isinstance(node, ast.Dict)
             and all(isinstance(k, ast.Constant)
                     and isinstance(k.value, str) for k in node.keys))
+
+
+def _unbounded_label_value(node):
+    """A short reason when a label-value expression is provably
+    unbounded (OBS006), else None.  Conservative by design: plain
+    names/attributes pass unless their terminal identifier IS a
+    per-request identity — a lint that cried wolf on ``tenant=t``
+    would get turned off."""
+    if isinstance(node, ast.JoinedStr):
+        return "f-string"
+    if (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod)
+            and isinstance(node.left, ast.Constant)
+            and isinstance(node.left.value, str)):
+        return "%-formatted string"
+    if isinstance(node, ast.Call):
+        func = qualname(node.func)
+        last = func.rsplit(".", 1)[-1] if func else None
+        if last in ("str", "format"):
+            return "stringified value"
+    terminal = None
+    if isinstance(node, ast.Name):
+        terminal = node.id
+    elif isinstance(node, ast.Attribute):
+        terminal = node.attr
+    if terminal in _IDENTITY_NAMES:
+        return "per-request identity '%s'" % terminal
+    return None

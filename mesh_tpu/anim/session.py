@@ -30,6 +30,7 @@ import numpy as np
 
 from ..errors import MeshError
 from ..obs.clock import monotonic
+from ..obs.context import bind_context, mint as mint_context
 from ..obs.ledger import get_ledger
 from ..obs.recorder import get_recorder
 from ..obs.trace import span as obs_span
@@ -149,18 +150,29 @@ class AvatarSession(object):
                 raise SessionClosed("session %s is stopped"
                                     % self.session_id)
             frame_no = next(self._frame_seq)
+            # per-frame request identity: tenant is the session id, seq
+            # the frame number, so a stream's frames join fleet-wide by
+            # session (doc/observability.md request identity)
+            ctx = mint_context(self.session_id, frame_no, monotonic(),
+                               routing_key=self.routing_key,
+                               session_id=self.session_id)
             rec = get_ledger().open(
                 tenant=self.session_id, op="anim_frame", frame=frame_no,
                 digest=self.digest,
                 deadline_s=(None if deadline_s is None
-                            else float(deadline_s)))
+                            else float(deadline_s)),
+                **(ctx.to_meta() if ctx is not None else {}))
             if rec is not None:
+                rec.ctx = ctx
                 self._inflight[frame_no] = rec
         t0 = monotonic()
         out = {"frame": frame_no, "action": None, "inflation": None}
         try:
-            with obs_span("anim.frame", session=self.session_id,
-                          frame=frame_no):
+            with bind_context(ctx), \
+                    obs_span("anim.frame", session=self.session_id,
+                             frame=frame_no) as sp:
+                if ctx is not None:
+                    ctx.root_span_id = getattr(sp, "span_id", None)
                 v_new = self._vertices(delta, vertices)
                 if rec is not None:
                     rec.stamp("queue")

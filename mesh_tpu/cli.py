@@ -472,6 +472,10 @@ def cmd_perfcheck(args):
         args.anim_golden or os.path.join(repo_root, "benchmarks",
                                          "anim_golden.json"),
         "anim golden")
+    trace_golden = _load_optional(
+        args.trace_golden or os.path.join(repo_root, "benchmarks",
+                                          "trace_golden.json"),
+        "trace golden")
     rc, lines = perfcheck(doc, baseline=baseline, proxy_golden=golden,
                           proxy_tol=args.proxy_tol,
                           headline_tol=args.headline_tol,
@@ -491,7 +495,9 @@ def cmd_perfcheck(args):
                           fleet_golden=fleet_golden,
                           fleet_tol=args.fleet_tol,
                           anim_golden=anim_golden,
-                          anim_tol=args.anim_tol)
+                          anim_tol=args.anim_tol,
+                          trace_golden=trace_golden,
+                          trace_tol=args.trace_tol)
     if args.json:
         json.dump({"rc": rc, "lines": lines}, sys.stdout, indent=2)
         sys.stdout.write("\n")
@@ -608,6 +614,27 @@ def cmd_fleet(args):
 
     from mesh_tpu.fleet.coordinator import read_sink
     from mesh_tpu.fleet.ring import HashRing
+
+    if args.fleet_command == "prof":
+        from mesh_tpu.obs import prof
+
+        named = []
+        try:
+            for path in args.sources:
+                name = os.path.splitext(os.path.basename(path))[0]
+                named.append((name, prof.load(path)))
+            rc, lines = prof.fleet_attribution(named)
+        except prof.ProfError as exc:
+            print("fleet prof: %s" % exc, file=sys.stderr)
+            sys.exit(2)
+        if args.json:
+            json.dump({"rc": rc, "lines": lines}, sys.stdout, indent=2)
+            sys.stdout.write("\n")
+        else:
+            print("fleet prof (%d replica profile(s))" % len(named))
+            for line in lines:
+                print("  " + line)
+        sys.exit(rc)
 
     def _hit_rate(metrics, hits_name, misses_name):
         def total(name):
@@ -731,6 +758,16 @@ def cmd_prof(args):
                 print("prof top %s" % args.source)
                 for line in prof.top_lines(stats):
                     print("  " + line)
+        elif args.prof_command == "trace":
+            trace = prof.request_trace(args.request_id,
+                                       paths=list(args.sources or ()))
+            rc = 0
+            if args.json:
+                json.dump(trace, sys.stdout, indent=2, sort_keys=True)
+                sys.stdout.write("\n")
+            else:
+                for line in prof.render_request_trace(trace):
+                    print(line)
         else:
             a = prof.load(args.a)
             b = prof.load(args.b)
@@ -1279,6 +1316,15 @@ def main():
                              "refit-vs-rebuild speedup vs the golden "
                              "(default 0.2; the 1.0x hard floor and the "
                              "exact traversal checksum hold regardless)")
+    p_perf.add_argument("--trace-golden", default=None,
+                        help="trace-context golden record (default: repo "
+                             "benchmarks/trace_golden.json)")
+    p_perf.add_argument("--trace-tol", type=float, default=0.0,
+                        help="allowed fractional drop of the traced "
+                             "request count vs the golden (default 0: "
+                             "the mix is synthesized deterministically; "
+                             "the join checksum must match exactly "
+                             "regardless)")
     p_perf.add_argument("--json", action="store_true",
                         help="machine-readable {rc, lines} instead of the "
                              "summary")
@@ -1349,6 +1395,18 @@ def main():
     p_fstat.add_argument("--json", action="store_true",
                          help="machine-readable {dir, replicas, ring}")
     p_fstat.set_defaults(func=cmd_fleet)
+    p_fprof = fleet_sub.add_parser(
+        "prof",
+        help="cross-replica p99 attribution: merge per-replica ledger "
+             "dumps or serve-stats sinks and name the (replica, stage) "
+             "that owns the fleet tail")
+    p_fprof.add_argument("sources", nargs="+",
+                         help="one profile source per replica (ledger "
+                              "JSONL dump or serve-stats sink; the "
+                              "replica name is the file's basename)")
+    p_fprof.add_argument("--json", action="store_true",
+                         help="machine-readable {rc, lines}")
+    p_fprof.set_defaults(func=cmd_fleet)
 
     p_prof = sub.add_parser(
         "prof",
@@ -1377,6 +1435,22 @@ def main():
     p_pdiff.add_argument("--json", action="store_true",
                          help="machine-readable {rc, lines}")
     p_pdiff.set_defaults(func=cmd_prof)
+    p_ptrace = prof_sub.add_parser(
+        "trace",
+        help="one request's joined story by request_id: ledger stages, "
+             "router hop, and the retained span tree (ledger JSONL "
+             "dumps and/or incident files as sources)")
+    p_ptrace.add_argument("request_id",
+                          help="the request identity to join on (e.g. a "
+                               "histogram exemplar's req-xxxxxxxx)")
+    p_ptrace.add_argument("sources", nargs="+",
+                          help="evidence files: ledger JSONL dumps and/or "
+                               "incident dumps (schema >= 4 incidents "
+                               "carry retained span trees)")
+    p_ptrace.add_argument("--json", action="store_true",
+                          help="machine-readable joined trace instead of "
+                               "the rendering")
+    p_ptrace.set_defaults(func=cmd_prof)
 
     p_replay = sub.add_parser(
         "replay",

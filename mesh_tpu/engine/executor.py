@@ -35,6 +35,7 @@ import numpy as np
 
 from ..errors import DeadlineExceeded, EngineShutdown
 from ..obs.clock import monotonic as _now
+from ..obs.context import bind_context
 from ..obs.trace import span as obs_span
 from ..utils import tuning
 from .stats import STATS
@@ -278,7 +279,15 @@ class EngineExecutor(object):
         if not group:
             return
         op = group[0].op
-        with obs_span("engine.coalesce", op=op, requests=len(group)):
+        # the request identity crosses the submit->drain thread hop on
+        # the ledger record: binding the group's first context here makes
+        # every worker-side span parent under that request's root span
+        # (one connected tree) instead of rooting a per-thread forest
+        ctx = next((req.record.ctx for req in group
+                    if req.record is not None
+                    and req.record.ctx is not None), None)
+        with bind_context(ctx), \
+                obs_span("engine.coalesce", op=op, requests=len(group)):
             drained = _now()
             for req in group:
                 # submit-to-dispatch wait: the queue-time half of the

@@ -49,12 +49,15 @@ mesh needs digesting); the fleet metrics ride the always-on registry:
 (doc/observability.md).
 """
 
+import itertools
 import json
 import threading
 import zlib
 from collections import OrderedDict
 
 from ..errors import ServeRejected
+from ..obs.clock import monotonic
+from ..obs.context import mint as mint_context
 from ..utils import knobs
 from .ring import HashRing
 
@@ -136,6 +139,7 @@ class FleetRouter(object):
         self._replicas = OrderedDict()
         self._ring = HashRing(vnodes=vnodes)
         self._seq = 0
+        self._mint_seq = itertools.count(1)
         self._log = {}                # name -> [admission event, ...]
         self._recorder = recorder
         self._init_metrics()
@@ -258,10 +262,16 @@ class FleetRouter(object):
                 "no fleet replica is admitting", retry_after=5.0,
                 reason="draining")
         primary = order[0]
+        # Mint the fleet-wide request identity at the admission edge:
+        # the routing key and chosen replica travel with the request so
+        # a spill hop stays attributable end-to-end (doc/observability.md).
+        ctx = mint_context(tenant, next(self._mint_seq), monotonic(),
+                           routing_key=key, replica=primary)
+        ctx_kw = {"ctx": ctx} if ctx is not None else {}
         try:
             future = self._replicas[primary].submit(
                 mesh, points, tenant=tenant, priority=priority,
-                deadline_s=deadline_s)
+                deadline_s=deadline_s, **ctx_kw)
         except ServeRejected as e:
             if (e.reason != "queue_full" or not spill_enabled()
                     or len(order) < 2):
@@ -273,10 +283,13 @@ class FleetRouter(object):
             self._m_spill.inc(replica=primary)
             self._record("fleet.spill", key=key, tenant=tenant,
                          src=primary, dst=sibling)
+            if ctx is not None:
+                ctx.replica = sibling
+                ctx.spilled = True
             try:
                 future = self._replicas[sibling].submit(
                     mesh, points, tenant=tenant, priority=priority,
-                    deadline_s=deadline_s)
+                    deadline_s=deadline_s, **ctx_kw)
             except ServeRejected:
                 self._m_requests.inc(replica=sibling, outcome="rejected")
                 self._record("fleet.reject", key=key, replica=sibling,

@@ -38,6 +38,16 @@ Import cost: stdlib only — jax is touched lazily and never required.
 """
 
 from .clock import enabled, monotonic, wall  # noqa: F401
+from .context import (  # noqa: F401
+    TRACE_TAIL,
+    RequestContext,
+    TraceTail,
+    bind_context,
+    current_context,
+    get_trace_tail,
+    mint,
+    trace_context_enabled,
+)
 from .export import prometheus_text, render_tree, write_jsonl  # noqa: F401
 from .jax_bridge import install_jax_monitoring_bridge  # noqa: F401
 from .ledger import (  # noqa: F401
@@ -125,6 +135,8 @@ __all__ = [
     "default_incident_dir", "list_incidents",
     "LEDGER", "LEDGER_STAGES", "LatencyLedger", "RequestRecord",
     "get_ledger", "ledger_enabled", "bind_current", "current_record",
+    "TRACE_TAIL", "RequestContext", "TraceTail", "bind_context",
+    "current_context", "get_trace_tail", "mint", "trace_context_enabled",
     "SERIES", "SampleRing", "WindowedSeries", "get_series",
     "quantile_from_cumulative",
     "SLO", "BurnRateRule", "SLOMonitor", "default_slos", "default_rules",
@@ -172,5 +184,6 @@ def reset():
     TRACER.clear()
     RECORDER.clear()
     LEDGER.clear()
+    TRACE_TAIL.clear()
     SERIES.clear()
     tuning.reset()

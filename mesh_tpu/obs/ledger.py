@@ -45,6 +45,7 @@ from contextlib import contextmanager
 
 from ..utils import knobs
 from .clock import monotonic
+from .context import TRACE_TAIL
 from .metrics import REGISTRY
 
 __all__ = [
@@ -116,12 +117,16 @@ class RequestRecord(object):
     ledger only reads it at ``close()``.
     """
 
-    __slots__ = ("t_admit", "stamps", "meta", "_clock")
+    __slots__ = ("t_admit", "stamps", "meta", "ctx", "_clock")
 
     def __init__(self, t_admit, meta, clock):
         self.t_admit = float(t_admit)
         self.stamps = {}
         self.meta = meta
+        #: the live RequestContext riding this record across the engine's
+        #: coalesce/drain thread hop (obs/context.py); never serialized —
+        #: the JSON-able identity lives in ``meta`` (request_id/seq/...)
+        self.ctx = None
         self._clock = clock
 
     def stamp(self, stage, t=None):
@@ -223,25 +228,43 @@ class LatencyLedger(object):
             "mesh_tpu_request_stage_seconds",
             "Per-request wall seconds by ledger stage and accel backend.",
         )
+        exemplar = record.meta.get("request_id")
         for stage, seconds in stages.items():
-            hist.observe(seconds, stage=stage, backend=backend)
+            hist.observe(seconds, exemplar=exemplar,
+                         stage=stage, backend=backend)
         row = record.to_dict()
         with self._lock:
             self._ring.append(row)
             listeners = tuple(self._listeners)
+        try:
+            TRACE_TAIL.observe_close(row)
+        except Exception:               # noqa: BLE001 — retention can't fail serving
+            self._observer_error("tail")
         for fn in listeners:
             try:
                 fn(row)
             except Exception:           # noqa: BLE001 — observers can't fail serving
-                pass
+                self._observer_error("listener")
         trace_path = knobs.get_str(REPLAY_TRACE_ENV)
         if trace_path:
             from .replay import capture_row
             try:
                 capture_row(row, trace_path)
             except Exception:           # noqa: BLE001 — capture can't fail serving
-                pass
+                self._observer_error("capture")
         return row
+
+    def _observer_error(self, where):
+        """A swallowed observer/capture failure is still counted — a
+        broken trace writer must be visible, never silent."""
+        try:
+            self._registry.counter(
+                "mesh_tpu_ledger_observer_errors_total",
+                "Ledger close-path observer failures swallowed to protect "
+                "serving (label `where`: listener / capture / tail).",
+            ).inc(where=where)
+        except Exception:               # noqa: BLE001 — last-resort guard
+            pass
 
     # -- consumption ---------------------------------------------------
 

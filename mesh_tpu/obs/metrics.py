@@ -132,7 +132,12 @@ class Histogram(_Metric):
         super(Histogram, self).__init__(name, help, lock)
         self.buckets = tuple(sorted(float(b) for b in buckets))
 
-    def observe(self, value, **labels):
+    def observe(self, value, exemplar=None, **labels):
+        """Record one observation.  ``exemplar`` is an optional request
+        identity (a request_id string, obs/context.py): the max-value
+        observation per bucket keeps its exemplar, so a fleet histogram
+        bucket links to one concrete replayable request — identity goes
+        HERE, never into label values (meshlint OBS006)."""
         value = float(value)
         key = _label_key(labels)
         with self._lock:
@@ -153,7 +158,14 @@ class Histogram(_Metric):
                     state["bucket_counts"][i] += 1
                     break
             else:
-                state["bucket_counts"][-1] += 1     # +Inf bucket
+                i = len(self.buckets)               # +Inf bucket
+                state["bucket_counts"][-1] += 1
+            if exemplar is not None:
+                exemplars = state.setdefault("exemplars", {})
+                prev = exemplars.get(i)
+                if prev is None or value >= prev["value"]:
+                    exemplars[i] = {"request_id": str(exemplar),
+                                    "value": value}
 
     def stat(self, **labels):
         """{count, sum, min, max, mean} for one labeled series (zeros when
@@ -182,14 +194,23 @@ class Histogram(_Metric):
                     running += state["bucket_counts"][i]
                     cumulative.append([bound, running])
                 cumulative.append(["+Inf", running + state["bucket_counts"][-1]])
-                out["series"].append({
+                series = {
                     "labels": dict(key),
                     "count": state["count"],
                     "sum": round(state["sum"], 9),
                     "min": state["min"],
                     "max": state["max"],
                     "buckets": cumulative,
-                })
+                }
+                exemplars = state.get("exemplars")
+                if exemplars:
+                    bounds = list(self.buckets) + ["+Inf"]
+                    series["exemplars"] = [
+                        {"le": bounds[i], "request_id": e["request_id"],
+                         "value": e["value"]}
+                        for i, e in sorted(exemplars.items())
+                    ]
+                out["series"].append(series)
         return out
 
 

@@ -390,7 +390,7 @@ def extract_records(doc):
     "proxy": rec|None, "accel": rec|None, "stream": rec|None,
     "mxu": rec|None, "store": rec|None, "tuner": rec|None,
     "replay": rec|None, "fleet": rec|None, "anim": rec|None,
-    "stages": {...}|None}``.
+    "trace": rec|None, "stages": {...}|None}``.
 
     The headline slot is only filled by a FRESH measurement — a
     ``stale: true`` envelope (last-good value republished while the
@@ -407,6 +407,7 @@ def extract_records(doc):
     replay = None
     fleet = None
     anim = None
+    trace = None
     stages = None
     if doc.get("kind") == "bench_partial":
         stages = doc.get("stages") or {}
@@ -440,6 +441,9 @@ def extract_records(doc):
         an = stages.get("anim_proxy") or {}
         if an.get("status") == "ok":
             anim = an.get("record")
+        tp = stages.get("trace_proxy") or {}
+        if tp.get("status") == "ok":
+            trace = tp.get("record")
     else:
         if doc.get("value") is not None and not doc.get("stale"):
             headline = doc
@@ -470,11 +474,14 @@ def extract_records(doc):
         an = doc.get("anim")
         if isinstance(an, dict) and an.get("value") is not None:
             anim = an
+        tp = doc.get("trace")
+        if isinstance(tp, dict) and tp.get("value") is not None:
+            trace = tp
         stages = doc.get("stages")
     return {"headline": headline, "proxy": proxy, "accel": accel,
             "stream": stream, "mxu": mxu, "store": store,
             "tuner": tuner, "replay": replay, "fleet": fleet,
-            "anim": anim, "stages": stages}
+            "anim": anim, "trace": trace, "stages": stages}
 
 
 def perfcheck(doc, baseline=None, proxy_golden=None, proxy_tol=0.5,
@@ -484,7 +491,8 @@ def perfcheck(doc, baseline=None, proxy_golden=None, proxy_tol=0.5,
               tuner_tol=0.25, mxu_golden=None, mxu_tol=0.2,
               replay_golden=None, replay_tol=0.0,
               fleet_golden=None, fleet_tol=0.05,
-              anim_golden=None, anim_tol=0.2):
+              anim_golden=None, anim_tol=0.2,
+              trace_golden=None, trace_tol=0.0):
     """Compare a bench JSON against the last-good baseline and the
     committed proxy golden.  Returns ``(rc, lines)`` — rc 0 when nothing
     regressed beyond its tolerance band, 1 on regression (including a
@@ -568,6 +576,19 @@ def perfcheck(doc, baseline=None, proxy_golden=None, proxy_tol=0.5,
     refit index and drift is a hard FAIL — refit boxes are allowed to
     be looser than fresh-build boxes, the *answers* are not allowed to
     differ by one ulp.
+
+    ``trace_golden`` grades the trace_proxy stage (doc/observability.md
+    "Request identity"): its value is the number of requests whose
+    minted ``request_id`` joined router admission, ledger row, and span
+    evidence across a 3-replica in-process fleet under a seeded
+    deterministic mix.  The retained-tail count (every forced
+    deadline-miss/error request must keep a connected span tree) is
+    exact-matched, and the join checksum — computed over run-stable
+    facts (replica, tenant, seq, outcome, stage names, retained span
+    shapes), never wall-clock ids — is a hard FAIL on drift: a changed
+    checksum means the identity join stopped reproducing, which is the
+    entire contract.  A candidate without a checksum is a hard FAIL
+    (determinism unproven).
     """
     lines = []
     rc = 0
@@ -932,6 +953,64 @@ def perfcheck(doc, baseline=None, proxy_golden=None, proxy_tol=0.5,
     elif cand_anim is not None:
         lines.append("note: anim record present but no golden to "
                      "compare against (record one: make anim-golden)")
+
+    trace_gold = None
+    if trace_golden:
+        trace_gold = (extract_records(trace_golden)["trace"]
+                      or (trace_golden
+                          if trace_golden.get("value") is not None
+                          else None))
+    cand_trace = recs["trace"]
+    if trace_gold is not None:
+        if cand_trace is None:
+            rc = 1
+            lines.append(
+                "FAIL trace: candidate carries no trace_proxy record "
+                "(a golden exists — the chip-free request-identity join "
+                "contract must always be fresh)")
+        else:
+            floor = trace_gold["value"] * (1.0 - trace_tol)
+            verdict = "ok" if cand_trace["value"] >= floor else "FAIL"
+            if verdict == "FAIL":
+                rc = 1
+            lines.append(
+                "%s trace requests joined: %d vs golden %d (floor %.1f, "
+                "tol %.0f%%)"
+                % (verdict, cand_trace["value"], trace_gold["value"],
+                   floor, 100 * trace_tol))
+            cand_tail = cand_trace.get("tail_retained")
+            gold_tail = trace_gold.get("tail_retained")
+            if cand_tail is not None and gold_tail is not None:
+                # the forced deadline-miss/error mix is deterministic:
+                # a different retained-tail count means the tail-sampling
+                # guarantee (every miss/error keeps its tree) broke
+                same = cand_tail == gold_tail
+                if not same:
+                    rc = 1
+                lines.append(
+                    "%s trace tail retained (miss/error trees): %d vs "
+                    "golden %d (exact)"
+                    % ("ok" if same else "FAIL", cand_tail, gold_tail))
+            cand_sum = cand_trace.get("checksum")
+            gold_sum = trace_gold.get("checksum")
+            if cand_sum is None:
+                rc = 1
+                lines.append(
+                    "FAIL trace: candidate record carries no join "
+                    "checksum — the request-identity join is unproven")
+            elif gold_sum is not None:
+                # CRC-exact, same rationale as the replay checksum
+                same = abs(cand_sum - gold_sum) <= 1e-6
+                if not same:
+                    rc = 1
+                lines.append(
+                    "%s trace join checksum: %.6f vs golden %.6f "
+                    "(exact — drift means the ledger/span/router join "
+                    "stopped reproducing)"
+                    % ("ok" if same else "FAIL", cand_sum, gold_sum))
+    elif cand_trace is not None:
+        lines.append("note: trace record present but no golden to "
+                     "compare against (record one: make trace-golden)")
 
     golden_rec = None
     if proxy_golden:

@@ -32,6 +32,8 @@ from .series import quantile_from_cumulative
 __all__ = [
     "ProfError", "load", "stats_from_records", "top_lines", "diff",
     "attribution",
+    "load_rows", "load_request_tails", "request_trace",
+    "render_request_trace", "fleet_attribution",
 ]
 
 #: histogram series the sink/bench paths read
@@ -302,3 +304,209 @@ def diff(a, b, tol=0.2, min_delta_s=1e-4):
                          % (label, kind, _ms(ta), _ms(tb),
                             1e2 * pct if ta > 0 else 0.0))
     return rc, lines
+
+
+# ---------------------------------------------------------------------------
+# request identity: one request's joined evidence + fleet-wide attribution
+#
+# Everything below keys on the ``request_id`` the router/service mints
+# (obs/context.py) and the ledger stamps into each row's meta — the join
+# key that connects a fleet histogram exemplar, a ledger row, a retained
+# span tree, and the router hop that placed the request.
+
+
+def _read_doc(path):
+    try:
+        with open(path) as fh:
+            text = fh.read()
+    except OSError as e:
+        raise ProfError("cannot read %s: %s" % (path, e))
+    try:
+        return json.loads(text), text
+    except ValueError:
+        return None, text
+
+
+def load_rows(path):
+    """Raw ledger rows from a row-based source — a JSONL dump, a JSON
+    row list, or an incident's frozen ledger tail.  Returns ``None``
+    for aggregate-only sources (serve-stats sink, bench stage_stats);
+    raises :class:`ProfError` only on unreadable files."""
+    doc, text = _read_doc(path)
+    if isinstance(doc, dict):
+        if doc.get("kind") == "incident":
+            return [r for r in (doc.get("ledger") or [])
+                    if isinstance(r, dict)]
+        return None
+    if isinstance(doc, list):
+        return [r for r in doc if isinstance(r, dict)]
+    rows = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            row = json.loads(line)
+        except ValueError:
+            return None
+        if isinstance(row, dict):
+            rows.append(row)
+    return rows or None
+
+
+def load_request_tails(path):
+    """Retained request tails (row + span tree) from an incident dump
+    (schema >= 4, the ``requests`` key), or ``None`` when the source
+    carries no tail."""
+    doc, _text = _read_doc(path)
+    if isinstance(doc, dict) and doc.get("kind") == "incident":
+        tails = doc.get("requests")
+        if isinstance(tails, list):
+            return [t for t in tails if isinstance(t, dict)]
+    return None
+
+
+def request_trace(request_id, paths=(), tail=None):
+    """Join one request's evidence by its ``request_id`` across disk
+    sources (ledger dumps / incident dumps) and — when ``tail`` is
+    given (a :class:`~mesh_tpu.obs.context.TraceTail`) — the live
+    in-process tail buffer.
+
+    Returns ``{"request_id", "rows", "spans", "retained", "sources"}``
+    with ``rows`` the matching ledger rows (fleet: one per replica hop
+    that admitted it) and ``spans`` the retained span tree (or ``[]``
+    if the request was not tail-sampled).  Raises :class:`ProfError`
+    when nothing matches anywhere.
+    """
+    rid = str(request_id)
+    rows, spans, retained, sources = [], [], None, []
+
+    def _norm(row):
+        # dump_jsonl stamps rows with schema; incident/live copies of
+        # the SAME close are unstamped — normalize so overlapping
+        # sources collapse (fleet hops still differ in replica/seq)
+        return {k: v for k, v in row.items() if k != "schema"}
+
+    def _add_row(row):
+        if isinstance(row, dict) and _norm(row) not in map(_norm, rows):
+            rows.append(row)
+
+    for path in paths:
+        hit = False
+        for row in load_rows(path) or ():
+            if row.get("request_id") == rid:
+                _add_row(row)
+                hit = True
+        for entry in load_request_tails(path) or ():
+            if entry.get("request_id") == rid:
+                if not spans:
+                    spans = list(entry.get("spans") or [])
+                    retained = entry.get("retained")
+                _add_row(entry.get("row"))
+                hit = True
+        if hit:
+            sources.append(str(path))
+    if tail is not None:
+        entry = tail.lookup(rid)
+        if entry is not None:
+            if not spans:
+                spans = list(entry.get("spans") or [])
+                retained = entry.get("retained")
+            _add_row(entry.get("row"))
+            sources.append("<live tail>")
+    if not rows and not spans:
+        raise ProfError(
+            "request %s not found in %d source(s) — it may have aged "
+            "out of the ledger ring, or was never tail-sampled "
+            "(only deadline-miss/error/spilled and reservoir-slow "
+            "requests keep their span tree)" % (rid, len(paths)))
+    return {"request_id": rid, "rows": rows, "spans": spans,
+            "retained": retained, "sources": sources}
+
+
+def render_request_trace(trace):
+    """Human-readable story of one request: identity/routing header,
+    per-hop ledger stage timings, and the retained span tree."""
+    from .export import render_tree
+
+    lines = ["request %s" % trace["request_id"]]
+    for row in trace["rows"]:
+        ident = []
+        for key in ("tenant", "seq", "session_id", "routing_key",
+                    "replica", "outcome"):
+            if row.get(key) is not None:
+                ident.append("%s=%s" % (key, row[key]))
+        if row.get("spilled"):
+            ident.append("SPILLED (router hop: primary rejected "
+                         "queue_full)")
+        lines.append("  " + " ".join(ident))
+        stages = row.get("stages") or {}
+        for stage in [s for s in LEDGER_STAGES if s in stages] + sorted(
+                set(stages) - set(LEDGER_STAGES)):
+            lines.append("    %-10s %10s ms" % (stage, _ms(stages[stage])))
+        if row.get("total_s") is not None:
+            lines.append("    %-10s %10s ms" % ("TOTAL", _ms(row["total_s"])))
+    if not trace["rows"]:
+        lines.append("  (no ledger row found — span tree only)")
+    if trace["spans"]:
+        lines.append("retained span tree (%s):"
+                     % (trace.get("retained") or "tail"))
+        for ln in render_tree(trace["spans"]).splitlines():
+            lines.append("  " + ln)
+    else:
+        lines.append("no retained span tree (request was not "
+                     "tail-sampled)")
+    if trace["sources"]:
+        lines.append("sources: " + ", ".join(trace["sources"]))
+    return lines
+
+
+def fleet_attribution(named_stats, q_key="p99_s"):
+    """Cross-replica latency attribution: which (replica, stage) owns
+    the fleet tail.
+
+    ``named_stats`` is ``[(replica_name, load()-shape stats), ...]`` —
+    one entry per replica's ledger dump or serve-stats sink.  Returns
+    ``(rc, lines)``: a per-replica quantile table, each laggard's
+    delta vs the fastest replica attributed to its dominating stage,
+    and a final fleet-p99 attribution line.  rc 0 always (this is a
+    reader, not a gate); raises :class:`ProfError` on empty input.
+    """
+    if not named_stats:
+        raise ProfError("fleet attribution needs at least one replica "
+                        "profile")
+    label = q_key.replace("_s", "")
+    per = []
+    for name, stats in named_stats:
+        total, exact = _totals(stats, q_key)
+        per.append((name, stats, total, exact))
+    per.sort(key=lambda t: t[2])
+    best_name, best_stats, best_total, _ = per[0]
+    lines = ["replica            %s ms   d vs best   dominating stage"
+             % label]
+    worst = None
+    for name, stats, total, exact in per:
+        delta = total - best_total
+        if name == best_name:
+            lines.append("%-16s %9s %11s   (fastest%s)"
+                         % (name, _ms(total), "-",
+                            "" if exact else ", stage-sum"))
+            continue
+        deltas = attribution(best_stats, stats, q_key)
+        top_stage, top_delta = deltas[0] if deltas else ("?", 0.0)
+        lines.append("%-16s %9s %+10.3f   %s (%+.3f ms)"
+                     % (name, _ms(total), 1e3 * delta, top_stage,
+                        1e3 * top_delta))
+        if worst is None or total > worst[2]:
+            worst = (name, top_stage, total, delta, top_delta)
+    if worst is not None:
+        name, stage, total, delta, top_delta = worst
+        lines.append(
+            "fleet %s is set by replica '%s' (%s ms): stage '%s' "
+            "accounts for %+.3f ms of its %+.3f ms gap to '%s'"
+            % (label, name, _ms(total), stage, 1e3 * top_delta,
+               1e3 * delta, best_name))
+    else:
+        lines.append("fleet %s: single replica '%s' at %s ms"
+                     % (label, best_name, _ms(best_total)))
+    return 0, lines

@@ -62,7 +62,10 @@ EVENTS_ENV = "MESH_TPU_RECORDER_EVENTS"
 #: v3: incidents carry a ``"knob_history"`` key — the tuning layer's
 #: newest MESH_TPU_KNOB_TAIL ``knob_change`` events (``mesh-tpu tune
 #: history`` reads it: "what did the tuner do during this incident?").
-SCHEMA_VERSION = 3
+#: v4: incidents carry a ``"requests"`` key — the tail-sampling ring's
+#: retained request traces (ledger row + span tree joined by
+#: request_id, obs/context.py; ``mesh-tpu prof trace <id>`` reads it).
+SCHEMA_VERSION = 4
 
 #: env prefixes captured into each incident (config forensics)
 _ENV_PREFIXES = ("MESH_TPU_", "JAX_", "XLA_")
@@ -248,6 +251,7 @@ class FlightRecorder(object):
             "engine": self._engine_summary(),
             "ledger": self._ledger_tail(),
             "knob_history": self._knob_history(),
+            "requests": self._requests_tail(),
             "env": {
                 k: v for k, v in sorted(os.environ.items())
                 if k.startswith(_ENV_PREFIXES)
@@ -264,6 +268,18 @@ class FlightRecorder(object):
             from .ledger import get_ledger
 
             return get_ledger().tail()
+        except Exception:
+            return []
+
+    @staticmethod
+    def _requests_tail():
+        """The tail-sampling ring's retained request traces (schema v4)
+        — imported lazily like the ledger tail (context never imports
+        recorder, so no cycle either way)."""
+        try:
+            from .context import get_trace_tail
+
+            return get_trace_tail().retained()
         except Exception:
             return []
 

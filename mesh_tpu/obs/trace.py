@@ -36,6 +36,7 @@ import threading
 from collections import deque
 
 from .clock import enabled, env_flag, monotonic, wall
+from .context import TRACE_TAIL, current_context
 
 __all__ = [
     "Span", "Tracer", "TRACER", "span", "timed_span", "traced",
@@ -97,8 +98,16 @@ class Span(object):
     def __enter__(self):
         tracer = self._tracer
         stack = tracer._stack()
+        ctx = current_context()
         if stack:
             self.parent_id = stack[-1].span_id
+        elif ctx is not None and ctx.root_span_id is not None:
+            # cross-thread linkage: a span opening with an empty stack
+            # under a bound RequestContext parents under the request's
+            # root span instead of rooting a per-thread forest
+            self.parent_id = ctx.root_span_id
+        if ctx is not None and "request_id" not in self.attrs:
+            self.attrs["request_id"] = ctx.request_id
         stack.append(self)
         thread = threading.current_thread()
         self.thread_name = thread.name
@@ -284,6 +293,11 @@ class Tracer(object):
 
 #: the process-wide tracer (one request path, one tracer)
 TRACER = Tracer()
+
+# tail sampling: finished spans carrying a request_id buffer in the
+# trace tail until their ledger row closes and decides retention
+# (obs/context.py; a span with no request_id costs one dict lookup)
+TRACER.add_sink(TRACE_TAIL.record_span)
 
 
 def span(name, **attrs):

@@ -37,6 +37,7 @@ import threading
 
 from ..errors import DeadlineExceeded
 from ..obs.clock import monotonic
+from ..obs.context import bind_context, current_context
 from ..obs.ledger import current_record
 from ..obs.perf import call_with_timeout
 from ..obs.recorder import get_recorder
@@ -264,16 +265,19 @@ def _rung_accel(mesh, points, chunk, timeout):
     import numpy as np
 
     # captured here because _call runs on the watchdog helper thread,
-    # where the serving worker's thread-local binding is invisible
+    # where the serving worker's thread-local bindings (ledger record
+    # AND request context) are invisible
     rec = current_record()
+    ctx = current_context()
 
     def _call():
         from ..accel.traverse import closest_faces_and_points_accel
 
         v, f = _facade_arrays(mesh)
         pts, n_q = _bucket_queries(points, 256)
-        res, stats = closest_faces_and_points_accel(
-            v, f, pts, with_stats=True, record=rec)
+        with bind_context(ctx):
+            res, stats = closest_faces_and_points_accel(
+                v, f, pts, with_stats=True, record=rec)
         out = {key: np.asarray(val)[:n_q] for key, val in res.items()}
         out["__backend__"] = stats["backend"]
         return out

@@ -24,6 +24,7 @@ Knobs (all overridable per-constructor): ``MESH_TPU_SERVE_QUEUE``
 ``MESH_TPU_SERVE_STATS`` (stats sink path).
 """
 
+import itertools
 import json
 import os
 import threading
@@ -33,6 +34,7 @@ from concurrent.futures import Future
 from ..errors import DeadlineExceeded, EngineShutdown, ServeRejected
 from ..utils import knobs
 from ..obs.clock import monotonic, wall
+from ..obs.context import bind_context, mint as mint_context
 from ..obs.ledger import bind_current, get_ledger
 from ..obs.recorder import get_recorder
 from ..obs.trace import span as obs_span
@@ -149,7 +151,7 @@ class ServeResponse(object):
 
 class _ServeRequest(object):
     __slots__ = ("mesh", "points", "tenant", "priority", "deadline",
-                 "future", "t_admit", "record")
+                 "future", "t_admit", "record", "ctx")
 
     def __init__(self, mesh, points, tenant, priority, deadline):
         self.mesh = mesh
@@ -160,6 +162,7 @@ class _ServeRequest(object):
         self.future = Future()
         self.t_admit = monotonic()
         self.record = None      # obs.ledger.RequestRecord, or None
+        self.ctx = None         # obs.context.RequestContext, or None
 
 
 class QueryService(object):
@@ -196,6 +199,7 @@ class QueryService(object):
         ]
         for worker in self._workers:
             worker.start()
+        self._admit_seq = itertools.count(1)
         self._init_metrics()
 
     # ------------------------------------------------------------------
@@ -244,15 +248,18 @@ class QueryService(object):
     # admission
 
     def submit(self, mesh, points, tenant="default", priority=0,
-               deadline_s=None):
+               deadline_s=None, ctx=None):
         """Admit one closest-point request; returns a Future of
         ServeResponse.  ``mesh`` may be a live mesh object or a *store
         key* (topology digest string) — keyed requests are resolved
         through the in-process page cache at execution time, with the
         paged/resident provenance recorded on the request's ledger
-        record (doc/store.md).  Raises ServeRejected (with
-        ``retry_after``) when backpressure applies — callers back off,
-        the queue never grows unbounded."""
+        record (doc/store.md).  ``ctx`` carries a request identity
+        minted upstream (the fleet router); standalone admissions mint
+        their own (obs/context.py — None with MESH_TPU_TRACE_CONTEXT
+        off).  Raises ServeRejected (with ``retry_after``) when
+        backpressure applies — callers back off, the queue never grows
+        unbounded."""
         if deadline_s is None:
             deadline_s = self.default_deadline_s
         state = self.health.state
@@ -288,11 +295,20 @@ class QueryService(object):
                     reason="queue_full")
             req = _ServeRequest(mesh, points, tenant, priority,
                                 Deadline(deadline_s))
+            if ctx is None:
+                ctx = mint_context(tenant, next(self._admit_seq),
+                                   req.t_admit)
+            req.ctx = ctx
             # admission IS the ledger's t_admit: every stamped stage
-            # downstream is measured from here (obs/ledger.py)
+            # downstream is measured from here (obs/ledger.py); the
+            # context's identity fields land in the record's meta so
+            # every dumped row joins by request_id
             req.record = get_ledger().open(
                 tenant=tenant, priority=priority,
-                deadline_s=float(deadline_s))
+                deadline_s=float(deadline_s),
+                **(ctx.to_meta() if ctx is not None else {}))
+            if req.record is not None:
+                req.record.ctx = ctx
             self._wfq.push(tenant, req)
             depth = self._wfq.depth(tenant)
             self._m_depth.set(depth, tenant=tenant)
@@ -402,8 +418,10 @@ class QueryService(object):
             except Exception as e:  # noqa: BLE001 — futures carry it
                 latency = req.deadline.elapsed()
                 self._m_requests.inc(tenant=tenant, outcome="error")
-                self._m_latency.observe(latency, tenant=tenant,
-                                        backend="none")
+                self._m_latency.observe(
+                    latency, exemplar=(req.ctx.request_id
+                                       if req.ctx is not None else None),
+                    tenant=tenant, backend="none")
                 self._recorder.record(
                     "serve.error", tenant=tenant, outcome="error",
                     error=type(e).__name__, store_key=store_key,
@@ -423,11 +441,18 @@ class QueryService(object):
         # feeding the slow path (serve/deadline.py effective_start_rung)
         start_rung = effective_start_rung(
             self.health.state == DEGRADED, self.ladder)
-        with obs_span("serve.request", tenant=tenant,
-                      mesh_source=mesh_source,
-                      q=int(req.points.shape[0] if hasattr(
-                          req.points, "shape") else len(req.points)),
-                      priority=req.priority):
+        rid = req.ctx.request_id if req.ctx is not None else None
+        with bind_context(req.ctx), \
+                obs_span("serve.request", tenant=tenant,
+                         mesh_source=mesh_source,
+                         q=int(req.points.shape[0] if hasattr(
+                             req.points, "shape") else len(req.points)),
+                         priority=req.priority) as sp:
+            # this span is the request's tree root: spans opened on
+            # OTHER threads (executor drain/dispatch) parent under it
+            # through the context instead of rooting their own forest
+            if req.ctx is not None:
+                req.ctx.root_span_id = getattr(sp, "span_id", None)
             try:
                 # the thread-local binding lets rungs downstream (engine
                 # submit, accel facade) stamp stages without widening the
@@ -438,23 +463,33 @@ class QueryService(object):
                         ladder=self.ladder, chunk=self.chunk,
                         start_rung=start_rung, health=self.health)
             except Exception as e:      # noqa: BLE001 — futures carry it
-                latency = req.deadline.elapsed()
-                missed = latency > req.deadline.seconds
-                if missed:
-                    self._m_miss.inc(tenant=tenant)
-                outcome = ("deadline" if isinstance(e, DeadlineExceeded)
-                           else "error")
-                self._m_requests.inc(tenant=tenant, outcome=outcome)
-                self._m_latency.observe(latency, tenant=tenant,
-                                        backend="none")
-                self._recorder.record(
-                    "serve.error", tenant=tenant, outcome=outcome,
-                    error=type(e).__name__,
-                    latency_ms=round(1e3 * latency, 3))
-                if req.record is not None:
-                    get_ledger().close(req.record, outcome=outcome)
-                req.future.set_exception(e)
-                return
+                # held until AFTER the span exits: the root span must
+                # reach the tail-sampling buffer before the ledger close
+                # decides this request's trace retention
+                error = e
+                sp.set(error=type(e).__name__)
+                if hasattr(sp, "status"):
+                    sp.status = "error"
+            else:
+                error = None
+        if error is not None:
+            latency = req.deadline.elapsed()
+            missed = latency > req.deadline.seconds
+            if missed:
+                self._m_miss.inc(tenant=tenant)
+            outcome = ("deadline" if isinstance(error, DeadlineExceeded)
+                       else "error")
+            self._m_requests.inc(tenant=tenant, outcome=outcome)
+            self._m_latency.observe(latency, exemplar=rid,
+                                    tenant=tenant, backend="none")
+            self._recorder.record(
+                "serve.error", tenant=tenant, outcome=outcome,
+                error=type(error).__name__,
+                latency_ms=round(1e3 * latency, 3))
+            if req.record is not None:
+                get_ledger().close(req.record, outcome=outcome)
+            req.future.set_exception(error)
+            return
         latency = req.deadline.elapsed()
         response = ServeResponse(result, tenant, retries, latency,
                                  req.deadline)
@@ -462,7 +497,8 @@ class QueryService(object):
             req.record.meta.get("backend") if req.record is not None
             else None) or "none"
         self._m_requests.inc(tenant=tenant, outcome="ok")
-        self._m_latency.observe(latency, tenant=tenant, backend=backend)
+        self._m_latency.observe(latency, exemplar=rid,
+                                tenant=tenant, backend=backend)
         self._m_rung.inc(rung=response.rung,
                          certified=str(response.certified).lower())
         if response.deadline_missed:

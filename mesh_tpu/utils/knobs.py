@@ -253,6 +253,26 @@ KNOB_TAIL = _declare(
     "How many newest `knob_change` events ride along in each "
     "flight-recorder incident dump's `knob_history` tail (min 1).",
     "Observability")
+TRACE_CONTEXT = _declare(
+    "MESH_TPU_TRACE_CONTEXT", "flag", True,
+    "End-to-end request identity kill switch (obs/context.py): on "
+    "(default) mints a RequestContext per admission — request_id in "
+    "ledger meta, span request_id tags, cross-thread span parent "
+    "linkage, tail-sampled trace retention; off is bit-identical to "
+    "the identity-free path (no context is ever minted).",
+    "Observability")
+TRACE_TAIL = _declare(
+    "MESH_TPU_TRACE_TAIL", "int", 64,
+    "Tail-sampling ring capacity in retained request traces (ledger "
+    "row + span tree + exemplar identity) per process; every "
+    "deadline-miss/error/spilled request is retained, plus a reservoir "
+    "of slow-ok ones (min 4).", "Observability")
+TRACE_RESERVOIR = _declare(
+    "MESH_TPU_TRACE_RESERVOIR", "int", 8,
+    "Slots in the slow-ok reservoir inside the tail-sampling ring: the "
+    "N slowest requests that closed `ok` keep their span trees too "
+    "(0 disables the reservoir; misses/errors are always retained).",
+    "Observability")
 
 # -- serving ---------------------------------------------------------------
 
@@ -456,6 +476,11 @@ ANIM_PROXY_QUERIES = _declare(
     "MESH_TPU_ANIM_PROXY_QUERIES", "int", None,
     "anim_proxy bench stage: override the per-frame query count (read "
     "by bench.py).", "Bench harness")
+TRACE_PROXY_SEED = _declare(
+    "MESH_TPU_TRACE_PROXY_SEED", "int", None,
+    "trace_proxy bench stage: override the synthesized mixed-outcome "
+    "trace seed (read by bench.py; changing it is expected to change "
+    "the committed golden checksum).", "Bench harness")
 
 
 # -- accessors -------------------------------------------------------------

@@ -844,6 +844,39 @@ def test_obs005_ledger_stage_doc_coverage(tmp_path):
     assert not run()
 
 
+def test_obs006_unbounded_label_values():
+    rule = ObservabilityHygieneRule()
+    # every provably-unbounded shape fires: f-string, %-format,
+    # str()/.format(), and a per-request identity terminal
+    findings = _run(rule, """
+        def record(c, h, ctx, digest):
+            c.inc(key=f"tenant-{ctx.tenant}")
+            c.inc(req="%s" % ctx.seq)
+            h.observe(0.5, who=str(ctx.tenant))
+            h.observe(0.5, key=digest)
+            c.inc(rid=ctx.request_id)
+        """)
+    assert _codes(findings) == ["OBS006"] * 5
+    assert all(f.severity == "error" for f in findings)
+    assert "request_id" in findings[4].message
+    assert "exemplar" in (findings[0].hint or "")
+    # negatives: bounded values (tenant/stage/outcome/replica and
+    # session ids are admission-bounded), the sanctioned exemplar=
+    # keyword, literals, span.set tagging, and the registry itself
+    assert not _run(rule, """
+        def record(c, h, sp, ctx, rid):
+            c.inc(tenant=ctx.tenant, stage="plan", replica=ctx.replica)
+            c.inc(tenant=self.session_id)
+            h.observe(0.5, exemplar=rid)
+            h.observe(0.5, tier="gold")
+            sp.set(request_id=rid)
+        """)
+    assert not check_source(
+        rule,
+        "def f(c, d):\n    c.inc(digest=d)\n",
+        relpath="mesh_tpu/obs/metrics.py")
+
+
 # -- LOK fixtures (interprocedural lock order) -------------------------
 
 def test_lok001_cross_function_lock_order_cycle():

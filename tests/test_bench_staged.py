@@ -393,13 +393,14 @@ def test_perfcheck_cli_exit_codes(tmp_path):
 
     def run(path):
         # --accel-golden/--stream-golden/--store-golden/--tuner-golden/
-        # --mxu-golden/--replay-golden/--fleet-golden/--anim-golden at
-        # nonexistent paths keep the repo's committed goldens from
-        # grading these proxy-only docs (those bands have their own
-        # coverage in tests/test_accel.py, tests/test_accel_stream.py,
-        # tests/test_store.py, tests/test_mxu.py, tests/test_replay.py,
-        # tests/test_fleet.py, tests/test_anim.py, and the tuner-band
-        # tests above)
+        # --mxu-golden/--replay-golden/--fleet-golden/--anim-golden/
+        # --trace-golden at nonexistent paths keep the repo's committed
+        # goldens from grading these proxy-only docs (those bands have
+        # their own coverage in tests/test_accel.py,
+        # tests/test_accel_stream.py, tests/test_store.py,
+        # tests/test_mxu.py, tests/test_replay.py, tests/test_fleet.py,
+        # tests/test_anim.py, tests/test_trace_context.py, and the
+        # tuner-band tests above)
         return subprocess.run(
             [sys.executable, "-m", "mesh_tpu.cli", "perfcheck", str(path),
              "--proxy-golden", str(golden),
@@ -410,7 +411,8 @@ def test_perfcheck_cli_exit_codes(tmp_path):
              "--mxu-golden", str(tmp_path / "no_mxu_golden.json"),
              "--replay-golden", str(tmp_path / "no_replay_golden.json"),
              "--fleet-golden", str(tmp_path / "no_fleet_golden.json"),
-             "--anim-golden", str(tmp_path / "no_anim_golden.json")],
+             "--anim-golden", str(tmp_path / "no_anim_golden.json"),
+             "--trace-golden", str(tmp_path / "no_trace_golden.json")],
             capture_output=True, text=True, cwd=_REPO)
 
     ok = run(good)

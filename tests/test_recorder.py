@@ -30,10 +30,11 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 #: every key an incident file must carry (doc/observability.md schema).
 #: schema v2 added "ledger": the latency ledger's newest request records.
 #: schema v3 added "knob_history": the tuner's newest knob-change events.
+#: schema v4 added "requests": the tail-sampling ring's retained traces.
 _INCIDENT_KEYS = {
     "schema_version", "kind", "reason", "written_utc", "mono_at_dump",
     "context", "ring", "metrics", "health", "engine", "env", "ledger",
-    "knob_history",
+    "knob_history", "requests",
 }
 
 

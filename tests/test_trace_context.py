@@ -1,0 +1,291 @@
+"""End-to-end request identity (obs/context.py, doc/observability.md).
+
+The acceptance chain the ISSUE pins, chip-free:
+
+- a minted RequestContext rides the serving tier into ledger meta, and
+  spans opened on the engine executor's worker thread parent under the
+  request's root span (ONE connected tree across the coalesce/drain
+  thread hop, not a per-thread forest);
+- tail sampling retains the full span tree for every deadline-miss /
+  error / spilled request, drops plain ``ok`` ones, and keeps a bounded
+  reservoir of the slowest ``ok`` closes;
+- the serve latency histogram carries request_id *exemplars* (identity
+  never becomes a label value — meshlint OBS006);
+- flight-recorder incidents embed the retained tail (schema v4
+  ``requests``) and ``mesh-tpu prof trace`` joins row + tree by id;
+- ``MESH_TPU_TRACE_CONTEXT=0`` is bit-identical to the identity-free
+  path: no request_id anywhere.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from mesh_tpu import engine, obs
+from mesh_tpu.errors import DeadlineExceeded
+from mesh_tpu.mesh import Mesh
+from mesh_tpu.obs import prof
+from mesh_tpu.obs.context import TraceTail, bind_context, mint
+from mesh_tpu.obs.recorder import SCHEMA_VERSION, FlightRecorder
+from mesh_tpu.obs.trace import span as obs_span
+from mesh_tpu.serve import HealthMonitor, QueryService, Rung, ServeResult
+from mesh_tpu.sphere import _icosphere
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch, tmp_path):
+    monkeypatch.setenv("MESH_TPU_OBS", "1")
+    for var in ("MESH_TPU_TRACE_CONTEXT", "MESH_TPU_TRACE_TAIL",
+                "MESH_TPU_TRACE_RESERVOIR", "MESH_TPU_LEDGER",
+                "MESH_TPU_RECORDER"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("MESH_TPU_INCIDENT_DIR", str(tmp_path / "incidents"))
+    obs.reset()
+    yield
+    obs.reset()
+
+
+def _answer(rung_name):
+    return ServeResult(np.zeros((1, 4), np.uint32),
+                       np.zeros((4, 3), np.float64), rung_name,
+                       certified=True)
+
+
+def _rung(name="ok", error=None):
+    def fn(mesh, points, chunk, timeout):
+        if error is not None:
+            raise error("%s rung" % name)
+        return _answer(name)
+    return Rung(name, fn)
+
+
+def _service(**kw):
+    kw.setdefault("health", HealthMonitor(watchdog=False))
+    kw.setdefault("workers", 1)
+    kw.setdefault("ladder", [_rung()])
+    return QueryService(**kw)
+
+
+_MESH = object()
+_PTS = np.zeros((4, 3), np.float32)
+
+
+def _roots(spans):
+    ids = {s["span_id"] for s in spans}
+    return [s for s in spans if s.get("parent_id") not in ids]
+
+
+# ---------------------------------------------------------------------------
+# minting + kill switch
+
+
+def test_mint_is_deterministic_and_killable(monkeypatch):
+    a = mint("tenant-a", 3, 12.5, routing_key="k", replica="r0")
+    b = mint("tenant-a", 3, 12.5)
+    assert a.request_id == b.request_id       # same admission -> same id
+    assert a.request_id.startswith("req-") and len(a.request_id) == 12
+    assert mint("tenant-a", 4, 12.5).request_id != a.request_id
+    meta = a.to_meta()
+    assert meta["request_id"] == a.request_id
+    assert meta["routing_key"] == "k" and meta["replica"] == "r0"
+    assert "spilled" not in meta              # only stamped on the hop
+    monkeypatch.setenv("MESH_TPU_TRACE_CONTEXT", "0")
+    assert mint("tenant-a", 3, 12.5) is None
+
+
+# ---------------------------------------------------------------------------
+# satellite: span parent linkage across the executor thread hop
+
+
+def test_executor_hop_yields_single_root_tree(monkeypatch):
+    monkeypatch.delenv("MESH_TPU_NO_ENGINE", raising=False)
+    obs.reset()
+    rng = np.random.RandomState(3)
+    v, f = _icosphere(2)
+    meshes = [Mesh(v=v + 0.01 * rng.randn(*v.shape), f=f)
+              for _ in range(2)]
+    ptss = [np.asarray(rng.randn(q, 3), np.float32) for q in (50, 70)]
+    ctx = mint("hop-tenant", 1, 10.0)
+    record = obs.get_ledger().open(tenant="hop-tenant", **ctx.to_meta())
+    record.ctx = ctx
+    ex = engine.get_executor()
+    with bind_context(ctx), \
+            obs_span("serve.request", tenant="hop-tenant") as sp:
+        ctx.root_span_id = sp.span_id
+        with ex.coalesce():
+            futs = [ex.submit("closest_point", m, p, record=record)
+                    for m, p in zip(meshes, ptss)]
+        ex.drain()
+        for fut in futs:
+            fut.result(timeout=60)
+    obs.get_ledger().close(record, outcome="error")   # retain the tree
+    entry = obs.get_trace_tail().lookup(ctx.request_id)
+    assert entry is not None and entry["retained"] == "tail"
+    spans = entry["spans"]
+    names = {s["name"] for s in spans}
+    assert {"serve.request", "engine.enqueue", "engine.coalesce"} <= names
+    # the dispatch really crossed a thread: worker-side spans ran on a
+    # different thread than the caller-side root
+    assert len({s["thread"] for s in spans}) >= 2
+    # ...and still form ONE connected tree rooted at serve.request
+    roots = _roots(spans)
+    assert len(roots) == 1 and roots[0]["name"] == "serve.request"
+    assert all(s["attrs"].get("request_id") == ctx.request_id
+               for s in spans)
+
+
+# ---------------------------------------------------------------------------
+# tail sampling: retention policy
+
+
+def test_serve_tail_retains_miss_and_error_not_ok(monkeypatch):
+    monkeypatch.setenv("MESH_TPU_TRACE_RESERVOIR", "0")
+    obs.reset()
+    svc = _service(ladder=[_rung("miss", DeadlineExceeded)],
+                   default_deadline_s=5.0)
+    try:
+        fut = svc.submit(_MESH, _PTS, tenant="misser")
+        with pytest.raises(DeadlineExceeded):
+            fut.result(timeout=30)
+    finally:
+        svc.stop(write_stats=False)
+    # a store-keyed request whose digest never resolves errors before
+    # the ladder — the "error" close path
+    svc = _service()
+    try:
+        fut = svc.submit("no-such-digest", _PTS, tenant="failer")
+        with pytest.raises(Exception):
+            fut.result(timeout=30)
+        svc.submit(_MESH, _PTS, tenant="fine").result(timeout=30)
+    finally:
+        svc.stop(write_stats=False)
+    entries = obs.get_trace_tail().retained()
+    by_outcome = {e["outcome"]: e for e in entries}
+    assert set(by_outcome) == {"deadline", "error"}   # ok not retained
+    for entry in entries:
+        assert entry["retained"] == "tail"
+        assert entry["row"]["request_id"] == entry["request_id"]
+    # the ladder-failing request kept its full connected span tree
+    miss = by_outcome["deadline"]
+    assert miss["spans"], "retained request kept no span tree"
+    assert len(_roots(miss["spans"])) == 1
+    # the ledger rows carry the same join keys
+    rows = {r["tenant"]: r for r in obs.get_ledger().records()}
+    assert rows["misser"]["request_id"] == miss["request_id"]
+
+
+def test_tail_policy_spill_reservoir_and_ring_bound(monkeypatch):
+    monkeypatch.setenv("MESH_TPU_TRACE_TAIL", "4")
+    monkeypatch.setenv("MESH_TPU_TRACE_RESERVOIR", "2")
+    tail = TraceTail()
+
+    def close(rid, outcome="ok", total=1.0, **extra):
+        tail.record_span({"name": "s", "span_id": 1, "parent_id": None,
+                          "attrs": {"request_id": rid}})
+        row = dict(request_id=rid, outcome=outcome, total_s=total, **extra)
+        tail.observe_close(row)
+
+    # a spilled ok request is tail-retained (the router hop is evidence)
+    close("req-spill", outcome="ok", spilled=True)
+    assert tail.lookup("req-spill")["retained"] == "tail"
+    # the slow-ok reservoir keeps the 2 slowest, evicting the fastest
+    close("req-s1", total=1.0)
+    close("req-s2", total=3.0)
+    close("req-s3", total=2.0)          # evicts req-s1 (1.0 < 2.0)
+    assert tail.lookup("req-s1") is None
+    assert tail.lookup("req-s2")["retained"] == "reservoir"
+    assert tail.lookup("req-s3")["retained"] == "reservoir"
+    close("req-fast", total=0.1)        # too fast for the reservoir
+    assert tail.lookup("req-fast") is None
+    # the ring is bounded: a storm of misses ages out the oldest
+    for i in range(6):
+        close("req-m%d" % i, outcome="deadline")
+    assert len(tail.retained()) == 4
+    assert tail.lookup("req-spill") is None
+
+
+# ---------------------------------------------------------------------------
+# exemplars: the histogram names the slowest request per bucket
+
+
+def test_latency_histogram_carries_request_id_exemplars():
+    obs.reset()
+    svc = _service()
+    try:
+        svc.submit(_MESH, _PTS, tenant="ex").result(timeout=30)
+    finally:
+        svc.stop(write_stats=False)
+    row = obs.get_ledger().records()[-1]
+    snap = obs.REGISTRY.get("mesh_tpu_serve_latency_seconds").snapshot()
+    exemplars = [e for series in snap["series"]
+                 for e in series.get("exemplars", ())]
+    assert exemplars, "latency histogram kept no exemplars"
+    assert row["request_id"] in {e["request_id"] for e in exemplars}
+    # stage histogram too (close() observes with the record's id)
+    snap = obs.REGISTRY.get("mesh_tpu_request_stage_seconds").snapshot()
+    stage_ex = [e for series in snap["series"]
+                for e in series.get("exemplars", ())]
+    assert row["request_id"] in {e["request_id"] for e in stage_ex}
+
+
+# ---------------------------------------------------------------------------
+# kill switch: identity-free path is bit-identical
+
+
+def test_kill_switch_removes_identity_everywhere(monkeypatch):
+    monkeypatch.setenv("MESH_TPU_TRACE_CONTEXT", "0")
+    obs.reset()
+    svc = _service(ladder=[_rung("miss", DeadlineExceeded)],
+                   default_deadline_s=5.0)
+    try:
+        fut = svc.submit(_MESH, _PTS, tenant="dark")
+        with pytest.raises(DeadlineExceeded):
+            fut.result(timeout=30)
+    finally:
+        svc.stop(write_stats=False)
+    row = obs.get_ledger().records()[-1]
+    assert "request_id" not in row and "seq" not in row
+    assert obs.get_trace_tail().retained() == []
+    snap = obs.REGISTRY.get("mesh_tpu_serve_latency_seconds").snapshot()
+    assert not any(series.get("exemplars")
+                   for series in snap["series"])
+
+
+# ---------------------------------------------------------------------------
+# incidents embed the tail (schema v4) + prof joins by request_id
+
+
+def test_incident_embeds_requests_tail_and_prof_joins(tmp_path):
+    obs.reset()
+    svc = _service(ladder=[_rung("miss", DeadlineExceeded)],
+                   default_deadline_s=5.0)
+    try:
+        fut = svc.submit(_MESH, _PTS, tenant="victim")
+        with pytest.raises(DeadlineExceeded):
+            fut.result(timeout=30)
+    finally:
+        svc.stop(write_stats=False)
+    rid = obs.get_trace_tail().retained()[-1]["request_id"]
+    rec = FlightRecorder(capacity=8)
+    path = rec.trigger("trace_tail_test")
+    with open(path) as fh:
+        incident = json.load(fh)
+    assert incident["schema_version"] == SCHEMA_VERSION >= 4
+    assert [e["request_id"] for e in incident["requests"]] == [rid]
+    assert incident["requests"][0]["spans"]
+    # prof joins the incident file's row + tree by id...
+    trace = prof.request_trace(rid, paths=[path])
+    assert trace["retained"] == "tail"
+    assert [r["tenant"] for r in trace["rows"]] == ["victim"]
+    assert trace["spans"] and len(_roots(trace["spans"])) == 1
+    rendered = "\n".join(prof.render_request_trace(trace))
+    assert rid in rendered and "victim" in rendered
+    # ...and from a plain ledger JSONL dump + the live tail
+    dump = tmp_path / "ledger.jsonl"
+    obs.get_ledger().dump_jsonl(str(dump))
+    trace = prof.request_trace(rid, paths=[str(dump)],
+                               tail=obs.get_trace_tail())
+    assert trace["rows"] and trace["spans"]
+    with pytest.raises(prof.ProfError):
+        prof.request_trace("req-ffffffff", paths=[path])

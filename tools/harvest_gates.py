@@ -271,6 +271,32 @@ def render_table(h):
                     "grades drift against benchmarks/anim_golden.json)"
                     % (an["value"], an["checksum"], an.get("frames"),
                        an.get("inflation_max")))
+        # request-identity gate: the trace join only counts as an
+        # improvement when the double-run join checksum is present and
+        # every forced deadline-miss/error kept its span tree —
+        # perfcheck fails hard on drift against
+        # benchmarks/trace_golden.json
+        tr = b.get("trace")
+        if isinstance(tr, dict):
+            if tr.get("value") is None or tr.get("checksum") is None:
+                lines.append(
+                    "gate 2 trace: NOT AN IMPROVEMENT — trace record "
+                    "carries no joined-request count/checksum to prove "
+                    "the request-identity join contract")
+            elif tr.get("double_run") != "checksum_equal":
+                lines.append(
+                    "gate 2 trace: NOT AN IMPROVEMENT — double-run "
+                    "verdict %r (the same mix must join to identical "
+                    "ledger/span/router evidence)" % (
+                        tr.get("double_run"),))
+            else:
+                lines.append(
+                    "gate 2 trace: %d requests joined OK — checksum "
+                    "%.6f, %s miss/error span trees retained, "
+                    "double-run equal (perfcheck grades drift against "
+                    "benchmarks/trace_golden.json)" % (
+                        tr["value"], tr["checksum"],
+                        tr.get("tail_retained")))
     for b in h.get("bench_variants", ()):
         if b.get("value") is None:
             lines.append(
